@@ -1,0 +1,24 @@
+"""tempo-tpu: a TPU-native time-series analytics framework.
+
+From-scratch rebuild of the capabilities of dbl-tempo
+(/root/reference, the Databricks Labs TSDF library) on JAX/XLA:
+series are packed, time-sorted columnar arrays sharded over a device
+mesh; ops are jitted/vmapped kernels (searchsorted AS-OF merges,
+prefix-scan rolling stats, segment-reduce resampling, associative-scan
+EMA, batched FFT) instead of Spark Window expressions.
+
+Public surface mirrors the reference: ``TSDF`` plus ``display``
+(python/tempo/__init__.py:1-2).
+"""
+
+import jax
+
+# int64-nanosecond timestamps and float64 golden-parity accumulations
+# require 64-bit mode; TPU fast paths opt into f32/bf16 explicitly.
+jax.config.update("jax_enable_x64", True)
+
+from tempo_tpu.frame import TSDF  # noqa: E402
+from tempo_tpu.utils import display  # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = ["TSDF", "display"]

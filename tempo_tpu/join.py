@@ -1,0 +1,275 @@
+"""Frame-level AS-OF join: packing, prefixing, skew bucketing, assembly.
+
+Reference behaviour being reproduced (python/tempo/tsdf.py:463-560):
+
+* column prefixing of non-partition columns on both sides (tsdf.py:77-94,
+  529-531), ``right_prefix`` defaulting to ``"right"``;
+* ``skipNulls`` / sequence-number tie-break / suppress_null_warning
+  semantics via the kernels in ``tempo_tpu.ops.asof``;
+* the skew variant (``tsPartitionVal``/``fraction``): overlapping
+  time-bucket partitions (tsdf.py:164-190) - here realised by composing
+  the partition key with a time-bracket id and replicating the trailing
+  ``fraction`` of each right bracket into the next one, which bounds the
+  padded series length (the packed-layout analog of Spark skew
+  mitigation) and doubles as the halo pattern used for time-sharded
+  series (SURVEY.md section 2.3);
+* the ``sql_join_opt`` broadcast fast path (tsdf.py:482-509): taken when
+  either side's estimated in-memory size is under 30MiB; its observable
+  difference - it is an *inner* range join, so left rows with no
+  preceding right row are dropped - is preserved;
+* per-column missing-lookback warnings for the skew path
+  (tsdf.py:150-159);
+* Scala's ``maxLookback`` row cap on the merged stream
+  (scala/.../asofJoin.scala:64-88), exposed as a keyword.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+from tempo_tpu import packing
+from tempo_tpu.ops import asof as asof_ops
+
+logger = logging.getLogger(__name__)
+
+BROADCAST_BYTES_THRESHOLD = 30 * 1024 * 1024  # tsdf.py:491
+
+
+def _estimate_bytes(df: pd.DataFrame) -> int:
+    """Size probe: the packed-columnar analog of the reference's
+    ``explain cost`` sizeInBytes regex scrape (tsdf.py:433-461)."""
+    return int(df.memory_usage(deep=True).sum())
+
+
+def _prefixed(cols: List[str], prefix: Optional[str]) -> dict:
+    if prefix is None or prefix == "":
+        return {c: c for c in cols}
+    return {c: f"{prefix}_{c}" for c in cols}
+
+
+def _gather(values: np.ndarray, idx: np.ndarray, ok: np.ndarray):
+    """Host gather with Spark-null semantics for any dtype."""
+    if values.shape[0] == 0:
+        # no right rows at all: every output is null
+        ok = np.zeros(idx.shape, dtype=bool)
+        values = np.empty(1, dtype=values.dtype)
+    safe = np.where(ok, idx, 0)
+    taken = values[safe]
+    if values.dtype == object:
+        out = taken.astype(object)
+        out[~ok] = None
+        return out
+    if np.issubdtype(values.dtype, np.datetime64):
+        out = taken.astype("datetime64[ns]")
+        out[~ok] = np.datetime64("NaT")
+        return out
+    if np.issubdtype(values.dtype, np.floating):
+        out = taken.astype(values.dtype)
+        out[~ok] = np.nan
+        return out
+    if np.issubdtype(values.dtype, np.bool_):
+        if ok.all():
+            return taken
+        out = pd.array(taken, dtype="boolean")
+        out[~ok] = pd.NA
+        return out
+    # integers: keep exact dtype when fully matched, else nullable Int64
+    if ok.all():
+        return taken
+    out = pd.array(taken.astype(np.int64), dtype="Int64")
+    out[~ok] = pd.NA
+    return out
+
+
+def _time_brackets(ts_ns: np.ndarray, ts_partition_val: float):
+    """Bracket id + remainder fraction, double-seconds math mirroring
+    tsdf.py:176-180 (cast to double, truncate toward zero)."""
+    ts_sec = ts_ns / packing.NS_PER_S
+    bracket = ts_partition_val * (ts_sec / ts_partition_val).astype(np.int64)
+    remainder = (ts_sec - bracket) / ts_partition_val
+    return bracket, remainder
+
+
+def asof_join(
+    left,
+    right,
+    left_prefix: Optional[str] = None,
+    right_prefix: str = "right",
+    tsPartitionVal: Optional[float] = None,
+    fraction: float = 0.5,
+    skipNulls: bool = True,
+    sql_join_opt: bool = False,
+    suppress_null_warning: bool = False,
+    maxLookback: int = 0,
+):
+    from tempo_tpu.frame import TSDF
+
+    broadcast_path = sql_join_opt and (
+        (_estimate_bytes(left.df) < BROADCAST_BYTES_THRESHOLD)
+        or (_estimate_bytes(right.df) < BROADCAST_BYTES_THRESHOLD)
+    )
+
+    if tsPartitionVal is not None:
+        if not skipNulls:
+            raise ValueError(
+                "Disabling null skipping with a partition value is not supported yet."
+            )
+        logger.warning(
+            "You are using the skew version of the AS OF join. This may result in "
+            "null values if there are any values outside of the maximum lookback. "
+            "For maximum efficiency, choose smaller values of maximum lookback, "
+            "trading off performance and potential blank AS OF values for sparse keys"
+        )
+
+    left._check_partition_cols_match(right)
+    left._validate_ts_col_match(right)
+
+    pcols = left.partitionCols
+
+    left_value_cols = [c for c in left.df.columns if c not in pcols]
+    right_value_cols = [c for c in right.df.columns if c not in pcols]
+    lmap = _prefixed(left_value_cols, left_prefix)
+    rmap = _prefixed(right_value_cols, right_prefix)
+
+    # --- joint key encoding over the union of both sides' keys ---------
+    l_codes, r_codes, key_frame = packing.encode_keys_joint(left.df, right.df, pcols)
+    l_ts_ns = packing.series_to_ns(left.df[left.ts_col])
+    r_ts_ns = packing.series_to_ns(right.df[right.ts_col])
+
+    r_seq_vals = (
+        pd.to_numeric(right.df[right.sequence_col]).to_numpy(dtype=np.float64)
+        if right.sequence_col
+        else None
+    )
+
+    # --- skew variant: compose key with overlapping time brackets ------
+    l_take = np.arange(len(left.df), dtype=np.int64)
+    r_take = np.arange(len(right.df), dtype=np.int64)
+    if tsPartitionVal is not None:
+        l_bracket, _ = _time_brackets(l_ts_ns, tsPartitionVal)
+        r_bracket, r_rem = _time_brackets(r_ts_ns, tsPartitionVal)
+        # replicate the trailing `fraction` of each right bracket forward
+        spill = r_rem >= (1.0 - fraction)
+        r_take = np.concatenate([r_take, r_take[spill]])
+        r_bracket = np.concatenate(
+            [r_bracket, r_bracket[spill] + tsPartitionVal]
+        )
+        # re-encode keys as (key, bracket)
+        all_brackets = np.concatenate([l_bracket, r_bracket])
+        all_codes = np.concatenate([l_codes, r_codes[r_take]])
+        joint = all_codes * np.int64(2**31) + pd.factorize(all_brackets)[0]
+        joint_codes, _ = pd.factorize(joint)
+        n_series = int(joint_codes.max()) + 1
+        l_codes_j = joint_codes[: len(l_bracket)].astype(np.int64)
+        r_codes_j = joint_codes[len(l_bracket):].astype(np.int64)
+        r_ts_j = r_ts_ns[r_take]
+        r_seq_j = r_seq_vals[r_take] if r_seq_vals is not None else None
+    else:
+        n_series = len(key_frame)
+        l_codes_j, r_codes_j = l_codes, r_codes
+        r_ts_j = r_ts_ns
+        r_seq_j = r_seq_vals
+
+    l_layout = packing.build_layout_from_codes(l_codes_j, l_ts_ns, None, n_series)
+    r_layout = packing.build_layout_from_codes(r_codes_j, r_ts_j, r_seq_j, n_series)
+
+    Ll = packing.pad_length(int(l_layout.lengths.max(initial=0)))
+    Lr = packing.pad_length(int(r_layout.lengths.max(initial=0)))
+    l_ts_p = packing.pack_column(l_layout.ts_ns, l_layout, Ll, fill=packing.TS_PAD)
+    r_ts_p = packing.pack_column(r_layout.ts_ns, r_layout, Lr, fill=packing.TS_PAD)
+
+    # validity masks per right column (order: right_value_cols)
+    r_sorted_take = r_take[r_layout.order]
+    r_valid_packed = []
+    for c in right_value_cols:
+        valid = (~pd.isna(right.df[c])).to_numpy()[r_sorted_take]
+        r_valid_packed.append(
+            packing.pack_column(valid, r_layout, Lr, fill=False)
+        )
+    r_valids = np.stack(r_valid_packed) if r_valid_packed else np.zeros((0, n_series, Lr), bool)
+
+    # --- kernel dispatch ----------------------------------------------
+    use_merge = bool(right.sequence_col) or (maxLookback and maxLookback > 0)
+    if broadcast_path:
+        idx, matched = asof_ops.asof_indices_inner(l_ts_p, r_ts_p)
+        last_row_idx = np.asarray(idx)
+        per_col_idx = None  # broadcast path is row-level, nulls included
+        keep_mask_packed = np.asarray(matched)
+    elif use_merge:
+        r_seq_packed = (
+            packing.pack_column(
+                r_seq_j[r_layout.order], r_layout, Lr, fill=np.inf
+            )
+            if r_seq_j is not None
+            else None
+        )
+        last_row_idx, per_col_idx = asof_ops.asof_indices_merge(
+            l_ts_p, None, r_ts_p, r_seq_packed, r_valids,
+            n_cols=len(right_value_cols), max_lookback=int(maxLookback),
+        )
+        last_row_idx = np.asarray(last_row_idx)
+        per_col_idx = np.asarray(per_col_idx)
+        keep_mask_packed = None
+    else:
+        last_row_idx, per_col_idx = asof_ops.asof_indices_searchsorted(
+            l_ts_p, r_ts_p, r_valids, n_cols=len(right_value_cols)
+        )
+        last_row_idx = np.asarray(last_row_idx)
+        per_col_idx = np.asarray(per_col_idx)
+        keep_mask_packed = None
+
+    # --- flatten back to left row coordinates --------------------------
+    pos = np.arange(l_layout.n_rows) - l_layout.starts[l_layout.key_ids]
+    k_ids = l_layout.key_ids
+
+    def flat_right_indices(packed_idx):
+        ridx = packed_idx[k_ids, pos]
+        ok = ridx >= 0
+        flat = r_layout.starts[k_ids] + np.where(ok, ridx, 0)
+        return flat, ok
+
+    out = {}
+    left_sorted = left.df.iloc[l_layout.order].reset_index(drop=True)
+    for c in pcols:
+        out[c] = left_sorted[c].to_numpy()
+    for c in left_value_cols:
+        out[lmap[c]] = left_sorted[c].to_numpy()
+
+    r_sorted_df = right.df.iloc[r_sorted_take].reset_index(drop=True)
+    for ci, c in enumerate(right_value_cols):
+        if skipNulls and not broadcast_path:
+            flat, ok = flat_right_indices(per_col_idx[ci])
+        else:
+            flat, ok = flat_right_indices(last_row_idx)
+        vals = r_sorted_df[c].to_numpy()
+        col_out = _gather(vals, flat, ok)
+        if (not skipNulls) and not broadcast_path:
+            # last right row's value, nulls included (tsdf.py:123-136)
+            col_valid = (~pd.isna(r_sorted_df[c])).to_numpy()
+            ok2 = ok & col_valid[np.where(ok, flat, 0)]
+            col_out = _gather(vals, flat, ok2)
+        out[rmap[c]] = col_out
+        if (
+            tsPartitionVal is not None
+            and not suppress_null_warning
+            and logger.isEnabledFor(logging.WARNING)
+        ):
+            if (~ok).any():
+                logger.warning(
+                    "Column " + rmap[c] + " had no values within the lookback "
+                    "window. Consider using a larger window to avoid missing "
+                    "values. If this is the first record in the data frame, "
+                    "this warning can be ignored."
+                )
+
+    res = pd.DataFrame(out)
+    if broadcast_path:
+        keep = keep_mask_packed[k_ids, pos]
+        res = res[keep].reset_index(drop=True)
+
+    new_ts = lmap[left.ts_col]
+    return TSDF(res, ts_col=new_ts, partition_cols=pcols)

@@ -1,0 +1,166 @@
+"""AS-OF join kernels on packed [K, L] series.
+
+Reference semantics (python/tempo/tsdf.py:463-560 ``asofJoin`` and its
+helper ``__getLastRightRow`` tsdf.py:111-162): for every left row, find
+the *last* right row at-or-before it in the total order
+(ts, sequence, side) - where, on a full tie, right rows sort before left
+rows (rec_ind -1 < 1, tsdf.py:119,546) and a null sequence (left rows)
+sorts before any non-null sequence (Spark NULLS FIRST ascending).  With
+``skipNulls=True`` each right column independently takes its last
+*non-null* value (tsdf.py:139); with ``skipNulls=False`` every column
+comes from the single last right row, nulls included (struct-wrap trick,
+tsdf.py:123-136).  Scala adds a ``maxLookback`` cap counted in rows of
+the merged left+right stream (scala/.../asofJoin.scala:64-88).
+
+TPU design: instead of union + shuffle + sorted window scan, we exploit
+that both sides are packed time-sorted per key:
+
+* fast path (no sequence col): a vmapped ``searchsorted`` of left
+  timestamps into right timestamps plus a cumulative last-valid-index
+  scan per column - O((Ll + Lr) log Lr), no materialised union;
+* general path (sequence tie-break or maxLookback): a stable multi-key
+  ``lax.sort`` merge of the two packed sides, then the same scans in
+  merged coordinates - exactly the reference's union algorithm but as
+  one fused XLA program per batch of series.
+
+Kernels return *row indices* into the right side ([K, Ll] int32, -1 for
+no match).  Value gathering happens in the frame layer, which keeps
+device work dtype-agnostic and lets string columns ride the same path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.ops import window_utils as wu
+
+
+# ----------------------------------------------------------------------
+# Fast path: no sequence column -> searchsorted
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_cols",))
+def asof_indices_searchsorted(
+    l_ts: jnp.ndarray,          # [K, Ll] int64, padded with TS_PAD
+    r_ts: jnp.ndarray,          # [K, Lr] int64, padded with TS_PAD
+    r_valids: jnp.ndarray,      # [n_cols, K, Lr] bool per right column
+    n_cols: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (last_row_idx [K, Ll], per_col_idx [n_cols, K, Ll]).
+
+    last_row_idx: index of the last right row with r_ts <= l_ts (-1 none)
+    per_col_idx:  index of the last right row at-or-before l_ts whose
+                  column value is non-null (-1 none) - skipNulls=True.
+    """
+    pos = wu.searchsorted_batched(r_ts, l_ts, side="right")  # [K, Ll]
+    last_row_idx = (pos - 1).astype(jnp.int32)               # -1 when none
+
+    def per_col(valid):                                       # [K, Lr] -> [K, Ll]
+        lv = wu.last_valid_index(valid)                       # [K, Lr]
+        # gather lv at last_row_idx (clip then mask)
+        g = jnp.take_along_axis(lv, jnp.maximum(last_row_idx, 0).astype(jnp.int32), axis=-1)
+        return jnp.where(last_row_idx >= 0, g, -1)
+
+    per_col_idx = jax.vmap(per_col)(r_valids) if n_cols else jnp.zeros((0,) + l_ts.shape, jnp.int32)
+    return last_row_idx, per_col_idx
+
+
+# ----------------------------------------------------------------------
+# General path: merge by (ts, seq, side) with stable multi-key sort
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_cols", "max_lookback"))
+def asof_indices_merge(
+    l_ts: jnp.ndarray,           # [K, Ll] int64 (TS_PAD padding)
+    l_seq: Optional[jnp.ndarray],  # [K, Ll] float64 or None
+    r_ts: jnp.ndarray,           # [K, Lr] int64
+    r_seq: Optional[jnp.ndarray],  # [K, Lr] float64 or None
+    r_valids: jnp.ndarray,       # [n_cols, K, Lr] bool
+    n_cols: int,
+    max_lookback: int = 0,       # 0 = unbounded (scala asofJoin.scala:68)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge-scan AS-OF with sequence tie-break and optional maxLookback.
+
+    Sort keys mirror the reference exactly: (combined_ts, sequence with
+    NULLS FIRST, rec_ind) - tsdf.py:117-121.  Left rows carry seq=-inf
+    when they have no sequence value (Spark nulls-first), rec=+1; right
+    rows rec=-1.
+    """
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[1]
+    Lc = Ll + Lr
+
+    neg_inf = jnp.float64(-jnp.inf)
+    l_seq_arr = l_seq if l_seq is not None else jnp.full((K, Ll), neg_inf, jnp.float64)
+    r_seq_arr = r_seq if r_seq is not None else jnp.full((K, Lr), neg_inf, jnp.float64)
+
+    ts = jnp.concatenate([l_ts, r_ts], axis=-1)
+    seq = jnp.concatenate([l_seq_arr, r_seq_arr], axis=-1)
+    rec = jnp.concatenate(
+        [jnp.ones((K, Ll), jnp.int32), -jnp.ones((K, Lr), jnp.int32)], axis=-1
+    )
+    src = jnp.concatenate(
+        [
+            jnp.broadcast_to(jnp.arange(Ll, dtype=jnp.int32), (K, Ll)),
+            jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.int32), (K, Lr)),
+        ],
+        axis=-1,
+    )
+
+    ts_s, seq_s, rec_s, src_s = jax.lax.sort(
+        (ts, seq, rec, src), dimension=-1, num_keys=3, is_stable=True
+    )
+    is_right = rec_s == -1
+    right_idx_sorted = jnp.where(is_right, src_s, -1)  # [K, Lc]
+
+    def running_last(cand):
+        if max_lookback and max_lookback > 0:
+            # rowsBetween(-maxLookback, 0) on the merged stream
+            return wu.windowed_max_last(cand, max_lookback + 1)
+        return jax.lax.cummax(cand, axis=cand.ndim - 1)
+
+    # last right row regardless of column validity
+    last_row_sorted = running_last(right_idx_sorted)
+
+    # scatter back to left-row coordinates
+    left_scatter = jnp.where(is_right, Ll, src_s)  # right rows -> dropped
+
+    def to_left(vals_sorted):
+        out = jnp.full((K, Ll), -1, jnp.int32)
+        return out.at[
+            jnp.arange(K)[:, None], left_scatter
+        ].set(vals_sorted, mode="drop")
+
+    last_row_idx = to_left(last_row_sorted)
+
+    def per_col(valid):  # [K, Lr] -> [K, Ll]
+        v = jnp.take_along_axis(
+            valid, jnp.maximum(right_idx_sorted, 0).astype(jnp.int32), axis=-1
+        )
+        cand = jnp.where(is_right & v, right_idx_sorted, -1)
+        return to_left(running_last(cand))
+
+    per_col_idx = (
+        jax.vmap(per_col)(r_valids)
+        if n_cols
+        else jnp.zeros((0, K, Ll), jnp.int32)
+    )
+    return last_row_idx, per_col_idx
+
+
+# ----------------------------------------------------------------------
+# Broadcast fast path (reference tsdf.py:482-509 sql_join_opt)
+# ----------------------------------------------------------------------
+
+@jax.jit
+def asof_indices_inner(l_ts: jnp.ndarray, r_ts: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Range-join flavour: like the searchsorted path but flags rows with
+    no preceding right row for *dropping* (the reference's SQL fast path
+    is an inner ``between`` join, so unmatched left rows disappear)."""
+    pos = wu.searchsorted_batched(r_ts, l_ts, side="right")
+    idx = (pos - 1).astype(jnp.int32)
+    return idx, idx >= 0

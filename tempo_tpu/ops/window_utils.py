@@ -1,0 +1,91 @@
+"""Shared window primitives for packed [K, L] series kernels.
+
+These replace Spark's Window-expression machinery (reference
+python/tempo/tsdf.py:563-580 window builders): instead of a sorted
+shuffle + streaming window scan per key, we use O(L log L) data-parallel
+primitives (prefix scans, log-doubling range queries, searchsorted) that
+map onto the TPU VPU and keep everything inside one fused XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def last_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Running index of the last True up to and including each position.
+
+    -1 where no valid element has been seen yet.  This is the vectorised
+    equivalent of Spark's ``last(col, ignoreNulls=True)`` over an
+    unbounded-preceding window (reference tsdf.py:139).
+    """
+    n = valid.shape[axis]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.broadcast_to(idx, valid.shape)
+    cand = jnp.where(valid, idx, -1)
+    return jax.lax.cummax(cand, axis=axis if axis >= 0 else valid.ndim + axis)
+
+
+def first_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Index of the first True at or after each position; n where none.
+
+    Equivalent of ``first(col, ignoreNulls=True)`` over a current-row-to-
+    unbounded-following window (reference interpol.py:216-222).
+    """
+    n = valid.shape[axis]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    idx = jnp.broadcast_to(idx, valid.shape)
+    cand = jnp.where(valid, idx, n)
+    return jax.lax.cummin(cand, axis=axis if axis >= 0 else valid.ndim + axis, reverse=True)
+
+
+def _shift_right(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:
+    """Shift along last axis: out[..., i] = x[..., i-k] (fill for i<k)."""
+    if k == 0:
+        return x
+    pad = jnp.full(x.shape[:-1] + (k,), fill, dtype=x.dtype)
+    return jnp.concatenate([pad, x[..., :-k]], axis=-1)
+
+
+def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """max over the trailing ``window`` elements (inclusive) per position.
+
+    Log-doubling sparse-table construction: O(L log W) work, fully
+    vectorised - the TPU-friendly replacement for Spark's
+    ``rowsBetween(-W+1, 0)`` max scan (scala asofJoin.scala:64-88
+    maxLookback window).
+    """
+    if window <= 0:
+        raise ValueError("window must be >= 1")
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    # doubling table: level k covers 2^k trailing elements
+    levels = [x]
+    span = 1
+    while span < window:
+        prev = levels[-1]
+        levels.append(jnp.maximum(prev, _shift_right(prev, span, neg)))
+        span *= 2
+    if span == window:
+        return levels[-1]
+    # combine two overlapping power-of-two spans covering exactly `window`
+    k = len(levels) - 1
+    half = 1 << (k - 1)
+    lo = levels[k - 1]
+    return jnp.maximum(lo, _shift_right(lo, window - half, neg))
+
+
+def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: str = "left") -> jnp.ndarray:
+    """vmapped searchsorted over the leading (series) axis."""
+    fn = lambda a, v: jnp.searchsorted(a, v, side=side)
+    return jax.vmap(fn)(sorted_keys, queries)
+
+
+def segment_bounds_from_sorted(ids: np.ndarray, n_segments: int) -> np.ndarray:
+    """Host helper: start offsets [n_segments+1] of each id-run in a sorted
+    id array (ids must be non-decreasing)."""
+    counts = np.bincount(ids, minlength=n_segments)
+    starts = np.zeros(n_segments + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return starts

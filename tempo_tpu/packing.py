@@ -1,0 +1,239 @@
+"""Ragged->padded packing: the foundational layout transform of tempo-tpu.
+
+The reference (dbl-tempo) represents a collection of time series as a lazy
+Spark DataFrame partitioned by key columns (``Window.partitionBy`` /
+``groupBy``); Spark's shuffle dynamically routes rows of one key to one
+task (see /root/reference/python/tempo/tsdf.py:121,571).  XLA wants static
+shapes, so tempo-tpu instead *packs* the ragged per-key row groups into
+dense ``[num_series, padded_len]`` arrays with validity masks.  Every
+kernel in ``tempo_tpu.ops`` consumes this layout and is ``vmap``-ed over
+the leading (series) axis, which is also the axis we shard across a TPU
+mesh (see ``tempo_tpu.parallel``).
+
+Time is canonicalised to int64 nanoseconds (``ts_ns``); a float64 seconds
+view is derived where the reference semantics are defined in seconds
+(range windows cast timestamps to long seconds, tsdf.py:567; skew
+bracketing casts to double seconds, tsdf.py:169-178).  We document the
+divergence: int64 ns is exact where Spark's double cast is not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+NS_PER_S = 1_000_000_000
+
+# Sentinel used in padded slots of the time axis: larger than any real
+# timestamp so sorted-order based kernels (searchsorted, merges) naturally
+# ignore padding.  We keep headroom so small arithmetic offsets cannot
+# overflow int64.
+TS_PAD = np.int64(2**62)
+
+
+def series_to_ns(values: "pd.Series | np.ndarray") -> np.ndarray:
+    """Convert a timestamp-like column to canonical int64 nanoseconds.
+
+    datetime64 -> ns since epoch; integers -> value interpreted as seconds
+    (matching Spark's ``cast("double")`` of numeric ts cols, which yields
+    the raw value in 'seconds' units for windowing math); floats -> seconds
+    scaled to ns.
+    """
+    arr = values.to_numpy() if isinstance(values, pd.Series) else np.asarray(values)
+    if np.issubdtype(arr.dtype, np.datetime64):
+        return arr.astype("datetime64[ns]").astype(np.int64)
+    if np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.int64) * NS_PER_S
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.round(arr * NS_PER_S).astype(np.int64)
+    raise TypeError(f"Unsupported timestamp dtype: {arr.dtype}")
+
+
+def ns_to_original(ns: np.ndarray, like_dtype) -> np.ndarray:
+    """Map canonical ns back to the dtype the user supplied."""
+    if np.issubdtype(like_dtype, np.datetime64):
+        return ns.astype("datetime64[ns]")
+    if np.issubdtype(like_dtype, np.integer):
+        return (ns // NS_PER_S).astype(like_dtype)
+    if np.issubdtype(like_dtype, np.floating):
+        return (ns / NS_PER_S).astype(like_dtype)
+    raise TypeError(f"Unsupported timestamp dtype: {like_dtype}")
+
+
+def encode_keys(
+    df: pd.DataFrame, partition_cols: List[str]
+) -> Tuple[np.ndarray, pd.DataFrame]:
+    """Factorize the partition-key tuple into dense int32 series ids.
+
+    Equivalent role to Spark's hash-shuffle routing on partition columns
+    (tsdf.py:121): decides which logical series each row belongs to.
+    Returns (key_ids [n_rows], key_frame [n_series x partition_cols]).
+    Key order is order of first appearance (stable), so round-trips keep
+    a deterministic layout.
+    """
+    if not partition_cols:
+        key_ids = np.zeros(len(df), dtype=np.int64)
+        key_frame = pd.DataFrame(index=[0])
+        return key_ids, key_frame
+    if len(partition_cols) == 1:
+        codes, uniques = pd.factorize(df[partition_cols[0]], use_na_sentinel=False)
+        key_frame = pd.DataFrame({partition_cols[0]: uniques})
+        return codes.astype(np.int64), key_frame
+    # tuple-key factorization via a MultiIndex
+    mi = pd.MultiIndex.from_frame(df[partition_cols])
+    codes, uniques = pd.factorize(mi, use_na_sentinel=False)
+    key_frame = pd.DataFrame(
+        [list(t) for t in uniques], columns=partition_cols
+    )
+    return codes.astype(np.int64), key_frame
+
+
+def encode_keys_joint(
+    df_left: pd.DataFrame, df_right: pd.DataFrame, partition_cols: List[str]
+) -> Tuple[np.ndarray, np.ndarray, pd.DataFrame]:
+    """Factorize partition keys over the *union* of two frames so both
+    sides share one series-id space - the packed analog of Spark
+    co-partitioning both join inputs on the same keys (tsdf.py:121)."""
+    nl = len(df_left)
+    if not partition_cols:
+        return (
+            np.zeros(nl, dtype=np.int64),
+            np.zeros(len(df_right), dtype=np.int64),
+            pd.DataFrame(index=[0]),
+        )
+    both = pd.concat(
+        [df_left[partition_cols], df_right[partition_cols]], ignore_index=True
+    )
+    codes, key_frame = encode_keys(both, partition_cols)
+    return codes[:nl], codes[nl:], key_frame
+
+
+@dataclasses.dataclass
+class FlatLayout:
+    """Sorted flat (row-major) layout of a series collection.
+
+    Rows are globally sorted by (key_id, ts_ns, seq) - the total order the
+    reference only *promises* (tsdf.py:37-39 'ordering is promised, not
+    enforced') but that every windowed op implicitly requires.  We enforce
+    it once at ingest so kernels can assume sortedness.
+    """
+
+    key_ids: np.ndarray       # int64 [n]
+    ts_ns: np.ndarray         # int64 [n]
+    order: np.ndarray         # int64 [n]  (positions into the user's df)
+    starts: np.ndarray        # int64 [K+1] row offsets per series
+    key_frame: pd.DataFrame   # [K x partition_cols]
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.ts_ns.shape[0])
+
+    @property
+    def n_series(self) -> int:
+        return int(self.starts.shape[0] - 1)
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return self.starts[1:] - self.starts[:-1]
+
+
+def build_flat_layout(
+    df: pd.DataFrame,
+    ts_col: str,
+    partition_cols: List[str],
+    sequence_col: Optional[str] = None,
+) -> FlatLayout:
+    key_ids, key_frame = encode_keys(df, partition_cols)
+    ts_ns = series_to_ns(df[ts_col])
+    if sequence_col:
+        seq = pd.to_numeric(df[sequence_col]).to_numpy()
+        order = np.lexsort((seq, ts_ns, key_ids))
+    else:
+        order = np.lexsort((ts_ns, key_ids))
+    key_sorted = key_ids[order]
+    ts_sorted = ts_ns[order]
+    n_series = len(key_frame)
+    counts = np.bincount(key_sorted, minlength=n_series)
+    starts = np.zeros(n_series + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return FlatLayout(
+        key_ids=key_sorted,
+        ts_ns=ts_sorted,
+        order=order,
+        starts=starts,
+        key_frame=key_frame,
+    )
+
+
+def build_layout_from_codes(
+    key_ids: np.ndarray,
+    ts_ns: np.ndarray,
+    seq: Optional[np.ndarray],
+    n_series: int,
+) -> FlatLayout:
+    """Like :func:`build_flat_layout` but with externally-assigned series
+    ids (joint join encodings, skew bracket composition)."""
+    if seq is not None:
+        order = np.lexsort((seq, ts_ns, key_ids))
+    else:
+        order = np.lexsort((ts_ns, key_ids))
+    key_sorted = key_ids[order]
+    counts = np.bincount(key_sorted, minlength=n_series)
+    starts = np.zeros(n_series + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return FlatLayout(
+        key_ids=key_sorted,
+        ts_ns=ts_ns[order],
+        order=order,
+        starts=starts,
+        key_frame=pd.DataFrame(index=range(n_series)),
+    )
+
+
+def pad_length(max_len: int, multiple: int = 8) -> int:
+    """Pad series length to a lane-friendly multiple (TPU sublane=8)."""
+    if max_len <= 0:
+        return multiple
+    return int(-(-max_len // multiple) * multiple)
+
+
+def pack_column(
+    values: np.ndarray,
+    layout: FlatLayout,
+    padded_len: Optional[int] = None,
+    fill=0,
+) -> np.ndarray:
+    """Scatter a flat (already key/ts-sorted) column into [K, L] dense form."""
+    if padded_len is None:
+        padded_len = pad_length(int(layout.lengths.max(initial=0)))
+    K = layout.n_series
+    out = np.full((K, padded_len), fill, dtype=values.dtype)
+    pos = np.arange(layout.n_rows, dtype=np.int64) - layout.starts[layout.key_ids]
+    out[layout.key_ids, pos] = values
+    return out
+
+
+def unpack_column(packed: np.ndarray, layout: FlatLayout) -> np.ndarray:
+    """Gather [K, L] padded form back into the sorted flat layout."""
+    pos = np.arange(layout.n_rows, dtype=np.int64) - layout.starts[layout.key_ids]
+    return packed[layout.key_ids, pos]
+
+
+def row_mask(layout: FlatLayout, padded_len: int) -> np.ndarray:
+    """Boolean [K, L] mask of real (non-padding) rows."""
+    return np.arange(padded_len)[None, :] < layout.lengths[:, None]
+
+
+def unpack_ragged(
+    packed: np.ndarray, lengths: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten a [K, L] array with per-series valid ``lengths`` into a flat
+    array plus the key_id of each row.  Used to materialise op outputs whose
+    per-series row counts differ from the input (resample, interpolate)."""
+    K, L = packed.shape[0], packed.shape[1]
+    mask = np.arange(L)[None, :] < lengths[:, None]
+    key_ids = np.repeat(np.arange(K, dtype=np.int64), lengths.astype(np.int64))
+    return packed[mask], key_ids

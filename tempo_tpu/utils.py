@@ -1,0 +1,78 @@
+"""Display / environment adapters.
+
+Parity with python/tempo/utils.py:11-98: detect the runtime environment
+(Databricks vs notebook vs terminal) and bind a ``display`` function that
+renders a TSDF appropriately.  The HTML path degrades gracefully when
+IPython is absent.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+PLATFORM = (
+    "DATABRICKS"
+    if "DATABRICKS_RUNTIME_VERSION" in os.environ
+    else "NON_DATABRICKS"
+)
+
+
+def __isnotebookenv() -> bool:
+    try:
+        from IPython import get_ipython  # type: ignore
+
+        shell = get_ipython().__class__.__name__
+        return shell == "ZMQInteractiveShell"
+    except Exception:
+        return False
+
+
+def display_html(df) -> None:
+    """Render a frame as HTML in notebook environments."""
+    try:
+        from IPython.core.display import HTML  # type: ignore
+        from IPython.display import display as ipydisplay  # type: ignore
+
+        ipydisplay(HTML("<style>pre { white-space: pre !important; }</style>"))
+    except Exception:
+        pass
+    if isinstance(df, pd.DataFrame):
+        print(df.head(20).to_string(index=False))
+    else:
+        logger.error("'display' method not available for this object")
+
+
+def display_unavailable(df) -> None:
+    logger.error(
+        "'display' method not available in this environment. Use 'show' method instead."
+    )
+
+
+ENV_BOOLEAN = __isnotebookenv()
+
+
+def _frame_of(obj):
+    return obj.df if type(obj).__name__ == "TSDF" else obj
+
+
+if ENV_BOOLEAN:
+
+    def display_html_improvised(obj):
+        display_html(_frame_of(obj))
+
+    display = display_html_improvised
+else:
+
+    def display_terminal(obj):
+        df = _frame_of(obj)
+        if isinstance(df, pd.DataFrame):
+            print(df.head(20).to_string(index=False))
+        else:
+            display_unavailable(df)
+
+    display = display_terminal

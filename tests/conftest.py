@@ -1,0 +1,41 @@
+"""Test configuration: simulate an 8-device TPU mesh on CPU.
+
+The reference tests simulate a cluster with Spark local mode +
+``shuffle.partitions=1`` (python/tests/tsdf_tests.py:16-24); the
+tempo-tpu analog is XLA's virtual host-device mesh: every sharded code
+path (pjit/shard_map, collectives) executes for real on 8 CPU devices.
+Must run before jax initialises, hence conftest + env vars.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ts():
+    """Shorthand timestamp parser used by golden fixtures."""
+    return lambda s: pd.Timestamp(s)
+
+
+def make_df(columns, rows):
+    """Build a DataFrame from (name, values) like the reference's
+    buildTestDF (tests/tsdf_tests.py:33-48); strings that look like
+    timestamps stay strings unless listed in ts_cols by the caller."""
+    return pd.DataFrame({c: [r[i] for r in rows] for i, c in enumerate(columns)})
+
+
+def with_ts(df, ts_cols):
+    out = df.copy()
+    for c in ts_cols:
+        out[c] = pd.to_datetime(out[c])
+    return out

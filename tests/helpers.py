@@ -1,0 +1,39 @@
+"""Shared golden-test helpers (the assertDataFramesEqual analog,
+reference python/tests/tsdf_tests.py:88-103: schema-insensitive to column
+order, set-equality on rows)."""
+
+import numpy as np
+import pandas as pd
+
+
+def build_df(columns, rows, ts_cols=()):
+    df = pd.DataFrame({c: [r[i] for r in rows] for i, c in enumerate(columns)})
+    for c in ts_cols:
+        df[c] = pd.to_datetime(df[c])
+    return df
+
+
+def assert_frames_equal(actual: pd.DataFrame, expected: pd.DataFrame, atol=1e-6):
+    """Column-order-insensitive, row-order-insensitive comparison with
+    null == null semantics (like subtract-count assertDataFramesEqual)."""
+    assert sorted(actual.columns) == sorted(expected.columns), (
+        f"columns differ: {sorted(actual.columns)} vs {sorted(expected.columns)}"
+    )
+    cols = sorted(actual.columns)
+    a = actual[cols].sort_values(cols, kind="stable").reset_index(drop=True)
+    e = expected[cols].sort_values(cols, kind="stable").reset_index(drop=True)
+    assert len(a) == len(e), f"row counts differ: {len(a)} vs {len(e)}"
+    for c in cols:
+        av, ev = a[c], e[c]
+        a_na = pd.isna(av).to_numpy()
+        e_na = pd.isna(ev).to_numpy()
+        assert (a_na == e_na).all(), f"null pattern differs in column {c}:\n{a}\n{e}"
+        if pd.api.types.is_float_dtype(av) or pd.api.types.is_float_dtype(ev):
+            av_ok = pd.to_numeric(av[~a_na]).to_numpy(dtype=float)
+            ev_ok = pd.to_numeric(ev[~e_na]).to_numpy(dtype=float)
+            np.testing.assert_allclose(av_ok, ev_ok, atol=atol, rtol=1e-6,
+                                       err_msg=f"column {c}")
+        else:
+            assert list(av[~a_na]) == list(ev[~e_na]), (
+                f"column {c} differs:\n{list(av)}\nvs\n{list(ev)}"
+            )

@@ -1,0 +1,289 @@
+"""AS-OF join golden tests.
+
+Fixtures ported from the reference test suite
+(/root/reference/python/tests/tsdf_tests.py:162-394) - they encode the
+contract: last-right-row semantics, skipNulls on/off, sequence-number
+tie-break, and skew (time-partitioned) joins.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+from tests.helpers import build_df, assert_frames_equal
+
+LEFT_COLS = ["symbol", "event_ts", "trade_pr"]
+RIGHT_COLS = ["symbol", "event_ts", "bid_pr", "ask_pr"]
+
+LEFT_DATA = [
+    ["S1", "2020-08-01 00:00:10", 349.21],
+    ["S1", "2020-08-01 00:01:12", 351.32],
+    ["S1", "2020-09-01 00:02:10", 361.1],
+    ["S1", "2020-09-01 00:19:12", 362.1],
+]
+
+RIGHT_DATA = [
+    ["S1", "2020-08-01 00:00:01", 345.11, 351.12],
+    ["S1", "2020-08-01 00:01:05", 348.10, 353.13],
+    ["S1", "2020-09-01 00:02:01", 358.93, 365.12],
+    ["S1", "2020-09-01 00:15:01", 359.21, 365.31],
+]
+
+EXPECTED_COLS = [
+    "symbol", "left_event_ts", "left_trade_pr",
+    "right_event_ts", "right_bid_pr", "right_ask_pr",
+]
+
+EXPECTED_DATA = [
+    ["S1", "2020-08-01 00:00:10", 349.21, "2020-08-01 00:00:01", 345.11, 351.12],
+    ["S1", "2020-08-01 00:01:12", 351.32, "2020-08-01 00:01:05", 348.10, 353.13],
+    ["S1", "2020-09-01 00:02:10", 361.1, "2020-09-01 00:02:01", 358.93, 365.12],
+    ["S1", "2020-09-01 00:19:12", 362.1, "2020-09-01 00:15:01", 359.21, 365.31],
+]
+
+
+def test_asof_join():
+    """tsdf_tests.py:164-224"""
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, RIGHT_DATA, ts_cols=["event_ts"])
+    expected = build_df(
+        EXPECTED_COLS, EXPECTED_DATA, ts_cols=["left_event_ts", "right_event_ts"]
+    )
+
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+
+    joined = tl.asofJoin(tr, left_prefix="left", right_prefix="right")
+    assert_frames_equal(joined.df, expected)
+    assert joined.ts_col == "left_event_ts"
+    assert joined.partitionCols == ["symbol"]
+
+    # no right prefix: right columns keep their names
+    no_prefix_cols = [
+        "symbol", "left_event_ts", "left_trade_pr", "event_ts", "bid_pr", "ask_pr",
+    ]
+    expected_np = build_df(
+        no_prefix_cols, EXPECTED_DATA, ts_cols=["left_event_ts", "event_ts"]
+    )
+    joined_np = tl.asofJoin(tr, left_prefix="left", right_prefix="")
+    assert_frames_equal(joined_np.df, expected_np)
+
+
+def test_asof_join_no_left_prefix():
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, RIGHT_DATA, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    joined = tl.asofJoin(tr)
+    assert "event_ts" in joined.df.columns
+    assert "right_event_ts" in joined.df.columns
+    assert joined.ts_col == "event_ts"
+
+
+def test_asof_join_skip_nulls():
+    """tsdf_tests.py:226-289"""
+    right_nulls = [
+        ["S1", "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:01:05", None, 353.13],
+        ["S1", "2020-09-01 00:02:01", None, None],
+        ["S1", "2020-09-01 00:15:01", 359.21, 365.31],
+    ]
+    expected_skip = [
+        ["S1", "2020-08-01 00:00:10", 349.21, "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:01:12", 351.32, "2020-08-01 00:01:05", 345.11, 353.13],
+        ["S1", "2020-09-01 00:02:10", 361.1, "2020-09-01 00:02:01", 345.11, 353.13],
+        ["S1", "2020-09-01 00:19:12", 362.1, "2020-09-01 00:15:01", 359.21, 365.31],
+    ]
+    expected_noskip = [
+        ["S1", "2020-08-01 00:00:10", 349.21, "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:01:12", 351.32, "2020-08-01 00:01:05", None, 353.13],
+        ["S1", "2020-09-01 00:02:10", 361.1, "2020-09-01 00:02:01", None, None],
+        ["S1", "2020-09-01 00:19:12", 362.1, "2020-09-01 00:15:01", 359.21, 365.31],
+    ]
+
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, right_nulls, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+
+    joined = tl.asofJoin(tr, left_prefix="left", right_prefix="right")
+    assert_frames_equal(
+        joined.df,
+        build_df(EXPECTED_COLS, expected_skip, ts_cols=["left_event_ts", "right_event_ts"]),
+    )
+
+    joined2 = tl.asofJoin(tr, left_prefix="left", right_prefix="right", skipNulls=False)
+    assert_frames_equal(
+        joined2.df,
+        build_df(EXPECTED_COLS, expected_noskip, ts_cols=["left_event_ts", "right_event_ts"]),
+    )
+
+
+def test_sequence_number_sort():
+    """tsdf_tests.py:291-341 - sequence tie-break within equal timestamps."""
+    left_cols = ["symbol", "event_ts", "trade_pr", "trade_id"]
+    right_cols = ["symbol", "event_ts", "bid_pr", "ask_pr", "seq_nb"]
+    left_data = [
+        ["S1", "2020-08-01 00:00:10", 349.21, 1],
+        ["S1", "2020-08-01 00:01:12", 351.32, 2],
+        ["S1", "2020-09-01 00:02:10", 361.1, 3],
+        ["S1", "2020-09-01 00:19:12", 362.1, 4],
+    ]
+    right_data = [
+        ["S1", "2020-08-01 00:00:01", 345.11, 351.12, 1],
+        ["S1", "2020-08-01 00:01:05", 348.10, 1000.13, 3],
+        ["S1", "2020-08-01 00:01:05", 348.10, 100.13, 2],
+        ["S1", "2020-09-01 00:02:01", 358.93, 365.12, 4],
+        ["S1", "2020-09-01 00:15:01", 359.21, 365.31, 5],
+    ]
+    expected_cols = [
+        "symbol", "event_ts", "trade_pr", "trade_id",
+        "right_event_ts", "right_bid_pr", "right_ask_pr", "right_seq_nb",
+    ]
+    expected_data = [
+        ["S1", "2020-08-01 00:00:10", 349.21, 1, "2020-08-01 00:00:01", 345.11, 351.12, 1],
+        ["S1", "2020-08-01 00:01:12", 351.32, 2, "2020-08-01 00:01:05", 348.10, 1000.13, 3],
+        ["S1", "2020-09-01 00:02:10", 361.1, 3, "2020-09-01 00:02:01", 358.93, 365.12, 4],
+        ["S1", "2020-09-01 00:19:12", 362.1, 4, "2020-09-01 00:15:01", 359.21, 365.31, 5],
+    ]
+
+    left = build_df(left_cols, left_data, ts_cols=["event_ts"])
+    right = build_df(right_cols, right_data, ts_cols=["event_ts"])
+    tl = TSDF(left, partition_cols=["symbol"])
+    tr = TSDF(right, partition_cols=["symbol"], sequence_col="seq_nb")
+    joined = tl.asofJoin(tr, right_prefix="right")
+    assert_frames_equal(
+        joined.df,
+        build_df(expected_cols, expected_data, ts_cols=["event_ts", "right_event_ts"]),
+    )
+
+
+def test_partitioned_asof_join():
+    """tsdf_tests.py:343-394 - skew variant must match the plain join
+    when the overlap fraction covers the lookback."""
+    left_data = [
+        ["S1", "2020-08-01 00:00:02", 349.21],
+        ["S1", "2020-08-01 00:00:08", 351.32],
+        ["S1", "2020-08-01 00:00:11", 361.12],
+        ["S1", "2020-08-01 00:00:18", 364.31],
+        ["S1", "2020-08-01 00:00:19", 362.94],
+        ["S1", "2020-08-01 00:00:21", 364.27],
+        ["S1", "2020-08-01 00:00:23", 367.36],
+    ]
+    right_data = [
+        ["S1", "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:00:09", 348.10, 353.13],
+        ["S1", "2020-08-01 00:00:12", 358.93, 365.12],
+        ["S1", "2020-08-01 00:00:19", 359.21, 365.31],
+    ]
+    expected_data = [
+        ["S1", "2020-08-01 00:00:02", 349.21, "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:00:08", 351.32, "2020-08-01 00:00:01", 345.11, 351.12],
+        ["S1", "2020-08-01 00:00:11", 361.12, "2020-08-01 00:00:09", 348.10, 353.13],
+        ["S1", "2020-08-01 00:00:18", 364.31, "2020-08-01 00:00:12", 358.93, 365.12],
+        ["S1", "2020-08-01 00:00:19", 362.94, "2020-08-01 00:00:19", 359.21, 365.31],
+        ["S1", "2020-08-01 00:00:21", 364.27, "2020-08-01 00:00:19", 359.21, 365.31],
+        ["S1", "2020-08-01 00:00:23", 367.36, "2020-08-01 00:00:19", 359.21, 365.31],
+    ]
+
+    left = build_df(LEFT_COLS, left_data, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, right_data, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    joined = tl.asofJoin(tr, left_prefix="left", right_prefix="right",
+                         tsPartitionVal=10, fraction=0.1)
+    assert_frames_equal(
+        joined.df,
+        build_df(EXPECTED_COLS, expected_data, ts_cols=["left_event_ts", "right_event_ts"]),
+    )
+
+
+def test_partitioned_asof_join_missing_lookback_nulls():
+    """The skew join's documented truncation: values outside the bracket
+    + overlap become null (tsdf.py:513-514 warning semantics)."""
+    left_data = [["S1", "2020-08-01 00:10:00", 100.0]]
+    right_data = [["S1", "2020-08-01 00:00:01", 1.0, 2.0]]
+    left = build_df(LEFT_COLS, left_data, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, right_data, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    joined = tl.asofJoin(
+        tr, right_prefix="right", tsPartitionVal=10, fraction=0.5,
+        suppress_null_warning=True,
+    )
+    assert pd.isna(joined.df["right_bid_pr"]).all()
+
+
+def test_broadcast_fast_path_matches():
+    """tsdf.py:482-509 - sql_join_opt path gives the same values on fully
+    matched data (inner-join drop only affects unmatched left rows)."""
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, RIGHT_DATA, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    joined = tl.asofJoin(tr, left_prefix="left", right_prefix="right", sql_join_opt=True)
+    expected = build_df(
+        EXPECTED_COLS, EXPECTED_DATA, ts_cols=["left_event_ts", "right_event_ts"]
+    )
+    assert_frames_equal(joined.df, expected)
+
+    # unmatched left rows (before any right row) are dropped on this path
+    early_left = build_df(
+        LEFT_COLS, [["S1", "2020-07-01 00:00:00", 1.0]] + LEFT_DATA,
+        ts_cols=["event_ts"],
+    )
+    tl2 = TSDF(early_left, ts_col="event_ts", partition_cols=["symbol"])
+    joined2 = tl2.asofJoin(tr, left_prefix="left", right_prefix="right", sql_join_opt=True)
+    assert len(joined2.df) == 4
+
+
+def test_max_lookback():
+    """Scala parity (asofJoin.scala:64-88): cap the lookback window in
+    merged-stream rows."""
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, RIGHT_DATA, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    # maxLookback=1: only the immediately-preceding merged row is visible;
+    # every left row's predecessor here is a right row, so results match
+    joined = tl.asofJoin(tr, left_prefix="left", right_prefix="right", maxLookback=1)
+    expected = build_df(
+        EXPECTED_COLS, EXPECTED_DATA, ts_cols=["left_event_ts", "right_event_ts"]
+    )
+    assert_frames_equal(joined.df, expected)
+
+
+def test_asof_join_key_only_on_left():
+    """Left keys with no right rows yield nulls, not errors."""
+    left_data = LEFT_DATA + [["S2", "2020-08-01 00:00:10", 10.0]]
+    left = build_df(LEFT_COLS, left_data, ts_cols=["event_ts"])
+    right = build_df(RIGHT_COLS, RIGHT_DATA, ts_cols=["event_ts"])
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["symbol"])
+    joined = tl.asofJoin(tr, right_prefix="right")
+    s2 = joined.df[joined.df["symbol"] == "S2"]
+    assert len(s2) == 1
+    assert pd.isna(s2["right_bid_pr"]).all()
+    assert pd.isna(s2["right_event_ts"]).all()
+
+
+def test_validation_errors():
+    """tsdf.py:45-75 validation surface."""
+    left = build_df(LEFT_COLS, LEFT_DATA, ts_cols=["event_ts"])
+    with pytest.raises(ValueError):
+        TSDF(left, ts_col="nonexistent", partition_cols=["symbol"])
+    with pytest.raises(TypeError):
+        TSDF(left, ts_col=123, partition_cols=["symbol"])
+    with pytest.raises(TypeError):
+        TSDF(left, ts_col="event_ts", partition_cols=123)
+
+    tl = TSDF(left, ts_col="event_ts", partition_cols=["symbol"])
+    right = build_df(
+        ["sym2", "event_ts", "bid_pr"],
+        [["S1", "2020-08-01 00:00:01", 345.11]],
+        ts_cols=["event_ts"],
+    )
+    tr = TSDF(right, ts_col="event_ts", partition_cols=["sym2"])
+    with pytest.raises(ValueError):
+        tl.asofJoin(tr)
