@@ -38,7 +38,11 @@ DEFAULT_SEQ_COLNAME = "sequence_num"  # parity: scala TSDF.scala:529
 
 
 def _is_numeric(dtype) -> bool:
-    return np.issubdtype(dtype, np.number) and not np.issubdtype(dtype, np.datetime64)
+    return (
+        pd.api.types.is_numeric_dtype(dtype)
+        and not pd.api.types.is_bool_dtype(dtype)
+        and not pd.api.types.is_complex_dtype(dtype)
+    )
 
 
 class TSDF:
@@ -343,6 +347,58 @@ class TSDF:
             suppress_null_warning=suppress_null_warning,
             maxLookback=maxLookback,
         )
+
+    def withRangeStats(
+        self, type: str = "range", colsToSummarize=None, rangeBackWindowSecs: int = 1000
+    ) -> "TSDF":
+        """Rolling range statistics (parity: tsdf.py:673-721)."""
+        from tempo_tpu import rolling
+
+        return rolling.with_range_stats(self, type, colsToSummarize, rangeBackWindowSecs)
+
+    def withGroupedStats(self, metricCols=None, freq=None) -> "TSDF":
+        """Tumbling-window grouped statistics (parity: tsdf.py:723-759)."""
+        from tempo_tpu import rolling
+
+        return rolling.with_grouped_stats(self, metricCols, freq)
+
+    def EMA(
+        self, colName: str, window: int = 30, exp_factor: float = 0.2,
+        exact: bool = False,
+    ) -> "TSDF":
+        """Exponential moving average (parity: tsdf.py:615-635; ``exact=True``
+        computes the untruncated recursive EMA via an associative scan)."""
+        from tempo_tpu import rolling
+
+        return rolling.ema(self, colName, window, exp_factor, exact)
+
+    def vwap(
+        self, frequency: str = "m", volume_col: str = "volume", price_col: str = "price"
+    ) -> "TSDF":
+        """Volume-weighted average price (spec: scala TSDF.scala:378-401)."""
+        from tempo_tpu import rolling
+
+        return rolling.vwap(self, frequency, volume_col, price_col)
+
+    def withLookbackFeatures(
+        self,
+        featureCols,
+        lookbackWindowSize: int,
+        exactSize: bool = True,
+        featureColName: str = "features",
+    ):
+        """Trailing lookback feature tensor (parity: tsdf.py:637-671)."""
+        from tempo_tpu import rolling
+
+        return rolling.with_lookback_features(
+            self, featureCols, lookbackWindowSize, exactSize, featureColName
+        )
+
+    def lookbackTensor(self, featureCols, lookbackWindowSize: int):
+        """TPU-native dense [K, L, w, F] lookback tensor + validity mask."""
+        from tempo_tpu import rolling
+
+        return rolling.lookback_tensor(self, featureCols, lookbackWindowSize)
 
     # ------------------------------------------------------------------
     # Sequence-number constructor (parity: scala TSDF.scala:584-616)

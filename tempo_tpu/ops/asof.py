@@ -121,7 +121,7 @@ def asof_indices_merge(
         if max_lookback and max_lookback > 0:
             # rowsBetween(-maxLookback, 0) on the merged stream
             return wu.windowed_max_last(cand, max_lookback + 1)
-        return jax.lax.cummax(cand, axis=cand.ndim - 1)
+        return wu.cummax(cand, axis=-1)
 
     # last right row regardless of column validity
     last_row_sorted = running_last(right_idx_sorted)

@@ -1,0 +1,213 @@
+"""Rolling/grouped stats, EMA, VWAP, lookback-features golden tests.
+
+Range/grouped fixtures ported from the reference
+(/root/reference/python/tests/tsdf_tests.py:442-564); EMA fixture from
+the Scala suite's exact expected values (EMATests.scala:29-37 defines
+the semantics; we check the Python lag range 0..window-1).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+from tests.helpers import build_df, assert_frames_equal
+
+
+def test_range_stats():
+    """tsdf_tests.py:444-502 - 20 minute rolling window."""
+    data = [
+        ["S1", "2020-08-01 00:00:10", 349.21],
+        ["S1", "2020-08-01 00:01:12", 351.32],
+        ["S1", "2020-09-01 00:02:10", 361.1],
+        ["S1", "2020-09-01 00:19:12", 362.1],
+    ]
+    df = build_df(["symbol", "event_ts", "trade_pr"], data, ts_cols=["event_ts"])
+    tsdf = TSDF(df, partition_cols=["symbol"])
+    res = tsdf.withRangeStats(rangeBackWindowSecs=1200).df
+
+    expected = build_df(
+        ["symbol", "event_ts", "trade_pr", "mean_trade_pr", "count_trade_pr",
+         "min_trade_pr", "max_trade_pr", "sum_trade_pr", "stddev_trade_pr",
+         "zscore_trade_pr"],
+        [
+            ["S1", "2020-08-01 00:00:10", 349.21, 349.21, 1, 349.21, 349.21, 349.21, None, None],
+            ["S1", "2020-08-01 00:01:12", 351.32, 350.26, 2, 349.21, 351.32, 700.53, 1.49, 0.71],
+            ["S1", "2020-09-01 00:02:10", 361.1, 361.1, 1, 361.1, 361.1, 361.1, None, None],
+            ["S1", "2020-09-01 00:19:12", 362.1, 361.6, 2, 361.1, 362.1, 723.2, 0.71, 0.71],
+        ],
+        ts_cols=["event_ts"],
+    )
+    # compare at cent precision like the reference (decimal(5,2) casts)
+    for c in ["mean_trade_pr", "min_trade_pr", "max_trade_pr", "sum_trade_pr",
+              "stddev_trade_pr", "zscore_trade_pr"]:
+        res[c] = res[c].round(2)
+    assert_frames_equal(res, expected)
+
+
+def test_range_stats_includes_same_second_following_rows():
+    """Spark rangeBetween windows include *following* rows that share the
+    current row's long-seconds order value."""
+    data = [
+        ["S1", "2020-08-01 00:00:10.100", 1.0],
+        ["S1", "2020-08-01 00:00:10.900", 3.0],
+    ]
+    df = build_df(["symbol", "event_ts", "x"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).withRangeStats(rangeBackWindowSecs=5).df
+    # both rows truncate to second 10 -> each sees both rows
+    assert list(res["count_x"]) == [2, 2]
+    assert list(res["mean_x"]) == [2.0, 2.0]
+
+
+def test_grouped_stats():
+    """tsdf_tests.py:504-564 - 1 minute tumbling windows."""
+    data = [
+        ["S1", "2020-08-01 00:00:10", 349.21, 1],
+        ["S1", "2020-08-01 00:00:33", 351.32, 1],
+        ["S1", "2020-09-01 00:02:10", 361.1, 1],
+        ["S1", "2020-09-01 00:02:49", 362.1, 1],
+    ]
+    df = build_df(["symbol", "event_ts", "trade_pr", "index"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).withGroupedStats(freq="1 min").df
+
+    assert len(res) == 2
+    ok = lambda a, b: abs(a - b) < 5e-3  # decimal(5,2)-style comparison
+    row0 = res[res["event_ts"] == pd.Timestamp("2020-08-01 00:00:00")].iloc[0]
+    assert ok(row0["mean_trade_pr"], 350.265)
+    assert row0["count_trade_pr"] == 2
+    assert ok(row0["min_trade_pr"], 349.21)
+    assert ok(row0["max_trade_pr"], 351.32)
+    assert ok(row0["sum_trade_pr"], 700.53)
+    assert ok(row0["stddev_trade_pr"], 1.49)
+    assert row0["stddev_index"] == 0.0
+    row1 = res[res["event_ts"] == pd.Timestamp("2020-09-01 00:02:00")].iloc[0]
+    assert ok(row1["mean_trade_pr"], 361.6)
+    assert ok(row1["stddev_trade_pr"], 0.71)
+
+
+def test_ema_compat():
+    """EMA = sum of e(1-e)^i lags, i in 0..window-1 (tsdf.py:627-632)."""
+    data = [
+        ["S1", "2020-08-01 00:00:01", 1.0],
+        ["S1", "2020-08-01 00:00:02", 2.0],
+        ["S1", "2020-08-01 00:00:03", 3.0],
+    ]
+    df = build_df(["symbol", "event_ts", "x"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).EMA("x", window=2, exp_factor=0.2).df
+    e = 0.2
+    expected = [
+        e * 1.0,
+        e * 2.0 + e * 0.8 * 1.0,
+        e * 3.0 + e * 0.8 * 2.0,
+    ]
+    np.testing.assert_allclose(res["EMA_x"].to_numpy(), expected, atol=1e-9)
+
+
+def test_ema_nulls_contribute_zero():
+    data = [
+        ["S1", "2020-08-01 00:00:01", 1.0],
+        ["S1", "2020-08-01 00:00:02", None],
+        ["S1", "2020-08-01 00:00:03", 3.0],
+    ]
+    df = build_df(["symbol", "event_ts", "x"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).EMA("x", window=3, exp_factor=0.2).df
+    e = 0.2
+    expected = [e * 1.0, 0.0 + e * 0.8 * 1.0, e * 3.0 + 0.0 + e * 0.64 * 1.0]
+    np.testing.assert_allclose(res["EMA_x"].to_numpy(), expected, atol=1e-9)
+
+
+def test_ema_exact():
+    data = [
+        ["S1", "2020-08-01 00:00:01", 1.0],
+        ["S1", "2020-08-01 00:00:02", 2.0],
+        ["S1", "2020-08-01 00:00:03", 3.0],
+    ]
+    df = build_df(["symbol", "event_ts", "x"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).EMA("x", exp_factor=0.5, exact=True).df
+    # y1=0.5, y2=0.5*0.5+0.5*2=1.25, y3=0.5*1.25+0.5*3=2.125
+    np.testing.assert_allclose(res["EMA_x"].to_numpy(), [0.5, 1.25, 2.125], atol=1e-12)
+
+
+def test_vwap():
+    """Scala VWAPTests semantics: minute buckets."""
+    data = [
+        ["S1", "2020-08-01 00:00:10", 10.0, 100.0],
+        ["S1", "2020-08-01 00:00:33", 20.0, 300.0],
+        ["S1", "2020-08-01 00:01:10", 30.0, 100.0],
+    ]
+    df = build_df(["symbol", "event_ts", "price", "volume"], data, ts_cols=["event_ts"])
+    res = TSDF(df, partition_cols=["symbol"]).vwap(frequency="m").df
+    assert len(res) == 2
+    m0 = res[res["event_ts"] == pd.Timestamp("2020-08-01 00:00:00")].iloc[0]
+    assert m0["dllr_value"] == 10.0 * 100 + 20.0 * 300
+    assert m0["volume"] == 400.0
+    assert m0["max_price"] == 20.0
+    assert abs(m0["vwap"] - 7000.0 / 400.0) < 1e-12
+    with pytest.raises(ValueError):
+        TSDF(df, partition_cols=["symbol"]).vwap(frequency="x")
+
+
+def test_lookback_features():
+    """tsdf.py:637-671: exactSize filtering and 2-D shape."""
+    data = [
+        ["S1", "2020-08-01 00:00:01", 1.0, 10.0],
+        ["S1", "2020-08-01 00:00:02", 2.0, 20.0],
+        ["S1", "2020-08-01 00:00:03", 3.0, 30.0],
+        ["S2", "2020-08-01 00:00:01", 9.0, 90.0],
+    ]
+    df = build_df(["symbol", "event_ts", "a", "b"], data, ts_cols=["event_ts"])
+    tsdf = TSDF(df, partition_cols=["symbol"])
+
+    exact = tsdf.withLookbackFeatures(["a", "b"], 2)
+    assert isinstance(exact, pd.DataFrame)  # reference quirk: bare DataFrame
+    assert len(exact) == 1
+    assert exact.iloc[0]["features"] == [[1.0, 10.0], [2.0, 20.0]]
+
+    loose = tsdf.withLookbackFeatures(["a", "b"], 2, exactSize=False)
+    assert not isinstance(loose, pd.DataFrame)
+    feats = loose.df.sort_values(["symbol", "event_ts"])["features"].tolist()
+    assert feats[0] == []          # first row: no lookback
+    assert feats[1] == [[1.0, 10.0]]
+    assert feats[2] == [[1.0, 10.0], [2.0, 20.0]]
+    assert feats[3] == []          # S2 series boundary respected
+
+    tens, mask = tsdf.lookbackTensor(["a", "b"], 2)
+    assert tens.shape == (2, 8, 2, 2)
+
+
+def test_range_stats_multi_key_and_cols():
+    """Cross-check against a pandas rolling oracle on random data."""
+    rng = np.random.default_rng(42)
+    n = 200
+    df = pd.DataFrame({
+        "symbol": rng.choice(["A", "B", "C"], n),
+        "event_ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(np.sort(rng.integers(0, 3600, n)), unit="s"),
+        "x": rng.normal(size=n),
+    })
+    # drop duplicate (symbol, second) to keep the oracle simple
+    df = df.drop_duplicates(subset=["symbol", "event_ts"]).reset_index(drop=True)
+    secs = 120
+    res = (
+        TSDF(df, partition_cols=["symbol"])
+        .withRangeStats(rangeBackWindowSecs=secs)
+        .df.sort_values(["symbol", "event_ts"])
+        .reset_index(drop=True)
+    )
+
+    oracle = []
+    for _, g in df.sort_values(["symbol", "event_ts"]).groupby("symbol"):
+        g = g.reset_index(drop=True)
+        t = g["event_ts"].to_numpy().astype("datetime64[s]").astype(np.int64)
+        for i in range(len(g)):
+            in_win = (t >= t[i] - secs) & (t <= t[i])
+            w = g["x"][in_win]
+            oracle.append((w.mean(), len(w), w.min(), w.max(), w.sum(),
+                           w.std(ddof=1) if len(w) > 1 else np.nan))
+    oracle = pd.DataFrame(oracle, columns=["mean", "cnt", "mn", "mx", "sm", "sd"])
+    np.testing.assert_allclose(res["mean_x"], oracle["mean"], atol=1e-9)
+    np.testing.assert_allclose(res["count_x"], oracle["cnt"])
+    np.testing.assert_allclose(res["min_x"], oracle["mn"], atol=1e-12)
+    np.testing.assert_allclose(res["max_x"], oracle["mx"], atol=1e-12)
+    np.testing.assert_allclose(res["sum_x"], oracle["sm"], atol=1e-9)
+    np.testing.assert_allclose(res["stddev_x"], oracle["sd"], atol=1e-9)
