@@ -348,6 +348,39 @@ class TSDF:
             maxLookback=maxLookback,
         )
 
+    def resample(
+        self, freq: str, func=None, metricCols=None, prefix=None, fill=None
+    ):
+        """Downsample by a coarser frequency (parity: tsdf.py:764-776).
+        Returns a ``_ResampledTSDF`` supporting chained ``.interpolate``."""
+        from tempo_tpu import resample as rs
+
+        return rs.resample(self, freq, func, metricCols, prefix, fill)
+
+    def calc_bars(self, freq: str, func=None, metricCols=None, fill=None) -> "TSDF":
+        """OHLC bars (parity: tsdf.py:813-826)."""
+        from tempo_tpu import resample as rs
+
+        return rs.calc_bars(self, freq, func, metricCols, fill)
+
+    def interpolate(
+        self,
+        freq: str = None,
+        func: str = None,
+        method: str = None,
+        target_cols=None,
+        ts_col: str = None,
+        partition_cols=None,
+        show_interpolated: bool = False,
+    ) -> "TSDF":
+        """Resample + fill missing values (parity: tsdf.py:778-811)."""
+        from tempo_tpu import interpol
+
+        return interpol.interpolate_frame(
+            self, freq, func, method, target_cols, ts_col, partition_cols,
+            show_interpolated,
+        )
+
     def withRangeStats(
         self, type: str = "range", colsToSummarize=None, rangeBackWindowSecs: int = 1000
     ) -> "TSDF":

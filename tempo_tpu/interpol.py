@@ -1,0 +1,191 @@
+"""Interpolation service (parity: python/tempo/interpol.py).
+
+``Interpolation(is_resampled)`` validates inputs (interpol.py:17-64),
+optionally resamples (interpol.py:292-296), then fills missing grid
+slots and null values with one of zero/null/ffill/bfill/linear -
+executed by the dense-grid kernel in ``tempo_tpu.ops.interpolate``
+instead of the reference's explode + window-scaffold plan.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from tempo_tpu import packing
+from tempo_tpu.freq import freq_to_seconds, validateFuncExists
+from tempo_tpu.ops import interpolate as ik
+
+method_options = ["zero", "null", "bfill", "ffill", "linear"]
+
+
+class Interpolation:
+    def __init__(self, is_resampled: bool):
+        self.is_resampled = is_resampled
+
+    def __validate_fill(self, method: str):
+        if method not in method_options:
+            raise ValueError(
+                f"Please select from one of the following fill options: {method_options}"
+            )
+
+    def __validate_col(
+        self,
+        df: pd.DataFrame,
+        partition_cols: List[str],
+        target_cols: List[str],
+        ts_col: str,
+    ):
+        for column in partition_cols:
+            if column not in df.columns:
+                raise ValueError(
+                    f"Partition Column: '{column}' does not exist in DataFrame."
+                )
+        for column in target_cols:
+            if column not in df.columns:
+                raise ValueError(
+                    f"Target Column: '{column}' does not exist in DataFrame."
+                )
+            if not (
+                pd.api.types.is_numeric_dtype(df[column].dtype)
+                and not pd.api.types.is_bool_dtype(df[column].dtype)
+            ):
+                raise ValueError(
+                    "Target Column needs to be one of the following types: "
+                    "['int', 'bigint', 'float', 'double']"
+                )
+        if ts_col not in df.columns:
+            raise ValueError(
+                f"Timestamp Column: '{ts_col}' does not exist in DataFrame."
+            )
+        if not pd.api.types.is_datetime64_any_dtype(df[ts_col].dtype):
+            raise ValueError("Timestamp Column needs to be of timestamp type.")
+
+    def interpolate(
+        self,
+        tsdf,
+        ts_col: str,
+        partition_cols: List[str],
+        target_cols: List[str],
+        freq: str,
+        func: str,
+        method: str,
+        show_interpolated: bool,
+    ) -> pd.DataFrame:
+        from tempo_tpu import resample as rs
+        from tempo_tpu.frame import TSDF
+
+        self.__validate_fill(method)
+        self.__validate_col(tsdf.df, partition_cols, target_cols, ts_col)
+
+        freq_sec = freq_to_seconds(freq)
+
+        if not self.is_resampled:
+            validateFuncExists(func)
+            sampled = rs.aggregate(tsdf, freq, func, metricCols=target_cols)
+        else:
+            sampled = tsdf.df[[*partition_cols, ts_col, *target_cols]]
+
+        sampled_tsdf = TSDF(sampled, ts_col=ts_col, partition_cols=partition_cols)
+        layout = sampled_tsdf.layout
+        K = layout.n_series
+        step_ns = np.int64(freq_sec) * packing.NS_PER_S
+
+        # per-series dense grid from first to last bucket
+        starts, ends = layout.starts[:-1], layout.starts[1:]
+        min_ns = layout.ts_ns[starts]
+        max_ns = layout.ts_ns[np.maximum(ends - 1, starts)]
+        glen = ((max_ns - min_ns) // step_ns + 1).astype(np.int64)
+        G = packing.pad_length(int(glen.max(initial=1)))
+
+        slot = (layout.ts_ns - min_ns[layout.key_ids]) // step_ns
+        real = np.zeros((K, G), dtype=bool)
+        real[layout.key_ids, slot] = True
+
+        vals = np.full((len(target_cols), K, G), np.nan)
+        valid = np.zeros((len(target_cols), K, G), dtype=bool)
+        for ci, c in enumerate(target_cols):
+            v, ok = sampled_tsdf.numeric_flat(c)
+            vals[ci, layout.key_ids, slot] = v
+            valid[ci, layout.key_ids, slot] = ok
+
+        ts_sec = (min_ns // packing.NS_PER_S)[:, None] + np.arange(G)[None, :] * np.int64(freq_sec)
+
+        out_v, out_ok, ts_interp, col_interp = ik.interpolate_columns(
+            jnp.asarray(real), jnp.asarray(glen.astype(np.int32)),
+            jnp.asarray(ts_sec.astype(np.float64)), jnp.asarray(float(freq_sec)),
+            jnp.asarray(vals), jnp.asarray(valid), method,
+        )
+        out_v = np.asarray(out_v)
+        out_ok = np.asarray(out_ok)
+        ts_interp = np.asarray(ts_interp)
+        col_interp = np.asarray(col_interp)
+
+        # unpack grid -> flat rows
+        gmask = np.arange(G)[None, :] < glen[:, None]
+        key_ids = np.repeat(np.arange(K), glen)
+        grid_ns = (min_ns[:, None] + np.arange(G)[None, :] * step_ns)[gmask]
+
+        out = {}
+        key_frame = layout.key_frame
+        for c in partition_cols:
+            out[c] = key_frame[c].to_numpy()[key_ids]
+        out[ts_col] = packing.ns_to_original(grid_ns, sampled[ts_col].dtype)
+        for ci, c in enumerate(target_cols):
+            col = out_v[ci][gmask]
+            col[~out_ok[ci][gmask]] = np.nan
+            out[c] = col
+        out["is_ts_interpolated"] = ts_interp[gmask]
+        for ci, c in enumerate(target_cols):
+            out[f"is_interpolated_{c}"] = col_interp[ci][gmask]
+
+        result = pd.DataFrame(out)
+        if not show_interpolated:
+            result = result.drop(
+                columns=["is_ts_interpolated"]
+                + [f"is_interpolated_{c}" for c in target_cols]
+            )
+        return result
+
+
+def interpolate_frame(
+    tsdf,
+    freq: str,
+    func: str,
+    method: str,
+    target_cols=None,
+    ts_col=None,
+    partition_cols=None,
+    show_interpolated: bool = False,
+):
+    """TSDF.interpolate (tsdf.py:778-811): defaults resolve from the
+    frame; resamples first, then fills."""
+    from tempo_tpu.frame import TSDF
+
+    if ts_col is None:
+        ts_col = tsdf.ts_col
+    if partition_cols is None:
+        partition_cols = tsdf.partitionCols
+    if target_cols is None:
+        prohibited = set(partition_cols + [ts_col])
+        target_cols = [
+            c
+            for c in tsdf.df.columns
+            if (
+                pd.api.types.is_numeric_dtype(tsdf.df[c].dtype)
+                and not pd.api.types.is_bool_dtype(tsdf.df[c].dtype)
+                and c not in prohibited
+            )
+        ]
+
+    service = Interpolation(is_resampled=False)
+    tsdf_input = TSDF(tsdf.df, ts_col=ts_col, partition_cols=partition_cols)
+    out = service.interpolate(
+        tsdf_input, ts_col, partition_cols, target_cols, freq, func, method,
+        show_interpolated,
+    )
+    return TSDF(out, ts_col=ts_col, partition_cols=partition_cols)

@@ -1,0 +1,222 @@
+"""Resampling / downsampling / upsample-fill.
+
+Reference semantics (python/tempo/resample.py):
+
+* ``aggregate`` (resample.py:38-117): epoch-aligned tumbling buckets via
+  ``f.window``; five funcs - floor/ceil pick the *whole record* with the
+  min/max timestamp in the bucket (struct-min trick, resample.py:62-66,
+  87-92), mean/min/max aggregate each metric column independently; the
+  bucket start becomes the new ts; metric columns default to every
+  non-grouping column (strings included - Spark's avg() of a string
+  yields a null double, which we reproduce); output columns are
+  partition cols + ts + sorted(rest) (resample.py:97-100); optional
+  ``fill`` upsamples to a dense grid and zero-fills numeric columns
+  (resample.py:102-116).
+* ``_ResampledTSDF`` (tsdf.py:905-944): remembers (freq, func) so a
+  chained ``.interpolate(method=...)`` needs no re-sample.
+
+TPU design: bucketing is integer arithmetic on the packed int64-ns time
+axis; per-bucket aggregation is a flat segment reduction (already-sorted
+rows mean segment ids are contiguous - no shuffle, no hash aggregation);
+floor/ceil are first/last-row-of-segment gathers that move *indices*,
+not values, so string columns ride along for free.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pandas as pd
+
+import jax.numpy as jnp
+
+from tempo_tpu import packing
+from tempo_tpu.freq import (
+    checkAllowableFreq,
+    freq_to_seconds,
+    validateFuncExists,
+    floor,
+    ceiling,
+    average,
+    min_func,
+    max_func,
+    CLOSEST_LEAD,
+    MEAN_LEAD,
+    MIN_LEAD,
+    MAX_LEAD,
+)
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.rolling import _bucket_ns, _segments
+
+
+def _is_numeric_col(df: pd.DataFrame, c: str) -> bool:
+    return (
+        pd.api.types.is_numeric_dtype(df[c].dtype)
+        and not pd.api.types.is_bool_dtype(df[c].dtype)
+    )
+
+
+_LEAD_ALIASES = {CLOSEST_LEAD: floor, MEAN_LEAD: average,
+                 MIN_LEAD: min_func, MAX_LEAD: max_func}
+
+
+def aggregate(tsdf, freq: str, func: str, metricCols=None, prefix=None,
+              fill=None) -> pd.DataFrame:
+    func = _LEAD_ALIASES.get(func, func)
+    freq_sec = freq_to_seconds(freq)
+
+    layout = tsdf.layout
+    grouping = set(tsdf.partitionCols + [tsdf.ts_col])
+    if metricCols is None:
+        metricCols = [c for c in tsdf.df.columns if c not in grouping]
+    prefix = "" if prefix is None else prefix + "_"
+
+    bucket = _bucket_ns(layout.ts_ns, freq_sec)
+    seg_ids, first_row, seg_bucket = _segments(layout, bucket)
+    n_seg = len(first_row)
+    n_seg_padded = max(8, 1 << (n_seg - 1).bit_length()) if n_seg else 8
+    last_row = (np.append(first_row[1:], layout.n_rows) - 1) if n_seg else first_row
+
+    sorted_df = tsdf.df.iloc[layout.order].reset_index(drop=True)
+    out = {}
+    for c in tsdf.partitionCols:
+        out[c] = sorted_df[c].to_numpy()[first_row]
+    out[tsdf.ts_col] = packing.ns_to_original(seg_bucket, tsdf.ts_dtype())
+
+    if func in (floor, ceiling):
+        # whole-record min/max-by-timestamp (struct trick equivalent):
+        # gather the first/last row of each contiguous segment
+        pick = first_row if func == floor else last_row
+        for c in metricCols:
+            out[prefix + c] = sorted_df[c].to_numpy()[pick]
+    else:
+        for c in metricCols:
+            if _is_numeric_col(sorted_df, c):
+                vals = pd.to_numeric(sorted_df[c], errors="coerce").to_numpy(np.float64)
+                valid = ~np.isnan(vals)
+                stats = rk.segment_stats(
+                    jnp.asarray(vals), jnp.asarray(valid),
+                    jnp.asarray(seg_ids), n_seg_padded,
+                )
+                key = {average: "mean", min_func: "min", max_func: "max"}[func]
+                out[prefix + c] = np.asarray(stats[key])[:n_seg]
+            elif func == average:
+                # Spark avg(string) -> null double (exercised by the
+                # reference's 5-minute mean resample golden)
+                out[prefix + c] = np.full(n_seg, np.nan)
+            else:
+                # lexicographic min/max for non-numerics, host-side
+                s = pd.Series(sorted_df[c].to_numpy(), copy=False)
+                agg = s.groupby(seg_ids).min() if func == min_func else s.groupby(seg_ids).max()
+                out[prefix + c] = agg.to_numpy()
+
+    res = pd.DataFrame(out)
+    # deterministic column order (resample.py:97-100)
+    non_part = sorted(set(res.columns) - set(tsdf.partitionCols) - {tsdf.ts_col})
+    res = res[tsdf.partitionCols + [tsdf.ts_col] + non_part]
+
+    if fill:
+        res = upsample_fill(res, tsdf.partitionCols, tsdf.ts_col, freq_sec)
+    return res
+
+
+def upsample_fill(res: pd.DataFrame, pcols: List[str], ts_col: str,
+                  freq_sec: int) -> pd.DataFrame:
+    """Dense per-key grid from min to max ts, left-join, zero-fill
+    numerics (resample.py:102-116)."""
+    step = np.int64(freq_sec) * packing.NS_PER_S
+    ts_ns = packing.series_to_ns(res[ts_col])
+    frames = []
+    key_iter = (
+        res.assign(__ts_ns=ts_ns).groupby(pcols, sort=False, dropna=False)
+        if pcols
+        else [((), res.assign(__ts_ns=ts_ns))]
+    )
+    for key, g in key_iter:
+        lo, hi = g["__ts_ns"].min(), g["__ts_ns"].max()
+        grid = np.arange(lo, hi + step, step, dtype=np.int64)
+        gdf = pd.DataFrame({ts_col: packing.ns_to_original(grid, res[ts_col].dtype)})
+        if pcols:
+            if not isinstance(key, tuple):
+                key = (key,)
+            for c, v in zip(pcols, key):
+                gdf[c] = v
+        frames.append(gdf)
+    imputes = pd.concat(frames, ignore_index=True)
+    merged = imputes.merge(res.drop(columns="__ts_ns", errors="ignore"),
+                           on=pcols + [ts_col], how="left")
+    metrics = [c for c in merged.columns if _is_numeric_col(merged, c)
+               and c not in pcols and c != ts_col]
+    merged[metrics] = merged[metrics].fillna(0)
+    return merged
+
+
+def resample(tsdf, freq: str, func=None, metricCols=None, prefix=None,
+             fill=None):
+    """TSDF.resample (tsdf.py:764-776): validates the func, aggregates,
+    returns a _ResampledTSDF that remembers (freq, func)."""
+    from tempo_tpu.frame import TSDF
+
+    validateFuncExists(func)
+    enriched = aggregate(tsdf, freq, func, metricCols, prefix, fill)
+    return _ResampledTSDF(
+        enriched, ts_col=tsdf.ts_col, partition_cols=tsdf.partitionCols,
+        freq=freq, func=func,
+    )
+
+
+def calc_bars(tsdf, freq: str, func=None, metricCols=None, fill=None):
+    """OHLC bars (tsdf.py:813-826): four resamples joined on key+ts."""
+    from tempo_tpu.frame import TSDF
+
+    opens = resample(tsdf, freq=freq, func="floor", metricCols=metricCols,
+                     prefix="open", fill=fill)
+    lows = resample(tsdf, freq=freq, func="min", metricCols=metricCols,
+                    prefix="low", fill=fill)
+    highs = resample(tsdf, freq=freq, func="max", metricCols=metricCols,
+                     prefix="high", fill=fill)
+    closes = resample(tsdf, freq=freq, func="ceil", metricCols=metricCols,
+                      prefix="close", fill=fill)
+
+    join_cols = opens.partitionCols + [opens.ts_col]
+    bars = (
+        opens.df.merge(highs.df, on=join_cols)
+        .merge(lows.df, on=join_cols)
+        .merge(closes.df, on=join_cols)
+    )
+    non_part = sorted(set(bars.columns) - set(opens.partitionCols) - {opens.ts_col})
+    bars = bars[opens.partitionCols + [opens.ts_col] + non_part]
+    return TSDF(bars, opens.ts_col, opens.partitionCols)
+
+
+from tempo_tpu.frame import TSDF  # noqa: E402  (frame never imports us eagerly)
+
+
+class _ResampledTSDF(TSDF):
+    """A TSDF that remembers its (freq, func) so a chained
+    ``.interpolate(method=...)`` needs no re-sample (tsdf.py:905-944)."""
+
+    def __init__(self, df, ts_col="event_ts", partition_cols=None,
+                 sequence_col=None, freq=None, func=None):
+        super().__init__(df, ts_col, partition_cols, sequence_col)
+        self._freq = freq
+        self._func = func
+
+    def interpolate(self, method: str, target_cols: Optional[List[str]] = None,
+                    show_interpolated: bool = False):
+        from tempo_tpu import interpol
+
+        if target_cols is None:
+            prohibited = set(self.partitionCols + [self.ts_col])
+            target_cols = [
+                c for c in self.df.columns
+                if _is_numeric_col(self.df, c) and c not in prohibited
+            ]
+        service = interpol.Interpolation(is_resampled=True)
+        out = service.interpolate(
+            tsdf=self, ts_col=self.ts_col, partition_cols=self.partitionCols,
+            target_cols=target_cols, freq=self._freq, func=self._func,
+            method=method, show_interpolated=show_interpolated,
+        )
+        return TSDF(out, ts_col=self.ts_col, partition_cols=self.partitionCols)

@@ -1,0 +1,282 @@
+"""Interpolation golden tests.
+
+Fixtures ported from /root/reference/python/tests/interpol_tests.py -
+they encode the contract for all five fill methods including boundary
+behaviour (null edges, next_null fallback, existing-null vs missing-row
+flags) and the resample->interpolate chaining defaults.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+from tempo_tpu.interpol import Interpolation
+from tests.helpers import build_df, assert_frames_equal
+
+SIMPLE_COLS = ["partition_a", "partition_b", "event_ts", "value_a", "value_b"]
+SIMPLE_DATA = [
+    ["A", "A-1", "2020-01-01 00:00:10", 0.0, None],
+    ["A", "A-1", "2020-01-01 00:01:10", 2.0, 2.0],
+    ["A", "A-1", "2020-01-01 00:01:32", None, None],
+    ["A", "A-1", "2020-01-01 00:02:03", None, None],
+    ["A", "A-1", "2020-01-01 00:03:32", None, 7.0],
+    ["A", "A-1", "2020-01-01 00:04:12", 8.0, 8.0],
+    ["A", "A-1", "2020-01-01 00:05:31", 11.0, None],
+]
+
+FLAG_COLS = SIMPLE_COLS + [
+    "is_ts_interpolated", "is_interpolated_value_a", "is_interpolated_value_b",
+]
+
+
+def simple_tsdf():
+    df = build_df(SIMPLE_COLS, SIMPLE_DATA, ts_cols=["event_ts"])
+    return TSDF(df, partition_cols=["partition_a", "partition_b"])
+
+
+def run(method, show=True):
+    helper = Interpolation(is_resampled=False)
+    return helper.interpolate(
+        tsdf=simple_tsdf(),
+        partition_cols=["partition_a", "partition_b"],
+        target_cols=["value_a", "value_b"],
+        freq="30 seconds",
+        ts_col="event_ts",
+        func="mean",
+        method=method,
+        show_interpolated=show,
+    )
+
+
+def test_validation_errors():
+    """interpol_tests.py:77-152"""
+    helper = Interpolation(is_resampled=False)
+    t = simple_tsdf()
+    with pytest.raises(ValueError):
+        helper.interpolate(t, "event_ts", ["partition_a", "partition_b"],
+                           ["value_a", "value_b"], "30 seconds", "mean", "abcd", True)
+    with pytest.raises(ValueError):
+        helper.interpolate(t, "event_ts", ["partition_a", "partition_b"],
+                           ["partition_a", "value_b"], "30 seconds", "mean", "zero", True)
+    with pytest.raises(ValueError):
+        helper.interpolate(t, "event_ts", ["partition_c", "partition_b"],
+                           ["value_a", "value_b"], "30 seconds", "mean", "zero", True)
+    with pytest.raises(ValueError):
+        helper.interpolate(t, "value_a", ["partition_a", "partition_b"],
+                           ["value_a", "value_b"], "30 seconds", "mean", "zero", True)
+
+
+def test_zero_fill():
+    """interpol_tests.py:154-191"""
+    expected = build_df(FLAG_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, 0.0, False, False, True],
+        ["A", "A-1", "2020-01-01 00:00:30", 0.0, 0.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:01:30", 0.0, 0.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:00", 0.0, 0.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:30", 0.0, 0.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:00", 0.0, 0.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:30", 0.0, 7.0, False, True, False],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:04:30", 0.0, 0.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:00", 0.0, 0.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, 0.0, False, False, True],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("zero"), expected)
+
+
+def test_null_fill():
+    """interpol_tests.py:193-231"""
+    expected = build_df(FLAG_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None, False, False, True],
+        ["A", "A-1", "2020-01-01 00:00:30", None, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:01:30", None, None, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:00", None, None, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:30", None, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:00", None, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:30", None, 7.0, False, True, False],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:04:30", None, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:00", None, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, None, False, False, True],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("null"), expected)
+
+
+def test_back_fill():
+    """interpol_tests.py:233-272"""
+    expected = build_df(FLAG_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, 2.0, False, False, True],
+        ["A", "A-1", "2020-01-01 00:00:30", 2.0, 2.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:01:30", 8.0, 7.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:00", 8.0, 7.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:30", 8.0, 7.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:00", 8.0, 7.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:30", 8.0, 7.0, False, True, False],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:04:30", 11.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:00", 11.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, None, False, False, True],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("bfill"), expected)
+
+
+def test_forward_fill():
+    """interpol_tests.py:274-312"""
+    expected = build_df(FLAG_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None, False, False, True],
+        ["A", "A-1", "2020-01-01 00:00:30", 0.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:01:30", 2.0, 2.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:00", 2.0, 2.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:30", 2.0, 2.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:00", 2.0, 2.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:30", 2.0, 7.0, False, True, False],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:04:30", 8.0, 8.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:00", 8.0, 8.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, 8.0, False, False, True],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("ffill"), expected)
+
+
+def test_linear_fill():
+    """interpol_tests.py:314-352"""
+    expected = build_df(FLAG_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None, False, False, True],
+        ["A", "A-1", "2020-01-01 00:00:30", 1.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:01:30", 3.0, 3.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:00", 4.0, 4.0, False, True, True],
+        ["A", "A-1", "2020-01-01 00:02:30", 5.0, 5.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:00", 6.0, 6.0, True, True, True],
+        ["A", "A-1", "2020-01-01 00:03:30", 7.0, 7.0, False, True, False],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0, False, False, False],
+        ["A", "A-1", "2020-01-01 00:04:30", 9.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:00", 10.0, None, True, True, True],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, None, False, False, True],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("linear"), expected)
+
+
+def test_show_interpolated_false():
+    """interpol_tests.py:354-402"""
+    expected = build_df(SIMPLE_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None],
+        ["A", "A-1", "2020-01-01 00:00:30", 1.0, None],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:01:30", 3.0, 3.0],
+        ["A", "A-1", "2020-01-01 00:02:00", 4.0, 4.0],
+        ["A", "A-1", "2020-01-01 00:02:30", 5.0, 5.0],
+        ["A", "A-1", "2020-01-01 00:03:00", 6.0, 6.0],
+        ["A", "A-1", "2020-01-01 00:03:30", 7.0, 7.0],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0],
+        ["A", "A-1", "2020-01-01 00:04:30", 9.0, None],
+        ["A", "A-1", "2020-01-01 00:05:00", 10.0, None],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, None],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(run("linear", show=False), expected)
+
+
+def test_interpolate_tsdf_defaults():
+    """interpol_tests.py:406-444: TSDF.interpolate defaults."""
+    actual = simple_tsdf().interpolate(freq="30 seconds", func="mean",
+                                       method="linear").df
+    expected = build_df(SIMPLE_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None],
+        ["A", "A-1", "2020-01-01 00:00:30", 1.0, None],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:01:30", 3.0, 3.0],
+        ["A", "A-1", "2020-01-01 00:02:00", 4.0, 4.0],
+        ["A", "A-1", "2020-01-01 00:02:30", 5.0, 5.0],
+        ["A", "A-1", "2020-01-01 00:03:00", 6.0, 6.0],
+        ["A", "A-1", "2020-01-01 00:03:30", 7.0, 7.0],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0],
+        ["A", "A-1", "2020-01-01 00:04:30", 9.0, None],
+        ["A", "A-1", "2020-01-01 00:05:00", 10.0, None],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, None],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(actual, expected)
+
+
+def test_interpolate_custom_ts_col():
+    """interpol_tests.py:446-495: custom ts col name flows through."""
+    renamed = simple_tsdf().df.rename(columns={"event_ts": "other_ts_col"})
+    t = TSDF(renamed, partition_cols=["partition_a", "partition_b"],
+             ts_col="other_ts_col")
+    actual = t.interpolate(
+        ts_col="other_ts_col", show_interpolated=True,
+        partition_cols=["partition_a", "partition_b"], target_cols=["value_a"],
+        freq="30 seconds", func="mean", method="linear",
+    )
+    assert actual.ts_col == "other_ts_col"
+    assert "is_interpolated_value_a" in actual.df.columns
+    assert len(actual.df) == 12
+    np.testing.assert_allclose(actual.df["value_a"], np.arange(12.0))
+
+
+def test_tsdf_constructor_params_updated():
+    """interpol_tests.py:497-512"""
+    actual = simple_tsdf().interpolate(
+        ts_col="event_ts", show_interpolated=True, partition_cols=["partition_b"],
+        target_cols=["value_a"], freq="30 seconds", func="mean", method="linear",
+    )
+    assert actual.ts_col == "event_ts"
+    assert actual.partitionCols == ["partition_b"]
+
+
+def test_interpolation_on_resampled_chain():
+    """interpol_tests.py:514-554: resample().interpolate() chaining."""
+    actual = (
+        simple_tsdf()
+        .resample(freq="30 seconds", func="mean", fill=None)
+        .interpolate(method="linear", target_cols=["value_a"], show_interpolated=True)
+        .df
+    )
+    assert len(actual) == 12
+    np.testing.assert_allclose(actual["value_a"], np.arange(12.0))
+    # golden (interpol_tests.py:450-462): 00:00:30, 00:02:30, 00:03:00,
+    # 00:04:30, 00:05:00 are generated timestamps
+    assert actual["is_ts_interpolated"].sum() == 5
+
+
+def test_defaults_with_resampled_df():
+    """interpol_tests.py:556-595: ffill with default target cols."""
+    actual = (
+        simple_tsdf()
+        .resample(freq="30 seconds", func="mean", fill=None)
+        .interpolate(method="ffill")
+        .df
+    )
+    expected = build_df(SIMPLE_COLS, [
+        ["A", "A-1", "2020-01-01 00:00:00", 0.0, None],
+        ["A", "A-1", "2020-01-01 00:00:30", 0.0, None],
+        ["A", "A-1", "2020-01-01 00:01:00", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:01:30", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:02:00", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:02:30", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:03:00", 2.0, 2.0],
+        ["A", "A-1", "2020-01-01 00:03:30", 2.0, 7.0],
+        ["A", "A-1", "2020-01-01 00:04:00", 8.0, 8.0],
+        ["A", "A-1", "2020-01-01 00:04:30", 8.0, 8.0],
+        ["A", "A-1", "2020-01-01 00:05:00", 8.0, 8.0],
+        ["A", "A-1", "2020-01-01 00:05:30", 11.0, 8.0],
+    ], ts_cols=["event_ts"])
+    assert_frames_equal(actual, expected)
+
+
+def test_multi_series_interpolation():
+    """Multiple keys with different grid extents stay independent."""
+    df = build_df(SIMPLE_COLS, SIMPLE_DATA + [
+        ["B", "B-1", "2020-01-01 00:00:05", 1.0, 1.0],
+        ["B", "B-1", "2020-01-01 00:01:07", 3.0, None],
+    ], ts_cols=["event_ts"])
+    t = TSDF(df, partition_cols=["partition_a", "partition_b"])
+    out = t.interpolate(freq="30 seconds", func="mean", method="linear").df
+    b = out[out["partition_a"] == "B"].reset_index(drop=True)
+    assert len(b) == 3  # 00:00:00, 00:00:30, 00:01:00
+    np.testing.assert_allclose(b["value_a"], [1.0, 2.0, 3.0])
+    assert b["value_b"].isna().tolist() == [False, True, True]

@@ -1,0 +1,124 @@
+"""Resample / upsample / bars golden tests.
+
+Fixtures ported from /root/reference/python/tests/tsdf_tests.py:578-741.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+from tests.helpers import build_df, assert_frames_equal
+
+COLS = ["symbol", "date", "event_ts", "trade_pr", "trade_pr_2"]
+DATA = [
+    ["S1", "SAME_DT", "2020-08-01 00:00:10", 349.21, 10.0],
+    ["S1", "SAME_DT", "2020-08-01 00:00:11", 340.21, 9.0],
+    ["S1", "SAME_DT", "2020-08-01 00:01:12", 353.32, 8.0],
+    ["S1", "SAME_DT", "2020-08-01 00:01:13", 351.32, 7.0],
+    ["S1", "SAME_DT", "2020-08-01 00:01:14", 350.32, 6.0],
+    ["S1", "SAME_DT", "2020-09-01 00:01:12", 361.1, 5.0],
+    ["S1", "SAME_DT", "2020-09-01 00:19:12", 362.1, 4.0],
+]
+
+
+def _tsdf():
+    return TSDF(build_df(COLS, DATA, ts_cols=["event_ts"]), partition_cols=["symbol"])
+
+
+def test_resample_floor():
+    """tsdf_tests.py:580-656: 1-minute floor keeps the whole earliest
+    record per bucket, including the string column."""
+    res = _tsdf().resample(freq="min", func="floor", prefix="floor").df
+    expected = build_df(
+        ["symbol", "event_ts", "floor_trade_pr", "floor_date", "floor_trade_pr_2"],
+        [
+            ["S1", "2020-08-01 00:00:00", 349.21, "SAME_DT", 10.0],
+            ["S1", "2020-08-01 00:01:00", 353.32, "SAME_DT", 8.0],
+            ["S1", "2020-09-01 00:01:00", 361.1, "SAME_DT", 5.0],
+            ["S1", "2020-09-01 00:19:00", 362.1, "SAME_DT", 4.0],
+        ],
+        ts_cols=["event_ts"],
+    )
+    assert_frames_equal(res, expected)
+
+
+def test_resample_mean_5min():
+    """5-minute mean; string col aggregates to null double."""
+    res = _tsdf().resample(freq="5 minutes", func="mean").df
+    res["trade_pr"] = res["trade_pr"].round(2)
+    expected = build_df(
+        ["symbol", "event_ts", "date", "trade_pr", "trade_pr_2"],
+        [
+            ["S1", "2020-08-01 00:00:00", None, 348.88, 8.0],
+            ["S1", "2020-09-01 00:00:00", None, 361.1, 5.0],
+            ["S1", "2020-09-01 00:15:00", None, 362.1, 4.0],
+        ],
+        ts_cols=["event_ts"],
+    )
+    expected["date"] = expected["date"].astype(float)
+    assert_frames_equal(res, expected)
+
+
+def test_calc_bars():
+    bars = _tsdf().calc_bars(freq="min", metricCols=["trade_pr", "trade_pr_2"]).df
+    expected = build_df(
+        ["symbol", "event_ts",
+         "close_trade_pr", "close_trade_pr_2", "high_trade_pr", "high_trade_pr_2",
+         "low_trade_pr", "low_trade_pr_2", "open_trade_pr", "open_trade_pr_2"],
+        [
+            ["S1", "2020-08-01 00:00:00", 340.21, 9.0, 349.21, 10.0, 340.21, 9.0, 349.21, 10.0],
+            ["S1", "2020-08-01 00:01:00", 350.32, 6.0, 353.32, 8.0, 350.32, 6.0, 353.32, 8.0],
+            ["S1", "2020-09-01 00:01:00", 361.1, 5.0, 361.1, 5.0, 361.1, 5.0, 361.1, 5.0],
+            ["S1", "2020-09-01 00:19:00", 362.1, 4.0, 362.1, 4.0, 362.1, 4.0, 362.1, 4.0],
+        ],
+        ts_cols=["event_ts"],
+    )
+    assert_frames_equal(bars, expected)
+    # column order contract: partition + ts + sorted rest
+    assert list(bars.columns)[:2] == ["symbol", "event_ts"]
+    assert list(bars.columns)[2:] == sorted(bars.columns[2:])
+
+
+def test_upsample_fill():
+    """tsdf_tests.py:662-741: fill=True zero-fills the dense grid."""
+    res = (
+        _tsdf().resample(freq="5 minutes", func="mean", fill=True).df
+    )
+    res["trade_pr"] = res["trade_pr"].round(2)
+    sel = res[res["event_ts"].isin(pd.to_datetime([
+        "2020-08-01 00:00:00", "2020-08-01 00:05:00",
+        "2020-09-01 00:00:00", "2020-09-01 00:15:00",
+    ]))].reset_index(drop=True)
+    expected = build_df(
+        ["symbol", "event_ts", "date", "trade_pr", "trade_pr_2"],
+        [
+            ["S1", "2020-08-01 00:00:00", 0.0, 348.88, 8.0],
+            ["S1", "2020-08-01 00:05:00", 0.0, 0.0, 0.0],
+            ["S1", "2020-09-01 00:00:00", 0.0, 361.1, 5.0],
+            ["S1", "2020-09-01 00:15:00", 0.0, 362.1, 4.0],
+        ],
+        ts_cols=["event_ts"],
+    )
+    assert_frames_equal(sel, expected)
+    # grid is dense: every 5-minute step between min and max present
+    steps = res["event_ts"].diff().dropna().dt.total_seconds()
+    assert (steps == 300).all()
+
+
+def test_resample_validation():
+    with pytest.raises(ValueError):
+        _tsdf().resample(freq="min", func=None)
+    with pytest.raises(ValueError):
+        _tsdf().resample(freq="min", func="bogus")
+    with pytest.raises(ValueError):
+        _tsdf().resample(freq="fortnight", func="mean")
+
+
+def test_resample_ceil_and_scala_leads():
+    res = _tsdf().resample(freq="min", func="ceil", prefix="ceil").df
+    bucket1 = res[res["event_ts"] == pd.Timestamp("2020-08-01 00:01:00")].iloc[0]
+    assert bucket1["ceil_trade_pr"] == 350.32  # latest record in bucket
+    # scala-side aliases (resample.scala:17-20) map onto the same engine
+    res2 = _tsdf().resample(freq="min", func="closest_lead", prefix="floor").df
+    assert res2.iloc[0]["floor_trade_pr"] == 349.21
