@@ -348,6 +348,41 @@ class TSDF:
             maxLookback=maxLookback,
         )
 
+    def fourier_transform(self, timestep: float, valueCol: str) -> "TSDF":
+        """Frequency-domain representation per series (parity:
+        tsdf.py:828-902, scipy-via-applyInPandas replaced by batched
+        on-device FFT)."""
+        from tempo_tpu import spectral
+
+        return spectral.fourier_transform(self, timestep, valueCol)
+
+    def autocorr(self, col: str, lag: int = 1) -> pd.DataFrame:
+        """Autocorrelation at a given lag per series (parity:
+        tsdf.py:192-316; returns a bare DataFrame like the reference)."""
+        from tempo_tpu import spectral
+
+        return spectral.autocorr(self, col, lag)
+
+    def describe(self) -> pd.DataFrame:
+        """Global + per-column summary table (parity: tsdf.py:384-431)."""
+        from tempo_tpu import describe as describe_mod
+
+        return describe_mod.describe(self)
+
+    def write(self, tabName=None, optimizationCols=None, spark=None,
+              base_dir=None) -> str:
+        """Optimized columnar persistence (parity: tsdf.py:761-762 /
+        io.py:10-43).  Accepts the reference's ``write(spark, tabName,
+        optimizationCols)`` calling convention as well."""
+        from tempo_tpu.io import writer
+
+        if not isinstance(tabName, str) and isinstance(optimizationCols, str):
+            # reference-style write(spark, tabName, ...) positional call
+            tabName, optimizationCols = optimizationCols, spark if isinstance(spark, list) else None
+        if not isinstance(tabName, str):
+            raise TypeError("write() requires a table name")
+        return writer.write(self, tabName, optimizationCols, base_dir)
+
     def resample(
         self, freq: str, func=None, metricCols=None, prefix=None, fill=None
     ):

@@ -93,31 +93,52 @@ class Interpolation:
         sampled_tsdf = TSDF(sampled, ts_col=ts_col, partition_cols=partition_cols)
         layout = sampled_tsdf.layout
         K = layout.n_series
+        n = layout.n_rows
+        kid = layout.key_ids
+        ts_ns = layout.ts_ns
         step_ns = np.int64(freq_sec) * packing.NS_PER_S
 
-        # per-series dense grid from first to last bucket
-        starts, ends = layout.starts[:-1], layout.starts[1:]
-        min_ns = layout.ts_ns[starts]
-        max_ns = layout.ts_ns[np.maximum(ends - 1, starts)]
-        glen = ((max_ns - min_ns) // step_ns + 1).astype(np.int64)
+        # Per-row generated-slot counts, mirroring the reference's
+        # explode(sequence(ts, next_ts - freq, freq)) (interpol.py:330-336):
+        # row i emits floor((next_ts - ts)/freq) slots at ts, ts+freq, ...;
+        # the last row of a series emits exactly itself; a row whose gap to
+        # the next is < freq emits nothing and is dropped (explode of an
+        # empty sequence removes the row - duplicate/misaligned input).
+        m = np.ones(n, dtype=np.int64)
+        if n > 1:
+            next_same_key = kid[1:] == kid[:-1]
+            gaps = ts_ns[1:] - ts_ns[:-1]
+            m[:-1] = np.where(next_same_key, gaps // step_ns, 1)
+
+        total = int(m.sum())
+        excl = np.concatenate([[0], np.cumsum(m)[:-1]])
+        row_of_slot = np.repeat(np.arange(n), m)
+        j = np.arange(total) - excl[row_of_slot]
+        grid_ns = ts_ns[row_of_slot] + j * step_ns
+        key_of_slot = kid[row_of_slot]
+        glen = np.bincount(key_of_slot, minlength=K).astype(np.int64)
         G = packing.pad_length(int(glen.max(initial=1)))
+        key_starts = np.concatenate([[0], np.cumsum(glen)[:-1]])
+        slot_in_key = np.arange(total) - key_starts[key_of_slot]
 
-        slot = (layout.ts_ns - min_ns[layout.key_ids]) // step_ns
         real = np.zeros((K, G), dtype=bool)
-        real[layout.key_ids, slot] = True
+        real[key_of_slot, slot_in_key] = j == 0
+        ts_sec = np.zeros((K, G), dtype=np.float64)
+        # unix_timestamp() truncation semantics (interpol.py:78-84)
+        ts_sec[key_of_slot, slot_in_key] = grid_ns // packing.NS_PER_S
 
+        kept = m > 0
+        kept_slot = excl[kept]  # flat slot of each kept row's own position
         vals = np.full((len(target_cols), K, G), np.nan)
         valid = np.zeros((len(target_cols), K, G), dtype=bool)
         for ci, c in enumerate(target_cols):
             v, ok = sampled_tsdf.numeric_flat(c)
-            vals[ci, layout.key_ids, slot] = v
-            valid[ci, layout.key_ids, slot] = ok
-
-        ts_sec = (min_ns // packing.NS_PER_S)[:, None] + np.arange(G)[None, :] * np.int64(freq_sec)
+            vals[ci, key_of_slot[kept_slot], slot_in_key[kept_slot]] = v[kept]
+            valid[ci, key_of_slot[kept_slot], slot_in_key[kept_slot]] = ok[kept]
 
         out_v, out_ok, ts_interp, col_interp = ik.interpolate_columns(
             jnp.asarray(real), jnp.asarray(glen.astype(np.int32)),
-            jnp.asarray(ts_sec.astype(np.float64)), jnp.asarray(float(freq_sec)),
+            jnp.asarray(ts_sec), jnp.asarray(float(freq_sec)),
             jnp.asarray(vals), jnp.asarray(valid), method,
         )
         out_v = np.asarray(out_v)
@@ -125,10 +146,9 @@ class Interpolation:
         ts_interp = np.asarray(ts_interp)
         col_interp = np.asarray(col_interp)
 
-        # unpack grid -> flat rows
+        # unpack grid -> flat rows (slots are already in key-major order)
         gmask = np.arange(G)[None, :] < glen[:, None]
         key_ids = np.repeat(np.arange(K), glen)
-        grid_ns = (min_ns[:, None] + np.arange(G)[None, :] * step_ns)[gmask]
 
         out = {}
         key_frame = layout.key_frame

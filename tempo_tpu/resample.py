@@ -33,7 +33,6 @@ import jax.numpy as jnp
 
 from tempo_tpu import packing
 from tempo_tpu.freq import (
-    checkAllowableFreq,
     freq_to_seconds,
     validateFuncExists,
     floor,
@@ -156,8 +155,6 @@ def resample(tsdf, freq: str, func=None, metricCols=None, prefix=None,
              fill=None):
     """TSDF.resample (tsdf.py:764-776): validates the func, aggregates,
     returns a _ResampledTSDF that remembers (freq, func)."""
-    from tempo_tpu.frame import TSDF
-
     validateFuncExists(func)
     enriched = aggregate(tsdf, freq, func, metricCols, prefix, fill)
     return _ResampledTSDF(
@@ -168,8 +165,6 @@ def resample(tsdf, freq: str, func=None, metricCols=None, prefix=None,
 
 def calc_bars(tsdf, freq: str, func=None, metricCols=None, fill=None):
     """OHLC bars (tsdf.py:813-826): four resamples joined on key+ts."""
-    from tempo_tpu.frame import TSDF
-
     opens = resample(tsdf, freq=freq, func="floor", metricCols=metricCols,
                      prefix="open", fill=fill)
     lows = resample(tsdf, freq=freq, func="min", metricCols=metricCols,

@@ -9,12 +9,20 @@ Must run before jax initialises, hence conftest + env vars.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the harness pre-sets JAX_PLATFORMS=axon: the test
+# suite targets the virtual multi-device mesh, not the single real chip
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# the axon plugin overrides JAX_PLATFORMS at import time; the config
+# knob wins over it
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402
