@@ -1,0 +1,41 @@
+"""Distribution layer: device meshes, sharded ops, halo exchange.
+
+The reference's only distribution model is Spark's hash shuffle over
+partition keys plus the overlapping time-bucket trick for skewed keys
+(/root/reference/python/tempo/tsdf.py:164-190; SURVEY.md §2.3).  The
+TPU-native equivalents here:
+
+* **series axis (data parallel)** — packed ``[K, L]`` arrays sharded
+  over a ``('series',)`` mesh axis with ``NamedSharding``; per-series
+  kernels are batched over K so XLA partitions them with zero
+  collectives (the analog of Spark routing each key to one task).
+* **time axis (sequence parallel)** — for series too long for one
+  chip, the time axis is sharded and rolling/AS-OF lookback windows
+  receive their trailing *halo* from the left neighbor via
+  ``lax.ppermute`` over ICI inside ``shard_map`` — the same overlap
+  algebra as the reference's ``tsPartitionVal`` fraction-overlap
+  brackets, turned into a neighbor exchange.
+* both axes compose on a 2-D ``('series', 'time')`` mesh.
+"""
+
+from tempo_tpu.parallel.mesh import (
+    make_mesh,
+    series_sharding,
+    shard_series,
+    pad_series_axis,
+)
+from tempo_tpu.parallel.halo import (
+    range_stats_time_sharded,
+    asof_time_sharded,
+    ema_time_sharded,
+)
+
+__all__ = [
+    "make_mesh",
+    "series_sharding",
+    "shard_series",
+    "pad_series_axis",
+    "range_stats_time_sharded",
+    "asof_time_sharded",
+    "ema_time_sharded",
+]

@@ -1,0 +1,71 @@
+"""Device-mesh construction and series-axis sharding helpers.
+
+Replaces the role of Spark's cluster manager + hash partitioner
+(reference: ``Window.partitionBy(partition_cols)`` routes each key's
+rows to one task, /root/reference/python/tempo/tsdf.py:121,571).  Here
+the routing is static: packed ``[K, L]`` arrays are laid out with the
+leading (series) axis sharded across devices, and XLA's SPMD
+partitioner splits every batched kernel along it without any
+communication.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh``.
+
+    ``axes`` maps axis name -> size, e.g. ``{"series": 4, "time": 2}``.
+    Defaults to all local devices on a 1-D ``('series',)`` axis — the
+    data-parallel layout that covers the reference's entire distribution
+    model (one series per task).  A ``'time'`` axis adds sequence
+    parallelism (see tempo_tpu.parallel.halo).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {"series": len(devs)}
+    names = tuple(axes.keys())
+    shape = tuple(axes.values())
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh needs {n} devices, only {len(devs)} available")
+    return Mesh(np.asarray(devs[:n]).reshape(shape), names)
+
+
+def series_sharding(mesh: Mesh, ndim: int = 2, axis: str = "series") -> NamedSharding:
+    """NamedSharding that splits the leading (series) axis only."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def pad_series_axis(arr: np.ndarray, n_shards: int, fill) -> np.ndarray:
+    """Pad the leading axis to a multiple of ``n_shards`` so an [K, L]
+    batch divides evenly across the mesh.  Padded series are all-padding
+    rows; kernels already ignore them via validity masks — the analog of
+    Spark simply having some idle tasks."""
+    K = arr.shape[0]
+    rem = (-K) % n_shards
+    if rem == 0:
+        return arr
+    pad = np.full((rem,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def shard_series(arr, mesh: Mesh, axis: str = "series"):
+    """Place an array on the mesh sharded along its leading axis.
+
+    The host->device scatter this performs is the ingest boundary —
+    the equivalent of Spark's shuffle-on-partition-cols distributing
+    rows to executors.  On multi-host topologies the same call (with a
+    process-spanning mesh) rides DCN via
+    ``jax.make_array_from_process_local_data``-style placement.
+    """
+    return jax.device_put(arr, series_sharding(mesh, np.ndim(arr), axis))

@@ -1,0 +1,214 @@
+"""Distribution-layer tests on the virtual 8-device CPU mesh.
+
+The single-device kernels (already golden-tested against the reference
+semantics) are the oracle: every sharded path must reproduce them
+bit-for-bit.  This mirrors the reference's local-mode cluster
+simulation (python/tests/tsdf_tests.py:16-24) but actually executes the
+collectives on 8 XLA devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tempo_tpu.ops import asof as asof_ops
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.parallel import (
+    asof_time_sharded,
+    ema_time_sharded,
+    make_mesh,
+    pad_series_axis,
+    range_stats_time_sharded,
+    series_sharding,
+    shard_series,
+)
+from tempo_tpu.packing import TS_PAD
+
+
+def _ragged_batch(rng, K, L, density=0.8):
+    """Packed [K, L] sorted int64-second ts + float values + masks with
+    ragged lengths and some nulls."""
+    lengths = rng.integers(max(1, L // 2), L + 1, size=K)
+    ts = np.full((K, L), TS_PAD, dtype=np.int64)
+    x = np.zeros((K, L))
+    valid = np.zeros((K, L), dtype=bool)
+    row_valid = np.zeros((K, L), dtype=bool)
+    for k in range(K):
+        n = lengths[k]
+        t = np.sort(rng.integers(0, 500, size=n))
+        ts[k, :n] = t
+        x[k, :n] = rng.normal(size=n)
+        row_valid[k, :n] = True
+        valid[k, :n] = rng.random(n) < density
+    return ts, x, valid, row_valid
+
+
+class TestMesh:
+    def test_make_mesh_default(self):
+        mesh = make_mesh()
+        assert mesh.devices.size == 8
+        assert mesh.axis_names == ("series",)
+
+    def test_make_mesh_2d(self):
+        mesh = make_mesh({"series": 4, "time": 2})
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "series": 4, "time": 2,
+        }
+
+    def test_make_mesh_too_big(self):
+        with pytest.raises(ValueError, match="devices"):
+            make_mesh({"series": 64})
+
+    def test_pad_series_axis(self):
+        arr = np.arange(10).reshape(5, 2)
+        out = pad_series_axis(arr, 4, -1)
+        assert out.shape == (8, 2)
+        assert (out[5:] == -1).all()
+        assert pad_series_axis(arr, 5, -1).shape == (5, 2)
+
+    def test_shard_series_layout(self):
+        mesh = make_mesh()
+        arr = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+        sharded = shard_series(arr, mesh)
+        assert sharded.sharding == series_sharding(mesh, 2)
+        np.testing.assert_array_equal(np.asarray(sharded), arr)
+
+
+class TestSeriesShardedOps:
+    """Data-parallel path: sharding the K axis must not change results."""
+
+    def test_range_stats_series_sharded(self):
+        rng = np.random.default_rng(0)
+        ts, x, valid, _ = _ragged_batch(rng, 16, 64)
+        mesh = make_mesh()
+        ts_s = ts // 1  # already seconds
+        start, end = rk.range_window_bounds(jnp.asarray(ts_s), jnp.asarray(10))
+        ref = rk.windowed_stats(jnp.asarray(x), jnp.asarray(valid), start, end)
+
+        ts_d = shard_series(ts_s, mesh)
+        x_d, v_d = shard_series(x, mesh), shard_series(valid, mesh)
+        start_d, end_d = rk.range_window_bounds(ts_d, jnp.asarray(10))
+        got = rk.windowed_stats(x_d, v_d, start_d, end_d)
+        for k in ref:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(ref[k]), rtol=1e-12, atol=1e-12
+            )
+
+
+class TestTimeSharded:
+    """Sequence-parallel path: halo exchange over the time axis."""
+
+    def _mesh(self):
+        return make_mesh({"series": 2, "time": 4})
+
+    def test_range_stats_matches_single_device(self):
+        rng = np.random.default_rng(1)
+        K, L, W = 4, 64, 5
+        ts, x, valid, _ = _ragged_batch(rng, K, L)
+        # make windows narrow enough that halo=chunk covers them:
+        # chunk = 16 rows; W=5s over ts density ~n/500 keeps lookback tiny
+        mesh = self._mesh()
+        start, end = rk.range_window_bounds(jnp.asarray(ts), jnp.asarray(W))
+        ref = rk.windowed_stats(jnp.asarray(x), jnp.asarray(valid), start, end)
+
+        got, clipped = range_stats_time_sharded(
+            mesh, jnp.asarray(ts), jnp.asarray(x), jnp.asarray(valid),
+            float(W), halo=16,
+        )
+        for k in ref:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(ref[k]), rtol=1e-9, atol=1e-9,
+                err_msg=k,
+            )
+
+    def test_range_stats_clipped_audit(self):
+        # a window wider than the halo can cover -> clipped > 0
+        K, L = 2, 32
+        ts = np.tile(np.arange(L, dtype=np.int64), (K, 1))
+        x = np.ones((K, L))
+        valid = np.ones((K, L), dtype=bool)
+        mesh = self._mesh()
+        _, clipped = range_stats_time_sharded(
+            mesh, jnp.asarray(ts), jnp.asarray(x), jnp.asarray(valid),
+            1000.0, halo=2,
+        )
+        assert int(clipped) > 0
+
+    def test_ema_exact_matches_single_device(self):
+        rng = np.random.default_rng(2)
+        K, L = 4, 64
+        _, x, valid, _ = _ragged_batch(rng, K, L)
+        alpha = 0.2
+        ref = rk.ema_exact(jnp.asarray(x), jnp.asarray(valid), alpha)
+        got = ema_time_sharded(
+            self._mesh(), jnp.asarray(x), jnp.asarray(valid), alpha
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-12, atol=1e-12
+        )
+
+    def test_ema_time_axis_only_mesh(self):
+        rng = np.random.default_rng(3)
+        _, x, valid, _ = _ragged_batch(rng, 3, 32)
+        mesh = make_mesh({"time": 8})
+        got = ema_time_sharded(mesh, jnp.asarray(x), jnp.asarray(valid), 0.3)
+        ref = rk.ema_exact(jnp.asarray(x), jnp.asarray(valid), 0.3)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
+
+    def test_asof_matches_single_device(self):
+        rng = np.random.default_rng(4)
+        K, Ll, Lr = 4, 32, 32
+        l_ts, _, _, _ = _ragged_batch(rng, K, Ll)
+        r_ts, r_x, r_val, r_row = _ragged_batch(rng, K, Lr)
+        n_cols = 2
+        r_vals = np.stack([r_x, r_x * 2 + 1])
+        r_valids = np.stack([r_val, r_row])
+
+        # single-device oracle
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids), n_cols
+        )
+        found_ref = np.asarray(col_idx) >= 0
+        safe = np.maximum(np.asarray(col_idx), 0)
+        vals_ref = np.take_along_axis(r_vals, safe, axis=-1)
+        vals_ref = np.where(found_ref, vals_ref, np.nan)
+
+        # halo = full chunk width of the right side: with 4 time shards of
+        # 8 cols each, halo=8 gives each shard its full left-neighbor
+        # block; matches within one-bracket lookback
+        mesh = self._mesh()
+        got_vals, got_found, clipped = asof_time_sharded(
+            mesh, jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_row),
+            jnp.asarray(r_valids), jnp.asarray(r_vals), halo=8,
+        )
+        got_vals, got_found = np.asarray(got_vals), np.asarray(got_found)
+
+        # The kernel's contract (common time brackets) guarantees a match
+        # lies in the left row's shard or the halo of the one before; the
+        # random fixtures here don't enforce that, so compare only rows
+        # whose oracle match satisfies it (halo = full chunk) — the rest
+        # is exactly what the clipped audit exists to count.
+        chunk = Lr // 4
+        l_shard = np.broadcast_to(
+            np.arange(Ll)[None, :] // (Ll // 4), safe.shape
+        )
+        diff = l_shard - safe // chunk
+        in_contract = ~found_ref | ((diff >= 0) & (diff <= 1))
+        np.testing.assert_array_equal(got_found[in_contract], found_ref[in_contract])
+        np.testing.assert_allclose(
+            got_vals[in_contract & found_ref],
+            vals_ref[in_contract & found_ref],
+            rtol=1e-12,
+        )
+        assert int(clipped) >= 0
+
+    def test_halo_validation(self):
+        mesh = self._mesh()
+        ts = jnp.zeros((2, 32), jnp.int64)
+        x = jnp.zeros((2, 32))
+        v = jnp.ones((2, 32), bool)
+        with pytest.raises(ValueError, match="halo"):
+            range_stats_time_sharded(mesh, ts, x, v, 1.0, halo=99)
+        with pytest.raises(ValueError, match="divisible"):
+            range_stats_time_sharded(mesh, ts[:, :30], x[:, :30], v[:, :30], 1.0, halo=2)
