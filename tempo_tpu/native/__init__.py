@@ -1,0 +1,224 @@
+"""ctypes loader for the native C++ packing engine.
+
+Compiles ``packer.cpp`` on first use with the system ``g++`` (pybind11
+is not in this image; the C ABI + ctypes keeps the binding dependency-
+free) and exposes numpy-typed wrappers.  Falls back silently when the
+toolchain or the build is unavailable — ``available()`` gates every call
+site in :mod:`tempo_tpu.packing`.  Set ``TEMPO_TPU_NATIVE=0`` to force
+the pure-numpy path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "packer.cpp")
+_SO = os.path.join(_HERE, "_packer.so")
+
+_lib = None
+_tried = False
+
+N_THREADS = int(os.environ.get("TEMPO_TPU_NATIVE_THREADS", os.cpu_count() or 1))
+
+
+def _build() -> bool:
+    # compile to a per-process temp name, then atomically rename:
+    # concurrent first-use builds (pytest workers, multiple interpreters)
+    # must never install each other's half-written output
+    tmp = None
+    try:
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            _SRC, "-o", tmp,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:  # pragma: no cover
+        logger.info("native packer build failed, using numpy path: %s", e)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return False
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("TEMPO_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        # binary-only installs (no .cpp) load whatever .so is shipped;
+        # a read-only package dir falls through to the numpy path
+        have_src = os.path.exists(_SRC)
+        stale = not os.path.exists(_SO) or (
+            have_src and os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if stale and (not have_src or not _build()):
+            return None
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # pragma: no cover
+        logger.info("native packer load failed: %s", e)
+        return None
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    cp = ctypes.c_char_p
+    lib.tempo_sort_layout.argtypes = [
+        i64p, i64p, f64p, i64p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
+        ctypes.c_int,
+    ]
+    lib.tempo_take.argtypes = [
+        cp, i64p, ctypes.c_int64, ctypes.c_int64, cp, ctypes.c_int,
+    ]
+    lib.tempo_pack.argtypes = [
+        cp, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, cp, cp,
+        ctypes.c_int,
+    ]
+    lib.tempo_unpack.argtypes = [
+        cp, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, cp,
+        ctypes.c_int,
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f64p(a: Optional[np.ndarray]):
+    if a is None:
+        return ctypes.cast(None, ctypes.POINTER(ctypes.c_double))
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _bytes_ptr(a: np.ndarray):
+    return ctypes.cast(a.ctypes.data, ctypes.c_char_p)
+
+
+def sort_layout(
+    key_ids: np.ndarray, ts_ns: np.ndarray, seq: Optional[np.ndarray],
+    n_series: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(order, starts) for the (key, ts, seq) total order — the native
+    equivalent of ``np.lexsort((seq, ts_ns, key_ids))`` + bincount.
+    Integer sequence columns take the exact int64 comparator (values
+    above 2^53 must not round through float64)."""
+    lib = _load()
+    n = key_ids.shape[0]
+    key_ids = np.ascontiguousarray(key_ids, dtype=np.int64)
+    ts_ns = np.ascontiguousarray(ts_ns, dtype=np.int64)
+    if n and (int(key_ids.min()) < 0 or int(key_ids.max()) >= n_series):
+        # the C++ writes are unchecked; fault here like bincount would
+        raise IndexError(
+            f"key_ids out of range [0, {n_series}) for native sort_layout"
+        )
+    seq_f = seq_i = None
+    if seq is not None:
+        dt = np.asarray(seq).dtype
+        if np.issubdtype(dt, np.unsignedinteger):
+            # uint64 above 2^63 would wrap negative through int64; the
+            # dispatcher (packing._sort_layout) keeps those on numpy
+            seq_i = np.ascontiguousarray(seq.astype(np.int64))
+        elif np.issubdtype(dt, np.integer):
+            seq_i = np.ascontiguousarray(seq, dtype=np.int64)
+        else:
+            seq_f = np.ascontiguousarray(seq, dtype=np.float64)
+    order = np.empty(n, dtype=np.int64)
+    starts = np.empty(n_series + 1, dtype=np.int64)
+    lib.tempo_sort_layout(
+        _i64p(key_ids), _i64p(ts_ns), _f64p(seq_f),
+        _i64p(seq_i) if seq_i is not None else ctypes.cast(None, ctypes.POINTER(ctypes.c_int64)),
+        ctypes.c_int64(n), ctypes.c_int64(n_series),
+        _i64p(order), _i64p(starts), N_THREADS,
+    )
+    return order, starts
+
+
+def take(values: np.ndarray, order: np.ndarray) -> np.ndarray:
+    """``values[order]`` along axis 0; rows of an N-D array are gathered
+    whole (the per-item stride is itemsize x trailing dims)."""
+    lib = _load()
+    values = np.ascontiguousarray(values)
+    order = np.ascontiguousarray(order, dtype=np.int64)
+    if order.size and (
+        int(order.min()) < 0 or int(order.max()) >= values.shape[0]
+    ):
+        raise IndexError("order out of range for native take")
+    row_bytes = values.dtype.itemsize * int(np.prod(values.shape[1:], dtype=np.int64))
+    out = np.empty((order.shape[0],) + values.shape[1:], dtype=values.dtype)
+    lib.tempo_take(
+        _bytes_ptr(values), _i64p(order), ctypes.c_int64(order.shape[0]),
+        ctypes.c_int64(row_bytes), _bytes_ptr(out), N_THREADS,
+    )
+    return out
+
+
+def pack(
+    values_sorted: np.ndarray, starts: np.ndarray, padded_len: int, fill,
+) -> np.ndarray:
+    lib = _load()
+    values_sorted = np.ascontiguousarray(values_sorted)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    K = starts.shape[0] - 1
+    lengths = np.diff(starts)
+    if lengths.size and (int(lengths.min()) < 0 or int(lengths.max()) > padded_len):
+        # match the numpy scatter path, which faults on overflow rather
+        # than silently truncating rows
+        raise IndexError(
+            f"series lengths {int(lengths.min())}..{int(lengths.max())} "
+            f"invalid for padded_len {padded_len}"
+        )
+    if int(starts[-1]) > values_sorted.shape[0] or int(starts[0]) < 0:
+        raise ValueError(
+            f"starts[-1]={int(starts[-1])} exceeds values length "
+            f"{values_sorted.shape[0]}"
+        )
+    out = np.empty((K, padded_len), dtype=values_sorted.dtype)
+    fill_elem = np.asarray(fill, dtype=values_sorted.dtype).tobytes()
+    lib.tempo_pack(
+        _bytes_ptr(values_sorted), _i64p(starts), ctypes.c_int64(K),
+        ctypes.c_int64(padded_len), ctypes.c_int64(values_sorted.dtype.itemsize),
+        ctypes.c_char_p(fill_elem), _bytes_ptr(out), N_THREADS,
+    )
+    return out
+
+
+def unpack(packed: np.ndarray, starts: np.ndarray) -> np.ndarray:
+    lib = _load()
+    packed = np.ascontiguousarray(packed)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    K = starts.shape[0] - 1
+    lengths = np.diff(starts)
+    if lengths.size and (
+        int(lengths.min()) < 0 or int(lengths.max()) > packed.shape[1]
+    ):
+        raise IndexError("starts inconsistent with packed shape in native unpack")
+    n = int(starts[-1])
+    out = np.empty(n, dtype=packed.dtype)
+    lib.tempo_unpack(
+        _bytes_ptr(packed), _i64p(starts), ctypes.c_int64(K),
+        ctypes.c_int64(packed.shape[1]), ctypes.c_int64(packed.dtype.itemsize),
+        _bytes_ptr(out), N_THREADS,
+    )
+    return out

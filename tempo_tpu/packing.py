@@ -25,6 +25,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import pandas as pd
 
+from tempo_tpu import native
+
 NS_PER_S = 1_000_000_000
 
 # Sentinel used in padded slots of the time axis: larger than any real
@@ -32,6 +34,11 @@ NS_PER_S = 1_000_000_000
 # ignore padding.  We keep headroom so small arithmetic offsets cannot
 # overflow int64.
 TS_PAD = np.int64(2**62)
+
+# Any ts at or above this is a sentinel, not data (real ns timestamps
+# stay far below 2^61 ≈ year 2043 in ns); window/halo kernels use it to
+# tell real rows from padding with headroom on both sides.
+TS_REAL_MAX = np.int64(2**61)
 
 
 def series_to_ns(values: "pd.Series | np.ndarray") -> np.ndarray:
@@ -148,24 +155,53 @@ def build_flat_layout(
 ) -> FlatLayout:
     key_ids, key_frame = encode_keys(df, partition_cols)
     ts_ns = series_to_ns(df[ts_col])
-    if sequence_col:
-        seq = pd.to_numeric(df[sequence_col]).to_numpy()
-        order = np.lexsort((seq, ts_ns, key_ids))
-    else:
-        order = np.lexsort((ts_ns, key_ids))
-    key_sorted = key_ids[order]
-    ts_sorted = ts_ns[order]
+    # keep integer sequence columns exact: int64 ids above 2^53 must not
+    # round through float64 before the tie-break sort
+    seq = pd.to_numeric(df[sequence_col]).to_numpy() if sequence_col else None
     n_series = len(key_frame)
-    counts = np.bincount(key_sorted, minlength=n_series)
-    starts = np.zeros(n_series + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
+    order, starts = _sort_layout(key_ids, ts_ns, seq, n_series)
     return FlatLayout(
-        key_ids=key_sorted,
-        ts_ns=ts_sorted,
+        key_ids=take(key_ids, order),
+        ts_ns=take(ts_ns, order),
         order=order,
         starts=starts,
         key_frame=key_frame,
     )
+
+
+def _sort_layout(
+    key_ids: np.ndarray,
+    ts_ns: np.ndarray,
+    seq: Optional[np.ndarray],
+    n_series: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(order, starts) of the (key, ts, seq) total order; dispatches to
+    the C++ engine (tempo_tpu/native) when built, numpy otherwise."""
+    native_ok = native.available()
+    if native_ok and seq is not None:
+        dt = np.asarray(seq).dtype
+        if np.issubdtype(dt, np.unsignedinteger):
+            # uint64 ids above 2^63 would wrap negative through the C
+            # ABI's int64; keep those on the exact numpy path
+            native_ok = seq.size == 0 or int(seq.max()) <= np.iinfo(np.int64).max
+    if native_ok:
+        return native.sort_layout(key_ids, ts_ns, seq, n_series)
+    if seq is not None:
+        order = np.lexsort((seq, ts_ns, key_ids))
+    else:
+        order = np.lexsort((ts_ns, key_ids))
+    counts = np.bincount(key_ids, minlength=n_series)
+    starts = np.zeros(n_series + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    return order, starts
+
+
+def take(values: np.ndarray, order: np.ndarray) -> np.ndarray:
+    """``values[order]`` through the multithreaded native gather when the
+    engine is built and the dtype has a fixed itemsize."""
+    if values.dtype != object and native.available():
+        return native.take(values, order)
+    return values[order]
 
 
 def build_layout_from_codes(
@@ -176,17 +212,10 @@ def build_layout_from_codes(
 ) -> FlatLayout:
     """Like :func:`build_flat_layout` but with externally-assigned series
     ids (joint join encodings, skew bracket composition)."""
-    if seq is not None:
-        order = np.lexsort((seq, ts_ns, key_ids))
-    else:
-        order = np.lexsort((ts_ns, key_ids))
-    key_sorted = key_ids[order]
-    counts = np.bincount(key_sorted, minlength=n_series)
-    starts = np.zeros(n_series + 1, dtype=np.int64)
-    np.cumsum(counts, out=starts[1:])
+    order, starts = _sort_layout(key_ids, ts_ns, seq, n_series)
     return FlatLayout(
-        key_ids=key_sorted,
-        ts_ns=ts_ns[order],
+        key_ids=take(key_ids, order),
+        ts_ns=take(ts_ns, order),
         order=order,
         starts=starts,
         key_frame=pd.DataFrame(index=range(n_series)),
@@ -209,6 +238,8 @@ def pack_column(
     """Scatter a flat (already key/ts-sorted) column into [K, L] dense form."""
     if padded_len is None:
         padded_len = pad_length(int(layout.lengths.max(initial=0)))
+    if values.dtype != object and native.available():
+        return native.pack(values, layout.starts, int(padded_len), fill)
     K = layout.n_series
     out = np.full((K, padded_len), fill, dtype=values.dtype)
     pos = np.arange(layout.n_rows, dtype=np.int64) - layout.starts[layout.key_ids]
@@ -218,6 +249,8 @@ def pack_column(
 
 def unpack_column(packed: np.ndarray, layout: FlatLayout) -> np.ndarray:
     """Gather [K, L] padded form back into the sorted flat layout."""
+    if packed.dtype != object and native.available():
+        return native.unpack(packed, layout.starts)
     pos = np.arange(layout.n_rows, dtype=np.int64) - layout.starts[layout.key_ids]
     return packed[layout.key_ids, pos]
 

@@ -1,0 +1,159 @@
+"""Native C++ packing engine vs the numpy reference path.
+
+The native engine (tempo_tpu/native/packer.cpp) reproduces the exact
+(key, ts, seq) total order of numpy ``lexsort`` — including NaN
+sequence values sorting last and stable tie-breaks — plus the padded
+pack/unpack round-trip.  These are the invariants every kernel relies
+on (SURVEY.md §7 step 1)."""
+
+import numpy as np
+import pytest
+
+from tempo_tpu import native, packing
+
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native packer unavailable"
+)
+
+
+def _random_inputs(rng, n, n_keys, with_seq, with_ties):
+    key_ids = rng.integers(0, n_keys, size=n).astype(np.int64)
+    if with_ties:
+        ts = rng.integers(0, max(n // 4, 2), size=n).astype(np.int64)
+    else:
+        ts = rng.permutation(n).astype(np.int64)
+    seq = None
+    if with_seq:
+        seq = rng.standard_normal(n)
+        seq[rng.random(n) < 0.2] = np.nan  # Spark nulls -> NaN, sorts last
+    return key_ids, ts, seq
+
+
+@needs_native
+@pytest.mark.parametrize("with_seq", [False, True])
+@pytest.mark.parametrize("with_ties", [False, True])
+def test_sort_layout_matches_lexsort(with_seq, with_ties):
+    rng = np.random.default_rng(42)
+    for trial in range(5):
+        n, n_keys = int(rng.integers(1, 500)), int(rng.integers(1, 12))
+        key_ids, ts, seq = _random_inputs(rng, n, n_keys, with_seq, with_ties)
+        got_order, got_starts = native.sort_layout(key_ids, ts, seq, n_keys)
+        if seq is not None:
+            want_order = np.lexsort((seq, ts, key_ids))
+        else:
+            want_order = np.lexsort((ts, key_ids))
+        counts = np.bincount(key_ids, minlength=n_keys)
+        want_starts = np.concatenate([[0], np.cumsum(counts)])
+        np.testing.assert_array_equal(got_order, want_order)
+        np.testing.assert_array_equal(got_starts, want_starts)
+
+
+@needs_native
+def test_sort_layout_empty_and_single():
+    order, starts = native.sort_layout(
+        np.zeros(0, np.int64), np.zeros(0, np.int64), None, 3
+    )
+    assert order.shape == (0,)
+    np.testing.assert_array_equal(starts, [0, 0, 0, 0])
+    order, starts = native.sort_layout(
+        np.array([1], np.int64), np.array([7], np.int64), None, 2
+    )
+    np.testing.assert_array_equal(order, [0])
+    np.testing.assert_array_equal(starts, [0, 0, 1])
+
+
+@needs_native
+@pytest.mark.parametrize(
+    "dtype,fill",
+    [
+        (np.float32, np.nan),
+        (np.float64, np.nan),
+        (np.int64, packing.TS_PAD),
+        (np.bool_, False),
+        ("datetime64[ns]", np.datetime64("NaT")),
+    ],
+)
+def test_pack_unpack_roundtrip(dtype, fill):
+    rng = np.random.default_rng(7)
+    n, n_keys = 333, 9
+    key_ids = np.sort(rng.integers(0, n_keys, size=n)).astype(np.int64)
+    counts = np.bincount(key_ids, minlength=n_keys)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    L = packing.pad_length(int(counts.max()))
+    vals = rng.integers(0, 1000, size=n).astype(dtype)
+    packed = native.pack(vals, starts, L, fill)
+    assert packed.shape == (n_keys, L)
+    # padding slots carry the fill value
+    for k in range(n_keys):
+        pad = packed[k, counts[k]:]
+        if np.issubdtype(packed.dtype, np.floating):
+            assert np.isnan(pad).all()
+        elif np.issubdtype(packed.dtype, np.datetime64):
+            assert np.isnat(pad).all()
+        else:
+            np.testing.assert_array_equal(
+                pad, np.full(L - counts[k], fill, dtype=packed.dtype)
+            )
+    back = native.unpack(packed, starts)
+    np.testing.assert_array_equal(back, vals)
+
+
+@needs_native
+def test_sort_layout_int64_seq_exact():
+    """Sequence ids above 2^53 must keep exact integer ordering — they
+    collide when rounded through float64 (regression)."""
+    base = 1_700_000_000_000_000_000
+    seq = np.array([base + 2, base + 1, base + 3], dtype=np.int64)
+    key_ids = np.zeros(3, dtype=np.int64)
+    ts = np.zeros(3, dtype=np.int64)  # full tie on (key, ts)
+    order, _ = native.sort_layout(key_ids, ts, seq, 1)
+    np.testing.assert_array_equal(order, [1, 0, 2])
+    # and through the packing dispatcher
+    order2, _ = packing._sort_layout(key_ids, ts, seq, 1)
+    np.testing.assert_array_equal(order2, [1, 0, 2])
+
+
+@needs_native
+def test_pack_overflow_raises():
+    """A series longer than padded_len must fault like the numpy scatter
+    does, not silently truncate (regression)."""
+    starts = np.array([0, 5], dtype=np.int64)
+    vals = np.arange(5, dtype=np.float64)
+    with pytest.raises(IndexError, match="padded_len"):
+        native.pack(vals, starts, 3, np.nan)
+
+
+@needs_native
+def test_take_matches_fancy_index():
+    rng = np.random.default_rng(3)
+    vals = rng.standard_normal(100).astype(np.float32)
+    order = rng.permutation(100).astype(np.int64)
+    np.testing.assert_array_equal(native.take(vals, order), vals[order])
+
+
+def test_packing_dispatch_equivalence(monkeypatch):
+    """build_flat_layout gives identical layouts with the engine on/off."""
+    import pandas as pd
+
+    rng = np.random.default_rng(11)
+    n = 400
+    df = pd.DataFrame({
+        "k": rng.integers(0, 7, size=n).astype(str),
+        "ts": pd.to_datetime(rng.integers(0, 10**6, size=n), unit="s"),
+        "seq": rng.integers(0, 5, size=n).astype(float),
+        "x": rng.standard_normal(n),
+    })
+    layouts = {}
+    for flag in ("1", "0"):
+        monkeypatch.setattr(native, "_tried", False)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setenv("TEMPO_TPU_NATIVE", flag)
+        layouts[flag] = packing.build_flat_layout(df, "ts", ["k"], "seq")
+    a, b = layouts["1"], layouts["0"]
+    np.testing.assert_array_equal(a.order, b.order)
+    np.testing.assert_array_equal(a.starts, b.starts)
+    np.testing.assert_array_equal(a.ts_ns, b.ts_ns)
+    # restore
+    monkeypatch.setattr(native, "_tried", False)
+    monkeypatch.setattr(native, "_lib", None)
