@@ -59,10 +59,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tempo_tpu.ops import asof as asof_ops
 from tempo_tpu.ops import rolling as rk
 
+from tempo_tpu.packing import TS_PAD, TS_REAL_MAX
+
 # sentinel smaller than any real ns timestamp, with headroom so
-# subtracting a window width cannot underflow int64 (TS_PAD is +2^62,
-# see tempo_tpu.packing)
-TS_NEG = np.int64(-(2**61))
+# subtracting a window width cannot underflow int64 (mirror of TS_PAD)
+TS_NEG = np.int64(-TS_REAL_MAX)
+# right-halo fill on the last shard: larger than any real timestamp so
+# the extended row stays sorted and no window ever includes it — the
+# same sentinel packed rows already use for padding
+TS_POS = TS_PAD
 
 
 def _specs(mesh: Mesh, ndim: int, time_axis: str, series_axis: str):
@@ -85,6 +90,23 @@ def _halo_from_left(
     recv = jax.lax.ppermute(tail, time_axis, perm)
     ti = jax.lax.axis_index(time_axis)
     return jnp.where(ti == 0, jnp.full_like(tail, fill), recv)
+
+
+def _halo_from_right(
+    arr: jnp.ndarray, halo: int, n_shards: int, time_axis: str, fill
+) -> jnp.ndarray:
+    """Return this shard's right halo: the first ``halo`` columns of the
+    right neighbor's block (``fill`` on the last shard).  Needed because
+    a Spark range window's frame includes *following* rows that share
+    the current row's order-key value (see range_window_bounds), and
+    such ties can straddle a shard boundary."""
+    head = arr[..., :halo]
+    if n_shards == 1:
+        return jnp.full_like(head, fill)
+    perm = [(i + 1, i) for i in range(n_shards - 1)]
+    recv = jax.lax.ppermute(head, time_axis, perm)
+    ti = jax.lax.axis_index(time_axis)
+    return jnp.where(ti == n_shards - 1, jnp.full_like(head, fill), recv)
 
 
 def _check_halo(mesh: Mesh, L: int, halo: int, time_axis: str) -> int:
@@ -117,21 +139,35 @@ def range_stats_time_sharded(
     n_time = _check_halo(mesh, int(ts_long.shape[-1]), halo, time_axis)
 
     def kernel(ts_l, x_l, v_l):
+        # left halo (lookback history) + right halo (following rows that
+        # tie on the order key - Spark's range frame includes them, see
+        # range_window_bounds' upper_bound end)
         h_ts = _halo_from_left(ts_l, halo, n_time, time_axis, TS_NEG)
         h_x = _halo_from_left(x_l, halo, n_time, time_axis, jnp.zeros((), x_l.dtype))
         h_v = _halo_from_left(v_l, halo, n_time, time_axis, False)
-        # device-0 halo fill is TS_NEG so the extended row stays sorted
-        ext_ts = jnp.concatenate([h_ts, ts_l], axis=-1)
-        ext_x = jnp.concatenate([h_x, x_l], axis=-1)
-        ext_v = jnp.concatenate([h_v, v_l], axis=-1)
+        r_ts = _halo_from_right(ts_l, halo, n_time, time_axis, TS_POS)
+        r_x = _halo_from_right(x_l, halo, n_time, time_axis, jnp.zeros((), x_l.dtype))
+        r_v = _halo_from_right(v_l, halo, n_time, time_axis, False)
+        # TS_NEG / TS_POS fills keep the extended row sorted end to end
+        ext_ts = jnp.concatenate([h_ts, ts_l, r_ts], axis=-1)
+        ext_x = jnp.concatenate([h_x, x_l, r_x], axis=-1)
+        ext_v = jnp.concatenate([h_v, v_l, r_v], axis=-1)
+        L_ext = ext_ts.shape[-1]
+        Ll = ts_l.shape[-1]
 
         start, end = rk.range_window_bounds(ext_ts, jnp.asarray(window_secs))
         stats = rk.windowed_stats(ext_x, ext_v, start, end)
-        out = {k: v[..., halo:] for k, v in stats.items()}
+        out = {k: v[..., halo:halo + Ll] for k, v in stats.items()}
 
         ti = jax.lax.axis_index(time_axis)
+        # audit both truncation sides: lookback fell off the left halo,
+        # or the tie run continued past the right halo
+        s_loc = start[..., halo:halo + Ll]
+        e_loc = end[..., halo:halo + Ll]
         local_clip = jnp.sum(
-            (start[..., halo:] == 0) & v_l & (ti > 0), dtype=jnp.int32
+            ((s_loc == 0) & v_l & (ti > 0))
+            | ((e_loc == L_ext) & v_l & (ti < n_time - 1)),
+            dtype=jnp.int32,
         )
         axes = (time_axis, series_axis) if series_axis in mesh.axis_names else (time_axis,)
         clipped = jax.lax.psum(local_clip, axes)
@@ -235,14 +271,23 @@ def asof_time_sharded(
         raise ValueError(f"left time axis {l_ts.shape[-1]} not divisible by {n_time}")
 
     def kernel(lts, rts, rrow, rval, rx):
+        # left halo: lookback history.  Right halo: right rows in the
+        # next shard that tie a left row's timestamp are the true AS-OF
+        # match (last right row with r_ts <= l_ts — equal ts included,
+        # tsdf.py:111-162), and a tie run can straddle the boundary.
         h_ts = _halo_from_left(rts, halo, n_time, time_axis, TS_NEG)
         h_row = _halo_from_left(rrow, halo, n_time, time_axis, False)
         h_val = _halo_from_left(rval, halo, n_time, time_axis, False)
         h_x = _halo_from_left(rx, halo, n_time, time_axis, jnp.zeros((), rx.dtype))
-        ext_ts = jnp.concatenate([h_ts, rts], axis=-1)
-        ext_row = jnp.concatenate([h_row, rrow], axis=-1)
-        ext_val = jnp.concatenate([h_val, rval], axis=-1)
-        ext_x = jnp.concatenate([h_x, rx], axis=-1)
+        g_ts = _halo_from_right(rts, halo, n_time, time_axis, TS_POS)
+        g_row = _halo_from_right(rrow, halo, n_time, time_axis, False)
+        g_val = _halo_from_right(rval, halo, n_time, time_axis, False)
+        g_x = _halo_from_right(rx, halo, n_time, time_axis, jnp.zeros((), rx.dtype))
+        ext_ts = jnp.concatenate([h_ts, rts, g_ts], axis=-1)
+        ext_row = jnp.concatenate([h_row, rrow, g_row], axis=-1)
+        ext_val = jnp.concatenate([h_val, rval, g_val], axis=-1)
+        ext_x = jnp.concatenate([h_x, rx, g_x], axis=-1)
+        L_ext = ext_ts.shape[-1]
 
         last_idx, col_idx = asof_ops.asof_indices_searchsorted(
             lts, ext_ts, ext_val, n_cols
@@ -252,13 +297,18 @@ def asof_time_sharded(
         vals = jnp.take_along_axis(ext_x, safe, axis=-1)
         vals = jnp.where(found, vals, jnp.nan)
 
-        # audit: left rows whose row-level match fell off the halo
+        # audit: left rows whose row-level match fell off the left halo,
+        # or whose tie run may continue past the right halo
         row_found = (last_idx >= 0) & jnp.take_along_axis(
             ext_row, jnp.maximum(last_idx, 0), axis=-1
         )
-        l_real = lts < np.int64(2**61)  # not TS_PAD padding
+        l_real = lts < TS_REAL_MAX  # not TS_PAD padding
         ti = jax.lax.axis_index(time_axis)
-        local_clip = jnp.sum(~row_found & l_real & (ti > 0), dtype=jnp.int32)
+        local_clip = jnp.sum(
+            (~row_found & l_real & (ti > 0))
+            | ((last_idx == L_ext - 1) & l_real & (ti < n_time - 1)),
+            dtype=jnp.int32,
+        )
         axes = (time_axis, series_axis) if series_axis in mesh.axis_names else (time_axis,)
         clipped = jax.lax.psum(local_clip, axes)
         return vals, found, clipped

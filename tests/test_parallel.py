@@ -203,6 +203,68 @@ class TestTimeSharded:
         )
         assert int(clipped) >= 0
 
+    def test_range_stats_boundary_ties(self):
+        """Equal timestamps straddling a shard boundary: Spark's range
+        frame includes *following* rows that tie on the order key, so the
+        right-halo exchange must pick them up (regression: previously
+        diverged silently with clipped == 0)."""
+        K, L = 2, 32
+        ts = np.tile(np.arange(L, dtype=np.int64), (K, 1))
+        # duplicate run straddling the shard-0/shard-1 boundary (chunk=8)
+        ts[:, 6:10] = 7
+        ts = np.sort(ts, axis=-1)
+        x = np.arange(K * L, dtype=np.float64).reshape(K, L)
+        valid = np.ones((K, L), dtype=bool)
+        W = 3
+        start, end = rk.range_window_bounds(jnp.asarray(ts), jnp.asarray(W))
+        ref = rk.windowed_stats(jnp.asarray(x), jnp.asarray(valid), start, end)
+        got, clipped = range_stats_time_sharded(
+            self._mesh(), jnp.asarray(ts), jnp.asarray(x),
+            jnp.asarray(valid), float(W), halo=8,
+        )
+        for k in ref:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(ref[k]), rtol=1e-12,
+                atol=1e-12, err_msg=k,
+            )
+        assert int(clipped) == 0
+
+    def test_asof_boundary_ties(self):
+        """Right rows tying a left timestamp at the start of the next
+        shard are the true AS-OF match (last r_ts <= l_ts includes equal
+        ts); the right-halo exchange must reach them (regression)."""
+        K, L = 2, 32
+        r_ts = np.tile(np.arange(L, dtype=np.int64), (K, 1))
+        r_ts[:, 5:10] = 7  # tie run straddling the chunk=8 boundary
+        r_ts = np.sort(r_ts, axis=-1)
+        l_ts = r_ts.copy()
+        r_x = np.arange(K * L, dtype=np.float64).reshape(K, L)
+        r_row = np.ones((K, L), dtype=bool)
+        # one column with earlier ties nulled so the per-column match
+        # must come from the next shard's leading tie rows
+        v0 = np.ones((K, L), dtype=bool)
+        v0[:, 5:8] = False
+        r_valids = np.stack([v0, r_row])
+        r_vals = np.stack([r_x, r_x * 3 + 1])
+
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids), 2
+        )
+        found_ref = np.asarray(col_idx) >= 0
+        safe = np.maximum(np.asarray(col_idx), 0)
+        vals_ref = np.take_along_axis(r_vals, safe, axis=-1)
+        vals_ref = np.where(found_ref, vals_ref, np.nan)
+
+        got_vals, got_found, clipped = asof_time_sharded(
+            self._mesh(), jnp.asarray(l_ts), jnp.asarray(r_ts),
+            jnp.asarray(r_row), jnp.asarray(r_valids), jnp.asarray(r_vals),
+            halo=8,
+        )
+        np.testing.assert_array_equal(np.asarray(got_found), found_ref)
+        np.testing.assert_allclose(
+            np.asarray(got_vals)[found_ref], vals_ref[found_ref], rtol=1e-12
+        )
+
     def test_halo_validation(self):
         mesh = self._mesh()
         ts = jnp.zeros((2, 32), jnp.int64)
