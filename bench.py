@@ -1,0 +1,103 @@
+"""Headline benchmark: fused AS-OF join + 10s range stats + EMA.
+
+Mirrors BASELINE.json configs 1-3 (quickstart phone<->watch asofJoin,
+withRangeStats 10s rolling mean/stddev, EMA) as one fused jitted program
+on packed [K, L] series.  The reference publishes no numbers
+(BASELINE.md) and pyspark is not installed in this image, so the
+denominator is the strongest available single-node CPU oracle for the
+same op set: pandas ``merge_asof(by=key)`` + groupby-rolling('10s')
+mean/std + groupby ewm — measured here on a subsample and scaled.
+Pandas local is faster than Spark local-mode per row, so ``vs_baseline``
+is a *conservative* stand-in for the >=20x-vs-Spark-local north star.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import tempo_tpu  # noqa: F401
+import jax
+
+from __graft_entry__ import N_RIGHT_COLS, _forward_step
+
+K = 1024          # series (partition keys)
+L = 8192          # rows per series  -> 8.4M left rows per step
+SUB_K = 32        # series subsample for the pandas oracle
+ITERS = 5
+
+
+def make_data(seed=0):
+    rng = np.random.default_rng(seed)
+    # ~1 event/sec with jitter, like the accelerometer quickstart data
+    gaps = rng.integers(1, 3, size=(K, L)).astype(np.int64)
+    l_secs = np.cumsum(gaps, axis=-1)
+    l_ts = l_secs * np.int64(1_000_000_000)
+    r_secs = np.cumsum(rng.integers(1, 3, size=(K, L)).astype(np.int64), axis=-1)
+    r_ts = r_secs * np.int64(1_000_000_000)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = np.ones((K, L), dtype=bool)
+    r_values = rng.standard_normal((N_RIGHT_COLS, K, L)).astype(np.float32)
+    r_valids = rng.random((N_RIGHT_COLS, K, L)) > 0.1
+    return l_ts, l_secs, x, valid, r_ts, r_valids, r_values
+
+
+def bench_tpu(data):
+    args = [jax.device_put(a) for a in data]
+    fn = jax.jit(_forward_step)
+    jax.block_until_ready(fn(*args))          # compile + warmup
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return (K * L) / float(np.median(times))
+
+
+def bench_pandas(data):
+    import pandas as pd
+
+    l_ts, l_secs, x, valid, r_ts, r_valids, r_values = data
+    ks = np.repeat(np.arange(SUB_K), L)
+    left = pd.DataFrame({
+        "key": ks,
+        "ts": pd.to_datetime(l_ts[:SUB_K].ravel()),
+        "x": x[:SUB_K].ravel().astype(np.float64),
+    })
+    rv = [np.where(r_valids[c, :SUB_K], r_values[c, :SUB_K], np.nan).ravel()
+          for c in range(N_RIGHT_COLS)]
+    right = pd.DataFrame({
+        "key": ks,
+        "ts": pd.to_datetime(r_ts[:SUB_K].ravel()),
+        **{f"v{c}": rv[c] for c in range(N_RIGHT_COLS)},
+    })
+    left = left.sort_values(["ts", "key"], kind="stable")
+    right = right.sort_values(["ts", "key"], kind="stable")
+
+    t0 = time.perf_counter()
+    joined = pd.merge_asof(left, right, on="ts", by="key")
+    g = joined.sort_values(["key", "ts"]).set_index("ts").groupby("key")["x"]
+    roll = g.rolling("10s")
+    _ = roll.mean()
+    _ = roll.std()
+    _ = joined.groupby("key")["x"].transform(lambda s: s.ewm(alpha=0.2).mean())
+    dt = time.perf_counter() - t0
+    return (SUB_K * L) / dt
+
+
+def main():
+    data = make_data()
+    tpu_rows_sec = bench_tpu(data)
+    cpu_rows_sec = bench_pandas(data)
+    print(json.dumps({
+        "metric": "asof_join+range_stats+ema rows/sec (1 chip)",
+        "value": round(tpu_rows_sec),
+        "unit": "rows/sec",
+        "vs_baseline": round(tpu_rows_sec / cpu_rows_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
