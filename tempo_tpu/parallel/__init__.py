@@ -29,6 +29,12 @@ from tempo_tpu.parallel.halo import (
     asof_time_sharded,
     ema_time_sharded,
 )
+from tempo_tpu.parallel.multihost import (
+    distributed_init,
+    process_mesh,
+    process_series_range,
+    shard_series_global,
+)
 
 __all__ = [
     "make_mesh",
@@ -38,4 +44,8 @@ __all__ = [
     "range_stats_time_sharded",
     "asof_time_sharded",
     "ema_time_sharded",
+    "distributed_init",
+    "process_mesh",
+    "process_series_range",
+    "shard_series_global",
 ]

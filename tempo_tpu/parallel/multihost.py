@@ -1,0 +1,131 @@
+"""Multi-host ingest and process-spanning meshes (DCN boundary).
+
+The reference's multi-node story is Spark's: the driver holds a logical
+plan and executors pull shuffled row partitions over the network
+(SURVEY.md §5 "Distributed communication backend").  The TPU-native
+equivalent splits that into two planes:
+
+* **control/ingest (DCN)** — each host process packs the series it
+  owns (``process_series_range``) and assembles a global ``jax.Array``
+  with :func:`jax.make_array_from_process_local_data`; XLA moves bytes
+  host->device locally, and cross-host traffic only happens if a
+  subsequent op reshards.
+* **compute (ICI)** — once arrays are global, every collective in
+  tempo_tpu.parallel.halo (ppermute halos, psum audits, all_gather EMA
+  carries) rides the ICI mesh exactly as in single-host mode; nothing
+  in the kernels changes.
+
+Single-process runs (tests, one-chip benches) degrade to plain
+``device_put`` so every code path here is exercised by the CPU-mesh
+test suite.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise JAX's multi-process runtime (idempotent, no-op when
+    single-process).  The moral analog of standing up the Spark cluster
+    (scala/.../utils/SparkSessionWrapper.scala:12-37 chooses local vs
+    cluster master); here the coordinator bootstraps over DCN."""
+    if num_processes is None or num_processes <= 1:
+        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None and is_init():
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # older jax has no is_initialized(); a double call raises here
+        if "once" not in str(e):
+            raise
+
+
+def process_mesh(axes: Optional[dict] = None) -> Mesh:
+    """Mesh over ALL devices in the job (every process), leading axis
+    'series' by default.  ``make_mesh`` already builds from the global
+    ``jax.devices()``; this alias exists so multi-host call sites read
+    explicitly."""
+    from tempo_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axes)
+
+
+def process_series_range(n_series: int, mesh: Mesh, axis: str = "series") -> Tuple[int, int]:
+    """[start, stop) of the series rows THIS process must supply for a
+    [K, ...] array sharded over ``axis``.
+
+    This is the ingest routing rule — the analog of Spark's hash
+    partitioner deciding which executor holds which keys (tsdf.py:121),
+    made static: contiguous series blocks per shard, shards laid out in
+    mesh order.  Callers pack only their slice and hand it to
+    :func:`shard_series_global`.
+    """
+    n_shards = mesh.shape[axis]
+    if n_series % n_shards != 0:
+        raise ValueError(
+            f"n_series {n_series} not divisible by '{axis}' axis {n_shards}; "
+            "pad with pad_series_axis first"
+        )
+    block = n_series // n_shards
+    # A process owns series-shard i if ANY of its devices sits in the
+    # mesh slice with series-index i: other mesh axes replicate the
+    # series block (P(axis, None, ...)), so every process holding a
+    # replica must supply the same local rows to
+    # make_array_from_process_local_data.
+    ax = mesh.axis_names.index(axis)
+    devs = np.moveaxis(np.asarray(mesh.devices), ax, 0).reshape(n_shards, -1)
+    me = jax.process_index()
+    mine = [
+        i for i in range(n_shards)
+        if any(d.process_index == me for d in devs[i])
+    ]
+    if not mine:
+        return 0, 0
+    lo, hi = min(mine), max(mine)
+    if mine != list(range(lo, hi + 1)):  # pragma: no cover - exotic meshes
+        raise ValueError(
+            "series axis devices of this process are not contiguous; "
+            "use a process-major mesh layout"
+        )
+    return lo * block, (hi + 1) * block
+
+
+def shard_series_global(
+    local_rows: np.ndarray, mesh: Mesh, n_series: int, axis: str = "series"
+):
+    """Assemble a global [n_series, ...] jax.Array from each process's
+    local series block (the rows ``process_series_range`` assigned it).
+
+    Single-process: equivalent to ``device_put`` with a series
+    NamedSharding.  Multi-process: wraps
+    ``jax.make_array_from_process_local_data`` so ingest stays on the
+    host-local DCN path — no host ever materialises the full array.
+    """
+    spec = P(axis, *([None] * (local_rows.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (n_series,) + tuple(local_rows.shape[1:])
+    if jax.process_count() == 1:
+        if local_rows.shape[0] != n_series:
+            raise ValueError(
+                f"single-process ingest expects all {n_series} series, "
+                f"got {local_rows.shape[0]}"
+            )
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, global_shape
+    )

@@ -1,0 +1,57 @@
+"""Multi-host ingest helpers on the single-process 8-device mesh.
+
+Single-process degrades to device_put; the routing math
+(process_series_range) and global-assembly path are what multi-host
+runs rely on, so they are pinned here."""
+
+import jax
+import numpy as np
+import pytest
+
+from tempo_tpu.parallel import (
+    distributed_init,
+    make_mesh,
+    process_mesh,
+    process_series_range,
+    series_sharding,
+    shard_series_global,
+)
+
+
+def test_distributed_init_noop():
+    distributed_init()  # single process: must be a no-op
+    distributed_init(num_processes=1)
+
+
+def test_process_mesh_matches_make_mesh():
+    mesh = process_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == ("series",)
+    mesh2 = process_mesh({"series": 4, "time": 2})
+    assert dict(zip(mesh2.axis_names, mesh2.devices.shape)) == {
+        "series": 4, "time": 2,
+    }
+
+
+def test_process_series_range_single_process():
+    mesh = make_mesh({"series": 8})
+    lo, hi = process_series_range(64, mesh)
+    # one process owns every shard -> full range
+    assert (lo, hi) == (0, 64)
+    with pytest.raises(ValueError, match="divisible"):
+        process_series_range(63, mesh)
+
+
+def test_process_series_range_2d_mesh():
+    mesh = make_mesh({"series": 4, "time": 2})
+    assert process_series_range(32, mesh) == (0, 32)
+
+
+def test_shard_series_global_roundtrip():
+    mesh = make_mesh({"series": 8})
+    arr = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    out = shard_series_global(arr, mesh, 16)
+    assert out.sharding == series_sharding(mesh, 2)
+    np.testing.assert_array_equal(np.asarray(out), arr)
+    with pytest.raises(ValueError, match="expects all"):
+        shard_series_global(arr[:8], mesh, 16)
