@@ -31,18 +31,10 @@ from typing import List, Optional
 import numpy as np
 import pandas as pd
 
-from tempo_tpu import packing
+from tempo_tpu import packing, profiling
 from tempo_tpu.ops import asof as asof_ops
 
 logger = logging.getLogger(__name__)
-
-BROADCAST_BYTES_THRESHOLD = 30 * 1024 * 1024  # tsdf.py:491
-
-
-def _estimate_bytes(df: pd.DataFrame) -> int:
-    """Size probe: the packed-columnar analog of the reference's
-    ``explain cost`` sizeInBytes regex scrape (tsdf.py:433-461)."""
-    return int(df.memory_usage(deep=True).sum())
 
 
 def _prefixed(cols: List[str], prefix: Optional[str]) -> dict:
@@ -108,10 +100,12 @@ def asof_join(
 ):
     from tempo_tpu.frame import TSDF
 
-    broadcast_path = sql_join_opt and (
-        (_estimate_bytes(left.df) < BROADCAST_BYTES_THRESHOLD)
-        or (_estimate_bytes(right.df) < BROADCAST_BYTES_THRESHOLD)
+    strategy = profiling.pick_asof_strategy(
+        left.df, right.df, sql_join_opt,
+        has_sequence=bool(right.sequence_col),
+        max_lookback=int(maxLookback or 0),
     )
+    broadcast_path = strategy == "broadcast"
 
     if tsPartitionVal is not None:
         if not skipNulls:
@@ -193,7 +187,7 @@ def asof_join(
     r_valids = np.stack(r_valid_packed) if r_valid_packed else np.zeros((0, n_series, Lr), bool)
 
     # --- kernel dispatch ----------------------------------------------
-    use_merge = bool(right.sequence_col) or (maxLookback and maxLookback > 0)
+    use_merge = strategy == "merge"
     if broadcast_path:
         idx, matched = asof_ops.asof_indices_inner(l_ts_p, r_ts_p)
         last_row_idx = np.asarray(idx)

@@ -1,0 +1,120 @@
+"""Profiling, cost probes, and strategy picking.
+
+The reference's only introspection hook is a driver-side size probe: it
+parses ``explain cost`` output to read the optimizer's ``sizeInBytes``
+estimate and uses it to pick the broadcast join strategy
+(python/tempo/tsdf.py:433-461, consumed at :482-509).  Observability
+beyond that is delegated to the Spark UI.
+
+The TPU-native equivalents:
+
+* :func:`trace` — a context manager around ``jax.profiler`` producing
+  TensorBoard-loadable traces (the Spark-UI analog).
+* :func:`compiled_cost` — XLA's own post-compilation cost/memory
+  analysis for a jitted function, the compiler-backed version of the
+  ``sizeInBytes`` scrape.
+* :func:`host_bytes` — cheap driver-side size estimate of a frame
+  (used by the join planner, tempo_tpu/join.py).
+* :func:`pick_asof_strategy` — the size-probe -> algorithm decision in
+  one audited place.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Dict, Optional
+
+import jax
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+# tsdf.py:491 uses 30MiB as the broadcast cutoff
+BROADCAST_BYTES_THRESHOLD = 30 * 1024 * 1024
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Profile everything inside the block to ``log_dir``.
+
+    Usage::
+
+        with profiling.trace("/tmp/tempo-trace"):
+            tsdf.asofJoin(other).df
+    """
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-span inside a :func:`trace` block (shows up on the TPU
+    timeline): ``with profiling.annotate("asof-kernel"): ...``"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def compiled_cost(fn, *args, **kwargs) -> Dict[str, Optional[float]]:
+    """Compile ``fn`` for the current backend and return XLA's cost and
+    memory analysis: flops, transcendentals, bytes accessed, and
+    per-space buffer sizes.  Values are ``None`` where a backend does
+    not report them."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    out: Dict[str, Optional[float]] = {
+        "flops": None,
+        "bytes_accessed": None,
+        "output_bytes": None,
+        "temp_bytes": None,
+        "argument_bytes": None,
+        "generated_code_bytes": None,
+    }
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        if cost:
+            out["flops"] = cost.get("flops")
+            out["bytes_accessed"] = cost.get("bytes accessed")
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.debug("cost_analysis unavailable: %s", e)
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["output_bytes"] = getattr(mem, "output_size_in_bytes", None)
+            out["temp_bytes"] = getattr(mem, "temp_size_in_bytes", None)
+            out["argument_bytes"] = getattr(mem, "argument_size_in_bytes", None)
+            out["generated_code_bytes"] = getattr(
+                mem, "generated_code_size_in_bytes", None
+            )
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.debug("memory_analysis unavailable: %s", e)
+    return out
+
+
+def host_bytes(df: pd.DataFrame) -> int:
+    """Driver-side in-memory size of a frame — the packed-columnar analog
+    of the reference's ``explain cost`` sizeInBytes scrape."""
+    return int(df.memory_usage(deep=True).sum())
+
+
+def pick_asof_strategy(
+    left_df: pd.DataFrame,
+    right_df: pd.DataFrame,
+    sql_join_opt: bool,
+    has_sequence: bool,
+    max_lookback: int,
+) -> str:
+    """'broadcast' | 'merge' | 'searchsorted' — mirrors the reference's
+    decision tree (tsdf.py:482-509 fast path; the union/sort algorithm
+    otherwise, with the merge variant when a sequence tie-break or row
+    cap forces merged-stream coordinates)."""
+    if sql_join_opt and (
+        host_bytes(left_df) < BROADCAST_BYTES_THRESHOLD
+        or host_bytes(right_df) < BROADCAST_BYTES_THRESHOLD
+    ):
+        return "broadcast"
+    if has_sequence or (max_lookback and max_lookback > 0):
+        return "merge"
+    return "searchsorted"

@@ -1,0 +1,56 @@
+"""Profiling / cost-probe / strategy-pick parity tests.
+
+The strategy decision tree mirrors tsdf.py:482-509 (broadcast under a
+30MiB side) and the merge dispatch conditions; compiled_cost exercises
+XLA's post-compile analyses on the CPU backend."""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import profiling
+
+
+def _df(n):
+    return pd.DataFrame({"ts": np.arange(n), "v": np.random.default_rng(0).standard_normal(n)})
+
+
+class TestStrategyPick:
+    def test_broadcast_when_small_and_opted_in(self):
+        small, big = _df(10), _df(10)
+        assert profiling.pick_asof_strategy(small, big, True, False, 0) == "broadcast"
+
+    def test_no_broadcast_without_opt_in(self):
+        small = _df(10)
+        assert profiling.pick_asof_strategy(small, small, False, False, 0) == "searchsorted"
+
+    def test_merge_for_sequence_or_lookback(self):
+        d = _df(10)
+        assert profiling.pick_asof_strategy(d, d, False, True, 0) == "merge"
+        assert profiling.pick_asof_strategy(d, d, False, False, 5) == "merge"
+
+    def test_broadcast_threshold(self):
+        # both sides over 30MiB -> no broadcast even when opted in
+        big = pd.DataFrame({"v": np.zeros(5_000_000)})  # 40MB of float64
+        assert profiling.host_bytes(big) > profiling.BROADCAST_BYTES_THRESHOLD
+        assert profiling.pick_asof_strategy(big, big, True, False, 0) == "searchsorted"
+
+
+class TestCostProbe:
+    def test_compiled_cost_reports_something(self):
+        def f(a, b):
+            return (a @ b).sum()
+
+        a = jnp.ones((64, 64), jnp.float32)
+        out = profiling.compiled_cost(f, a, a)
+        assert isinstance(out, dict)
+        # the CPU backend reports flops for a matmul
+        assert out["flops"] is None or out["flops"] > 0
+
+    def test_trace_context(self, tmp_path):
+        with profiling.trace(str(tmp_path)):
+            with profiling.annotate("unit-test-span"):
+                jnp.ones((8,)).sum().block_until_ready()
+        # a trace directory must have been produced
+        assert any(tmp_path.iterdir())
