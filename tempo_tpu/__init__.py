@@ -11,11 +11,27 @@ Public surface mirrors the reference: ``TSDF`` plus ``display``
 (python/tempo/__init__.py:1-2).
 """
 
+import os as _os
+
+# capture the platform the user asked for BEFORE importing jax: device
+# plugins may rewrite JAX_PLATFORMS during jax import, which would
+# silently retarget e.g. an explicitly requested CPU run
+_requested_platform = _os.environ.get("JAX_PLATFORMS")
+
 import jax
 
 # int64-nanosecond timestamps and float64 golden-parity accumulations
 # require 64-bit mode; TPU fast paths opt into f32/bf16 explicitly.
 jax.config.update("jax_enable_x64", True)
+
+# Enforce the platform the user named in the environment: device
+# plugins may prepend themselves to jax_platforms during import (e.g.
+# 'cpu' -> 'axon,cpu'), silently retargeting an explicitly requested
+# CPU run.  An env var set at process start is an explicit user choice;
+# code that wants a different platform can still call
+# jax.config.update("jax_platforms", ...) after importing tempo_tpu.
+if _requested_platform and jax.config.jax_platforms != _requested_platform:
+    jax.config.update("jax_platforms", _requested_platform)
 
 from tempo_tpu.frame import TSDF  # noqa: E402
 from tempo_tpu.utils import display  # noqa: E402
