@@ -69,7 +69,7 @@ def main():
     print("\nEMA tail:")
     display(ema.select("User", "event_ts", "z", "EMA_z").limit(5))
 
-    # 5. Gap-fill: resample to 100ms grid, linearly interpolate
+    # 5. Gap-fill: resample to a 1s grid, linearly interpolate
     interp = phone_tsdf.interpolate(freq="sec", func="mean", method="linear")
     print(f"\ninterpolated rows: {len(interp.df)}")
 

@@ -432,13 +432,16 @@ class TSDF:
 
     def EMA(
         self, colName: str, window: int = 30, exp_factor: float = 0.2,
-        exact: bool = False,
+        exact: bool = False, inclusive_window: bool = False,
     ) -> "TSDF":
         """Exponential moving average (parity: tsdf.py:615-635; ``exact=True``
-        computes the untruncated recursive EMA via an associative scan)."""
+        computes the untruncated recursive EMA via an associative scan;
+        ``inclusive_window=True`` matches the Scala 0..window lag range,
+        EMA.scala:31)."""
         from tempo_tpu import rolling
 
-        return rolling.ema(self, colName, window, exp_factor, exact)
+        return rolling.ema(self, colName, window, exp_factor, exact,
+                           inclusive_window)
 
     def vwap(
         self, frequency: str = "m", volume_col: str = "volume", price_col: str = "price"

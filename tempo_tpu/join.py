@@ -143,6 +143,10 @@ def asof_join(
     # --- skew variant: compose key with overlapping time brackets ------
     l_take = np.arange(len(left.df), dtype=np.int64)
     r_take = np.arange(len(right.df), dtype=np.int64)
+    if broadcast_path:
+        # the reference's sql_join_opt fast path returns before any skew
+        # handling (tsdf.py:492-509) — the broadcast join never buckets
+        tsPartitionVal = None
     if tsPartitionVal is not None:
         l_bracket, _ = _time_brackets(l_ts_ns, tsPartitionVal)
         r_bracket, r_rem = _time_brackets(r_ts_ns, tsPartitionVal)
@@ -262,8 +266,18 @@ def asof_join(
 
     res = pd.DataFrame(out)
     if broadcast_path:
+        # apply the inner-join filter while rows are still in packed
+        # order — keep_mask_packed is indexed by (k_ids, pos)
         keep = keep_mask_packed[k_ids, pos]
         res = res[keep].reset_index(drop=True)
+    if tsPartitionVal is not None:
+        # the joint (key, bracket) layout emits rows in bracket order;
+        # restore the same (key, ts) order the non-skew path produces so
+        # the two strategies are interchangeable row-for-row
+        perm = np.lexsort(
+            (l_ts_ns[l_layout.order], l_codes[l_layout.order])
+        )
+        res = res.iloc[perm].reset_index(drop=True)
 
     new_ts = lmap[left.ts_col]
     return TSDF(res, ts_col=new_ts, partition_cols=pcols)

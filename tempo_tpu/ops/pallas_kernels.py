@@ -1,0 +1,254 @@
+"""Pallas TPU kernels for the scan-shaped hot ops.
+
+The reference's rolling ops are Spark Window scans (tsdf.py:615-635 EMA;
+interpol.py:197-222 ffill/bfill via ``last/first ignorenulls`` over
+unbounded windows).  On TPU these are first-order recurrences along the
+time axis; XLA's ``lax.associative_scan`` computes them in O(log L)
+*separate fused loops*, each a full HBM read+write of the operand.  The
+kernels here run the whole Hillis-Steele ladder inside one
+``pallas_call`` with the operand resident in VMEM, so HBM is touched
+exactly twice (one read, one write) regardless of L.
+
+Mosaic cannot lower ``cumsum`` / dynamic gathers (probed on v5e), so the
+ladder is built from the primitives it does support: ``pltpu.roll``
+(static lane shift) + ``broadcasted_iota`` masks.
+
+Kernels:
+
+* ``ema_scan``  - y_t = (1-a) * y_{t-1} + a * x_t, invalid rows carry
+  the previous EMA forward (exact infinite-horizon EMA; the reference
+  truncates to ``window`` lags, tsdf.py:617-618 TODO).  Wired into the
+  flagship fused pipeline (__graft_entry__).
+* ``last_valid_index_scan`` / ``first_valid_index_scan`` - running
+  index of the last/next valid element, the engine under
+  ``window_utils.last_valid_index``/``first_valid_index`` (which back
+  ffill/bfill/linear interpolation scaffolds and skipNulls AS-OF);
+  those wrappers dispatch here on TPU.
+* ``last_valid_scan`` - forward-fill of the last valid *value* in one
+  pass, for f32 packed-array pipelines that need filled values rather
+  than indices.
+
+Kernels engage for [K, L] blocks with L a multiple of 128 on TPU
+(float32 for the value kernels; the index kernels are dtype-agnostic -
+they only read the validity mask) and fall back to the XLA
+implementations elsewhere (CPU-mesh tests, float64 golden runs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+_BK = 32  # series rows per grid step; carries + roll temps + I/O double
+          # buffers for a [32, 8192] f32 block stay under the 16M VMEM cap
+
+
+def _ladder_levels(L: int):
+    spans = []
+    s = 1
+    while s < L:
+        spans.append(s)
+        s *= 2
+    return spans
+
+
+def _shift_with_identity(arr, span: int, identity):
+    """arr shifted right by ``span`` along the lane axis; the first
+    ``span`` lanes (which pltpu.roll wraps) become ``identity``."""
+    # under jax_enable_x64 a python-int shift traces as i64, which
+    # tpu.dynamic_rotate rejects
+    rolled = pltpu.roll(arr, shift=jnp.int32(span), axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, arr.shape, dimension=1)
+    return jnp.where(lane >= span, rolled, identity)
+
+
+def _ema_kernel(alpha_ref, x_ref, valid_ref, out_ref):
+    a = alpha_ref[0]
+    valid = valid_ref[:]
+    # linear recurrence y_i = d_i * y_{i-1} + v_i
+    d = jnp.where(valid, 1.0 - a, 1.0)
+    v = jnp.where(valid, a * x_ref[:], 0.0)
+    for span in _ladder_levels(d.shape[1]):
+        d_prev = _shift_with_identity(d, span, 1.0)
+        v_prev = _shift_with_identity(v, span, 0.0)
+        v = v + d * v_prev
+        d = d * d_prev
+    out_ref[:] = v
+
+
+def _last_valid_kernel(x_ref, valid_ref, out_ref, outv_ref):
+    has = valid_ref[:].astype(jnp.float32)
+    val = jnp.where(valid_ref[:], x_ref[:], 0.0)
+    for span in _ladder_levels(has.shape[1]):
+        has_prev = _shift_with_identity(has, span, 0.0)
+        val_prev = _shift_with_identity(val, span, 0.0)
+        val = jnp.where(has > 0, val, val_prev)
+        has = jnp.maximum(has, has_prev)
+    out_ref[:] = val
+    outv_ref[:] = has > 0
+
+
+def _shift_left_with_identity(arr, span: int, identity):
+    """arr shifted left by ``span`` along the lane axis (for reverse
+    scans); the last ``span`` lanes become ``identity``."""
+    L = arr.shape[1]
+    rolled = pltpu.roll(arr, shift=jnp.int32(L - span), axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, arr.shape, dimension=1)
+    return jnp.where(lane < L - span, rolled, identity)
+
+
+def _last_valid_index_kernel(valid_ref, out_ref):
+    L = valid_ref.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, valid_ref.shape, dimension=1)
+    cand = jnp.where(valid_ref[:], lane, -1)
+    for span in _ladder_levels(L):
+        cand = jnp.maximum(cand, _shift_with_identity(cand, span, -1))
+    out_ref[:] = cand
+
+
+def _first_valid_index_kernel(valid_ref, out_ref):
+    L = valid_ref.shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, valid_ref.shape, dimension=1)
+    cand = jnp.where(valid_ref[:], lane, L)
+    for span in _ladder_levels(L):
+        cand = jnp.minimum(cand, _shift_left_with_identity(cand, span, L))
+    out_ref[:] = cand
+
+
+def _supported(x: jax.Array) -> bool:
+    return (
+        x.dtype == jnp.float32
+        and x.ndim == 2
+        and x.shape[1] % LANE == 0
+        and jax.default_backend() == "tpu"
+    )
+
+
+def _grid(K: int):
+    bk = min(_BK, K) if K % min(_BK, K) == 0 else 8 if K % 8 == 0 else 1
+    return (K // bk,), bk
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ema_call(x, valid, alpha, interpret=False):
+    K, L = x.shape
+    grid, bk = _grid(K)
+    # index maps must trace as i32: under the library's global x64 mode
+    # they come out i64, which Mosaic's func.return rejects
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            _ema_kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                spec,
+                spec,
+            ],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((K, L), jnp.float32),
+            interpret=interpret,
+        )(jnp.asarray([alpha], jnp.float32), x, valid)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _last_valid_call(x, valid, interpret=False):
+    K, L = x.shape
+    grid, bk = _grid(K)
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            _last_valid_kernel,
+            grid=grid,
+            in_specs=[spec, spec],
+            out_specs=[
+                spec,
+                pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((K, L), jnp.float32),
+                jax.ShapeDtypeStruct((K, L), jnp.bool_),
+            ],
+            interpret=interpret,
+        )(x, valid)
+
+
+@functools.partial(jax.jit, static_argnames=("kernel", "interpret"))
+def _index_scan_call(valid, kernel, interpret=False):
+    K, L = valid.shape
+    grid, bk = _grid(K)
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[spec],
+            out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((K, L), jnp.int32),
+            interpret=interpret,
+        )(valid)
+
+
+def _index_supported(valid: jax.Array) -> bool:
+    return (
+        valid.ndim == 2
+        and valid.shape[1] % LANE == 0
+        and jax.default_backend() == "tpu"
+    )
+
+
+def last_valid_index_scan(valid, interpret: bool = False):
+    """Running index of the last True at-or-before each lane; -1 before
+    the first.  Pallas on TPU, XLA cummax elsewhere."""
+    valid = jnp.asarray(valid)
+    if interpret or _index_supported(valid):
+        return _index_scan_call(valid, _last_valid_index_kernel,
+                                interpret=interpret)
+    from tempo_tpu.ops import window_utils as wu
+
+    return wu.last_valid_index_xla(valid)
+
+
+def first_valid_index_scan(valid, interpret: bool = False):
+    """Index of the first True at-or-after each lane; L where none."""
+    valid = jnp.asarray(valid)
+    if interpret or _index_supported(valid):
+        return _index_scan_call(valid, _first_valid_index_kernel,
+                                interpret=interpret)
+    from tempo_tpu.ops import window_utils as wu
+
+    return wu.first_valid_index_xla(valid)
+
+
+def ema_scan(x, valid, alpha: float, interpret: bool = False):
+    """Exact EMA over [K, L]; Pallas on TPU/f32, XLA scan otherwise."""
+    x = jnp.asarray(x)
+    valid = jnp.asarray(valid)
+    if interpret or _supported(x):
+        return _ema_call(x, valid, float(alpha), interpret=interpret)
+    from tempo_tpu.ops import rolling as rk
+
+    return rk.ema_exact(x, valid, alpha)
+
+
+def last_valid_scan(x, valid, interpret: bool = False):
+    """(ffilled values, any-valid-so-far mask) over [K, L]."""
+    x = jnp.asarray(x)
+    valid = jnp.asarray(valid)
+    if interpret or _supported(x):
+        return _last_valid_call(x, valid, interpret=interpret)
+    # XLA fallback: the same scan via associative_scan
+    def combine(c1, c2):
+        h1, v1 = c1
+        h2, v2 = c2
+        return jnp.logical_or(h2, h1), jnp.where(h2, v2, v1)
+
+    has, val = jax.lax.associative_scan(
+        combine, (valid, jnp.where(valid, x, 0)), axis=1
+    )
+    return val, has

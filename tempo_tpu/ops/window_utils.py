@@ -36,13 +36,7 @@ def cummin(x: jnp.ndarray, axis: int = -1, reverse: bool = False) -> jnp.ndarray
     )
 
 
-def last_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
-    """Running index of the last True up to and including each position.
-
-    -1 where no valid element has been seen yet.  This is the vectorised
-    equivalent of Spark's ``last(col, ignoreNulls=True)`` over an
-    unbounded-preceding window (reference tsdf.py:139).
-    """
+def last_valid_index_xla(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     n = valid.shape[axis]
     idx = jnp.arange(n, dtype=jnp.int32)
     idx = jnp.broadcast_to(idx, valid.shape)
@@ -50,17 +44,43 @@ def last_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     return cummax(cand, axis=axis)
 
 
-def first_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
-    """Index of the first True at or after each position; n where none.
+def last_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Running index of the last True up to and including each position.
 
-    Equivalent of ``first(col, ignoreNulls=True)`` over a current-row-to-
-    unbounded-following window (reference interpol.py:216-222).
+    -1 where no valid element has been seen yet.  This is the vectorised
+    equivalent of Spark's ``last(col, ignoreNulls=True)`` over an
+    unbounded-preceding window (reference tsdf.py:139).  On TPU the
+    [K, L] lane-aligned case runs as a fused Pallas VMEM scan.
     """
+    if valid.ndim == 2 and axis in (-1, 1):
+        from tempo_tpu.ops import pallas_kernels as pk
+
+        if pk._index_supported(jnp.asarray(valid)):
+            return pk.last_valid_index_scan(valid)
+    return last_valid_index_xla(valid, axis)
+
+
+def first_valid_index_xla(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     n = valid.shape[axis]
     idx = jnp.arange(n, dtype=jnp.int32)
     idx = jnp.broadcast_to(idx, valid.shape)
     cand = jnp.where(valid, idx, n)
     return cummin(cand, axis=axis, reverse=True)
+
+
+def first_valid_index(valid: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Index of the first True at or after each position; n where none.
+
+    Equivalent of ``first(col, ignoreNulls=True)`` over a current-row-to-
+    unbounded-following window (reference interpol.py:216-222).  On TPU
+    the [K, L] lane-aligned case runs as a fused Pallas VMEM scan.
+    """
+    if valid.ndim == 2 and axis in (-1, 1):
+        from tempo_tpu.ops import pallas_kernels as pk
+
+        if pk._index_supported(jnp.asarray(valid)):
+            return pk.first_valid_index_scan(valid)
+    return first_valid_index_xla(valid, axis)
 
 
 def _shift_right(x: jnp.ndarray, k: int, fill) -> jnp.ndarray:

@@ -118,15 +118,19 @@ def with_grouped_stats(tsdf, metricCols=None, freq: Optional[str] = None):
 
 
 def ema(tsdf, colName: str, window: int = 30, exp_factor: float = 0.2,
-        exact: bool = False):
+        exact: bool = False, inclusive_window: bool = False):
+    """``inclusive_window=True`` reproduces the Scala lag range 0..window
+    (EMA.scala:31, one more tap than the Python 0..window-1 range,
+    tsdf.py:627 - the divergence tabled in SURVEY.md §2.4)."""
     from tempo_tpu.frame import TSDF
 
     layout = tsdf.layout
     v, m = tsdf.packed_numeric(colName)
+    n_taps = int(window) + (1 if inclusive_window else 0)
     if exact:
         y = rk.ema_exact(jnp.asarray(v), jnp.asarray(m), exp_factor)
     else:
-        y = rk.ema_compat(jnp.asarray(v), jnp.asarray(m), int(window), float(exp_factor))
+        y = rk.ema_compat(jnp.asarray(v), jnp.asarray(m), n_taps, float(exp_factor))
     out = tsdf.df.iloc[layout.order].reset_index(drop=True)
     out["EMA_" + colName] = packing.unpack_column(np.asarray(y), layout)
     return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)

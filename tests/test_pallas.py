@@ -1,0 +1,85 @@
+"""Pallas scan kernels: interpret-mode correctness vs XLA/numpy oracles.
+
+On CPU the kernels run through the Pallas interpreter (the compiled path
+is TPU-only and exercised by bench.py on real hardware); the ladder
+logic (roll + iota masking) is identical in both modes.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tempo_tpu.ops import pallas_kernels as pk
+from tempo_tpu.ops import rolling as rk
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    K, L = 8, 256
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.25
+    valid[3] = False          # an all-null series
+    valid[4, :10] = False     # leading nulls
+    return x, valid
+
+
+def test_ema_scan_matches_associative_scan(data):
+    x, valid = data
+    y_pallas = np.asarray(pk.ema_scan(jnp.asarray(x), jnp.asarray(valid),
+                                      0.2, interpret=True))
+    y_xla = np.asarray(rk.ema_exact(jnp.asarray(x), jnp.asarray(valid), 0.2))
+    np.testing.assert_allclose(y_pallas, y_xla, rtol=1e-5, atol=1e-6)
+
+
+def test_ema_scan_recurrence_oracle(data):
+    x, valid = data
+    y = np.asarray(pk.ema_scan(jnp.asarray(x), jnp.asarray(valid),
+                               0.3, interpret=True))
+    K, L = x.shape
+    expect = np.zeros((K, L), dtype=np.float64)
+    for k in range(K):
+        acc = 0.0
+        for i in range(L):
+            if valid[k, i]:
+                acc = 0.7 * acc + 0.3 * float(x[k, i])
+            expect[k, i] = acc
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_last_valid_scan(data):
+    x, valid = data
+    val, has = pk.last_valid_scan(jnp.asarray(x), jnp.asarray(valid),
+                                  interpret=True)
+    val, has = np.asarray(val), np.asarray(has)
+    idx = np.where(valid, np.arange(x.shape[1])[None, :], -1)
+    idx = np.maximum.accumulate(idx, axis=1)
+    has_o = idx >= 0
+    assert np.array_equal(has, has_o)
+    filled_o = np.where(
+        has_o,
+        np.take_along_axis(np.where(valid, x, 0.0), np.maximum(idx, 0), 1),
+        0.0,
+    )
+    np.testing.assert_allclose(val, filled_o, rtol=1e-6)
+
+
+def test_index_scans_match_xla(data):
+    _, valid = data
+    from tempo_tpu.ops import window_utils as wu
+
+    v = jnp.asarray(valid)
+    last_p = np.asarray(pk.last_valid_index_scan(v, interpret=True))
+    last_x = np.asarray(wu.last_valid_index_xla(v))
+    assert np.array_equal(last_p, last_x)
+    first_p = np.asarray(pk.first_valid_index_scan(v, interpret=True))
+    first_x = np.asarray(wu.first_valid_index_xla(v))
+    assert np.array_equal(first_p, first_x)
+
+
+def test_fallback_path_f64(data):
+    """float64 input must take the XLA fallback and stay exact."""
+    x, valid = data
+    x64 = x.astype(np.float64)
+    val, has = pk.last_valid_scan(jnp.asarray(x64), jnp.asarray(valid))
+    assert np.asarray(val).dtype == np.float64
