@@ -33,6 +33,19 @@ jax.config.update("jax_enable_x64", True)
 if _requested_platform and jax.config.jax_platforms != _requested_platform:
     jax.config.update("jax_platforms", _requested_platform)
 
+# Persistent compilation cache: TSDF kernels are compiled per packed
+# shape and some (notably windowed range stats) take tens of seconds of
+# XLA time; caching makes every process after the first start warm.
+# Opt out with TEMPO_TPU_CACHE_DIR="" or pre-set jax_compilation_cache_dir.
+if jax.config.jax_compilation_cache_dir is None:
+    _cache_dir = _os.environ.get(
+        "TEMPO_TPU_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "tempo_tpu", "jax"),
+    )
+    if _cache_dir:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 from tempo_tpu.frame import TSDF  # noqa: E402
 from tempo_tpu.utils import display  # noqa: E402
 

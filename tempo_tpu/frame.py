@@ -191,12 +191,16 @@ class TSDF:
         return self._packed[key]
 
     def packed_numeric(self, col: str):
-        """([K, L] float64 values with NaN padding, [K, L] valid bool)."""
-        key = f"num:{col}"
+        """([K, L] float values with NaN padding, [K, L] valid bool).
+
+        Values are in ``packing.compute_dtype()`` — float32 on TPU
+        (float64 is emulated there), float64 on CPU."""
+        dt = packing.compute_dtype()
+        key = f"num:{col}:{dt}"
         if key not in self._packed:
             vals, valid = self.numeric_flat(col)
             L = self.packed_len()
-            pv = packing.pack_column(vals, self.layout, L, fill=np.nan)
+            pv = packing.pack_column(vals.astype(dt), self.layout, L, fill=np.nan)
             pm = packing.pack_column(valid, self.layout, L, fill=False)
             self._packed[key] = (pv, pm)
         return self._packed[key]

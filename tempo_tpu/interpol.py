@@ -136,10 +136,23 @@ class Interpolation:
             vals[ci, key_of_slot[kept_slot], slot_in_key[kept_slot]] = v[kept]
             valid[ci, key_of_slot[kept_slot], slot_in_key[kept_slot]] = ok[kept]
 
+        # f32 compute on TPU: rebase grid seconds to per-series offsets
+        # (linear interpolation only ever differences timestamps within a
+        # series) so they stay exactly representable; grids spanning
+        # >2^24s (~194 days) keep f64
+        dt = packing.compute_dtype()
+        ts_dev = ts_sec
+        if dt == np.float32:
+            base = ts_sec[:, :1]
+            span = ts_sec - base
+            if span.max(initial=0.0) < 2**24:
+                ts_dev = span.astype(np.float32)
+            else:
+                dt = np.dtype(np.float64)
         out_v, out_ok, ts_interp, col_interp = ik.interpolate_columns(
             jnp.asarray(real), jnp.asarray(glen.astype(np.int32)),
-            jnp.asarray(ts_sec), jnp.asarray(float(freq_sec)),
-            jnp.asarray(vals), jnp.asarray(valid), method,
+            jnp.asarray(ts_dev), jnp.asarray(dt.type(freq_sec)),
+            jnp.asarray(vals.astype(dt)), jnp.asarray(valid), method,
         )
         out_v = np.asarray(out_v)
         out_ok = np.asarray(out_ok)
@@ -156,7 +169,7 @@ class Interpolation:
             out[c] = key_frame[c].to_numpy()[key_ids]
         out[ts_col] = packing.ns_to_original(grid_ns, sampled[ts_col].dtype)
         for ci, c in enumerate(target_cols):
-            col = out_v[ci][gmask]
+            col = out_v[ci][gmask].astype(np.float64)
             col[~out_ok[ci][gmask]] = np.nan
             out[c] = col
         out["is_ts_interpolated"] = ts_interp[gmask]

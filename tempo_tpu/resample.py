@@ -90,16 +90,17 @@ def aggregate(tsdf, freq: str, func: str, metricCols=None, prefix=None,
         for c in metricCols:
             out[prefix + c] = sorted_df[c].to_numpy()[pick]
     else:
+        dt = packing.compute_dtype()
         for c in metricCols:
             if _is_numeric_col(sorted_df, c):
                 vals = pd.to_numeric(sorted_df[c], errors="coerce").to_numpy(np.float64)
                 valid = ~np.isnan(vals)
                 stats = rk.segment_stats(
-                    jnp.asarray(vals), jnp.asarray(valid),
+                    jnp.asarray(vals.astype(dt)), jnp.asarray(valid),
                     jnp.asarray(seg_ids), n_seg_padded,
                 )
                 key = {average: "mean", min_func: "min", max_func: "max"}[func]
-                out[prefix + c] = np.asarray(stats[key])[:n_seg]
+                out[prefix + c] = np.asarray(stats[key])[:n_seg].astype(np.float64)
             elif func == average:
                 # Spark avg(string) -> null double (exercised by the
                 # reference's 5-minute mean resample golden)

@@ -47,14 +47,26 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
         # reference adds zero stat columns in this case (tsdf.py:691-721)
         return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
     ts_long = tsdf.packed_ts() // packing.NS_PER_S   # Spark cast-to-long seconds
-    start, end = rk.range_window_bounds(jnp.asarray(ts_long),
-                                        jnp.asarray(rangeBackWindowSecs))
+    # 64-bit compares are emulated on TPU: rebase to per-series int32
+    # seconds when spans allow (range windows only ever compare within a
+    # series, so a per-series origin is safe)
+    ts_long, _ = packing.rebase_seconds(ts_long, ~tsdf.packed_mask())
+    # a window larger than any rebased span is equivalent to 'unbounded
+    # preceding'; clamp so huge windows cannot overflow the int32 path
+    w = min(int(rangeBackWindowSecs), int(np.iinfo(ts_long.dtype).max) // 2)
+    start, end = rk.range_window_bounds(
+        jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))
+    )
 
     vals, valids = _packed_metric_stack(tsdf, cols)
     stats = jax.vmap(rk.windowed_stats, in_axes=(0, 0, None, None))(
         jnp.asarray(vals), jnp.asarray(valids), start, end
     )
-    stats = {k: np.asarray(v) for k, v in stats.items()}
+    # one stacked device->host transfer: the axon tunnel has a >1s
+    # per-transfer latency floor, so 7 separate fetches cost seconds
+    names = sorted(stats)
+    stacked = np.asarray(jnp.stack([stats[k] for k in names]))
+    stats = {k: stacked[i] for i, k in enumerate(names)}
 
     for ci, c in enumerate(cols):
         for stat in ("mean", "count", "min", "max", "sum", "stddev", "zscore"):
@@ -62,7 +74,8 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
             if stat == "count":
                 out[f"{stat}_{c}"] = flat.astype(np.int64)
             else:
-                out[f"{stat}_{c}"] = flat
+                # Spark emits DoubleType stats regardless of input width
+                out[f"{stat}_{c}"] = flat.astype(np.float64)
     return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
 
 
@@ -104,15 +117,19 @@ def with_grouped_stats(tsdf, metricCols=None, freq: Optional[str] = None):
         out[c] = sorted_df[c].to_numpy()[first_row]
     out[tsdf.ts_col] = packing.ns_to_original(seg_bucket, tsdf.ts_dtype())
 
+    dt = packing.compute_dtype()
     for c in cols:
         v, m = tsdf.numeric_flat(c)
         stats = rk.segment_stats(
-            jnp.asarray(v), jnp.asarray(m), jnp.asarray(seg_ids), n_seg_padded
+            jnp.asarray(v.astype(dt)), jnp.asarray(m), jnp.asarray(seg_ids),
+            n_seg_padded,
         )
         for stat in ("mean", "count", "min", "max", "sum", "stddev"):
             arr = np.asarray(stats[stat])[:n_seg]
             if stat == "count":
                 arr = arr.astype(np.int64)
+            else:
+                arr = arr.astype(np.float64)
             out[f"{stat}_{c}"] = arr
     return TSDF(pd.DataFrame(out), tsdf.ts_col, tsdf.partitionCols)
 
@@ -128,11 +145,15 @@ def ema(tsdf, colName: str, window: int = 30, exp_factor: float = 0.2,
     v, m = tsdf.packed_numeric(colName)
     n_taps = int(window) + (1 if inclusive_window else 0)
     if exact:
-        y = rk.ema_exact(jnp.asarray(v), jnp.asarray(m), exp_factor)
+        from tempo_tpu.ops import pallas_kernels as pk
+
+        y = pk.ema_scan(jnp.asarray(v), jnp.asarray(m), exp_factor)
     else:
         y = rk.ema_compat(jnp.asarray(v), jnp.asarray(m), n_taps, float(exp_factor))
     out = tsdf.df.iloc[layout.order].reset_index(drop=True)
-    out["EMA_" + colName] = packing.unpack_column(np.asarray(y), layout)
+    out["EMA_" + colName] = packing.unpack_column(
+        np.asarray(y), layout
+    ).astype(np.float64)
     return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
 
 
@@ -157,8 +178,10 @@ def vwap(tsdf, frequency: str = "m", volume_col: str = "volume",
     n_seg = len(first_row)
     n_seg_padded = max(8, 1 << (n_seg - 1).bit_length()) if n_seg else 8
 
+    dt = packing.compute_dtype()
     price, p_ok = tsdf.numeric_flat(price_col)
     vol, v_ok = tsdf.numeric_flat(volume_col)
+    price, vol = price.astype(dt), vol.astype(dt)
     d_ok = p_ok & v_ok
 
     seg = jnp.asarray(seg_ids)
@@ -171,11 +194,11 @@ def vwap(tsdf, frequency: str = "m", volume_col: str = "volume",
     for c in tsdf.partitionCols:
         out[c] = sorted_df[c].to_numpy()[first_row]
     out[tsdf.ts_col] = packing.ns_to_original(seg_bucket, tsdf.ts_dtype())
-    dllr_sum = np.asarray(s_d["sum"])[:n_seg]
-    vol_sum = np.asarray(s_v["sum"])[:n_seg]
+    dllr_sum = np.asarray(s_d["sum"])[:n_seg].astype(np.float64)
+    vol_sum = np.asarray(s_v["sum"])[:n_seg].astype(np.float64)
     out["dllr_value"] = dllr_sum
     out[volume_col] = vol_sum
-    out["max_" + price_col] = np.asarray(s_p["max"])[:n_seg]
+    out["max_" + price_col] = np.asarray(s_p["max"])[:n_seg].astype(np.float64)
     out["vwap"] = dllr_sum / vol_sum
     return TSDF(pd.DataFrame(out), tsdf.ts_col, tsdf.partitionCols)
 

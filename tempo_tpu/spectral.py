@@ -133,7 +133,7 @@ def autocorr(tsdf, col: str, lag: int = 1) -> pd.DataFrame:
     # a series only yields a row when the numerator join is non-empty
     # (reference tsdf.py:248-253 inner joins drop pairless series)
     present = np.asarray((lengths > lag) & (cnt > lag))
-    ac = np.asarray(jnp.where(any_pair, num, jnp.nan) / denom)
+    ac = np.asarray(jnp.where(any_pair, num, jnp.nan) / denom).astype(np.float64)
 
     out = tsdf.layout.key_frame.copy()
     if not tsdf.partitionCols:

@@ -77,6 +77,41 @@ def test_index_scans_match_xla(data):
     assert np.array_equal(first_p, first_x)
 
 
+def test_range_query_f32_log2_misround():
+    """floor(log2) in f32 rounds UP for lengths just below large powers
+    of two (2^21-1 -> 21); the RMQ must decrement the level instead of
+    reading out-of-window elements."""
+    import jax.numpy as jnp
+    from tempo_tpu.ops import rolling as R
+
+    L = 2**21 + 8
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((1, L)).astype(np.float32)
+    i = 2**21 - 2                      # window [0, i] has length 2^21 - 1
+    start = np.zeros((1, 1), np.int32)
+    end = np.full((1, 1), i + 1, np.int32)
+    table = R._sparse_table(jnp.asarray(x), jnp.float32(np.inf), jnp.minimum)
+    got = float(np.asarray(R._range_query(table, jnp.asarray(start),
+                                          jnp.asarray(end), jnp.minimum))[0, 0])
+    assert got == float(x[0, : i + 1].min())
+
+
+def test_huge_range_window_clamps():
+    """rangeBackWindowSecs beyond the int32 rebased-seconds range must
+    behave as 'unbounded preceding', not overflow."""
+    import pandas as pd
+    from tempo_tpu import TSDF
+
+    df = pd.DataFrame({
+        "k": ["a"] * 4,
+        "event_ts": pd.to_datetime(
+            ["2024-01-01", "2024-01-02", "2024-01-03", "2024-01-04"]),
+        "v": [1.0, 2.0, 3.0, 4.0],
+    })
+    r = TSDF(df, "event_ts", ["k"]).withRangeStats(rangeBackWindowSecs=10**12)
+    assert r.df["count_v"].tolist() == [1, 2, 3, 4]
+
+
 def test_fallback_path_f64(data):
     """float64 input must take the XLA fallback and stay exact."""
     x, valid = data
