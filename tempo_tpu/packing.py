@@ -93,6 +93,12 @@ def series_to_ns(values: "pd.Series | np.ndarray") -> np.ndarray:
     the raw value in 'seconds' units for windowing math); floats -> seconds
     scaled to ns.
     """
+    if isinstance(values, pd.Series) and isinstance(
+        values.dtype, pd.DatetimeTZDtype
+    ):
+        # tz-aware columns canonicalise through UTC (Spark stores
+        # session-local timestamps as UTC micros the same way)
+        values = values.dt.tz_convert("UTC").dt.tz_localize(None)
     arr = values.to_numpy() if isinstance(values, pd.Series) else np.asarray(values)
     if np.issubdtype(arr.dtype, np.datetime64):
         return arr.astype("datetime64[ns]").astype(np.int64)
@@ -103,8 +109,11 @@ def series_to_ns(values: "pd.Series | np.ndarray") -> np.ndarray:
     raise TypeError(f"Unsupported timestamp dtype: {arr.dtype}")
 
 
-def ns_to_original(ns: np.ndarray, like_dtype) -> np.ndarray:
+def ns_to_original(ns: np.ndarray, like_dtype):
     """Map canonical ns back to the dtype the user supplied."""
+    if isinstance(like_dtype, pd.DatetimeTZDtype):
+        utc = pd.Series(ns.astype("datetime64[ns]")).dt.tz_localize("UTC")
+        return utc.dt.tz_convert(like_dtype.tz).to_numpy()
     if np.issubdtype(like_dtype, np.datetime64):
         return ns.astype("datetime64[ns]")
     if np.issubdtype(like_dtype, np.integer):

@@ -1,0 +1,55 @@
+"""Ingestion edge cases: tz-aware timestamps and pandas nullable dtypes.
+
+The reference inherits these from Spark's session-timezone handling
+(timestamps are stored as UTC and rendered in the session zone); the
+tempo-tpu analog is canonicalising tz-aware columns through UTC ns at
+pack time and restoring the original zone on output.
+"""
+
+import numpy as np
+import pandas as pd
+
+from tempo_tpu import TSDF
+
+
+def _tz_frame():
+    ts = pd.to_datetime(
+        ["2024-01-01 10:00", "2024-01-01 11:00", "2024-01-01 10:30"]
+    ).tz_localize("America/New_York")
+    return pd.DataFrame({"k": ["a", "a", "a"], "event_ts": ts,
+                         "v": [1.0, 2.0, 1.5]})
+
+
+def test_tz_aware_range_stats_and_order():
+    t = TSDF(_tz_frame(), "event_ts", ["k"])
+    r = t.withRangeStats(rangeBackWindowSecs=1800)
+    # sorted by instant, windows computed in absolute time
+    assert r.df["count_v"].tolist() == [1, 2, 2]
+
+
+def test_tz_aware_resample_restores_zone():
+    t = TSDF(_tz_frame(), "event_ts", ["k"])
+    rs = t.resample("hr", "mean")
+    assert isinstance(rs.df["event_ts"].dtype, pd.DatetimeTZDtype)
+    assert str(rs.df["event_ts"].dtype.tz) == "America/New_York"
+    # hourly buckets are aligned on UTC epoch boundaries
+    assert rs.df["v"].tolist() == [1.25, 2.0]
+
+
+def test_tz_aware_asof_join():
+    t = TSDF(_tz_frame(), "event_ts", ["k"])
+    right = TSDF(_tz_frame().rename(columns={"v": "bid"}), "event_ts", ["k"])
+    j = t.asofJoin(right)
+    assert j.df["right_bid"].tolist() == [1.0, 1.5, 2.0]
+
+
+def test_nullable_extension_dtypes():
+    df = pd.DataFrame({
+        "k": ["a", "a"],
+        "event_ts": pd.to_datetime(["2024-01-01", "2024-01-02"]),
+        "v": pd.array([1.5, pd.NA], dtype="Float64"),
+        "n": pd.array([1, pd.NA], dtype="Int64"),
+    })
+    r = TSDF(df, "event_ts", ["k"]).withRangeStats(rangeBackWindowSecs=90000)
+    assert r.df["mean_v"].tolist() == [1.5, 1.5]
+    assert r.df["count_n"].tolist() == [1, 1]
