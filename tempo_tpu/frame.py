@@ -319,6 +319,37 @@ class TSDF:
     def to_pandas(self) -> pd.DataFrame:
         return self.df
 
+    def to_arrow(self):
+        """The frame as a pyarrow Table (zero-copy where pandas allows)."""
+        import pyarrow as pa
+
+        return pa.Table.from_pandas(self.df, preserve_index=False)
+
+    @classmethod
+    def from_arrow(
+        cls,
+        table,
+        ts_col: str = "event_ts",
+        partition_cols: Optional[Union[str, List[str]]] = None,
+        sequence_col: Optional[str] = None,
+    ) -> "TSDF":
+        """Build a TSDF from a pyarrow Table (e.g. a Parquet/Flight read)."""
+        return cls(table.to_pandas(), ts_col, partition_cols, sequence_col)
+
+    @classmethod
+    def from_spark(
+        cls,
+        spark_df,
+        ts_col: str = "event_ts",
+        partition_cols: Optional[Union[str, List[str]]] = None,
+        sequence_col: Optional[str] = None,
+    ) -> "TSDF":
+        """Build a TSDF from a Spark DataFrame — the hand-off point when
+        migrating from the reference (its TSDF wraps exactly this,
+        tsdf.py:22-36).  Collects through Arrow when the session allows.
+        """
+        return cls(spark_df.toPandas(), ts_col, partition_cols, sequence_col)
+
     # ------------------------------------------------------------------
     # Time-series operations (implementations live in sibling modules)
     # ------------------------------------------------------------------
