@@ -299,6 +299,15 @@ class TSDF:
         """Parity: tsdf.py:583-590 (note: drops sequence_col, as reference does)."""
         return TSDF(self.df, self.ts_col, partitionCols)
 
+    # Scala front-end spellings (TSDF.scala:89 partitionedBy, :72 rangeStats)
+    partitionedBy = withPartitionCols
+
+    def rangeStats(self, colsToSummarise=None, rangeBackWindowSecs: int = 1000):
+        return self.withRangeStats(
+            colsToSummarize=colsToSummarise,
+            rangeBackWindowSecs=rangeBackWindowSecs,
+        )
+
     def show(self, n: int = 20, truncate: bool = True, vertical: bool = False):
         """Parity: tsdf.py:345-382 - renders via pandas instead of Spark."""
         view = self.df.head(n)

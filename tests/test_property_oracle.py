@@ -173,3 +173,104 @@ def test_resample_mean_matches_floor_buckets(seed):
     assert len(got) == len(want)
     np.testing.assert_allclose(got["v"], want["v"], atol=1e-12, equal_nan=True)
     np.testing.assert_allclose(got["w"], want["w"], atol=1e-12, equal_nan=True)
+
+
+@pytest.mark.parametrize("seed", [9, 10])
+def test_skew_join_matches_plain(seed):
+    """The tsPartitionVal bucketing must be invisible when the overlap
+    fraction covers the lookback (tsdf.py:164-190 contract)."""
+    rng = np.random.default_rng(seed)
+    left = _random_frame(rng, 3, 100)
+    right = _random_frame(rng, 3, 120)
+    tl = TSDF(left, ts_col="ts", partition_cols=["k"])
+    tr = TSDF(right, ts_col="ts", partition_cols=["k"])
+
+    plain = tl.asofJoin(tr).df
+    skew = tl.asofJoin(tr, tsPartitionVal=40, fraction=1.0,
+                       suppress_null_warning=True).df
+    pd.testing.assert_frame_equal(plain, skew)
+
+
+@pytest.mark.parametrize("method", ["ffill", "bfill", "zero", "linear"])
+def test_interpolate_against_pandas_oracle(method):
+    """Grid fill vs an independent pandas implementation: resample to
+    10s means, build the dense per-key grid, fill (interpol.py:96-180).
+    Linear is checked on the all-non-null case where its contract is
+    plain interpolation between consecutive resampled points."""
+    rng = np.random.default_rng(11)
+    null_frac = 0.0 if method == "linear" else 0.25
+    df = _random_frame(rng, 2, 80, null_frac=null_frac, tie_frac=0.0)
+
+    got = (
+        TSDF(df, ts_col="ts", partition_cols=["k"])
+        .interpolate(freq="10 seconds", func="mean", method=method)
+        .df.sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+
+    res = (
+        df.assign(ts=df.ts.dt.floor("10s"))
+        .groupby(["k", "ts"], as_index=False)[["v", "w"]].mean()
+    )
+    frames = []
+    for k, g in res.groupby("k", sort=False):
+        grid = pd.date_range(g.ts.min(), g.ts.max(), freq="10s")
+        gg = g.set_index("ts").reindex(grid)
+        gg["k"] = k
+        if method == "ffill":
+            gg[["v", "w"]] = gg[["v", "w"]].ffill()
+        elif method == "bfill":
+            gg[["v", "w"]] = gg[["v", "w"]].bfill()
+        elif method == "zero":
+            gg[["v", "w"]] = gg[["v", "w"]].fillna(0.0)
+        else:
+            gg[["v", "w"]] = gg[["v", "w"]].interpolate(method="time")
+        frames.append(gg.rename_axis("ts").reset_index())
+    want = (
+        pd.concat(frames)[["k", "ts", "v", "w"]]
+        .sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    assert len(got) == len(want)
+    for c in ("v", "w"):
+        np.testing.assert_allclose(
+            got[c].to_numpy(), want[c].to_numpy(), atol=1e-9, equal_nan=True,
+            err_msg=f"{method}:{c}",
+        )
+
+
+@pytest.mark.parametrize("seed", [12])
+def test_grouped_stats_matches_pandas_groupby(seed):
+    rng = np.random.default_rng(seed)
+    df = _random_frame(rng, 3, 140, null_frac=0.1)
+
+    got = (
+        TSDF(df, ts_col="ts", partition_cols=["k"])
+        .withGroupedStats(metricCols=["v"], freq="1 minute")
+        .df.sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    g = (
+        df.assign(ts=df.ts.dt.floor("min"))
+        .groupby(["k", "ts"])["v"]
+        .agg(["mean", "count", "min", "max", "sum", "std"])
+        .reset_index()
+        .sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    np.testing.assert_allclose(got["mean_v"], g["mean"], atol=1e-9, equal_nan=True)
+    # pandas count() counts non-null, matching Spark count(col)
+    np.testing.assert_allclose(got["count_v"], g["count"], atol=0)
+    np.testing.assert_allclose(
+        got["stddev_v"], g["std"], atol=1e-9, equal_nan=True
+    )
+
+
+@pytest.mark.parametrize("lag", [1, 3])
+def test_autocorr_matches_numpy(lag):
+    rng = np.random.default_rng(13)
+    df = _random_frame(rng, 2, 100, null_frac=0.0, tie_frac=0.0)
+    got = TSDF(df, ts_col="ts", partition_cols=["k"]).autocorr("v", lag)
+
+    for k, g in df.sort_values(["ts"], kind="stable").groupby("k"):
+        x = g["v"].to_numpy()
+        sub = x - x.mean()
+        want = (sub[:-lag] * sub[lag:]).sum() / (sub * sub).sum()
+        row = got[got.k == k][f"autocorr_lag_{lag}"].iloc[0]
+        np.testing.assert_allclose(row, want, atol=1e-9)
