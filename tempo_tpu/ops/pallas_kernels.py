@@ -120,6 +120,57 @@ def _first_valid_index_kernel(valid_ref, out_ref):
     out_ref[:] = cand
 
 
+def _cumsum3_kernel(x_ref, valid_ref, s1_ref, s2_ref, c_ref):
+    """Inclusive prefix sums of (masked x, masked x^2, valid count) in
+    one VMEM pass — the three scans behind windowed range stats."""
+    valid = valid_ref[:]
+    xz = jnp.where(valid, x_ref[:], 0.0)
+    s1 = xz
+    s2 = xz * xz
+    c = valid.astype(jnp.float32)
+    for span in _ladder_levels(s1.shape[1]):
+        s1 = s1 + _shift_with_identity(s1, span, 0.0)
+        s2 = s2 + _shift_with_identity(s2, span, 0.0)
+        c = c + _shift_with_identity(c, span, 0.0)
+    s1_ref[:] = s1
+    s2_ref[:] = s2
+    c_ref[:] = c
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _cumsum3_call(x, valid, interpret=False):
+    K, L = x.shape
+    # three carries + three outputs live at once: halve the row block
+    grid, bk = _grid(K, bk_max=16)
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            _cumsum3_kernel,
+            grid=grid,
+            in_specs=[spec, spec],
+            out_specs=[spec, spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((K, L), jnp.float32)] * 3,
+            interpret=interpret,
+        )(x, valid)
+
+
+def cumsum3(x, valid, interpret: bool = False):
+    """(cumsum(xz), cumsum(xz^2), cumsum(valid)) inclusive along lanes;
+    Pallas on TPU/f32, XLA associative scans elsewhere."""
+    x = jnp.asarray(x)
+    valid = jnp.asarray(valid)
+    if interpret or _supported(x):
+        return _cumsum3_call(x, valid, interpret=interpret)
+    from tempo_tpu.ops import window_utils as wu
+
+    xz = jnp.where(valid, x, 0.0)
+    return (
+        wu.cumsum(xz, axis=-1),
+        wu.cumsum(xz * xz, axis=-1),
+        wu.cumsum(valid.astype(x.dtype), axis=-1),
+    )
+
+
 def _supported(x: jax.Array) -> bool:
     return (
         x.dtype == jnp.float32
@@ -129,8 +180,8 @@ def _supported(x: jax.Array) -> bool:
     )
 
 
-def _grid(K: int):
-    bk = min(_BK, K) if K % min(_BK, K) == 0 else 8 if K % 8 == 0 else 1
+def _grid(K: int, bk_max: int = _BK):
+    bk = min(bk_max, K) if K % min(bk_max, K) == 0 else 8 if K % 8 == 0 else 1
     return (K // bk,), bk
 
 

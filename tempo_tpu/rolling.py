@@ -58,15 +58,24 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
         jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))
     )
 
+    # static row bound for the min/max sparse tables: a 10s window over
+    # 1Hz data needs 4 levels, not log2(L); bucket to a power of two so
+    # distinct datasets reuse the compiled kernel
+    max_w = max(1, int(jax.device_get(jnp.max(end - start))))
+    max_w = 1 << (max_w - 1).bit_length()
+
     vals, valids = _packed_metric_stack(tsdf, cols)
-    stats = jax.vmap(rk.windowed_stats, in_axes=(0, 0, None, None))(
-        jnp.asarray(vals), jnp.asarray(valids), start, end
+    C, K, L = vals.shape
+    flat = lambda a: jnp.asarray(a).reshape(C * K, L)
+    tile = lambda a: jnp.broadcast_to(a[None], (C, K, L)).reshape(C * K, L)
+    stats = rk.windowed_stats(
+        flat(vals), flat(valids), tile(start), tile(end), max_window=max_w
     )
     # one stacked device->host transfer: the axon tunnel has a >1s
     # per-transfer latency floor, so 7 separate fetches cost seconds
     names = sorted(stats)
     stacked = np.asarray(jnp.stack([stats[k] for k in names]))
-    stats = {k: stacked[i] for i, k in enumerate(names)}
+    stats = {k: stacked[i].reshape(C, K, L) for i, k in enumerate(names)}
 
     for ci, c in enumerate(cols):
         for stat in ("mean", "count", "min", "max", "sum", "stddev", "zscore"):

@@ -64,6 +64,38 @@ def test_last_valid_scan(data):
     np.testing.assert_allclose(val, filled_o, rtol=1e-6)
 
 
+def test_cumsum3_matches_numpy(data):
+    x, valid = data
+    s1, s2, c = pk.cumsum3(jnp.asarray(x), jnp.asarray(valid), interpret=True)
+    xz = np.where(valid, x, 0.0).astype(np.float64)
+    np.testing.assert_allclose(np.asarray(s1), np.cumsum(xz, -1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s2), np.cumsum(xz * xz, -1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(c), np.cumsum(valid, -1))
+
+
+def test_windowed_stats_max_window_cap(data):
+    """Capped sparse tables must agree with the uncapped path when the
+    bound really covers every window."""
+    import jax.numpy as jnp2
+    from tempo_tpu.ops import rolling as R
+
+    x, valid = data
+    K, L = x.shape
+    secs = np.cumsum(np.random.default_rng(5).integers(1, 3, (K, L)), -1)
+    start, end = R.range_window_bounds(jnp2.asarray(secs.astype(np.int32)),
+                                       jnp2.asarray(np.int32(10)))
+    max_w = int(np.max(np.asarray(end) - np.asarray(start)))
+    full = R.windowed_stats(jnp2.asarray(x), jnp2.asarray(valid), start, end)
+    capped = R.windowed_stats(jnp2.asarray(x), jnp2.asarray(valid), start, end,
+                              max_window=1 << (max_w - 1).bit_length())
+    for k in full:
+        np.testing.assert_allclose(np.asarray(full[k]), np.asarray(capped[k]),
+                                   rtol=1e-5, atol=1e-6, equal_nan=True,
+                                   err_msg=k)
+
+
 def test_index_scans_match_xla(data):
     _, valid = data
     from tempo_tpu.ops import window_utils as wu
