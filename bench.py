@@ -26,7 +26,7 @@ from __graft_entry__ import N_RIGHT_COLS, _forward_step
 K = 1024          # series (partition keys)
 L = 8192          # rows per series  -> 8.4M left rows per step
 SUB_K = 32        # series subsample for the pandas oracle
-ITERS = 5
+ITERS = 7
 
 
 def make_data(seed=0):
@@ -44,7 +44,7 @@ def make_data(seed=0):
     return l_ts, l_secs, x, valid, r_ts, r_valids, r_values
 
 
-def bench_tpu(data, burst: int = 30):
+def bench_tpu(data, burst: int = 100):
     """Sustained device throughput: launch a burst of async dispatches
     and block once at the end.  Per-call ``block_until_ready`` would
     charge each step the full host->device round-trip (~150us on this
