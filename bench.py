@@ -20,6 +20,7 @@ import numpy as np
 
 import tempo_tpu  # noqa: F401
 import jax
+import jax.numpy as jnp
 
 from __graft_entry__ import N_RIGHT_COLS, _forward_step
 
@@ -49,15 +50,28 @@ def bench_tpu(data, burst: int = 100):
     and block once at the end.  Per-call ``block_until_ready`` would
     charge each step the full host->device round-trip (~150us on this
     tunnel), which bulk pipelines amortise by keeping the device queue
-    full; a burst measures what the chip actually sustains."""
+    full; a burst measures what the chip actually sustains.
+
+    Every dispatch gets a distinct scalar scale on the metric input so
+    no layer of the stack (runtime result caches, remote-execution
+    memoization) can elide repeated identical executions — measured
+    identical-args bursts ran faster than the HBM bandwidth bound
+    allows, i.e. they were not all executing."""
     args = [jax.device_put(a) for a in data]
-    fn = jax.jit(_forward_step)
-    jax.block_until_ready(fn(*args))          # compile + warmup
+
+    @jax.jit
+    def step(scale, l_ts, l_secs, x, valid, r_ts, r_valids, r_values):
+        return _forward_step(l_ts, l_secs, x * scale, valid, r_ts,
+                             r_valids, r_values)
+
+    jax.block_until_ready(step(jnp.float32(1.0), *args))   # compile + warmup
     times = []
+    i = 0
     for _ in range(ITERS):
         t0 = time.perf_counter()
         for _ in range(burst):
-            out = fn(*args)
+            i += 1
+            out = step(jnp.float32(1.0 + i * 1e-6), *args)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) / burst)
     return (K * L) / float(np.median(times))

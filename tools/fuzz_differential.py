@@ -1,0 +1,146 @@
+"""Differential fuzz: tempo_tpu ops vs pandas oracles, adversarial shapes.
+
+Usage:
+    JAX_PLATFORMS=cpu FUZZ_SEEDS=60 python tools/fuzz_differential.py   # exact f64
+    FUZZ_SEEDS=6 FUZZ_ATOL=1e-4 python tools/fuzz_differential.py       # on TPU, f32
+
+Adversarial modes per seed: plain, all-tied timestamps, sub-second
+timestamps, all-null metric, shuffled input order.  Exits non-zero on
+any divergence.  (Kept out of the default pytest run for time; CI runs
+the fixed-fixture + property suites.)
+"""
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pandas as pd
+
+import tempo_tpu
+from tempo_tpu import TSDF
+
+ATOL = float(os.environ.get("FUZZ_ATOL", "1e-9"))
+N_SEEDS = int(os.environ.get("FUZZ_SEEDS", "60"))
+
+fails = []
+
+
+def frame(rng, adversarial):
+    n_keys = int(rng.integers(1, 6))
+    n = int(rng.integers(1, 120))
+    keys = rng.integers(0, n_keys, n)
+    secs = rng.integers(-50, 200, n).astype(float)
+    if adversarial == "allties":
+        secs[:] = 42.0
+    elif adversarial == "subsec":
+        secs = secs + rng.random(n)
+    ts = pd.Timestamp("2024-01-01") + pd.to_timedelta((secs * 1000).astype(int), unit="ms")
+    v = rng.standard_normal(n)
+    if adversarial == "allnull":
+        v[:] = np.nan
+    else:
+        v[rng.random(n) < 0.2] = np.nan
+    df = pd.DataFrame({"k": np.char.add("s", keys.astype(str)), "ts": ts, "v": v})
+    if adversarial == "shuffled":
+        df = df.sample(frac=1.0, random_state=int(rng.integers(1 << 30))).reset_index(drop=True)
+    return df
+
+
+def check(name, seed, adv, fn):
+    try:
+        fn()
+    except Exception:
+        fails.append((name, seed, adv, traceback.format_exc(limit=4)))
+
+
+def oracle_asof(left, right):
+    rows = []
+    for (k, lts) in left[["k", "ts"]].itertuples(index=False):
+        sub = right[(right.k == k) & (right.ts <= lts)]
+        rv = sub.sort_values("ts", kind="stable")["v"].dropna()
+        rows.append(rv.iloc[-1] if len(rv) else np.nan)
+    return np.array(rows)
+
+
+def t_asof(rng, adv):
+    left, right = frame(rng, adv), frame(rng, adv)
+    tl = TSDF(left, "ts", ["k"])
+    tr = TSDF(right, "ts", ["k"])
+    got = tl.asofJoin(tr).df.sort_values(["k", "ts"], kind="stable").reset_index(drop=True)
+    ls = left.sort_values(["k", "ts"], kind="stable").reset_index(drop=True)
+    want = oracle_asof(ls, right)
+    np.testing.assert_allclose(got["right_v"].to_numpy(dtype=float), want,
+                               atol=ATOL, rtol=1e-5, equal_nan=True)
+
+
+def t_rangestats(rng, adv):
+    df = frame(rng, adv)
+    W = int(rng.integers(1, 30))
+    got = TSDF(df, "ts", ["k"]).withRangeStats(colsToSummarize=["v"],
+                                               rangeBackWindowSecs=W).df
+    for i, (k, ts) in enumerate(got[["k", "ts"]].itertuples(index=False)):
+        tl = df.ts.astype("datetime64[ns]").astype("int64") // 10**9
+        me = ts.value // 10**9
+        sub = df[(df.k == k) & (tl >= me - W) & (tl <= me)]
+        vv = sub["v"].dropna()
+        want_cnt = len(vv)
+        assert int(got["count_v"].iloc[i]) == want_cnt, (i, k, ts)
+        if want_cnt:
+            np.testing.assert_allclose(got["mean_v"].iloc[i], vv.mean(),
+                                       atol=ATOL, rtol=1e-5)
+            np.testing.assert_allclose(got["min_v"].iloc[i], vv.min(),
+                                       atol=ATOL, rtol=1e-5)
+            np.testing.assert_allclose(got["max_v"].iloc[i], vv.max(),
+                                       atol=ATOL, rtol=1e-5)
+
+
+def t_resample_interp(rng, adv):
+    df = frame(rng, adv)
+    r = TSDF(df, "ts", ["k"]).resample("min", "mean")
+    assert len(r.df) >= 1 or len(df) == 0
+    out = r.interpolate(method="ffill")
+    assert len(out.df) >= len(r.df)
+
+
+def t_grouped_ema_vwap(rng, adv):
+    df = frame(rng, adv)
+    t = TSDF(df, "ts", ["k"])
+    t.withGroupedStats(metricCols=["v"], freq="1 minute")
+    t.EMA("v", window=5)
+    t.EMA("v", exact=True)
+    df2 = df.rename(columns={"v": "price"}).assign(volume=np.abs(rng.standard_normal(len(df))) + 0.1)
+    TSDF(df2, "ts", ["k"]).vwap(frequency="m")
+    t.describe()
+    if len(df) > 2:
+        t.autocorr("v", 1)
+
+
+def t_fourier_lookback(rng, adv):
+    df = frame(rng, adv)
+    t = TSDF(df, "ts", ["k"])
+    t.fourier_transform(1.0, "v")
+    t.withLookbackFeatures(["v"], 3, exactSize=False)
+
+
+def main():
+    ADVS = [None, "allties", "subsec", "allnull", "shuffled"]
+    TESTS = [t_asof, t_rangestats, t_resample_interp, t_grouped_ema_vwap, t_fourier_lookback]
+
+    for seed in range(N_SEEDS):
+        for adv in ADVS:
+            rng = np.random.default_rng(seed * 37 + hash(adv or "x") % 1000)
+            for fn in TESTS:
+                check(fn.__name__, seed, adv, lambda: fn(np.random.default_rng(seed * 101 + 7), adv))
+
+    print(f"fuzz done: {len(fails)} failures")
+    for name, seed, adv, tb in fails[:6]:
+        print("=" * 70)
+        print(name, "seed", seed, "adv", adv)
+        print(tb)
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    main()
