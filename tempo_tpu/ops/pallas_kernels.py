@@ -172,12 +172,7 @@ def cumsum3(x, valid, interpret: bool = False):
 
 
 def _supported(x: jax.Array) -> bool:
-    return (
-        x.dtype == jnp.float32
-        and x.ndim == 2
-        and x.shape[1] % LANE == 0
-        and jax.default_backend() == "tpu"
-    )
+    return x.dtype == jnp.float32 and _index_supported(x)
 
 
 def _grid(K: int, bk_max: int = _BK):
@@ -217,10 +212,7 @@ def _last_valid_call(x, valid, interpret=False):
             _last_valid_kernel,
             grid=grid,
             in_specs=[spec, spec],
-            out_specs=[
-                spec,
-                pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            ],
+            out_specs=[spec, spec],
             out_shape=[
                 jax.ShapeDtypeStruct((K, L), jnp.float32),
                 jax.ShapeDtypeStruct((K, L), jnp.bool_),

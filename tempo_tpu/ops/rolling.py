@@ -29,13 +29,6 @@ import jax.numpy as jnp
 from tempo_tpu.ops import window_utils as wu
 
 
-def _exclusive_cumsum(x: jnp.ndarray) -> jnp.ndarray:
-    """[..., L] -> [..., L+1] exclusive prefix sums."""
-    c = wu.cumsum(x, axis=-1)
-    zero = jnp.zeros(x.shape[:-1] + (1,), dtype=c.dtype)
-    return jnp.concatenate([zero, c], axis=-1)
-
-
 def _sparse_table(arr: jnp.ndarray, fill, reducer, nlev: int = 0) -> jnp.ndarray:
     """Log-doubling table [K, L, nlev]: level k reduces the trailing 2^k
     elements ending at each position.  ``nlev`` caps the levels when the
@@ -123,7 +116,6 @@ def windowed_stats(
     from tempo_tpu.ops import pallas_kernels as pk
 
     P1, P2, Pc = pk.cumsum3(xc, valid)
-    P2 = P2.astype(x.dtype)
 
     def win(P):
         P = P.astype(x.dtype)
