@@ -1,0 +1,43 @@
+"""Display/env adapter tests (parity: tests/tsdf_tests.py:567-576)."""
+
+import logging
+
+import pandas as pd
+
+import tempo_tpu.utils as utils
+from tempo_tpu import TSDF, display
+
+
+def _frame():
+    return pd.DataFrame({
+        "k": ["a", "b"],
+        "event_ts": pd.to_datetime(["2024-01-01", "2024-01-02"]),
+        "v": [1.0, 2.0],
+    })
+
+
+def test_display_binding_matches_environment():
+    """Outside a notebook the terminal binding is active (the reference
+    asserts the env-appropriate function is bound, tsdf_tests.py:571-576)."""
+    assert not utils.ENV_BOOLEAN
+    assert display is utils.display
+
+
+def test_display_renders_tsdf_and_dataframe(capsys):
+    t = TSDF(_frame(), "event_ts", ["k"])
+    display(t)
+    display(t.df)
+    out = capsys.readouterr().out
+    assert out.count("2024-01-01") == 2
+
+
+def test_display_rejects_non_frames(caplog):
+    with caplog.at_level(logging.ERROR):
+        display(42)
+    assert "not available" in caplog.text
+
+
+def test_show_vertical(capsys):
+    TSDF(_frame(), "event_ts", ["k"]).show(vertical=True)
+    out = capsys.readouterr().out
+    assert "-RECORD 0-" in out
