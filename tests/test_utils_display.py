@@ -17,9 +17,12 @@ def _frame():
 
 
 def test_display_binding_matches_environment():
-    """Outside a notebook the terminal binding is active (the reference
-    asserts the env-appropriate function is bound, tsdf_tests.py:571-576)."""
-    assert not utils.ENV_BOOLEAN
+    """The env-appropriate function is bound (reference asserts per
+    environment, tsdf_tests.py:571-576)."""
+    if utils.ENV_BOOLEAN:
+        assert utils.display.__name__ == "display_html_improvised"
+    else:
+        assert utils.display.__name__ == "display_terminal"
     assert display is utils.display
 
 
