@@ -35,8 +35,16 @@ from tempo_tpu.parallel.multihost import (
     process_series_range,
     shard_series_global,
 )
+from tempo_tpu.parallel.reshard import (
+    reshard,
+    all_to_all_series_to_time,
+    all_to_all_time_to_series,
+)
 
 __all__ = [
+    "reshard",
+    "all_to_all_series_to_time",
+    "all_to_all_time_to_series",
     "make_mesh",
     "series_sharding",
     "shard_series",

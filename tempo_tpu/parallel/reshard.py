@@ -1,0 +1,104 @@
+"""Resharding between parallelism layouts (the shuffle analog).
+
+The reference switches distribution layouts with Spark shuffles: a
+``Window.partitionBy(key)`` stage hash-shuffles by key, a skew-bucketed
+stage re-shuffles by (key, bracket) (tsdf.py:164-190, 549-558).  The
+TPU-native equivalent is moving a packed ``[K, L]`` batch between
+
+* **series layout** ``P('series', None)`` — each device owns whole
+  series (the data-parallel layout every per-key op wants), and
+* **time layout** ``P(None, 'time')`` or ``P('series', 'time')`` — each
+  device owns a time slice (the sequence-parallel layout the halo
+  kernels in :mod:`tempo_tpu.parallel.halo` want for series too long
+  for one device),
+
+with ICI collectives instead of a network shuffle.  Two entry points:
+
+* :func:`reshard` — declarative: hand XLA the target sharding and let
+  it plan the collectives (the normal path; XLA emits an all-to-all).
+* :func:`all_to_all_series_to_time` / ``..._time_to_series`` —
+  explicit ``lax.all_to_all`` inside ``shard_map``, for composition
+  into hand-written distributed kernels where the collective must stay
+  inside the same program as the compute it feeds.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tempo_tpu.parallel.halo import shard_map
+
+
+def reshard(arr: jax.Array, mesh: Mesh, spec: P) -> jax.Array:
+    """Move ``arr`` to ``NamedSharding(mesh, spec)``; XLA plans the
+    ICI/DCN collectives (all-to-all for a layout switch, all-gather for
+    replication)."""
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def _axis_sizes(mesh: Mesh, series_axis: str, time_axis: str):
+    return mesh.shape[series_axis], mesh.shape[time_axis]
+
+
+def all_to_all_series_to_time(
+    arr: jax.Array,
+    mesh: Mesh,
+    series_axis: str = "series",
+    time_axis: str = "time",
+) -> jax.Array:
+    """[K, L] sharded P(series, time) -> P(time-major on series dim):
+    after the call the ``time`` axis owns contiguous series blocks and
+    every device holds full rows for its block — one ``lax.all_to_all``
+    over the time axis per series group.
+
+    Use when a time-sharded pipeline stage (halo kernels) feeds a
+    per-series stage (resample, FFT) without a host round-trip.
+    """
+    n_s, n_t = _axis_sizes(mesh, series_axis, time_axis)
+    if arr.shape[0] % (n_s * n_t) != 0:
+        raise ValueError(
+            f"series dim {arr.shape[0]} must divide mesh {n_s}x{n_t}"
+        )
+
+    def kernel(block):  # block: [K/n_s, L/n_t] on each device
+        # split local series between time-axis peers, exchange, and
+        # concatenate the received time slices back into full rows
+        return jax.lax.all_to_all(
+            block, time_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    fn = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(series_axis, time_axis),),
+        out_specs=P((series_axis, time_axis), None),
+    )
+    return jax.jit(fn)(arr)
+
+
+def all_to_all_time_to_series(
+    arr: jax.Array,
+    mesh: Mesh,
+    series_axis: str = "series",
+    time_axis: str = "time",
+) -> jax.Array:
+    """Inverse of :func:`all_to_all_series_to_time`: full-row blocks
+    sharded over (series, time) jointly on dim 0 -> P(series, time)."""
+    n_s, n_t = _axis_sizes(mesh, series_axis, time_axis)
+    if arr.shape[0] % (n_s * n_t) != 0 or arr.shape[1] % n_t != 0:
+        raise ValueError(f"shape {arr.shape} incompatible with {n_s}x{n_t}")
+
+    def kernel(block):  # block: [K/(n_s*n_t), L] on each device
+        return jax.lax.all_to_all(
+            block, time_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+
+    fn = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P((series_axis, time_axis), None),),
+        out_specs=P(series_axis, time_axis),
+    )
+    return jax.jit(fn)(arr)
