@@ -43,6 +43,25 @@ def test_tz_aware_asof_join():
     assert j.df["right_bid"].tolist() == [1.0, 1.5, 2.0]
 
 
+def test_from_ordering_columns():
+    """Scala sequence-number ctor (TSDF.scala:584-616): synthesize a
+    per-key row_number over the ordering columns."""
+    df = pd.DataFrame({
+        "k": ["a", "a", "b", "a"],
+        "event_ts": pd.to_datetime(
+            ["2024-01-01 10:00"] * 2 + ["2024-01-01 10:00", "2024-01-01 09:00"]),
+        "prio": [2, 1, 5, 9],
+    })
+    t = TSDF.fromOrderingColumns(df, "event_ts", ["event_ts", "prio"],
+                                 partition_cols=["k"])
+    assert t.sequence_col == "sequence_num"
+    out = t.df.sort_values(["k", "sequence_num"]).reset_index(drop=True)
+    # key a: 09:00 first, then the tied 10:00 rows ordered by prio 1 < 2
+    assert out[out.k == "a"]["prio"].tolist() == [9, 1, 2]
+    assert out[out.k == "a"]["sequence_num"].tolist() == [1, 2, 3]
+    assert out[out.k == "b"]["sequence_num"].tolist() == [1]
+
+
 def test_nullable_extension_dtypes():
     df = pd.DataFrame({
         "k": ["a", "a"],
