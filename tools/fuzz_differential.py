@@ -75,6 +75,31 @@ def t_asof(rng, adv):
                                atol=ATOL, rtol=1e-5, equal_nan=True)
 
 
+def t_asof_sequence(rng, adv):
+    """Merge-path AS-OF with a sequence tie-break.  Left rows carry a
+    null sequence, which sorts NULLS FIRST (tsdf.py:117-121): at a tied
+    timestamp the left row precedes every right row with a non-null
+    sequence, so only strictly-earlier right rows are eligible."""
+    left, right = frame(rng, adv), frame(rng, adv)
+    right = right.assign(seq=rng.integers(0, 50, len(right)))
+
+    tl = TSDF(left, "ts", ["k"])
+    tr = TSDF(right, "ts", ["k"], sequence_col="seq")
+    got = tl.asofJoin(tr).df.sort_values(["k", "ts"], kind="stable").reset_index(drop=True)
+
+    rs = right.sort_values(["ts", "seq"], kind="stable")
+    rows = []
+    for (k, lts) in (
+        left.sort_values(["k", "ts"], kind="stable")[["k", "ts"]]
+        .itertuples(index=False)
+    ):
+        sub = rs[(rs.k == k) & (rs.ts < lts)]["v"].dropna()
+        rows.append(sub.iloc[-1] if len(sub) else np.nan)
+    np.testing.assert_allclose(got["right_v"].to_numpy(dtype=float),
+                               np.array(rows), atol=ATOL, rtol=1e-5,
+                               equal_nan=True)
+
+
 def t_rangestats(rng, adv):
     df = frame(rng, adv)
     W = int(rng.integers(1, 30))
@@ -126,7 +151,7 @@ def t_fourier_lookback(rng, adv):
 
 def main():
     ADVS = [None, "allties", "subsec", "allnull", "shuffled"]
-    TESTS = [t_asof, t_rangestats, t_resample_interp, t_grouped_ema_vwap, t_fourier_lookback]
+    TESTS = [t_asof, t_asof_sequence, t_rangestats, t_resample_interp, t_grouped_ema_vwap, t_fourier_lookback]
 
     for seed in range(N_SEEDS):
         for adv in ADVS:
