@@ -1,0 +1,48 @@
+"""Device kernels on packed [K, L] series — the public window-builder
+surface (the packed-array equivalent of the reference's WindowSpec
+builders, scala TSDF.scala:127-159; mapping table in MIGRATION.md).
+
+Kernel-choice note: the scan-shaped ops (EMA, last/first-valid, prefix
+sums) run as Pallas VMEM ladders on TPU (see ``pallas_kernels``);
+variable-width *range* windows stay on XLA because their queries need
+per-element dynamic gathers, which Mosaic cannot lower (probed on v5e)
+— and XLA's cumsum+gather formulation is already near the HBM bound.
+"""
+
+from tempo_tpu.ops.rolling import (
+    range_window_bounds,
+    windowed_stats,
+    segment_stats,
+    ema_compat,
+    ema_exact,
+)
+from tempo_tpu.ops.window_utils import (
+    last_valid_index,
+    first_valid_index,
+    windowed_max_last,
+    searchsorted_batched,
+)
+from tempo_tpu.ops.pallas_kernels import (
+    ema_scan,
+    cumsum3,
+    last_valid_scan,
+    last_valid_index_scan,
+    first_valid_index_scan,
+)
+
+__all__ = [
+    "range_window_bounds",
+    "windowed_stats",
+    "segment_stats",
+    "ema_compat",
+    "ema_exact",
+    "last_valid_index",
+    "first_valid_index",
+    "windowed_max_last",
+    "searchsorted_batched",
+    "ema_scan",
+    "cumsum3",
+    "last_valid_scan",
+    "last_valid_index_scan",
+    "first_valid_index_scan",
+]
