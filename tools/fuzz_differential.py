@@ -100,6 +100,41 @@ def t_asof_sequence(rng, adv):
                                equal_nan=True)
 
 
+def t_asof_max_lookback(rng, adv):
+    """Scala maxLookback (asofJoin.scala:64-88): the lookback is a ROW
+    cap on the merged left+right stream ordered by (ts, rec) with right
+    rows before left rows at a tied timestamp."""
+    left, right = frame(rng, adv), frame(rng, adv)
+    cap = int(rng.integers(1, 6))
+    tl = TSDF(left, "ts", ["k"])
+    tr = TSDF(right, "ts", ["k"])
+    got = (
+        tl.asofJoin(tr, maxLookback=cap)
+        .df.sort_values(["k", "ts"], kind="stable").reset_index(drop=True)
+    )
+
+    rows = []
+    for k, lg in left.sort_values(["k", "ts"], kind="stable").groupby("k", sort=False):
+        stream = []  # (ts, rec, is_right, v) in merged order
+        for t, v in right[right.k == k].sort_values("ts", kind="stable")[["ts", "v"]].itertuples(index=False):
+            stream.append((t, -1, True, v))
+        for t in lg["ts"]:
+            stream.append((t, 1, False, np.nan))
+        stream.sort(key=lambda r: (r[0].value, r[1]))
+        for p, (t, rec, is_right, _) in enumerate(stream):
+            if is_right:
+                continue
+            lo = max(0, p - cap)
+            vals = [v for (tt, rr, ir, v) in stream[lo:p + 1]
+                    if ir and not (isinstance(v, float) and np.isnan(v))]
+            rows.append((k, t, vals[-1] if vals else np.nan))
+    want = pd.DataFrame(rows, columns=["k", "ts", "want"]).sort_values(
+        ["k", "ts"], kind="stable").reset_index(drop=True)
+    np.testing.assert_allclose(got["right_v"].to_numpy(dtype=float),
+                               want["want"].to_numpy(), atol=ATOL,
+                               rtol=1e-5, equal_nan=True)
+
+
 def t_rangestats(rng, adv):
     df = frame(rng, adv)
     W = int(rng.integers(1, 30))
@@ -151,7 +186,7 @@ def t_fourier_lookback(rng, adv):
 
 def main():
     ADVS = [None, "allties", "subsec", "allnull", "shuffled"]
-    TESTS = [t_asof, t_asof_sequence, t_rangestats, t_resample_interp, t_grouped_ema_vwap, t_fourier_lookback]
+    TESTS = [t_asof, t_asof_sequence, t_asof_max_lookback, t_rangestats, t_resample_interp, t_grouped_ema_vwap, t_fourier_lookback]
 
     for seed in range(N_SEEDS):
         for adv in ADVS:
