@@ -1,0 +1,81 @@
+"""DataFrame-mirror operations (parity: scala TSDF.scala:218-293 and
+MirroredDataTests.scala:33-45, which chains the ops and asserts counts).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+
+
+def _tsdf():
+    df = pd.DataFrame({
+        "symbol": ["A", "A", "B", "B"],
+        "event_ts": pd.to_datetime(
+            ["2024-01-01 10:00", "2024-01-01 11:00",
+             "2024-01-01 10:30", "2024-01-01 11:30"]),
+        "price": [10.0, 11.0, 20.0, 21.0],
+        "qty": [1, 2, 3, 4],
+    })
+    return TSDF(df, "event_ts", ["symbol"])
+
+
+def test_chained_mirror_ops():
+    """Chain the full mirror surface like the Scala MirroredDataTests."""
+    t = _tsdf()
+    out = (
+        t.select("symbol", "event_ts", "price", "qty")
+        .withColumn("notional", lambda df: df.price * df.qty)
+        .withColumnRenamed("qty", "quantity")
+        .filter("price > 10")
+        .where(lambda df: df.quantity > 1)
+        .union(t.withColumn("notional", lambda df: df.price * df.qty)
+                .withColumnRenamed("qty", "quantity")
+                .filter("price > 10")
+                .where(lambda df: df.quantity > 1))
+        .limit(10)
+        .drop("notional")
+    )
+    assert isinstance(out, TSDF)
+    assert out.count() == 6
+    assert out.ts_col == "event_ts" and out.partitionCols == ["symbol"]
+
+
+def test_select_requires_structural_cols():
+    with pytest.raises(Exception):
+        _tsdf().select("price")
+    sel = _tsdf().select("symbol", "event_ts", "price")
+    assert sel.columns == ["symbol", "event_ts", "price"]
+
+
+def test_select_star_and_list():
+    t = _tsdf()
+    assert t.select("*").columns == t.columns
+    assert t.select(["symbol", "event_ts", "qty"]).columns == [
+        "symbol", "event_ts", "qty"]
+
+
+def test_select_expr_alias():
+    out = _tsdf().selectExpr("symbol", "event_ts", "price * qty as notional")
+    assert out.df["notional"].tolist() == [10.0, 22.0, 60.0, 84.0]
+
+
+def test_rename_structural_column_tracks():
+    t = _tsdf().withColumnRenamed("event_ts", "ts")
+    assert t.ts_col == "ts"
+    t2 = _tsdf().withColumnRenamed("symbol", "sym")
+    assert t2.partitionCols == ["sym"]
+
+
+def test_column_classes():
+    t = _tsdf()
+    assert t.structuralColumns == ["event_ts", "symbol"]
+    assert t.observationColumns == ["price", "qty"]
+    assert t.measureColumns == ["price", "qty"]
+
+
+def test_partitioned_by_alias():
+    t = _tsdf().partitionedBy([])
+    assert t.partitionCols == []
+    assert t.unionAll(_tsdf().partitionedBy([])).count() == 8
