@@ -211,3 +211,24 @@ def test_range_stats_multi_key_and_cols():
     np.testing.assert_allclose(res["max_x"], oracle["mx"], atol=1e-12)
     np.testing.assert_allclose(res["sum_x"], oracle["sm"], atol=1e-9)
     np.testing.assert_allclose(res["stddev_x"], oracle["sd"], atol=1e-9)
+
+
+def test_ema_scala_inclusive_window_golden():
+    """Exact Scala expected values (EMATests.scala:25-40): window=2,
+    exp_factor=0.5, lag range 0..window INCLUSIVE, with a tied-timestamp
+    pair resolved by stable input order."""
+    df = pd.DataFrame({
+        "symbol": ["S1", "S1", "S1", "S2", "S2", "S2"],
+        "event_ts": pd.to_datetime([
+            "2020-08-01 00:00:10", "2020-08-01 00:01:12",
+            "2020-08-01 00:02:23", "2020-09-01 00:02:10",
+            "2020-09-01 00:19:12", "2020-09-01 00:19:12"]),
+        "trade_pr": [8.0, 4.0, 2.0, 8.0, 16.0, 32.0],
+    })
+    res = TSDF(df, partition_cols=["symbol"]).EMA(
+        "trade_pr", window=2, exp_factor=0.5, inclusive_window=True
+    ).df
+    np.testing.assert_allclose(
+        res["EMA_trade_pr"].to_numpy(), [4.0, 4.0, 3.0, 4.0, 10.0, 21.0],
+        atol=1e-9,
+    )
