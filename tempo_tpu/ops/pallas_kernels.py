@@ -140,8 +140,8 @@ def _cumsum3_kernel(x_ref, valid_ref, s1_ref, s2_ref, c_ref):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _cumsum3_call(x, valid, interpret=False):
     K, L = x.shape
-    # three carries + three outputs live at once: halve the row block
-    grid, bk = _grid(K, bk_max=16)
+    # three carries + three outputs live at once: a larger array budget
+    grid, bk = _grid(K, L, arrays=16, bk_max=16)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         return pl.pallas_call(
@@ -175,15 +175,27 @@ def _supported(x: jax.Array) -> bool:
     return x.dtype == jnp.float32 and _index_supported(x)
 
 
-def _grid(K: int, bk_max: int = _BK):
-    bk = min(bk_max, K) if K % min(bk_max, K) == 0 else 8 if K % 8 == 0 else 1
+def _grid(K: int, L: int, arrays: int = 12, bk_max: int = _BK):
+    """Row-block size fitting the scoped-VMEM cap: ``arrays`` is a
+    conservative count of simultaneously-live [bk, L] f32 buffers
+    (carries + roll temps + pipelined I/O).  A fixed block OOMs once L
+    grows — [32, 16384] f32 blew the 16M cap at 23.5M, measured."""
+    budget = 14 * 2**20  # headroom under the 16M scoped-vmem limit
+    cap = max(1, budget // (L * 4 * arrays))
+    # Mosaic requires the sublane block be a multiple of 8 or the whole
+    # array: descend through powers of two >= 8 that divide K
+    bk = 1 << max(min(bk_max, cap, K), 1).bit_length() - 1
+    while bk >= 8 and K % bk != 0:
+        bk //= 2
+    if bk < 8:
+        return (1,), K
     return (K // bk,), bk
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _ema_call(x, valid, alpha, interpret=False):
     K, L = x.shape
-    grid, bk = _grid(K)
+    grid, bk = _grid(K, L)
     # index maps must trace as i32: under the library's global x64 mode
     # they come out i64, which Mosaic's func.return rejects
     with jax.enable_x64(False):
@@ -205,7 +217,7 @@ def _ema_call(x, valid, alpha, interpret=False):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _last_valid_call(x, valid, interpret=False):
     K, L = x.shape
-    grid, bk = _grid(K)
+    grid, bk = _grid(K, L)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         return pl.pallas_call(
@@ -224,7 +236,7 @@ def _last_valid_call(x, valid, interpret=False):
 @functools.partial(jax.jit, static_argnames=("kernel", "interpret"))
 def _index_scan_call(valid, kernel, interpret=False):
     K, L = valid.shape
-    grid, bk = _grid(K)
+    grid, bk = _grid(K, L, arrays=8)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         return pl.pallas_call(
