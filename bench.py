@@ -1,19 +1,47 @@
 """Headline benchmark: fused AS-OF join + 10s range stats + EMA.
 
-Mirrors BASELINE.json configs 1-3 (quickstart phone<->watch asofJoin,
-withRangeStats 10s rolling mean/stddev, EMA) as one fused jitted program
-on packed [K, L] series.  The reference publishes no numbers
-(BASELINE.md) and pyspark is not installed in this image, so the
-denominator is the strongest available single-node CPU oracle for the
-same op set: pandas ``merge_asof(by=key)`` + groupby-rolling('10s')
-mean/std + groupby ewm — measured here on a subsample and scaled.
-Pandas local is faster than Spark local-mode per row, so ``vs_baseline``
-is a *conservative* stand-in for the >=20x-vs-Spark-local north star.
+Covers BASELINE.json configs 1-5 (quickstart phone<->watch asofJoin,
+withRangeStats 10s rolling stats, resample+EMA, synthetic skewed NBBO
+join, and the 1B-row skew-bracketed join) as jitted programs on packed
+[K, L] series.  The reference publishes no numbers (BASELINE.md) and
+pyspark is not installed in this image, so the denominator is the
+strongest available single-node CPU oracle for the same op set: pandas
+``merge_asof(by=key)`` + groupby-rolling('10s') mean/std + groupby ewm —
+measured here on a subsample and scaled.  Pandas local is faster than
+Spark local-mode per row, so ``vs_baseline`` is a *conservative*
+stand-in for the >=20x-vs-Spark-local north star.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Honesty guards (round-2 rework; VERDICT r1 found the round-1 number
+physically impossible — the remote execution stack materialises
+dispatch results *lazily*, so un-consumed burst dispatches never
+executed at all):
+
+* the pipeline iterations are chained INSIDE one compiled program: a
+  ``lax.fori_loop`` whose carry (``scale_{i+1} = 1 + eps *
+  tanh(probe(out_i))``, the probe touching every output) makes every
+  iteration data-dependent on the previous one, and whose timestamp
+  inputs are shifted by a carry-derived offset each iteration so no
+  sub-computation is loop-invariant — nothing can be elided, hoisted,
+  memoized, or reordered, and the accumulated probe is returned to the
+  host;
+* per-iteration time comes from *differencing two trip counts*
+  (t(N2) - t(N1)) / (N2 - N1), cancelling the tunnel's multi-second
+  per-dispatch round-trip so the number measures the chip;
+* a physics assertion: implied compulsory HBM traffic (the input
+  arrays are re-read from HBM every iteration — they exceed VMEM)
+  divided by the per-iteration time must not exceed the v5e spec
+  (~819 GB/s), else the benchmark aborts loudly;
+* a value audit: the TPU f32 output of the fused step is checked
+  against a numpy float64 oracle on a series subsample.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"} plus
+supporting fields (implied HBM GB/s + fraction of spec, per-config
+rows/sec).
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -22,12 +50,32 @@ import tempo_tpu  # noqa: F401
 import jax
 import jax.numpy as jnp
 
-from __graft_entry__ import N_RIGHT_COLS, _forward_step
+from __graft_entry__ import (
+    MAX_WINDOW_ROWS, N_RIGHT_COLS, WINDOW_SECS, _forward_step,
+)
+from tempo_tpu.ops import asof as asof_ops
+from tempo_tpu.ops import pallas_kernels as pk
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.packing import TS_PAD
 
 K = 1024          # series (partition keys)
 L = 8192          # rows per series  -> 8.4M left rows per step
-SUB_K = 32        # series subsample for the pandas oracle
-ITERS = 7
+SUB_K = 8         # series subsample for the oracles
+ITERS = 5         # timing repeats per trip count (median)
+N_SHORT = 16      # fori_loop trip counts for the differencing estimate
+N_LONG = 528
+TOTAL_ROWS_CONFIG5 = 1_000_000_000
+
+if os.environ.get("TEMPO_BENCH_SMOKE"):
+    # correctness smoke (CPU CI): full code path, tiny scale
+    K, L, SUB_K, ITERS = 64, 512, 4, 2
+    N_SHORT, N_LONG = 2, 10
+    TOTAL_ROWS_CONFIG5 = 2_000_000
+
+# v5e spec sheet: 819 GB/s HBM bandwidth per chip.  Compulsory traffic
+# (inputs once + outputs once, no intermediates) at a higher implied
+# rate is physically impossible — it means dispatches did not all run.
+V5E_HBM_BYTES_PER_SEC = 819e9
 
 
 def make_data(seed=0):
@@ -45,53 +93,326 @@ def make_data(seed=0):
     return l_ts, l_secs, x, valid, r_ts, r_valids, r_values
 
 
-def bench_tpu(data, burst: int = 100):
-    """Sustained device throughput: launch a burst of async dispatches
-    and block once at the end.  Per-call ``block_until_ready`` would
-    charge each step the full host->device round-trip (~150us on this
-    tunnel), which bulk pipelines amortise by keeping the device queue
-    full; a burst measures what the chip actually sustains.
+def _tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
 
-    Every dispatch gets a distinct scalar scale on the metric input so
-    no layer of the stack (runtime result caches, remote-execution
-    memoization) can elide repeated identical executions — measured
-    identical-args bursts ran faster than the HBM bandwidth bound
-    allows, i.e. they were not all executing."""
-    args = [jax.device_put(a) for a in data]
+
+def _probe(out):
+    """A scalar consuming EVERY element of every output array (full
+    reductions — a single-element sample would let XLA slice-propagate
+    and narrow the per-iteration work), folded into the next
+    iteration's input.  NaN-safe: unmatched join slots are legitimately
+    NaN and must not poison the carry (a NaN scale makes the int jitter
+    UB — measured: it faults the TPU worker)."""
+    leaves = jax.tree.leaves(out)
+    acc = jnp.float32(0.0)
+    for leaf in leaves:
+        acc = acc + jnp.nan_to_num(leaf.astype(jnp.float32)).sum() * 1e-9
+    return acc
+
+
+def _jitter_secs(scale):
+    """Small integer second-offset derived from the loop carry: shifting
+    BOTH sides' timestamps by it preserves every op's semantics while
+    making all inputs iteration-dependent, so no sub-computation
+    (searchsorted, sparse tables, ...) is loop-invariant-hoistable."""
+    return (jnp.abs(scale) * 1e6).astype(jnp.int64) % 16
+
+
+def _loop_rate(body, args, n_rows, label):
+    """Per-iteration rate of ``body(scale, *args) -> (out_dict)``,
+    chained inside one fori_loop dispatch, timed by trip-count
+    differencing, physics-audited against the HBM spec.
+
+    Returns (rows_per_sec, implied_bw, t_iter)."""
 
     @jax.jit
-    def step(scale, l_ts, l_secs, x, valid, r_ts, r_valids, r_values):
-        return _forward_step(l_ts, l_secs, x * scale, valid, r_ts,
-                             r_valids, r_values)
+    def run(n, scale0, *args):
+        def step(i, carry):
+            scale, acc = carry
+            out = body(scale, *args)
+            p = _probe(out)
+            return 1.0 + 1e-6 * jnp.tanh(p + acc * 1e-12), acc + p
+        return jax.lax.fori_loop(0, n, step, (scale0, jnp.float32(0.0)))
 
-    jax.block_until_ready(step(jnp.float32(1.0), *args))   # compile + warmup
-    times = []
-    i = 0
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        for _ in range(burst):
-            i += 1
-            out = step(jnp.float32(1.0 + i * 1e-6), *args)
-        jax.block_until_ready(out)
-        times.append((time.perf_counter() - t0) / burst)
-    return (K * L) / float(np.median(times))
+    print(f"[{label}] compiling...", file=sys.stderr, flush=True)
+    jax.block_until_ready(run(jnp.int32(1), jnp.float32(1.0), *args))
+    print(f"[{label}] timing...", file=sys.stderr, flush=True)
+
+    def timed(n):
+        ts = []
+        for i in range(ITERS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(
+                run(jnp.int32(n), jnp.float32(1.0 + i * 1e-6), *args)
+            )
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t_short, t_long = timed(N_SHORT), timed(N_LONG)
+    t_iter = max(t_long - t_short, 1e-9) / (N_LONG - N_SHORT)
+
+    # compulsory traffic floor: the input arrays exceed VMEM, so every
+    # iteration re-reads them from HBM (outputs/intermediates are extra)
+    in_bytes = _tree_bytes(args)
+    implied_bw = in_bytes / t_iter
+    if implied_bw > V5E_HBM_BYTES_PER_SEC and jax.default_backend() == "tpu":
+        raise SystemExit(
+            f"PHYSICS VIOLATION [{label}]: implied HBM read traffic "
+            f"{implied_bw / 1e9:.0f} GB/s exceeds the v5e spec "
+            f"{V5E_HBM_BYTES_PER_SEC / 1e9:.0f} GB/s "
+            f"({in_bytes / 1e6:.0f} MB compulsory reads/iteration in "
+            f"{t_iter * 1e6:.0f} us). Iterations were elided; the "
+            f"measurement is invalid."
+        )
+    print(f"[{label}] {n_rows / t_iter:,.0f} rows/s  "
+          f"({implied_bw / 1e9:.0f} GB/s implied)", file=sys.stderr,
+          flush=True)
+    return n_rows / t_iter, implied_bw, t_iter
+
+
+# ----------------------------------------------------------------------
+# Value audit: numpy float64 oracle on a subsample
+# ----------------------------------------------------------------------
+
+def _numpy_oracle(data, sub=SUB_K):
+    l_ts, l_secs, x, valid, r_ts, r_valids, r_values = (
+        a[..., :sub, :] for a in data
+    )
+    x64 = x.astype(np.float64)
+    Kx, Lx = x64.shape
+
+    pos = np.stack([np.searchsorted(r_ts[k], l_ts[k], side="right")
+                    for k in range(Kx)])
+    last = pos - 1
+    joined = np.full((N_RIGHT_COLS, Kx, Lx), np.nan)
+    for c in range(N_RIGHT_COLS):
+        lv = np.where(r_valids[c], np.arange(Lx)[None, :], -1)
+        lv = np.maximum.accumulate(lv, axis=1)
+        idx = np.take_along_axis(lv, np.maximum(last, 0), axis=1)
+        ok = (last >= 0) & (idx >= 0)
+        vals = np.take_along_axis(r_values[c].astype(np.float64),
+                                  np.maximum(idx, 0), axis=1)
+        joined[c] = np.where(ok, vals, np.nan)
+
+    mean = np.empty_like(x64)
+    cnt = np.empty_like(x64)
+    mn = np.empty_like(x64)
+    mx = np.empty_like(x64)
+    std = np.empty_like(x64)
+    w = int(WINDOW_SECS)
+    for k in range(Kx):
+        s = np.searchsorted(l_secs[k], l_secs[k] - w, side="left")
+        e = np.searchsorted(l_secs[k], l_secs[k], side="right")
+        for i in range(Lx):
+            win = x64[k, s[i]:e[i]][valid[k, s[i]:e[i]]]
+            cnt[k, i] = len(win)
+            mean[k, i] = win.mean() if len(win) else np.nan
+            mn[k, i] = win.min() if len(win) else np.nan
+            mx[k, i] = win.max() if len(win) else np.nan
+            std[k, i] = win.std(ddof=1) if len(win) > 1 else np.nan
+
+    ema = np.zeros_like(x64)
+    acc = np.zeros(Kx)
+    for i in range(Lx):
+        v = valid[:, i]
+        acc = np.where(v, 0.8 * acc + 0.2 * x64[:, i], acc)
+        ema[:, i] = acc
+    return {"joined": joined, "stats_mean": mean, "stats_count": cnt,
+            "stats_min": mn, "stats_max": mx, "stats_stddev": std,
+            "ema": ema}
+
+
+def _value_audit(out_full, data):
+    """Compare a SUB_K slice of the already-computed full-shape output
+    against the f64 oracle.  Reuses the bench's compiled program — a
+    separate small-shape compile repeatedly hung the axon remote
+    compiler — and fetches everything as ONE transfer."""
+    ref = _numpy_oracle(data)
+    keys = sorted(set(out_full) & set(ref))
+
+    @jax.jit
+    def slice_concat(out):
+        return jnp.concatenate([
+            out[k][..., :SUB_K, :].astype(jnp.float32).reshape(-1)
+            for k in keys
+        ])
+
+    flat = np.asarray(slice_concat(out_full)).astype(np.float64)
+    shapes = [out_full[k].shape[:-2] + (SUB_K, out_full[k].shape[-1])
+              for k in keys]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offs = np.cumsum([0] + sizes)
+    out = {k: flat[offs[i]:offs[i + 1]].reshape(shapes[i])
+           for i, k in enumerate(keys)}
+    for k, expect in ref.items():
+        # f32 prefix-sum drift at L=8192 bounds abs error near 1e-3 for
+        # the stddev/var path (quantified in BASELINE.md); the audit
+        # guards against wrong results, not ulp-level divergence
+        np.testing.assert_allclose(
+            out[k], expect, rtol=2e-3, atol=2e-3, equal_nan=True,
+            err_msg=f"TPU f32 output '{k}' diverged from the f64 oracle",
+        )
+
+
+# ----------------------------------------------------------------------
+# Per-config device benches (BASELINE.json configs 1-5)
+# ----------------------------------------------------------------------
+
+def bench_fused(data):
+    """Configs 1-3 fused: the headline number."""
+    args = [jax.device_put(a) for a in data]
+
+    # window-bound audit (ADVICE r1): the static MAX_WINDOW_ROWS cap must
+    # cover every real window or min/max silently degrade
+    start, end = rk.range_window_bounds(
+        jnp.asarray(data[1]), jnp.asarray(WINDOW_SECS)
+    )
+    real_max = int(jax.device_get(jnp.max(end - start)))
+    assert real_max + 16 <= MAX_WINDOW_ROWS, (
+        f"data windows span {real_max} rows (+16 jitter headroom) > "
+        f"MAX_WINDOW_ROWS={MAX_WINDOW_ROWS}; min/max would degrade"
+    )
+
+    def body(scale, l_ts, l_secs, x, valid, r_ts, r_valids, r_values):
+        js = _jitter_secs(scale)
+        ns = js * 1_000_000_000
+        return _forward_step(l_ts + ns, l_secs + js, x * scale, valid,
+                             r_ts + ns, r_valids, r_values)
+
+    return _loop_rate(body, args, K * L, label="fused")
+
+
+def bench_asof(data):
+    """Config 1: the AS-OF join alone."""
+    l_ts, _, _, _, r_ts, r_valids, r_values = data
+    args = [jax.device_put(a) for a in (l_ts, r_ts, r_valids, r_values)]
+
+    def body(scale, l_ts, r_ts, r_valids, r_values):
+        ns = _jitter_secs(scale) * 1_000_000_000
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            l_ts + ns, r_ts + ns, r_valids, n_cols=N_RIGHT_COLS
+        )
+        vals = jnp.take_along_axis(r_values * scale,
+                                   jnp.maximum(col_idx, 0), axis=-1)
+        return {"joined": jnp.where(col_idx >= 0, vals, jnp.nan)}
+
+    return _loop_rate(body, args, K * L, label="asof")
+
+
+def bench_range_stats(data):
+    """Config 2: withRangeStats 10s window."""
+    _, l_secs, x, valid, _, _, _ = data
+    args = [jax.device_put(a) for a in (l_secs, x, valid)]
+
+    def body(scale, l_secs, x, valid):
+        js = _jitter_secs(scale)
+        start, end = rk.range_window_bounds(l_secs + js,
+                                            jnp.asarray(WINDOW_SECS))
+        return rk.windowed_stats(x * scale, valid, start, end,
+                                 max_window=MAX_WINDOW_ROWS)
+
+    return _loop_rate(body, args, K * L, label="range_stats")
+
+
+def bench_resample_ema(data):
+    """Config 3: resample('min', 'floor') + EMA on the resampled series.
+    The downsampled series is represented packed-in-place: the value at
+    each 60s bucket head, invalid elsewhere (host compaction is not
+    device work)."""
+    _, l_secs, x, valid, _, _, _ = data
+    args = [jax.device_put(a) for a in (l_secs, x, valid)]
+
+    def body(scale, l_secs, x, valid):
+        bucket = (l_secs + _jitter_secs(scale)) // 60
+        head = jnp.concatenate(
+            [jnp.ones_like(bucket[:, :1], dtype=bool),
+             bucket[:, 1:] != bucket[:, :-1]], axis=-1,
+        ) & valid
+        res = jnp.where(head, x * scale, jnp.nan)
+        ema = pk.ema_scan(x * scale, head, 0.2)
+        return {"resampled": res, "ema": ema}
+
+    return _loop_rate(body, args, K * L, label="resample_ema")
+
+
+def _zipf_row_mask(rng, k, l):
+    """Validity mask with Zipfian per-series lengths (skewed symbols)."""
+    ranks = np.arange(1, k + 1, dtype=np.float64)
+    lengths = np.maximum((l / ranks ** 0.6).astype(np.int64), 32)
+    rng.shuffle(lengths)
+    return np.arange(l)[None, :] < lengths[:, None], int(lengths.sum())
+
+
+def bench_nbbo(seed=1):
+    """Config 4: synthetic NBBO quotes<->trades AS-OF join with Zipfian
+    symbol skew.  Counts only real (non-padding) left rows."""
+    rng = np.random.default_rng(seed)
+    mask, n_rows = _zipf_row_mask(rng, K, L)
+    gaps = rng.integers(1, 1000, size=(K, L)).astype(np.int64)  # ms ticks
+    secs = np.cumsum(gaps, axis=-1)
+    t_ts = np.where(mask, secs * np.int64(1_000_000), TS_PAD)   # trades
+    q_ts = np.where(mask, (secs - rng.integers(0, 500, size=(K, L)))
+                    * np.int64(1_000_000), TS_PAD)              # quotes
+    # quote jitter can unsort within a row: restore sorted order and
+    # carry the values along (real rows keep the leading slots, so the
+    # arange<length mask stays the validity mask after the sort)
+    order = np.argsort(q_ts, axis=-1, kind="stable")
+    q_ts = np.take_along_axis(q_ts, order, axis=-1)
+    q_vals = np.stack([
+        np.take_along_axis(100.0 + rng.standard_normal((K, L)), order, -1),
+        np.take_along_axis(100.1 + rng.standard_normal((K, L)), order, -1),
+    ]).astype(np.float32)
+    q_valid = np.broadcast_to(mask, (2, K, L)).copy()
+    args = [jax.device_put(a) for a in (t_ts, q_ts, q_valid, q_vals)]
+
+    def body(scale, t_ts, q_ts, q_valid, q_vals):
+        ns = _jitter_secs(scale) * 1_000_000
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            t_ts + ns, q_ts + ns, q_valid, n_cols=2
+        )
+        vals = jnp.take_along_axis(q_vals * scale,
+                                   jnp.maximum(col_idx, 0), axis=-1)
+        return {"joined": jnp.where(col_idx >= 0, vals, jnp.nan)}
+
+    rate, bw, _ = _loop_rate(body, args, n_rows, label="nbbo")
+    return rate, bw
+
+
+def bench_skew_1b(t_iter_fused, overlap=1.5):
+    """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
+
+    In this framework tsPartitionVal's overlap brackets are a *packing*
+    strategy: hot series are chopped into bracket rows with a trailing
+    ``fraction`` overlap (join.py:150-168), giving near-dense [K', L]
+    blocks at ~``overlap``x row duplication (fraction=0.5).  The device
+    cost per original row is therefore ``overlap`` dispatched rows.
+    Reported rows/sec counts original rows only, from the fused
+    pipeline's measured per-iteration time: 1B rows = ceil(1B * overlap
+    / (K*L)) chained iterations of the same program.
+    """
+    total_rows = TOTAL_ROWS_CONFIG5
+    rows_per_iter = int(K * L / overlap)
+    n_iter = -(-total_rows // rows_per_iter)
+    return total_rows / (n_iter * t_iter_fused)
 
 
 def bench_pandas(data):
     import pandas as pd
 
     l_ts, l_secs, x, valid, r_ts, r_valids, r_values = data
-    ks = np.repeat(np.arange(SUB_K), L)
+    sub = 32
+    ks = np.repeat(np.arange(sub), L)
     left = pd.DataFrame({
         "key": ks,
-        "ts": pd.to_datetime(l_ts[:SUB_K].ravel()),
-        "x": x[:SUB_K].ravel().astype(np.float64),
+        "ts": pd.to_datetime(l_ts[:sub].ravel()),
+        "x": x[:sub].ravel().astype(np.float64),
     })
-    rv = [np.where(r_valids[c, :SUB_K], r_values[c, :SUB_K], np.nan).ravel()
+    rv = [np.where(r_valids[c, :sub], r_values[c, :sub], np.nan).ravel()
           for c in range(N_RIGHT_COLS)]
     right = pd.DataFrame({
         "key": ks,
-        "ts": pd.to_datetime(r_ts[:SUB_K].ravel()),
+        "ts": pd.to_datetime(r_ts[:sub].ravel()),
         **{f"v{c}": rv[c] for c in range(N_RIGHT_COLS)},
     })
     left = left.sort_values(["ts", "key"], kind="stable")
@@ -105,18 +426,41 @@ def bench_pandas(data):
     _ = roll.std()
     _ = joined.groupby("key")["x"].transform(lambda s: s.ewm(alpha=0.2).mean())
     dt = time.perf_counter() - t0
-    return (SUB_K * L) / dt
+    return (sub * L) / dt
 
 
 def main():
     data = make_data()
-    tpu_rows_sec = bench_tpu(data)
+    fused_rows_sec, implied_bw, t_iter_fused = bench_fused(data)
+
+    print("value audit (TPU f32 vs numpy f64 oracle)...", file=sys.stderr,
+          flush=True)
+    out = jax.jit(_forward_step)(*[jax.device_put(a) for a in data])
+    _value_audit(out, data)
+    del out
+
+    asof_rs, _, _ = bench_asof(data)
+    stats_rs, _, _ = bench_range_stats(data)
+    res_rs, _, _ = bench_resample_ema(data)
+    nbbo_rs, _ = bench_nbbo()
+    skew_rs = bench_skew_1b(t_iter_fused)
     cpu_rows_sec = bench_pandas(data)
+
     print(json.dumps({
         "metric": "asof_join+range_stats+ema rows/sec (1 chip)",
-        "value": round(tpu_rows_sec),
+        "value": round(fused_rows_sec),
         "unit": "rows/sec",
-        "vs_baseline": round(tpu_rows_sec / cpu_rows_sec, 2),
+        "vs_baseline": round(fused_rows_sec / cpu_rows_sec, 2),
+        "hbm_gbps": round(implied_bw / 1e9, 1),
+        "hbm_frac_of_spec": round(implied_bw / V5E_HBM_BYTES_PER_SEC, 3),
+        "configs": {
+            "1_quickstart_asof": round(asof_rs),
+            "2_range_stats_10s": round(stats_rs),
+            "3_resample_ema": round(res_rs),
+            "4_nbbo_skew_asof": round(nbbo_rs),
+            "5_skew_1b_bracketed": round(skew_rs),
+        },
+        "denominator": "pandas single-core (pyspark absent; see BASELINE.md)",
     }))
 
 

@@ -48,6 +48,7 @@ if jax.config.jax_compilation_cache_dir is None:
 
 from tempo_tpu.frame import TSDF  # noqa: E402
 from tempo_tpu.utils import display  # noqa: E402
+from tempo_tpu.dist import DistributedTSDF  # noqa: E402
 
 __version__ = "0.1.0"
-__all__ = ["TSDF", "display"]
+__all__ = ["TSDF", "DistributedTSDF", "display"]

@@ -1,0 +1,744 @@
+"""DistributedTSDF: the device mesh wired into the frame-level API.
+
+In the reference every op is distributed *by construction* because
+``Window.partitionBy``/shuffle is the execution substrate
+(/root/reference/python/tempo/tsdf.py:121,571).  This module gives
+tempo-tpu the same property: ``TSDF.on_mesh(...)`` packs the frame once
+into mesh-sharded ``jax.Array``s and returns a :class:`DistributedTSDF`
+whose op methods (``asofJoin`` / ``withRangeStats`` / ``EMA`` /
+``resample``) run as shard_map programs over the mesh — data parallel
+over the ``series`` axis, sequence parallel with halo exchange over the
+``time`` axis — with results staying device-resident across chained
+ops.  ``collect()`` materialises back to a host :class:`TSDF` with ONE
+stacked device->host transfer.
+
+This is also the single-chip device-residency mechanism: on a 1-device
+mesh a chain of N ops performs exactly one pack and one unpack
+(``_PACK_EVENTS`` / ``_FETCH_EVENTS`` count them for the tests), where
+the host frame path would re-pack per op.
+
+Design notes:
+
+* Shard boundaries on the ``time`` axis are positional (each packed row
+  is ascending reals then ``TS_PAD`` pads), and lookback windows read
+  their history through a trailing neighbor halo
+  (:mod:`tempo_tpu.parallel.halo`).  For the AS-OF join this mirrors
+  the reference's ``tsPartitionVal`` contract exactly: a match further
+  back than the halo yields a null plus a *deferred audit* warning (the
+  reference's missing-lookback warning, tsdf.py:150-159) — audits are
+  device scalars fetched at ``collect()`` so chains stay sync-free.
+* Timestamps compute in int64 ns on device.  The joined right
+  timestamp column is carried through the value-gather path as three
+  21-bit chunk planes (each exact in float32) and recomposed to exact
+  int64 ns at collect.
+* Non-numeric columns stay on host and re-join the frame at collect
+  (they are untouched by the device ops, like Spark columns that no
+  expression references).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tempo_tpu import packing
+from tempo_tpu.freq import (
+    freq_to_seconds, validateFuncExists, floor, ceiling, average,
+    min_func, max_func,
+)
+from tempo_tpu.ops import asof as asof_ops
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.parallel import halo as ph
+from tempo_tpu.parallel.halo import shard_map
+from tempo_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger(__name__)
+
+# transfer-count instrumentation: a chain of N ops must do 1 pack + 1
+# fetch (tests assert this; the host frame path re-packs per op)
+_PACK_EVENTS = 0
+_FETCH_EVENTS = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class DistCol:
+    """One device-resident column: values + validity, with
+    materialisation hints."""
+
+    values: jax.Array          # [K_dev, L] compute dtype
+    valid: jax.Array           # [K_dev, L] bool
+    int64: bool = False        # cast to int64 at collect (counts)
+    # (target ts column, bit shift): this col is one 21-bit chunk of an
+    # int64-ns timestamp — three such planes recompose the ts EXACTLY
+    # at collect even when the compute dtype is float32 (2^21 < 2^24)
+    ts_chunk: Optional[Tuple[str, int]] = None
+
+
+def _spec(mesh: Mesh, series_axis: str, time_axis: Optional[str],
+          ndim: int = 2) -> P:
+    lead = [None] * (ndim - 2)
+    return P(*(lead + [series_axis, time_axis]))
+
+
+class DistributedTSDF:
+    """A TSDF whose packed cache is a sharded ``jax.Array`` on a device
+    mesh and whose ops run distributed (SURVEY.md §2.3)."""
+
+    def __init__(self, mesh: Mesh, series_axis: str,
+                 time_axis: Optional[str], ts, mask,
+                 cols: Dict[str, DistCol], layout, ts_col: str,
+                 partition_cols: List[str], ts_dtype, source_df,
+                 host_cols: Dict[str, str], halo_fraction: float,
+                 audits: Optional[List[Tuple[str, jax.Array]]] = None,
+                 resampled: bool = False):
+        self.mesh = mesh
+        self.series_axis = series_axis
+        self.time_axis = time_axis
+        self.ts = ts                      # [K_dev, L] int64 ns, TS_PAD pads
+        self.mask = mask                  # [K_dev, L] bool (real rows)
+        self.cols = cols
+        self.layout = layout
+        self.ts_col = ts_col
+        self.partitionCols = list(partition_cols)
+        self._ts_dtype = ts_dtype
+        self._source_df = source_df
+        self.host_cols = dict(host_cols)   # output name -> source column
+        self.halo_fraction = halo_fraction
+        self.audits = list(audits or [])
+        self.resampled = resampled
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+
+    @property
+    def n_time(self) -> int:
+        return self.mesh.shape[self.time_axis] if self.time_axis else 1
+
+    @property
+    def n_series_shards(self) -> int:
+        return self.mesh.shape[self.series_axis]
+
+    @property
+    def L(self) -> int:
+        return int(self.ts.shape[1])
+
+    @property
+    def K_dev(self) -> int:
+        return int(self.ts.shape[0])
+
+    def _sharding(self, ndim: int = 2) -> NamedSharding:
+        return NamedSharding(
+            self.mesh, _spec(self.mesh, self.series_axis, self.time_axis, ndim)
+        )
+
+    @classmethod
+    def from_tsdf(cls, tsdf, mesh: Optional[Mesh] = None,
+                  series_axis: str = "series",
+                  time_axis: Optional[str] = None,
+                  halo_fraction: float = 0.5) -> "DistributedTSDF":
+        """Pack + shard a host TSDF onto the mesh (the ingest boundary —
+        the analog of Spark's shuffle-on-partition-cols).  ONE
+        host->device transfer for the whole frame."""
+        global _PACK_EVENTS
+        mesh = mesh if mesh is not None else make_mesh()
+        if time_axis is not None and time_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis named {time_axis!r}")
+        n_s = mesh.shape[series_axis]
+        n_t = mesh.shape[time_axis] if time_axis else 1
+
+        layout = tsdf.layout
+        K = layout.n_series
+        # series dim: multiple of every mesh axis so layout-switching
+        # collectives (all_to_all resample path) stay legal
+        k_mult = n_s * n_t
+        K_dev = max(1, -(-K // k_mult)) * k_mult
+        L = packing.pad_length(int(layout.lengths.max(initial=0)),
+                               multiple=8 * n_t)
+
+        dt = packing.compute_dtype()
+        ts_p = packing.pack_column(layout.ts_ns, layout, L, fill=packing.TS_PAD)
+        mask_p = packing.row_mask(layout, L)
+        ts_p = _pad_k(ts_p, K_dev, packing.TS_PAD)
+        mask_p = _pad_k(mask_p, K_dev, False)
+
+        cols: Dict[str, DistCol] = {}
+        host_cols: Dict[str, str] = {}
+        structural = {tsdf.ts_col, *tsdf.partitionCols}
+        if tsdf.sequence_col:
+            structural.add(tsdf.sequence_col)
+        for c in tsdf.df.columns:
+            if c in structural:
+                continue
+            if pd.api.types.is_numeric_dtype(tsdf.df[c].dtype) and not \
+                    pd.api.types.is_bool_dtype(tsdf.df[c].dtype):
+                vals, valid = tsdf.numeric_flat(c)
+                pv = packing.pack_column(vals.astype(dt), layout, L, fill=np.nan)
+                pm = packing.pack_column(valid, layout, L, fill=False)
+                cols[c] = DistCol(_pad_k(pv, K_dev, np.nan),
+                                  _pad_k(pm, K_dev, False))
+            else:
+                host_cols[c] = c
+
+        sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis))
+        ts_d = jax.device_put(ts_p, sharding)
+        mask_d = jax.device_put(mask_p, sharding)
+        cols_d = {
+            c: DistCol(jax.device_put(col.values, sharding),
+                       jax.device_put(col.valid, sharding))
+            for c, col in cols.items()
+        }
+        _PACK_EVENTS += 1
+        return cls(mesh, series_axis, time_axis, ts_d, mask_d, cols_d,
+                   layout, tsdf.ts_col, tsdf.partitionCols,
+                   tsdf.ts_dtype(), tsdf.df, host_cols, halo_fraction)
+
+    def _with(self, **kw) -> "DistributedTSDF":
+        base = dict(
+            mesh=self.mesh, series_axis=self.series_axis,
+            time_axis=self.time_axis, ts=self.ts, mask=self.mask,
+            cols=self.cols, layout=self.layout, ts_col=self.ts_col,
+            partition_cols=self.partitionCols, ts_dtype=self._ts_dtype,
+            source_df=self._source_df, host_cols=self.host_cols,
+            halo_fraction=self.halo_fraction, audits=self.audits,
+            resampled=self.resampled,
+        )
+        base.update(kw)
+        return DistributedTSDF(**base)
+
+    def numeric_columns(self) -> List[str]:
+        return [c for c, col in self.cols.items() if col.ts_chunk is None]
+
+    def _halo(self, L: int) -> int:
+        shard = L // self.n_time
+        return max(1, min(shard, int(shard * self.halo_fraction)))
+
+    # ------------------------------------------------------------------
+    # withRangeStats (tsdf.py:673-721)
+    # ------------------------------------------------------------------
+
+    def withRangeStats(self, colsToSummarize=None,
+                       rangeBackWindowSecs: int = 1000,
+                       strategy: str = "exact") -> "DistributedTSDF":
+        """Distributed rolling range stats.  On a time-sharded mesh:
+
+        * ``strategy="exact"`` (default) — switch to a series-local
+          layout with one all_to_all each way and compute the exact
+          Spark rangeBetween semantics regardless of window size.
+        * ``strategy="halo"`` — stay time-sharded and read the lookback
+          through a trailing neighbor-halo exchange (O(halo) comm
+          instead of O(L)); windows longer than the halo truncate, and
+          a deferred audit (collect-time warning) counts affected rows
+          — the reference's own tsPartitionVal trade-off
+          (tsdf.py:164-190).
+        """
+        if strategy not in ("exact", "halo"):
+            raise ValueError("strategy must be 'exact' or 'halo'")
+        cols = colsToSummarize or self.numeric_columns()
+        w = float(rangeBackWindowSecs)
+        new_cols = dict(self.cols)
+        audits = list(self.audits)
+        for c in cols:
+            col = self.cols[c]
+            if self.n_time > 1 and strategy == "halo":
+                halo = self._halo(self.L)
+                stats, clipped = _range_stats_halo(
+                    self.mesh, self.series_axis, self.time_axis, w, halo,
+                )(self.ts, col.values, col.valid)
+                audits.append((
+                    f"withRangeStats({c}): %d rows had windows truncated "
+                    f"at the time-shard halo ({halo} rows); increase the "
+                    f"halo_fraction or shard count", clipped,
+                ))
+            elif self.n_time > 1:
+                stats = _range_stats_a2a(
+                    self.mesh, self.series_axis, self.time_axis, w,
+                )(self.ts, col.values, col.valid)
+            else:
+                stats = _range_stats_local(
+                    self.mesh, self.series_axis, w,
+                )(self.ts, col.values, col.valid)
+            for stat in ("mean", "count", "min", "max", "sum", "stddev",
+                         "zscore"):
+                new_cols[f"{stat}_{c}"] = DistCol(
+                    stats[stat], self.mask, int64=(stat == "count"),
+                )
+        return self._with(cols=new_cols, audits=audits)
+
+    rangeStats = withRangeStats
+
+    # ------------------------------------------------------------------
+    # EMA (tsdf.py:615-635; exact scan form)
+    # ------------------------------------------------------------------
+
+    def EMA(self, colName: str, window: int = 30, exp_factor: float = 0.2,
+            exact: bool = True) -> "DistributedTSDF":
+        """Distributed EMA.  The exact infinite-horizon scan composes
+        across time shards (associative carry stitch); the reference's
+        truncated-lag approximation (window taps) is only available on
+        meshes without a time axis."""
+        col = self.cols[colName]
+        if self.n_time > 1:
+            if not exact:
+                raise ValueError(
+                    "truncated-lag EMA does not cross time shards; use "
+                    "exact=True (or a series-only mesh)"
+                )
+            y = ph.ema_time_sharded(self.mesh, col.values, col.valid,
+                                    float(exp_factor),
+                                    time_axis=self.time_axis,
+                                    series_axis=self.series_axis)
+        else:
+            y = _ema_local(self.mesh, self.series_axis, float(exp_factor),
+                           bool(exact), int(window))(col.values, col.valid)
+        new_cols = dict(self.cols)
+        new_cols["EMA_" + colName] = DistCol(y, self.mask)
+        return self._with(cols=new_cols)
+
+    # ------------------------------------------------------------------
+    # asofJoin (tsdf.py:463-560, fast path)
+    # ------------------------------------------------------------------
+
+    def asofJoin(self, right: "DistributedTSDF",
+                 left_prefix: Optional[str] = None,
+                 right_prefix: str = "right",
+                 skipNulls: bool = True,
+                 suppress_null_warning: bool = False) -> "DistributedTSDF":
+        """Distributed AS-OF join.  The right frame is aligned to the
+        left's series-id space with one device gather (the
+        co-partitioning shuffle analog), then joined shard-locally with
+        a trailing halo on time-sharded meshes.
+
+        sequence_col tie-break / maxLookback need the merge kernel and
+        are host-path-only for now (``TSDF.asofJoin``)."""
+        if right.mesh is not self.mesh and right.mesh != self.mesh:
+            raise ValueError("both frames must live on the same mesh")
+        if self.partitionCols != right.partitionCols:
+            raise ValueError(
+                "left and right dataframe partition columns should have same name in same order"
+            )
+
+        # host-side key-space alignment (K-sized metadata only)
+        perm, ok = _key_perm(self.layout.key_frame, right.layout.key_frame,
+                             self.partitionCols, self.K_dev)
+        align2 = _align_fn(self.mesh, self.series_axis, self.time_axis)
+
+        r_names = right.numeric_columns()
+        r_ts_al = align2(right.ts, perm, ok, packing.TS_PAD)
+
+        dt = packing.compute_dtype()
+        # value stack: numeric cols + the right timestamp as three
+        # 21-bit ns chunks (exact in f32) + (for skipNulls=False)
+        # per-col validity planes to recover nulls
+        planes = [right.cols[c].values for c in r_names]
+        valid_planes = [right.cols[c].valid for c in r_names]
+        chunk_mask = jnp.int64((1 << 21) - 1)
+        ts_chunks = [
+            ((right.ts >> shift) & chunk_mask).astype(dt)
+            for shift in (42, 21, 0)
+        ]
+        planes.extend(ts_chunks)
+        if skipNulls:
+            vstack = jnp.stack(valid_planes + [right.mask] * 3)
+        else:
+            planes.extend(v.astype(dt) for v in valid_planes)
+            vstack = jnp.stack([right.mask] * len(planes))
+        pstack = jnp.stack(planes)
+
+        align3 = _align3_fn(self.mesh, self.series_axis, self.time_axis)
+        pstack = align3(pstack, perm, ok, np.nan)
+        vstack = align3(vstack, perm, ok, False)
+
+        if self.n_time > 1:
+            # joins are *global* per series (unbounded lookback), so the
+            # time-sharded layout switches to series-local full rows
+            # with one all_to_all each way (reshard.py pattern), joins
+            # exactly, and switches back — no halo approximation
+            vals, found = _asof_a2a(self.mesh, self.series_axis,
+                                    self.time_axis)(
+                self.ts, r_ts_al, vstack, pstack
+            )
+        else:
+            vals, found = _asof_local(self.mesh, self.series_axis)(
+                self.ts, r_ts_al, vstack, pstack
+            )
+        audits = list(self.audits)
+
+        rename = (lambda c: f"{left_prefix}_{c}") if left_prefix else (lambda c: c)
+        new_cols = {rename(c): col for c, col in self.cols.items()}
+        new_host = {rename(c): src for c, src in self.host_cols.items()}
+        n = len(r_names)
+        for i, c in enumerate(r_names):
+            if skipNulls:
+                v, f = vals[i], found[i]
+            else:
+                v = vals[i]
+                f = found[i] & (vals[n + 3 + i] > 0.5)
+            new_cols[f"{right_prefix}_{c}"] = DistCol(
+                jnp.where(f, v, jnp.nan), f
+            )
+        rts_name = f"{right_prefix}_{right.ts_col}"
+        for j, shift in enumerate((42, 21, 0)):
+            new_cols[f"__{rts_name}__c{j}"] = DistCol(
+                vals[n + j], found[n + j], ts_chunk=(rts_name, shift)
+            )
+        # the left ts column itself is the frame's time axis (renamed
+        # when left_prefix is set, tsdf.py:529-531)
+        return self._with(cols=new_cols, audits=audits,
+                          host_cols=new_host, ts_col=rename(self.ts_col))
+
+    # ------------------------------------------------------------------
+    # resample (resample.py:38-117), device-resident representation
+    # ------------------------------------------------------------------
+
+    def resample(self, freq: str, func: str,
+                 metricCols=None) -> "DistributedTSDF":
+        """Distributed downsample.  The result keeps the packed [K, L]
+        shape as a *bucket-head view*: each row's ts becomes its bucket
+        start, only the first row of each bucket is valid, and column
+        values hold the bucket aggregate at head rows.  ``collect()``
+        compacts the view; chained device ops (EMA, range stats) treat
+        it like any masked frame.  On a time-sharded mesh the rows are
+        switched to a series-local layout with one all_to_all each way
+        (the reshard analog of the reference's groupBy shuffle).
+        """
+        validateFuncExists(func)
+        step = freq_to_seconds(freq) * packing.NS_PER_S
+        cols = metricCols or self.numeric_columns()
+        fkey = {floor: 0, ceiling: 1, average: 2, min_func: 3, max_func: 4}[
+            _canon_func(func)
+        ]
+
+        kernel = _resample_fn(self.mesh, self.series_axis, self.time_axis,
+                              int(step), fkey, len(cols))
+        vals = jnp.stack([self.cols[c].values for c in cols])
+        valids = jnp.stack([self.cols[c].valid for c in cols])
+        new_ts, head, out_vals, out_valid = kernel(self.ts, self.mask,
+                                                   vals, valids)
+        new_cols = {
+            c: DistCol(out_vals[i], out_valid[i]) for i, c in enumerate(cols)
+        }
+        return self._with(ts=new_ts, mask=head, cols=new_cols,
+                          resampled=True)
+
+    # ------------------------------------------------------------------
+    # Materialisation
+    # ------------------------------------------------------------------
+
+    def collect(self):
+        """ONE stacked device->host transfer -> host TSDF."""
+        global _FETCH_EVENTS
+        from tempo_tpu.frame import TSDF
+
+        names = list(self.cols)
+        # single stacked fetch: float cols as one [C, K, L] f64 block
+        if names:
+            stacked = np.asarray(
+                jnp.stack([self.cols[c].values.astype(jnp.float64)
+                           for c in names]
+                          + [self.cols[c].valid.astype(jnp.float64)
+                             for c in names])
+            )
+            val_block = stacked[: len(names)]
+            ok_block = stacked[len(names):] > 0.5
+        ts_h = np.asarray(self.ts)
+        mask_h = np.asarray(self.mask)
+        _FETCH_EVENTS += 1
+
+        for msg, count in self.audits:
+            n = int(np.asarray(count))
+            if n > 0:
+                logger.warning(msg, n) if "%d" in msg else logger.warning(msg)
+        K = self.layout.n_series
+        mask_h = mask_h[:K]
+        ts_h = ts_h[:K]
+
+        lengths = mask_h.sum(axis=1).astype(np.int64)
+        key_ids = np.repeat(np.arange(K, dtype=np.int64), lengths)
+        flat = lambda a: a[:K][mask_h]
+
+        out = {}
+        kf = self.layout.key_frame
+        for c in self.partitionCols:
+            out[c] = kf[c].to_numpy()[key_ids]
+        out[self.ts_col] = packing.ns_to_original(flat(ts_h), self._ts_dtype)
+        ts_parts: Dict[str, dict] = {}
+        for i, c in enumerate(names):
+            col = self.cols[c]
+            v = flat(val_block[i])
+            okv = flat(ok_block[i])
+            if col.ts_chunk is not None:
+                target, shift = col.ts_chunk
+                part = ts_parts.setdefault(target, {"ns": 0, "ok": okv})
+                part["ns"] = part["ns"] + (
+                    np.round(np.where(okv, v, 0.0)).astype(np.int64) << shift
+                )
+            elif col.int64:
+                out[c] = np.where(okv, v, 0).astype(np.int64)
+            else:
+                out[c] = np.where(okv, v, np.nan)
+        for target, part in ts_parts.items():
+            tsv = packing.ns_to_original(part["ns"], self._ts_dtype)
+            if np.issubdtype(np.asarray(tsv).dtype, np.datetime64):
+                tsv = np.where(part["ok"], tsv, np.datetime64("NaT"))
+            out[target] = tsv
+        if not self.resampled:
+            # host-resident (non-numeric) columns rejoin by row identity
+            for c, src in self.host_cols.items():
+                out[c] = self._source_df[src].to_numpy()[self.layout.order]
+        return TSDF(pd.DataFrame(out), self.ts_col, self.partitionCols)
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.collect().df
+
+    def count(self) -> int:
+        return int(np.asarray(jnp.sum(self.mask)))
+
+
+def _pad_k(arr: np.ndarray, K_dev: int, fill) -> np.ndarray:
+    K = arr.shape[0]
+    if K == K_dev:
+        return arr
+    pad = np.full((K_dev - K,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def _canon_func(func: str) -> str:
+    from tempo_tpu.freq import CLOSEST_LEAD, MEAN_LEAD, MIN_LEAD, MAX_LEAD
+
+    return {CLOSEST_LEAD: floor, MEAN_LEAD: average, MIN_LEAD: min_func,
+            MAX_LEAD: max_func}.get(func, func)
+
+
+def _key_perm(left_kf: pd.DataFrame, right_kf: pd.DataFrame,
+              pcols: List[str], K_dev: int):
+    """For each left series id, the right series id with the same
+    partition-key tuple (-1 when absent)."""
+    if not pcols:
+        perm = np.zeros(K_dev, np.int32)
+        ok = np.zeros(K_dev, bool)
+        ok[0] = len(right_kf.index) > 0
+        return jnp.asarray(perm), jnp.asarray(ok)
+    rk_idx = right_kf.reset_index().rename(columns={"index": "__rid__"})
+    merged = left_kf.merge(rk_idx, on=pcols, how="left")
+    rid = merged["__rid__"].to_numpy()
+    ok = ~pd.isna(rid)
+    perm = np.where(ok, rid, 0).astype(np.int32)
+    perm = np.concatenate([perm, np.zeros(K_dev - len(perm), np.int32)])
+    okp = np.concatenate([ok, np.zeros(K_dev - len(ok), bool)])
+    return jnp.asarray(perm), jnp.asarray(okp)
+
+
+# ----------------------------------------------------------------------
+# Cached shard_map program builders (compile once per mesh/params/shape)
+# ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _range_stats_halo(mesh, series_axis, time_axis, window_secs, halo):
+    def fn(ts, x, valid):
+        secs = ts // packing.NS_PER_S
+        return ph.range_stats_time_sharded(
+            mesh, secs, x, valid, window_secs, halo,
+            time_axis=time_axis, series_axis=series_axis,
+        )
+
+    return fn
+
+
+@functools.lru_cache(maxsize=256)
+def _range_stats_local(mesh, series_axis, window_secs):
+    sp = _spec(mesh, series_axis, None)
+    w = window_secs
+
+    def kernel(ts, x, valid):
+        secs = ts // packing.NS_PER_S
+        start, end = rk.range_window_bounds(secs, jnp.asarray(w))
+        return rk.windowed_stats(x, valid, start, end)
+
+    stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
+                                  "stddev", "zscore")}
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
+                             out_specs=stats_spec))
+
+
+@functools.lru_cache(maxsize=256)
+def _range_stats_a2a(mesh, series_axis, time_axis, window_secs):
+    """Exact range stats on a time-sharded mesh via the series-local
+    layout switch (all_to_all in, compute full rows, all_to_all out)."""
+    sp = _spec(mesh, series_axis, time_axis)
+    w = window_secs
+
+    def kernel(ts, x, valid):
+        fwd = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=0, concat_axis=1, tiled=True)
+        rev = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=1, concat_axis=0, tiled=True)
+        ts, x, valid = fwd(ts), fwd(x), fwd(valid)
+        secs = ts // packing.NS_PER_S
+        start, end = rk.range_window_bounds(secs, jnp.asarray(w))
+        stats = rk.windowed_stats(x, valid, start, end)
+        return {k: rev(v) for k, v in stats.items()}
+
+    stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
+                                  "stddev", "zscore")}
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
+                             out_specs=stats_spec))
+
+
+@functools.lru_cache(maxsize=256)
+def _ema_local(mesh, series_axis, alpha, exact, window):
+    sp = _spec(mesh, series_axis, None)
+
+    def kernel(x, valid):
+        if exact:
+            from tempo_tpu.ops import pallas_kernels as pk
+
+            return pk.ema_scan(x, valid, alpha)
+        return rk.ema_compat(x, valid, window, alpha)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp),
+                             out_specs=sp))
+
+
+@functools.lru_cache(maxsize=256)
+def _asof_local(mesh, series_axis):
+    sp2 = _spec(mesh, series_axis, None)
+    sp3 = _spec(mesh, series_axis, None, ndim=3)
+
+    def kernel(l_ts, r_ts, r_valids, r_values):
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            l_ts, r_ts, r_valids, n_cols=int(r_values.shape[0])
+        )
+        found = col_idx >= 0
+        vals = jnp.take_along_axis(r_values, jnp.maximum(col_idx, 0),
+                                   axis=-1)
+        return jnp.where(found, vals, jnp.nan), found
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp3, sp3),
+                             out_specs=(sp3, sp3)))
+
+
+@functools.lru_cache(maxsize=256)
+def _asof_a2a(mesh, series_axis, time_axis):
+    """Exact AS-OF join on a time-sharded mesh: switch both sides to a
+    series-local layout (full rows per device, one ``all_to_all`` per
+    array), join locally, switch the [n_cols, K, Ll] results back."""
+    sp2 = _spec(mesh, series_axis, time_axis)
+    sp3 = _spec(mesh, series_axis, time_axis, 3)
+
+    def kernel(l_ts, r_ts, r_valids, r_values):
+        fwd = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+            tiled=True)
+        rev = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
+            tiled=True)
+        l_full, r_full = fwd(l_ts), fwd(r_ts)
+        rv_full, rx_full = fwd(r_valids), fwd(r_values)
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            l_full, r_full, rv_full, n_cols=int(rv_full.shape[0])
+        )
+        found = col_idx >= 0
+        vals = jnp.take_along_axis(rx_full, jnp.maximum(col_idx, 0),
+                                   axis=-1)
+        vals = jnp.where(found, vals, jnp.nan)
+        return rev(vals), rev(found)
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp3, sp3),
+                             out_specs=(sp3, sp3)))
+
+
+@functools.lru_cache(maxsize=256)
+def _align_fn(mesh, series_axis, time_axis):
+    """Gather a right-frame [K_r, L] array into the left key order along
+    the sharded series axis (XLA plans the cross-device movement)."""
+    sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis))
+
+    def fn(arr, perm, ok, fill):
+        g = jnp.take(arr, jnp.clip(perm, 0, arr.shape[0] - 1), axis=0)
+        return jnp.where(ok[:, None], g, jnp.asarray(fill, arr.dtype))
+
+    return jax.jit(fn, out_shardings=sharding, static_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=256)
+def _align3_fn(mesh, series_axis, time_axis):
+    sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis, 3))
+
+    def fn(arr, perm, ok, fill):
+        g = jnp.take(arr, jnp.clip(perm, 0, arr.shape[1] - 1), axis=1)
+        return jnp.where(ok[None, :, None], g, jnp.asarray(fill, arr.dtype))
+
+    return jax.jit(fn, out_shardings=sharding, static_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=256)
+def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols):
+    """Bucket-head resample kernel.  On a time-sharded mesh the blocks
+    all_to_all to a series-local layout (full rows per device), compute,
+    and switch back — the reference's groupBy shuffle as two ICI
+    collectives (reshard.py pattern)."""
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    sp2 = _spec(mesh, series_axis, time_axis)
+    sp3 = _spec(mesh, series_axis, time_axis, 3)
+
+    def local(ts, mask, vals, valids):
+        step = jnp.int64(step_ns)
+        b = jnp.where(mask, (ts // step) * step, packing.TS_PAD)
+        prev_b = jnp.concatenate(
+            [jnp.full_like(b[:, :1], -1), b[:, :-1]], axis=-1
+        )
+        head = mask & (b != prev_b)
+        start = rk.wu.searchsorted_batched(b, b, side="left")
+        end = rk.wu.searchsorted_batched(b, b + step, side="left")
+        start = start.astype(jnp.int32)
+        end = end.astype(jnp.int32)
+
+        outs = []
+        oks = []
+        for i in range(n_cols):
+            x, v = vals[i], valids[i]
+            if fkey == 0:          # floor: first record of the bucket
+                outs.append(x)
+                oks.append(head & v)
+            elif fkey == 1:        # ceil: last record of the bucket
+                last = jnp.maximum(end - 1, 0)
+                outs.append(jnp.take_along_axis(x, last, axis=-1))
+                oks.append(head & jnp.take_along_axis(v, last, axis=-1))
+            else:
+                stats = rk.windowed_stats(x, v, start, end)
+                key = {2: "mean", 3: "min", 4: "max"}[fkey]
+                outs.append(stats[key])
+                oks.append(head & (stats["count"] > 0))
+        new_ts = jnp.where(mask, b, packing.TS_PAD)
+        return new_ts, head, jnp.stack(outs), jnp.stack(oks)
+
+    def kernel(ts, mask, vals, valids):
+        if n_t > 1:
+            a2a_in = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+                tiled=True)
+            a2a_out = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
+                tiled=True)
+            ts, mask, vals, valids = (a2a_in(a) for a in
+                                      (ts, mask, vals, valids))
+            new_ts, head, ov, ok = local(ts, mask, vals, valids)
+            return (a2a_out(new_ts), a2a_out(head), a2a_out(ov),
+                    a2a_out(ok))
+        return local(ts, mask, vals, valids)
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp3, sp3),
+                             out_specs=(sp2, sp2, sp3, sp3)))

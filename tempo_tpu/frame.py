@@ -359,6 +359,43 @@ class TSDF:
         """
         return cls(spark_df.toPandas(), ts_col, partition_cols, sequence_col)
 
+    def to_spark(self, spark=None):
+        """The frame as a Spark DataFrame (via Arrow) — the return leg
+        of the migration hand-off, so pipelines can move data *back* to
+        the reference's world (two-way interop; the reference's writer
+        feeds Spark-queryable tables, io.py:10-43).  For Spark-readable
+        *files* without a live session, use
+        ``write(..., format="delta")``."""
+        try:
+            from pyspark.sql import SparkSession
+        except ImportError as e:  # pragma: no cover - pyspark optional
+            raise RuntimeError(
+                "to_spark() needs pyspark installed; alternatively "
+                "export files with write(..., format='delta') or "
+                "to_arrow()"
+            ) from e
+        spark = spark or SparkSession.builder.getOrCreate()
+        spark.conf.set("spark.sql.execution.arrow.pyspark.enabled", "true")
+        return spark.createDataFrame(self.df)
+
+    def on_mesh(self, mesh=None, time_axis=None, series_axis: str = "series",
+                halo_fraction: float = 0.5):
+        """Distribute this frame over a device mesh: packs the columns
+        once into sharded ``jax.Array``s and returns a
+        :class:`~tempo_tpu.dist.DistributedTSDF` whose ops (asofJoin,
+        withRangeStats, EMA, resample) execute distributed and chain
+        device-resident.  With no arguments, a 1-D ``('series',)`` mesh
+        over all local devices (the reference's entire distribution
+        model, SURVEY.md §2.3); pass a 2-D mesh + ``time_axis`` for
+        sequence parallelism with halo exchange.  On a single device
+        this is the device-residency fast path for chained pipelines."""
+        from tempo_tpu.dist import DistributedTSDF
+
+        return DistributedTSDF.from_tsdf(
+            self, mesh, series_axis=series_axis, time_axis=time_axis,
+            halo_fraction=halo_fraction,
+        )
+
     # ------------------------------------------------------------------
     # Time-series operations (implementations live in sibling modules)
     # ------------------------------------------------------------------
@@ -414,10 +451,12 @@ class TSDF:
         return describe_mod.describe(self)
 
     def write(self, tabName=None, optimizationCols=None, spark=None,
-              base_dir=None) -> str:
+              base_dir=None, format: str = "parquet") -> str:
         """Optimized columnar persistence (parity: tsdf.py:761-762 /
         io.py:10-43).  Accepts the reference's ``write(spark, tabName,
-        optimizationCols)`` calling convention as well."""
+        optimizationCols)`` calling convention as well.
+        ``format="delta"`` additionally writes a Delta transaction log
+        so Spark/delta-rs readers accept the table directly."""
         from tempo_tpu.io import writer
 
         if not isinstance(tabName, str) and isinstance(optimizationCols, str):
@@ -425,7 +464,8 @@ class TSDF:
             tabName, optimizationCols = optimizationCols, spark if isinstance(spark, list) else None
         if not isinstance(tabName, str):
             raise TypeError("write() requires a table name")
-        return writer.write(self, tabName, optimizationCols, base_dir)
+        return writer.write(self, tabName, optimizationCols, base_dir,
+                            format=format)
 
     def resample(
         self, freq: str, func=None, metricCols=None, prefix=None, fill=None

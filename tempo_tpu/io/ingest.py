@@ -1,0 +1,292 @@
+"""Chunked / out-of-core Parquet ingest (VERDICT r1 gap #2).
+
+Spark streams arbitrarily large inputs through executors; the packed
+layout previously required the whole dataset in one process's host
+memory (`packing.build_flat_layout`).  This module packs a Parquet
+dataset *straight into device-sharded arrays* with bounded host
+memory:
+
+* **pass 1** — stream only (partition cols, ts) column batches to
+  build the key census: per-key row counts, the padded series length
+  L, and the deterministic key order (lexicographic — independent of
+  file layout, unlike the in-memory first-appearance order).
+* **pass 2** — one series *shard* at a time (the mesh's own ingest
+  unit, `process_series_range` analog): stream row batches filtered to
+  that shard's keys (predicate pushdown prunes row groups when the
+  dataset was written sort-clustered by `io.writer`), sort, pack each
+  numeric column to [K_shard, L], and `device_put` the per-device
+  blocks.  The global sharded `jax.Array` is assembled from the
+  single-device blocks, so no host ever holds more than one shard of
+  one column (+ one streaming batch).
+
+Host working-set bound: ``K_shard x L`` values for one column at a
+time.  ``budget_bytes`` enforces it — ingest *fails loudly* rather
+than silently ballooning past the cap (the test runs a dataset >= 2x
+the cap to prove the path really streams).
+
+Non-numeric columns cannot ride an out-of-core frame (they would need
+host materialisation) and are skipped with a log notice; sequence
+columns are not supported here.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pandas as pd
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tempo_tpu import packing
+
+logger = logging.getLogger(__name__)
+
+
+def _dataset(path: str):
+    import pyarrow.dataset as pads
+
+    return pads.dataset(path, partitioning="hive")
+
+
+def _census(ds, ts_col: str, partition_cols: List[str], batch_rows: int):
+    """Pass 1: per-key row counts + global max series length."""
+    counts: Dict[Tuple, int] = {}
+    for batch in ds.to_batches(columns=partition_cols + [ts_col],
+                               batch_size=batch_rows):
+        if batch.num_rows == 0:
+            continue
+        dfb = batch.to_pandas()
+        if partition_cols:
+            grp = dfb.groupby(partition_cols, sort=False, dropna=False).size()
+            for key, n in grp.items():
+                key = key if isinstance(key, tuple) else (key,)
+                counts[key] = counts.get(key, 0) + int(n)
+        else:
+            counts[()] = counts.get((), 0) + len(dfb)
+    if not counts:
+        counts[tuple([None] * len(partition_cols))] = 0
+    keys = sorted(counts, key=lambda t: tuple(str(v) for v in t))
+    key_frame = pd.DataFrame(
+        [list(k) for k in keys] if partition_cols else None,
+        columns=partition_cols or None,
+        index=range(len(keys)),
+    )
+    lengths = np.asarray([counts[k] for k in keys], dtype=np.int64)
+    return key_frame, lengths
+
+
+def _numeric_schema_cols(ds, ts_col: str, partition_cols: List[str],
+                         columns: Optional[List[str]]):
+    import pyarrow as pa
+
+    skip = {ts_col, *partition_cols, "event_dt", "event_time"}
+    out = []
+    for field in ds.schema:
+        if field.name in skip:
+            continue
+        if columns is not None and field.name not in columns:
+            continue
+        if (pa.types.is_integer(field.type) or pa.types.is_floating(field.type)):
+            out.append(field.name)
+        else:
+            logger.info(
+                "out-of-core ingest skips non-numeric column %r", field.name
+            )
+    return out
+
+
+def from_parquet(
+    path: str,
+    ts_col: str = "event_ts",
+    partition_cols: Optional[List[str]] = None,
+    mesh=None,
+    time_axis: Optional[str] = None,
+    series_axis: str = "series",
+    columns: Optional[List[str]] = None,
+    batch_rows: int = 1 << 18,
+    budget_bytes: Optional[int] = None,
+    halo_fraction: float = 0.5,
+):
+    """Stream a Parquet dataset into a :class:`DistributedTSDF` with
+    bounded host memory (see module docstring)."""
+    from tempo_tpu.dist import DistCol, DistributedTSDF
+    from tempo_tpu.parallel.mesh import make_mesh
+
+    pcols = list(partition_cols or [])
+    mesh = mesh if mesh is not None else make_mesh()
+    n_s = mesh.shape[series_axis]
+    n_t = mesh.shape[time_axis] if time_axis else 1
+
+    ds = _dataset(path)
+    key_frame, lengths = _census(ds, ts_col, pcols, batch_rows)
+    K = len(lengths)
+    k_mult = n_s * n_t
+    K_dev = max(1, -(-K // k_mult)) * k_mult
+    L = packing.pad_length(int(lengths.max(initial=0)), multiple=8 * n_t)
+    num_cols = _numeric_schema_cols(ds, ts_col, pcols, columns)
+
+    blk = K_dev // n_s
+    dt = packing.compute_dtype()
+    shard_bytes = blk * L * max(np.dtype(dt).itemsize, 8)
+    if budget_bytes is not None and shard_bytes > budget_bytes:
+        raise MemoryError(
+            f"one series shard needs {shard_bytes} host bytes "
+            f"({blk} series x {L} slots) > budget {budget_bytes}; use a "
+            "mesh with more series shards"
+        )
+
+    # device placement map: mesh coordinates -> device, per (si, ti)
+    ax_s = mesh.axis_names.index(series_axis)
+    devs = np.moveaxis(np.asarray(mesh.devices), ax_s, 0).reshape(n_s, -1)
+    if time_axis:
+        ax_t = mesh.axis_names.index(time_axis)
+        order = np.moveaxis(
+            np.asarray(mesh.devices), (ax_s, ax_t), (0, 1)
+        ).reshape(n_s, n_t)
+    else:
+        order = devs.reshape(n_s, n_t)
+
+    Lt = L // n_t
+    spec = P(*([series_axis, time_axis] if time_axis else [series_axis, None]))
+    sharding = NamedSharding(mesh, spec)
+
+    # per-column per-device block lists, filled shard by shard
+    blocks: Dict[str, List] = {"__ts__": [], "__mask__": []}
+    for c in num_cols:
+        blocks[c] = []
+        blocks[c + "/valid"] = []
+
+    import pyarrow.compute as pc
+
+    read_cols = pcols + [ts_col] + num_cols
+    for si in range(n_s):
+        k0, k1 = si * blk, min((si + 1) * blk, K)
+        if k1 <= k0:
+            # padding shard past the real key range: all-pad blocks
+            _scatter_shard(blocks["__ts__"],
+                           np.full((blk, L), packing.TS_PAD, np.int64),
+                           order[si], Lt)
+            _scatter_shard(blocks["__mask__"],
+                           np.zeros((blk, L), np.bool_), order[si], Lt)
+            for c in num_cols:
+                _scatter_shard(blocks[c], np.full((blk, L), np.nan, dt),
+                               order[si], Lt)
+                _scatter_shard(blocks[c + "/valid"],
+                               np.zeros((blk, L), np.bool_), order[si], Lt)
+            continue
+        shard_keys = key_frame.iloc[k0:k1] if pcols else None
+        # stream this shard's rows: pushdown on the first partition col
+        filt = None
+        if pcols:
+            vals = shard_keys[pcols[0]].unique().tolist()
+            filt = pc.field(pcols[0]).isin(vals)
+        parts = []
+        held = 0
+        for batch in ds.to_batches(columns=read_cols, batch_size=batch_rows,
+                                   filter=filt):
+            if batch.num_rows == 0:
+                continue
+            dfb = batch.to_pandas()
+            if pcols and k1 > k0:
+                # exact membership for compound keys
+                marked = dfb.merge(
+                    shard_keys.assign(__in__=True), on=pcols, how="left"
+                )
+                dfb = dfb[marked["__in__"].fillna(False).to_numpy(bool)]
+            if len(dfb) == 0:
+                continue
+            held += int(dfb.memory_usage(deep=False).sum())
+            if budget_bytes is not None and held > budget_bytes:
+                raise MemoryError(
+                    f"series shard {si} exceeded the host ingest budget "
+                    f"({held} > {budget_bytes} bytes)"
+                )
+            parts.append(dfb)
+        shard_df = (
+            pd.concat(parts, ignore_index=True)
+            if parts else pd.DataFrame(columns=read_cols)
+        )
+        del parts
+
+        # local layout for this shard's keys (ids relative to k0)
+        if pcols and len(shard_df):
+            kid = shard_df.merge(
+                shard_keys.reset_index().rename(columns={"index": "__kid__"}),
+                on=pcols, how="left",
+            )["__kid__"].to_numpy(np.int64) - k0
+        else:
+            kid = np.zeros(len(shard_df), dtype=np.int64)
+        ts_ns = (
+            packing.series_to_ns(shard_df[ts_col])
+            if len(shard_df) else np.zeros(0, np.int64)
+        )
+        order_idx = np.lexsort((ts_ns, kid))
+        kid, ts_ns = kid[order_idx], ts_ns[order_idx]
+        starts = np.zeros(blk + 1, dtype=np.int64)
+        np.cumsum(np.bincount(kid, minlength=blk), out=starts[1:])
+        pos = np.arange(len(kid), dtype=np.int64) - starts[kid]
+
+        def pack(vals, fill, dtype):
+            out = np.full((blk, L), fill, dtype=dtype)
+            if len(vals):
+                out[kid, pos] = vals
+            return out
+
+        local_lens = starts[1:] - starts[:-1]
+        ts_p = pack(ts_ns, packing.TS_PAD, np.int64)
+        mask_p = np.arange(L)[None, :] < local_lens[:, None]
+        _scatter_shard(blocks["__ts__"], ts_p, order[si], Lt)
+        _scatter_shard(blocks["__mask__"], mask_p, order[si], Lt)
+        for c in num_cols:
+            raw = (
+                pd.to_numeric(shard_df[c], errors="coerce")
+                .to_numpy(np.float64)[order_idx]
+                if len(shard_df) else np.zeros(0, np.float64)
+            )
+            valid = ~np.isnan(raw)
+            _scatter_shard(blocks[c], pack(raw.astype(dt), np.nan, dt),
+                           order[si], Lt)
+            _scatter_shard(blocks[c + "/valid"],
+                           pack(valid, False, np.bool_), order[si], Lt)
+        del shard_df
+
+    def assemble(name):
+        shape = (K_dev, L)
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, blocks.pop(name)
+        )
+
+    ts_d = assemble("__ts__")
+    mask_d = assemble("__mask__")
+    cols = {
+        c: DistCol(assemble(c), assemble(c + "/valid")) for c in num_cols
+    }
+
+    layout = packing.FlatLayout(
+        key_ids=np.zeros(0, np.int64), ts_ns=np.zeros(0, np.int64),
+        order=np.zeros(0, np.int64),
+        starts=np.concatenate([[0], np.cumsum(lengths)]).astype(np.int64),
+        key_frame=key_frame,
+    )
+    frame = DistributedTSDF(
+        mesh, series_axis, time_axis, ts_d, mask_d, cols, layout, ts_col,
+        pcols, np.dtype("datetime64[ns]"), None, {}, halo_fraction,
+    )
+    # count as one logical pack event for the residency accounting
+    from tempo_tpu import dist as dist_mod
+
+    dist_mod._PACK_EVENTS += 1
+    return frame
+
+
+def _scatter_shard(sink: List, host_block: np.ndarray, dev_row, Lt: int):
+    """Split one series-shard host block along time and place each
+    piece on its device; appends in mesh device order."""
+    for ti, dev in enumerate(dev_row):
+        sink.append(
+            jax.device_put(host_block[:, ti * Lt:(ti + 1) * Lt], dev)
+        )

@@ -33,12 +33,19 @@ def _table_path(tab_name: str, base_dir: Optional[str]) -> str:
 
 
 def write(tsdf, tab_name: str, optimization_cols: Optional[List[str]] = None,
-          base_dir: Optional[str] = None) -> str:
+          base_dir: Optional[str] = None, format: str = "parquet") -> str:
     """Write the TSDF as a partitioned, sort-optimized Parquet dataset.
 
     Returns the table path.  Derived columns mirror io.py:29-33:
     ``event_dt`` = date of ts, ``event_time`` = HHMMSS.fff as double.
+
+    ``format="delta"`` also commits a Delta transaction log
+    (``_delta_log/...0.json`` with protocol/metaData/add actions) so the
+    output is a table Spark + delta readers accept as-is — the two-way
+    leg of the reference's Delta writer (io.py:10-43).
     """
+    if format not in ("parquet", "delta"):
+        raise ValueError("format must be 'parquet' or 'delta'")
     import pyarrow as pa
     import pyarrow.parquet as pq
 
@@ -66,14 +73,111 @@ def write(tsdf, tab_name: str, optimization_cols: Optional[List[str]] = None,
 
     if os.path.isdir(path):
         shutil.rmtree(path)
-    table = pa.Table.from_pandas(df, preserve_index=False)
-    pq.write_to_dataset(
-        table,
-        root_path=path,
-        partition_cols=["event_dt"],
-    )
+
+    if format == "delta":
+        _write_delta(df, path)
+    else:
+        table = pa.Table.from_pandas(df, preserve_index=False)
+        pq.write_to_dataset(
+            table,
+            root_path=path,
+            partition_cols=["event_dt"],
+        )
     logger.info("wrote %d rows to %s (sorted by %s)", len(df), path, sort_cols)
     return path
+
+
+# Spark SQL type names for the Delta schemaString
+_SPARK_TYPES = {
+    "int8": "byte", "int16": "short", "int32": "integer", "int64": "long",
+    "uint8": "short", "uint16": "integer", "uint32": "long",
+    "uint64": "long",
+    "float32": "float", "float64": "double", "bool": "boolean",
+    "object": "string", "string": "string",
+}
+
+
+def _spark_type(dtype) -> str:
+    name = str(dtype)
+    if name.startswith("datetime64"):
+        return "timestamp"
+    if name.startswith("Int"):
+        return _SPARK_TYPES.get(name.lower(), "long")
+    return _SPARK_TYPES.get(name, "string")
+
+
+def _write_delta(df: pd.DataFrame, path: str) -> None:
+    """One parquet file per event_dt partition + a version-0 Delta
+    commit (protocol, metaData with a Spark-JSON schema, add actions)."""
+    import json
+    import time
+    import uuid
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    now_ms = int(time.time() * 1000)
+    # Spark's parquet reader rejects TIMESTAMP(NANOS) and has no
+    # unsigned types: coerce to micros + signed before writing
+    df = df.copy()
+    for c in df.columns:
+        if str(df[c].dtype) == "uint64":
+            if len(df) and int(df[c].max()) > np.iinfo(np.int64).max:
+                raise OverflowError(
+                    f"column {c!r}: uint64 values above int64 range "
+                    "cannot be represented in a Spark-readable table"
+                )
+            df[c] = df[c].astype(np.int64)
+    adds = []
+    for i, (dt_val, part) in enumerate(df.groupby("event_dt", sort=True)):
+        part_dir = os.path.join(path, f"event_dt={dt_val}")
+        os.makedirs(part_dir, exist_ok=True)
+        fname = f"part-{i:05d}-{uuid.uuid4()}.snappy.parquet"
+        fpath = os.path.join(part_dir, fname)
+        # Delta stores partition values in the log, not the file
+        table = pa.Table.from_pandas(
+            part.drop(columns=["event_dt"]), preserve_index=False
+        )
+        pq.write_table(table, fpath, compression="snappy",
+                       coerce_timestamps="us",
+                       allow_truncated_timestamps=True)
+        adds.append({
+            "add": {
+                "path": f"event_dt={dt_val}/{fname}",
+                "partitionValues": {"event_dt": str(dt_val)},
+                "size": os.path.getsize(fpath),
+                "modificationTime": now_ms,
+                "dataChange": True,
+                "stats": json.dumps({"numRecords": len(part)}),
+            }
+        })
+
+    fields = [
+        {"name": c, "type": _spark_type(df[c].dtype), "nullable": True,
+         "metadata": {}}
+        for c in df.columns if c != "event_dt"
+    ] + [{"name": "event_dt", "type": "string", "nullable": True,
+          "metadata": {}}]
+    actions = [
+        {"protocol": {"minReaderVersion": 1, "minWriterVersion": 2}},
+        {"metaData": {
+            "id": str(uuid.uuid4()),
+            "format": {"provider": "parquet", "options": {}},
+            "schemaString": json.dumps({"type": "struct", "fields": fields}),
+            "partitionColumns": ["event_dt"],
+            "configuration": {},
+            "createdTime": now_ms,
+        }},
+        *adds,
+        {"commitInfo": {"timestamp": now_ms, "operation": "WRITE",
+                        "operationParameters": {"mode": "Overwrite"}}},
+    ]
+    log_dir = os.path.join(path, "_delta_log")
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, f"{0:020d}.json"), "w") as f:
+        for action in actions:
+            f.write(json.dumps(action) + "\n")
 
 
 def read(tab_name: str, ts_col: str = "event_ts",

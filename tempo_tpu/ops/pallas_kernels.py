@@ -141,17 +141,19 @@ def _cumsum3_kernel(x_ref, valid_ref, s1_ref, s2_ref, c_ref):
 def _cumsum3_call(x, valid, interpret=False):
     K, L = x.shape
     # three carries + three outputs live at once: a larger array budget
-    grid, bk = _grid(K, L, arrays=16, bk_max=16)
+    grid, bk, K_pad = _plan(K, L, arrays=16, bk_max=16) or ((1,), K, K)
+    x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        return pl.pallas_call(
+        out = pl.pallas_call(
             _cumsum3_kernel,
             grid=grid,
             in_specs=[spec, spec],
             out_specs=[spec, spec, spec],
-            out_shape=[jax.ShapeDtypeStruct((K, L), jnp.float32)] * 3,
+            out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 3,
             interpret=interpret,
         )(x, valid)
+    return tuple(o[:K] for o in out)
 
 
 def cumsum3(x, valid, interpret: bool = False):
@@ -159,7 +161,7 @@ def cumsum3(x, valid, interpret: bool = False):
     Pallas on TPU/f32, XLA associative scans elsewhere."""
     x = jnp.asarray(x)
     valid = jnp.asarray(valid)
-    if interpret or _supported(x):
+    if interpret or _supported(x, arrays=16, bk_max=16):
         return _cumsum3_call(x, valid, interpret=interpret)
     from tempo_tpu.ops import window_utils as wu
 
@@ -171,36 +173,57 @@ def cumsum3(x, valid, interpret: bool = False):
     )
 
 
-def _supported(x: jax.Array) -> bool:
-    return x.dtype == jnp.float32 and _index_supported(x)
+def _supported(x: jax.Array, arrays: int = 12, bk_max: int = _BK) -> bool:
+    return x.dtype == jnp.float32 and _index_supported(x, arrays, bk_max)
 
 
-def _grid(K: int, L: int, arrays: int = 12, bk_max: int = _BK):
-    """Row-block size fitting the scoped-VMEM cap: ``arrays`` is a
-    conservative count of simultaneously-live [bk, L] f32 buffers
-    (carries + roll temps + pipelined I/O).  A fixed block OOMs once L
-    grows — [32, 16384] f32 blew the 16M cap at 23.5M, measured."""
-    budget = 14 * 2**20  # headroom under the 16M scoped-vmem limit
-    cap = max(1, budget // (L * 4 * arrays))
-    # Mosaic requires the sublane block be a multiple of 8 or the whole
-    # array: descend through powers of two >= 8 that divide K
-    bk = 1 << max(min(bk_max, cap, K), 1).bit_length() - 1
-    while bk >= 8 and K % bk != 0:
-        bk //= 2
-    if bk < 8:
-        return (1,), K
-    return (K // bk,), bk
+_VMEM_BUDGET = 14 * 2**20  # headroom under the 16M scoped-vmem limit
+
+
+def _plan(K: int, L: int, arrays: int = 12, bk_max: int = _BK):
+    """(grid, bk, K_padded) row-blocking plan fitting the scoped-VMEM
+    cap, or None when no legal block fits.  ``arrays`` is a conservative
+    count of simultaneously-live [bk, L] f32 buffers (carries + roll
+    temps + pipelined I/O).  A fixed block OOMs once L grows — [32,
+    16384] f32 blew the 16M cap at 23.5M, measured.
+
+    Mosaic requires the sublane block be a multiple of 8 or the whole
+    array, so K that no power-of-two >= 8 divides is *padded up* to the
+    chosen block (callers pad inputs / slice outputs); when even an
+    8-row block exceeds the budget (huge L) there is no feasible plan
+    and callers must stay on the XLA path.
+    """
+    if K * L * 4 * arrays <= _VMEM_BUDGET:
+        return (1,), K, K          # whole array in one block
+    cap = _VMEM_BUDGET // (L * 4 * arrays)
+    if cap < 8:
+        return None                # not even [8, L] fits: infeasible
+    bk = 1 << min(bk_max, cap).bit_length() - 1
+    K_pad = -(-K // bk) * bk
+    return (K_pad // bk,), bk, K_pad
+
+
+def _feasible(shape, arrays: int, bk_max: int) -> bool:
+    return _plan(int(shape[0]), int(shape[1]), arrays, bk_max) is not None
+
+
+def _pad_rows(arr, K_pad: int):
+    K = arr.shape[0]
+    if K_pad == K:
+        return arr
+    return jnp.pad(arr, ((0, K_pad - K), (0, 0)))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _ema_call(x, valid, alpha, interpret=False):
     K, L = x.shape
-    grid, bk = _grid(K, L)
+    grid, bk, K_pad = _plan(K, L) or ((1,), K, K)
+    x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
     # index maps must trace as i32: under the library's global x64 mode
     # they come out i64, which Mosaic's func.return rejects
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        return pl.pallas_call(
+        out = pl.pallas_call(
             _ema_kernel,
             grid=grid,
             in_specs=[
@@ -209,51 +232,58 @@ def _ema_call(x, valid, alpha, interpret=False):
                 spec,
             ],
             out_specs=spec,
-            out_shape=jax.ShapeDtypeStruct((K, L), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((K_pad, L), jnp.float32),
             interpret=interpret,
         )(jnp.asarray([alpha], jnp.float32), x, valid)
+    return out[:K]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _last_valid_call(x, valid, interpret=False):
     K, L = x.shape
-    grid, bk = _grid(K, L)
+    grid, bk, K_pad = _plan(K, L) or ((1,), K, K)
+    x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        return pl.pallas_call(
+        out = pl.pallas_call(
             _last_valid_kernel,
             grid=grid,
             in_specs=[spec, spec],
             out_specs=[spec, spec],
             out_shape=[
-                jax.ShapeDtypeStruct((K, L), jnp.float32),
-                jax.ShapeDtypeStruct((K, L), jnp.bool_),
+                jax.ShapeDtypeStruct((K_pad, L), jnp.float32),
+                jax.ShapeDtypeStruct((K_pad, L), jnp.bool_),
             ],
             interpret=interpret,
         )(x, valid)
+    return out[0][:K], out[1][:K]
 
 
 @functools.partial(jax.jit, static_argnames=("kernel", "interpret"))
 def _index_scan_call(valid, kernel, interpret=False):
     K, L = valid.shape
-    grid, bk = _grid(K, L, arrays=8)
+    grid, bk, K_pad = _plan(K, L, arrays=8) or ((1,), K, K)
+    valid = _pad_rows(valid, K_pad)
     with jax.enable_x64(False):
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
-        return pl.pallas_call(
+        out = pl.pallas_call(
             kernel,
             grid=grid,
             in_specs=[spec],
             out_specs=spec,
-            out_shape=jax.ShapeDtypeStruct((K, L), jnp.int32),
+            out_shape=jax.ShapeDtypeStruct((K_pad, L), jnp.int32),
             interpret=interpret,
         )(valid)
+    return out[:K]
 
 
-def _index_supported(valid: jax.Array) -> bool:
+def _index_supported(valid: jax.Array, arrays: int = 8,
+                     bk_max: int = _BK) -> bool:
     return (
         valid.ndim == 2
         and valid.shape[1] % LANE == 0
         and jax.default_backend() == "tpu"
+        and _feasible(valid.shape, arrays, bk_max)
     )
 
 

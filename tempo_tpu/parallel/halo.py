@@ -135,8 +135,21 @@ def range_stats_time_sharded(
     non-first shard — i.e. rows whose true window may extend past the H
     rows of halo (the reference's skew-join warning analog).
     """
+    _check_halo(mesh, int(ts_long.shape[-1]), halo, time_axis)
+    fn = _build_range_stats(mesh, float(window_secs), int(halo),
+                            time_axis, series_axis)
+    return fn(ts_long, x, valid)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_range_stats(
+    mesh: Mesh, window_secs: float, halo: int,
+    time_axis: str, series_axis: str,
+):
+    """Jitted program builder, cached so chained frame-level pipelines
+    compile each (mesh, window, halo) combination once."""
     spec2 = _specs(mesh, 2, time_axis, series_axis)
-    n_time = _check_halo(mesh, int(ts_long.shape[-1]), halo, time_axis)
+    n_time = mesh.shape[time_axis]
 
     def kernel(ts_l, x_l, v_l):
         # left halo (lookback history) + right halo (following rows that
@@ -182,7 +195,7 @@ def range_stats_time_sharded(
         in_specs=(spec2, spec2, spec2),
         out_specs=(out_stats_spec, P()),
     )
-    return jax.jit(fn)(ts_long, x, valid)
+    return jax.jit(fn)
 
 
 def ema_time_sharded(
@@ -202,10 +215,18 @@ def ema_time_sharded(
     truncated-lag approximation that cannot cross partitions at all
     (tsdf.py:615-635).
     """
+    if x.shape[-1] % mesh.shape[time_axis] != 0:
+        raise ValueError(
+            f"time axis {x.shape[-1]} not divisible by {mesh.shape[time_axis]}"
+        )
+    fn = _build_ema(mesh, float(alpha), time_axis, series_axis)
+    return fn(x, valid)
+
+
+@functools.lru_cache(maxsize=256)
+def _build_ema(mesh: Mesh, alpha: float, time_axis: str, series_axis: str):
     spec2 = _specs(mesh, 2, time_axis, series_axis)
     n_time = mesh.shape[time_axis]
-    if x.shape[-1] % n_time != 0:
-        raise ValueError(f"time axis {x.shape[-1]} not divisible by {n_time}")
 
     def kernel(x_l, v_l):
         a = jnp.asarray(alpha, x_l.dtype)
@@ -236,77 +257,115 @@ def ema_time_sharded(
     fn = shard_map(
         kernel, mesh=mesh, in_specs=(spec2, spec2), out_specs=spec2,
     )
-    return jax.jit(fn)(x, valid)
+    return jax.jit(fn)
 
 
 def asof_time_sharded(
     mesh: Mesh,
     l_ts: jnp.ndarray,       # [K, Ll] int64, time-sharded
     r_ts: jnp.ndarray,       # [K, Lr] int64, time-sharded
-    r_row_valid: jnp.ndarray,  # [K, Lr] bool (real rows)
     r_valids: jnp.ndarray,   # [n_cols, K, Lr] bool per-column non-null
+                             # (False on padding rows — the carry
+                             # relies on that invariant)
     r_values: jnp.ndarray,   # [n_cols, K, Lr] float column values
     halo: int,
     time_axis: str = "time",
     series_axis: str = "series",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """AS-OF join over time-sharded left/right (skipNulls=True path).
+    """AS-OF join over time-sharded left/right with *unbounded* lookback.
 
-    Contract (the reference's skew-join contract, tsdf.py:164-190): both
-    sides are packed against common time brackets, so shard *i*'s left
-    rows match right rows in shard *i* or in the trailing ``halo`` rows
-    of shard *i-1*.  Values are gathered locally from the halo-extended
-    right block, so no cross-shard gather is ever needed.
+    Shard-local matching handles rows whose match lives in the same
+    time shard; matches any distance further back ride a cross-shard
+    **carry**: each shard publishes its last non-null value per column
+    (one [n_cols, K] vector), an exclusive combine over an
+    ``all_gather`` of those supplies the latest preceding value to rows
+    with no local match — the associative-scan form of the reference's
+    ``last(col, ignoreNulls) over unboundedPreceding`` (tsdf.py:139),
+    so lookback depth is unlimited, unlike the reference's
+    ``tsPartitionVal`` bracket which nulls beyond the overlap.  The
+    trailing ``halo`` from the *right* neighbor covers Spark's
+    equal-timestamp tie rule (a tie run straddling the boundary).
+
+    Precondition (value-aligned shards): for every shard *i*, every
+    right row in shards j < i must be at-or-before every left row in
+    shard *i* — true when both sides share a time grid (telemetry
+    joins, the driver dryrun) or were bracket-packed against common
+    boundaries.  For independently-packed sides use the exact
+    all-to-all layout-switch join instead
+    (``tempo_tpu.dist._asof_a2a``, what ``DistributedTSDF.asofJoin``
+    dispatches to); under misalignment this kernel's carry can surface
+    a *later* right value than the true as-of match.
 
     Returns (values [n_cols, K, Ll], found [n_cols, K, Ll] bool,
-    clipped count) — ``clipped`` counts left rows that found no match on
-    a non-first shard, the reference's missing-lookback warning
-    (tsdf.py:150-159).
+    clipped count) — ``clipped`` counts left rows whose equal-ts tie run
+    may continue past the right halo (audit, tsdf.py:150-159 analog).
     """
-    spec2 = _specs(mesh, 2, time_axis, series_axis)
-    spec3 = _specs(mesh, 3, time_axis, series_axis)
-    n_cols = int(r_values.shape[0])
     n_time = _check_halo(mesh, int(r_ts.shape[-1]), halo, time_axis)
     if l_ts.shape[-1] % n_time != 0:
         raise ValueError(f"left time axis {l_ts.shape[-1]} not divisible by {n_time}")
+    fn = _build_asof(mesh, int(halo), time_axis, series_axis)
+    return fn(l_ts, r_ts, r_valids, r_values)
 
-    def kernel(lts, rts, rrow, rval, rx):
-        # left halo: lookback history.  Right halo: right rows in the
-        # next shard that tie a left row's timestamp are the true AS-OF
-        # match (last right row with r_ts <= l_ts — equal ts included,
-        # tsdf.py:111-162), and a tie run can straddle the boundary.
-        h_ts = _halo_from_left(rts, halo, n_time, time_axis, TS_NEG)
-        h_row = _halo_from_left(rrow, halo, n_time, time_axis, False)
-        h_val = _halo_from_left(rval, halo, n_time, time_axis, False)
-        h_x = _halo_from_left(rx, halo, n_time, time_axis, jnp.zeros((), rx.dtype))
+
+@functools.lru_cache(maxsize=256)
+def _build_asof(mesh: Mesh, halo: int, time_axis: str, series_axis: str):
+    spec2 = _specs(mesh, 2, time_axis, series_axis)
+    spec3 = _specs(mesh, 3, time_axis, series_axis)
+    n_time = mesh.shape[time_axis]
+
+    def kernel(lts, rts, rval, rx):
+        # right halo only: right rows in the next shard that tie a left
+        # row's timestamp are the true AS-OF match (last right row with
+        # r_ts <= l_ts — equal ts included, tsdf.py:111-162), and a tie
+        # run can straddle the boundary.  History older than this shard
+        # arrives via the carry below, not a halo.
         g_ts = _halo_from_right(rts, halo, n_time, time_axis, TS_POS)
-        g_row = _halo_from_right(rrow, halo, n_time, time_axis, False)
         g_val = _halo_from_right(rval, halo, n_time, time_axis, False)
         g_x = _halo_from_right(rx, halo, n_time, time_axis, jnp.zeros((), rx.dtype))
-        ext_ts = jnp.concatenate([h_ts, rts, g_ts], axis=-1)
-        ext_row = jnp.concatenate([h_row, rrow, g_row], axis=-1)
-        ext_val = jnp.concatenate([h_val, rval, g_val], axis=-1)
-        ext_x = jnp.concatenate([h_x, rx, g_x], axis=-1)
+        ext_ts = jnp.concatenate([rts, g_ts], axis=-1)
+        ext_val = jnp.concatenate([rval, g_val], axis=-1)
+        ext_x = jnp.concatenate([rx, g_x], axis=-1)
         L_ext = ext_ts.shape[-1]
 
         last_idx, col_idx = asof_ops.asof_indices_searchsorted(
-            lts, ext_ts, ext_val, n_cols
+            lts, ext_ts, ext_val, n_cols=int(rval.shape[0])
         )
         found = col_idx >= 0
         safe = jnp.maximum(col_idx, 0)
         vals = jnp.take_along_axis(ext_x, safe, axis=-1)
+
+        if n_time > 1:
+            # cross-shard carry: this shard's last non-null value per
+            # (col, series) — from the LOCAL block only — combined
+            # exclusively across the time axis (latest prior shard wins)
+            lv = jnp.max(
+                jnp.where(rval, jnp.arange(rts.shape[-1], dtype=jnp.int32),
+                          -1),
+                axis=-1,
+            )                                             # [n_cols, K]
+            has_local = lv >= 0
+            v_local = jnp.take_along_axis(
+                rx, jnp.maximum(lv, 0)[..., None], axis=-1
+            )[..., 0]
+            hg = jax.lax.all_gather(has_local, time_axis)  # [n_t, C, K]
+            vg = jax.lax.all_gather(v_local, time_axis)
+            ti = jax.lax.axis_index(time_axis)
+            carry_has = jnp.zeros_like(has_local)
+            carry_val = jnp.zeros_like(v_local)
+            for j in range(n_time):                        # static
+                take = (j < ti) & hg[j]
+                carry_has = jnp.where(take, True, carry_has)
+                carry_val = jnp.where(take, vg[j], carry_val)
+            vals = jnp.where(found, vals, carry_val[..., None])
+            found = found | carry_has[..., None]
         vals = jnp.where(found, vals, jnp.nan)
 
-        # audit: left rows whose row-level match fell off the left halo,
-        # or whose tie run may continue past the right halo
-        row_found = (last_idx >= 0) & jnp.take_along_axis(
-            ext_row, jnp.maximum(last_idx, 0), axis=-1
-        )
+        # audit: left rows whose equal-ts tie run may continue past the
+        # right halo (their match could be an even later tied right row)
         l_real = lts < TS_REAL_MAX  # not TS_PAD padding
-        ti = jax.lax.axis_index(time_axis)
+        ti2 = jax.lax.axis_index(time_axis)
         local_clip = jnp.sum(
-            (~row_found & l_real & (ti > 0))
-            | ((last_idx == L_ext - 1) & l_real & (ti < n_time - 1)),
+            (last_idx == L_ext - 1) & l_real & (ti2 < n_time - 1),
             dtype=jnp.int32,
         )
         axes = (time_axis, series_axis) if series_axis in mesh.axis_names else (time_axis,)
@@ -316,7 +375,7 @@ def asof_time_sharded(
     fn = shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(spec2, spec2, spec2, spec3, spec3),
+        in_specs=(spec2, spec2, spec3, spec3),
         out_specs=(spec3, spec3, P()),
     )
-    return jax.jit(fn)(l_ts, r_ts, r_row_valid, r_valids, r_values)
+    return jax.jit(fn)

@@ -46,6 +46,16 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     if not cols:
         # reference adds zero stat columns in this case (tsdf.py:691-721)
         return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
+    if layout.n_rows == 0:
+        # empty frame: emit the stat schema (Spark yields the columns
+        # with zero rows) without dispatching zero-size reductions
+        for c in cols:
+            for stat in ("mean", "count", "min", "max", "sum", "stddev",
+                         "zscore"):
+                out[f"{stat}_{c}"] = np.zeros(
+                    0, dtype=np.int64 if stat == "count" else np.float64
+                )
+        return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
     ts_long = tsdf.packed_ts() // packing.NS_PER_S   # Spark cast-to-long seconds
     # 64-bit compares are emulated on TPU: rebase to per-series int32
     # seconds when spans allow (range windows only ever compare within a
@@ -60,8 +70,12 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
 
     # static row bound for the min/max sparse tables: a 10s window over
     # 1Hz data needs 4 levels, not log2(L); bucket to a power of two so
-    # distinct datasets reuse the compiled kernel
-    max_w = max(1, int(jax.device_get(jnp.max(end - start))))
+    # distinct datasets reuse the compiled kernel.  Padded slots all
+    # share the clamped sentinel timestamp, so their windows span the
+    # whole pad run — mask them out of the bound or ragged series
+    # inflate it toward L
+    real = jnp.asarray(tsdf.packed_mask())
+    max_w = max(1, int(jax.device_get(jnp.max(jnp.where(real, end - start, 0)))))
     max_w = 1 << (max_w - 1).bit_length()
 
     vals, valids = _packed_metric_stack(tsdf, cols)
@@ -226,22 +240,33 @@ def with_lookback_features(tsdf, featureCols: List[str], lookbackWindowSize: int
 
     layout = tsdf.layout
     sorted_df = tsdf.df.iloc[layout.order].reset_index(drop=True)
-    feats = np.stack(
-        [pd.to_numeric(sorted_df[c]).to_numpy(dtype=np.float64) for c in featureCols],
-        axis=1,
-    )  # [n, F]
     n = len(sorted_df)
     w = int(lookbackWindowSize)
-    starts = layout.starts[layout.key_ids]  # series start per row
-    col = np.empty(n, dtype=object)
-    for i in range(n):
-        lo = max(i - w, starts[i])
-        col[i] = feats[lo:i].tolist()
+
+    # heavy lifting on device: the dense [K, L, w, F] shifted stack (the
+    # same path lookback_tensor exposes), fetched once — the per-row
+    # Python slicing loop this replaces crawled at quickstart scale
+    tensor, _ = lookback_tensor(tsdf, featureCols, w)
+    # flatten packed rows back to the sorted flat layout: [n, w, F]
+    pos = np.arange(n, dtype=np.int64) - layout.starts[layout.key_ids]
+    flat = np.asarray(tensor, dtype=np.float64)[layout.key_ids, pos]
+    # rows nearer their series start have only pos valid lookback
+    # entries, sitting at the *end* of the window axis
+    cnt = np.minimum(pos, w)
+
     out = sorted_df.copy()
-    out[featureColName] = col
     if exactSize:
-        keep = np.array([len(col[i]) == w for i in range(n)])
-        return out[keep].reset_index(drop=True)
+        keep = cnt == w
+        out = out[keep].reset_index(drop=True)
+        # single C-level materialisation of the object lists
+        out[featureColName] = pd.Series(
+            flat[keep].tolist(), index=out.index, dtype=object
+        )
+        return out
+    nested = flat.tolist()
+    out[featureColName] = pd.Series(
+        [nested[i][w - cnt[i]:] for i in range(n)], dtype=object
+    )
     return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
 
 

@@ -60,7 +60,28 @@ def _frame_of(obj):
     return obj.df if type(obj).__name__ == "TSDF" else obj
 
 
-if ENV_BOOLEAN:
+def _databricks_native_display():
+    """The Databricks notebook's own ``display`` from the IPython user
+    namespace (reference utils.py:57-60) — the rich-table binding users
+    expect on that platform; None when unavailable."""
+    try:
+        from IPython import get_ipython  # type: ignore
+
+        return get_ipython().user_ns["display"]
+    except Exception:
+        return None
+
+
+if PLATFORM == "DATABRICKS" and _databricks_native_display() is not None:
+    method = _databricks_native_display()
+
+    def display_improvised(obj):
+        """Parity: reference utils.py:61-66 — route through the
+        notebook's native display, unwrapping TSDFs."""
+        method(_frame_of(obj))
+
+    display = display_improvised
+elif ENV_BOOLEAN:
 
     def display_html_improvised(obj):
         display_html(_frame_of(obj))

@@ -1,0 +1,107 @@
+"""Two-way interop (VERDICT r1 gap #3): arrow/spark hand-off + the
+Delta-compatible writer mode."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF
+from tempo_tpu.io import writer
+
+
+def _frame():
+    rng = np.random.default_rng(5)
+    n = 200
+    return TSDF(pd.DataFrame({
+        "symbol": rng.choice(["a", "b"], size=n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 3 * 86400, size=n)) * 1_000_000_000),
+        "price": rng.standard_normal(n) + 100,
+        "qty": rng.integers(1, 50, size=n),
+        "venue": [f"v{i % 3}" for i in range(n)],
+    }), "event_ts", ["symbol"])
+
+
+def test_arrow_round_trip_identity():
+    t = _frame()
+    back = TSDF.from_arrow(t.to_arrow(), "event_ts", ["symbol"])
+    pd.testing.assert_frame_equal(back.df, t.df)
+
+
+def test_spark_round_trip_or_explicit_error():
+    """from_spark(to_spark(tsdf)) identity where pyspark exists; a
+    clear actionable error where it does not (this image ships none)."""
+    t = _frame()
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        with pytest.raises(RuntimeError, match="pyspark"):
+            t.to_spark()
+        return
+    sdf = t.to_spark()
+    back = TSDF.from_spark(sdf, "event_ts", ["symbol"])
+    got = back.df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+    want = t.df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+class TestDeltaWriter:
+    @pytest.fixture()
+    def table(self, tmp_path):
+        t = _frame()
+        path = t.write("trades", optimizationCols=["price"],
+                       base_dir=str(tmp_path), format="delta")
+        return t, path
+
+    def test_log_structure(self, table):
+        t, path = table
+        log = os.path.join(path, "_delta_log", f"{0:020d}.json")
+        assert os.path.isfile(log)
+        actions = [json.loads(line) for line in open(log)]
+        kinds = [next(iter(a)) for a in actions]
+        assert kinds[0] == "protocol" and kinds[1] == "metaData"
+        meta = actions[1]["metaData"]
+        assert meta["partitionColumns"] == ["event_dt"]
+        schema = json.loads(meta["schemaString"])
+        by_name = {f["name"]: f["type"] for f in schema["fields"]}
+        assert by_name["event_ts"] == "timestamp"
+        assert by_name["price"] == "double"
+        assert by_name["qty"] == "long"
+        assert by_name["venue"] == "string"
+        assert by_name["event_dt"] == "string"
+        adds = [a["add"] for a in actions if "add" in a]
+        assert adds, "no add actions"
+        total = 0
+        for add in adds:
+            fpath = os.path.join(path, add["path"])
+            assert os.path.isfile(fpath)
+            assert add["size"] == os.path.getsize(fpath)
+            assert add["partitionValues"]["event_dt"] in add["path"]
+            total += json.loads(add["stats"])["numRecords"]
+        assert total == len(t.df)
+
+    def test_readable_as_parquet_dataset(self, table):
+        """The files must stay readable by any engine's parquet+hive
+        reader (Spark reads Delta through exactly these files)."""
+        t, path = table
+        back = writer.read("trades", "event_ts", ["symbol"],
+                           base_dir=os.path.dirname(path))
+        got = back.df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+        want = t.df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+        np.testing.assert_allclose(got["price"].to_numpy(),
+                                   want["price"].to_numpy())
+        assert (got["venue"].to_numpy() == want["venue"].to_numpy()).all()
+        assert len(got) == len(want)
+
+    def test_delta_reader_accepts_table(self, table):
+        """Full fidelity check with a real Delta reader when one is
+        installed (deltalake / pyspark+delta); structural checks above
+        otherwise."""
+        _, path = table
+        deltalake = pytest.importorskip("deltalake")
+        dt = deltalake.DeltaTable(path)
+        assert dt.version() == 0
+        assert len(dt.files()) > 0

@@ -150,3 +150,38 @@ def test_fallback_path_f64(data):
     x64 = x.astype(np.float64)
     val, has = pk.last_valid_scan(jnp.asarray(x64), jnp.asarray(valid))
     assert np.asarray(val).dtype == np.float64
+
+
+def test_odd_k_padding_plan():
+    """K not divisible by any pow2>=8 block must be padded up, not run
+    as one whole-array block that can blow the VMEM budget."""
+    rng = np.random.default_rng(11)
+    K, L = 13, 256
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.3
+    y = np.asarray(pk.ema_scan(jnp.asarray(x), jnp.asarray(valid), 0.2,
+                               interpret=True))
+    y_ref = np.asarray(rk.ema_exact(jnp.asarray(x), jnp.asarray(valid), 0.2))
+    np.testing.assert_allclose(y, y_ref, rtol=1e-5, atol=1e-6)
+    s1, _, c = pk.cumsum3(jnp.asarray(x), jnp.asarray(valid), interpret=True)
+    assert np.asarray(s1).shape == (K, L)
+    np.testing.assert_allclose(np.asarray(c), np.cumsum(valid, -1))
+    idx = np.asarray(pk.last_valid_index_scan(jnp.asarray(valid),
+                                              interpret=True))
+    assert idx.shape == (K, L)
+
+
+def test_plan_feasibility():
+    """_plan must refuse shapes whose minimum block exceeds the VMEM
+    budget (the caller then stays on XLA), and always emit blocks that
+    fit: bk * L * 4 * arrays <= budget."""
+    assert pk._plan(1001, 2**17, arrays=12) is None      # [8, 131072] > 14M
+    for K, L, arrays in [(1001, 8192, 12), (64, 8192, 16), (7, 128, 12),
+                         (1024, 8192, 12), (3 * 1024, 8192, 16)]:
+        plan = pk._plan(K, L, arrays=arrays)
+        assert plan is not None
+        grid, bk, K_pad = plan
+        assert K_pad >= K and K_pad % bk == 0 and grid[0] * bk == K_pad
+        if grid[0] > 1:
+            assert bk % 8 == 0
+            assert bk * L * 4 * arrays <= pk._VMEM_BUDGET

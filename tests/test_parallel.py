@@ -157,51 +157,44 @@ class TestTimeSharded:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-12)
 
     def test_asof_matches_single_device(self):
+        """Value-aligned shards (shared time grid, the kernel's
+        documented precondition): the carry + right-halo kernel must be
+        EXACT for every row — unbounded lookback included (a column can
+        be null across several whole shards and the match still comes
+        through the cross-shard carry)."""
         rng = np.random.default_rng(4)
-        K, Ll, Lr = 4, 32, 32
-        l_ts, _, _, _ = _ragged_batch(rng, K, Ll)
-        r_ts, r_x, r_val, r_row = _ragged_batch(rng, K, Lr)
-        n_cols = 2
+        K, L = 4, 32
+        # shared, dense time grid on both sides (telemetry-join shape)
+        ts = np.cumsum(rng.integers(1, 4, size=(K, L)), axis=-1).astype(np.int64)
+        l_ts = ts
+        r_ts = ts
+        r_row = np.ones((K, L), dtype=bool)
+        r_x = rng.standard_normal((K, L))
+        # col 0: sparse — null through entire shards, so many matches
+        # must ride the carry across >1 shard
+        v0 = rng.random((K, L)) > 0.9
+        v0[:, 0] = True
+        v1 = rng.random((K, L)) > 0.3
+        r_valids = np.stack([v0, v1])
         r_vals = np.stack([r_x, r_x * 2 + 1])
-        r_valids = np.stack([r_val, r_row])
 
-        # single-device oracle
         _, col_idx = asof_ops.asof_indices_searchsorted(
-            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids), n_cols
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids), 2
         )
         found_ref = np.asarray(col_idx) >= 0
         safe = np.maximum(np.asarray(col_idx), 0)
         vals_ref = np.take_along_axis(r_vals, safe, axis=-1)
         vals_ref = np.where(found_ref, vals_ref, np.nan)
 
-        # halo = full chunk width of the right side: with 4 time shards of
-        # 8 cols each, halo=8 gives each shard its full left-neighbor
-        # block; matches within one-bracket lookback
-        mesh = self._mesh()
         got_vals, got_found, clipped = asof_time_sharded(
-            mesh, jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_row),
+            self._mesh(), jnp.asarray(l_ts), jnp.asarray(r_ts),
             jnp.asarray(r_valids), jnp.asarray(r_vals), halo=8,
         )
-        got_vals, got_found = np.asarray(got_vals), np.asarray(got_found)
-
-        # The kernel's contract (common time brackets) guarantees a match
-        # lies in the left row's shard or the halo of the one before; the
-        # random fixtures here don't enforce that, so compare only rows
-        # whose oracle match satisfies it (halo = full chunk) — the rest
-        # is exactly what the clipped audit exists to count.
-        chunk = Lr // 4
-        l_shard = np.broadcast_to(
-            np.arange(Ll)[None, :] // (Ll // 4), safe.shape
-        )
-        diff = l_shard - safe // chunk
-        in_contract = ~found_ref | ((diff >= 0) & (diff <= 1))
-        np.testing.assert_array_equal(got_found[in_contract], found_ref[in_contract])
+        np.testing.assert_array_equal(np.asarray(got_found), found_ref)
         np.testing.assert_allclose(
-            got_vals[in_contract & found_ref],
-            vals_ref[in_contract & found_ref],
-            rtol=1e-12,
+            np.asarray(got_vals), vals_ref, rtol=1e-12, equal_nan=True,
         )
-        assert int(clipped) >= 0
+        assert int(clipped) == 0
 
     def test_range_stats_boundary_ties(self):
         """Equal timestamps straddling a shard boundary: Spark's range
@@ -257,8 +250,7 @@ class TestTimeSharded:
 
         got_vals, got_found, clipped = asof_time_sharded(
             self._mesh(), jnp.asarray(l_ts), jnp.asarray(r_ts),
-            jnp.asarray(r_row), jnp.asarray(r_valids), jnp.asarray(r_vals),
-            halo=8,
+            jnp.asarray(r_valids), jnp.asarray(r_vals), halo=8,
         )
         np.testing.assert_array_equal(np.asarray(got_found), found_ref)
         np.testing.assert_allclose(

@@ -232,3 +232,21 @@ def test_ema_scala_inclusive_window_golden():
         res["EMA_trade_pr"].to_numpy(), [4.0, 4.0, 3.0, 4.0, 10.0, 21.0],
         atol=1e-9,
     )
+
+
+def test_range_stats_empty_frame_emits_schema():
+    """Empty input: the stat columns exist with zero rows (regression —
+    zero-size jnp.max raised)."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+
+    df = pd.DataFrame({
+        "k": pd.Series([], dtype=str),
+        "event_ts": pd.Series([], dtype="datetime64[ns]"),
+        "v": pd.Series([], dtype=float),
+    })
+    out = TSDF(df, "event_ts", ["k"]).withRangeStats(colsToSummarize=["v"])
+    assert len(out.df) == 0
+    for stat in ("mean", "count", "min", "max", "sum", "stddev", "zscore"):
+        assert f"{stat}_v" in out.df.columns

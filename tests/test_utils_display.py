@@ -44,3 +44,48 @@ def test_show_vertical(capsys):
     TSDF(_frame(), "event_ts", ["k"]).show(vertical=True)
     out = capsys.readouterr().out
     assert "-RECORD 0-" in out
+
+
+def test_databricks_native_display_binding(monkeypatch):
+    """PLATFORM == DATABRICKS binds the notebook's own display from the
+    IPython user namespace (reference utils.py:57-68), unwrapping TSDFs."""
+    import importlib
+    import sys
+    import types
+
+    calls = []
+
+    class FakeShell:
+        user_ns = {"display": lambda obj: calls.append(obj)}
+
+    fake_ipython = types.ModuleType("IPython")
+    fake_ipython.get_ipython = lambda: FakeShell()
+    monkeypatch.setitem(sys.modules, "IPython", fake_ipython)
+    monkeypatch.setenv("DATABRICKS_RUNTIME_VERSION", "14.3")
+    mod = importlib.reload(utils)
+    try:
+        assert mod.PLATFORM == "DATABRICKS"
+        assert mod.display.__name__ == "display_improvised"
+        t = TSDF(_frame(), "event_ts", ["k"])
+        mod.display(t)
+        assert len(calls) == 1 and calls[0] is t.df  # unwrapped
+        mod.display(t.df)
+        assert calls[1] is t.df
+    finally:
+        monkeypatch.undo()
+        importlib.reload(utils)
+
+
+def test_databricks_without_user_ns_degrades(monkeypatch):
+    """DATABRICKS env without a native display falls back gracefully."""
+    import importlib
+
+    monkeypatch.setenv("DATABRICKS_RUNTIME_VERSION", "14.3")
+    mod = importlib.reload(utils)
+    try:
+        assert mod.PLATFORM == "DATABRICKS"
+        assert mod.display.__name__ in ("display_terminal",
+                                        "display_html_improvised")
+    finally:
+        monkeypatch.undo()
+        importlib.reload(utils)
