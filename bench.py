@@ -51,25 +51,25 @@ import jax
 import jax.numpy as jnp
 
 from __graft_entry__ import (
-    MAX_WINDOW_ROWS, N_RIGHT_COLS, WINDOW_SECS, _forward_step,
+    MAX_TIE_ROWS, MAX_WINDOW_ROWS, N_RIGHT_COLS, WINDOW_SECS, _forward_step,
 )
-from tempo_tpu.ops import asof as asof_ops
 from tempo_tpu.ops import pallas_kernels as pk
-from tempo_tpu.ops import rolling as rk
+from tempo_tpu.ops import sortmerge as sm
 from tempo_tpu.packing import TS_PAD
 
 K = 1024          # series (partition keys)
 L = 8192          # rows per series  -> 8.4M left rows per step
 SUB_K = 8         # series subsample for the oracles
-ITERS = 5         # timing repeats per trip count (median)
-N_SHORT = 16      # fori_loop trip counts for the differencing estimate
-N_LONG = 528
+ITERS = 3         # timing repeats per trip count (median)
+TARGET_SECS = 20  # wall budget for the long timing run: big enough to
+                  # swamp dispatch overhead, small enough to stay way
+                  # under the tunnel's RPC deadline (~60s, measured)
 TOTAL_ROWS_CONFIG5 = 1_000_000_000
 
 if os.environ.get("TEMPO_BENCH_SMOKE"):
     # correctness smoke (CPU CI): full code path, tiny scale
     K, L, SUB_K, ITERS = 64, 512, 4, 2
-    N_SHORT, N_LONG = 2, 10
+    TARGET_SECS = 1
     TOTAL_ROWS_CONFIG5 = 2_000_000
 
 # v5e spec sheet: 819 GB/s HBM bandwidth per chip.  Compulsory traffic
@@ -119,38 +119,68 @@ def _jitter_secs(scale):
     return (jnp.abs(scale) * 1e6).astype(jnp.int64) % 16
 
 
-def _loop_rate(body, args, n_rows, label):
+def _loop_rate(body, args, n_rows, label, want_outputs=False):
     """Per-iteration rate of ``body(scale, *args) -> (out_dict)``,
     chained inside one fori_loop dispatch, timed by trip-count
     differencing, physics-audited against the HBM spec.
 
-    Returns (rows_per_sec, implied_bw, t_iter)."""
+    Returns (rows_per_sec, implied_bw, t_iter[, out_small]).
+
+    ``want_outputs`` threads a SUB_K-series f32 slice of the final
+    iteration's outputs through the loop carry so the value audit can
+    reuse THIS compiled program — a *separate* jit of the body reliably
+    hangs the axon remote compiler (round-1 finding, reconfirmed this
+    round at full shape: >25 min, killed)."""
+
+    def small(out):
+        return {k: v[..., :SUB_K, :].astype(jnp.float32)
+                for k, v in out.items()}
 
     @jax.jit
     def run(n, scale0, *args):
         def step(i, carry):
-            scale, acc = carry
+            scale, acc, _ = carry
             out = body(scale, *args)
             p = _probe(out)
-            return 1.0 + 1e-6 * jnp.tanh(p + acc * 1e-12), acc + p
-        return jax.lax.fori_loop(0, n, step, (scale0, jnp.float32(0.0)))
+            return (1.0 + 1e-6 * jnp.tanh(p + acc * 1e-12), acc + p,
+                    small(out))
+
+        init_small = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda s, *a: small(body(s, *a)), scale0, *args),
+        )
+        return jax.lax.fori_loop(
+            0, n, step, (scale0, jnp.float32(0.0), init_small)
+        )
 
     print(f"[{label}] compiling...", file=sys.stderr, flush=True)
-    jax.block_until_ready(run(jnp.int32(1), jnp.float32(1.0), *args))
+    # NB: every timed call FETCHES the carry scalar.  On this remote
+    # backend ``block_until_ready`` alone does NOT force execution (the
+    # stack materialises lazily — measured: un-fetched fori_loop runs
+    # return immediately); only a device->host read of a value that
+    # data-depends on every iteration proves the work happened.
+    float(run(jnp.int32(1), jnp.float32(1.0), *args)[1])
     print(f"[{label}] timing...", file=sys.stderr, flush=True)
 
-    def timed(n):
+    def timed(n, salt):
         ts = []
         for i in range(ITERS):
             t0 = time.perf_counter()
-            jax.block_until_ready(
-                run(jnp.int32(n), jnp.float32(1.0 + i * 1e-6), *args)
-            )
+            float(run(jnp.int32(n), jnp.float32(1.0 + salt + i * 1e-6),
+                      *args)[1])
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
-    t_short, t_long = timed(N_SHORT), timed(N_LONG)
-    t_iter = max(t_long - t_short, 1e-9) / (N_LONG - N_SHORT)
+    # adaptive trip counts: pilot-estimate the per-iteration time, then
+    # size the long run to ~TARGET_SECS of pure device work so the
+    # measurement swamps dispatch overhead without tripping the
+    # tunnel's RPC deadline on slow kernels
+    t_pilot = timed(4, 1e-4)
+    est_iter = max(t_pilot / 4, 1e-6)
+    n_long = int(np.clip(TARGET_SECS / est_iter, 8, 4096))
+    n_short = max(n_long // 8, 1)
+    t_short, t_long = timed(n_short, 2e-4), timed(n_long, 3e-4)
+    t_iter = max(t_long - t_short, 1e-9) / (n_long - n_short)
 
     # compulsory traffic floor: the input arrays exceed VMEM, so every
     # iteration re-reads them from HBM (outputs/intermediates are extra)
@@ -168,6 +198,11 @@ def _loop_rate(body, args, n_rows, label):
     print(f"[{label}] {n_rows / t_iter:,.0f} rows/s  "
           f"({implied_bw / 1e9:.0f} GB/s implied)", file=sys.stderr,
           flush=True)
+    if want_outputs:
+        # one more n=1 trip of the same compiled program at scale 1.0
+        # (identity jitter/scale) for the value audit
+        out_small = run(jnp.int32(1), jnp.float32(1.0), *args)[2]
+        return n_rows / t_iter, implied_bw, t_iter, out_small
     return n_rows / t_iter, implied_bw, t_iter
 
 
@@ -223,28 +258,14 @@ def _numpy_oracle(data, sub=SUB_K):
             "ema": ema}
 
 
-def _value_audit(out_full, data):
-    """Compare a SUB_K slice of the already-computed full-shape output
-    against the f64 oracle.  Reuses the bench's compiled program — a
-    separate small-shape compile repeatedly hung the axon remote
-    compiler — and fetches everything as ONE transfer."""
+def _value_audit(out_small, data):
+    """Compare the SUB_K output slice (threaded through the timing
+    loop's carry — see ``_loop_rate(want_outputs=True)``) against the
+    f64 oracle.  No extra compile: the axon remote compiler hangs on a
+    second jit of the body."""
     ref = _numpy_oracle(data)
-    keys = sorted(set(out_full) & set(ref))
-
-    @jax.jit
-    def slice_concat(out):
-        return jnp.concatenate([
-            out[k][..., :SUB_K, :].astype(jnp.float32).reshape(-1)
-            for k in keys
-        ])
-
-    flat = np.asarray(slice_concat(out_full)).astype(np.float64)
-    shapes = [out_full[k].shape[:-2] + (SUB_K, out_full[k].shape[-1])
-              for k in keys]
-    sizes = [int(np.prod(s)) for s in shapes]
-    offs = np.cumsum([0] + sizes)
-    out = {k: flat[offs[i]:offs[i + 1]].reshape(shapes[i])
-           for i, k in enumerate(keys)}
+    keys = sorted(set(out_small) & set(ref))
+    out = {k: np.asarray(out_small[k]).astype(np.float64) for k in keys}
     for k, expect in ref.items():
         # f32 prefix-sum drift at L=8192 bounds abs error near 1e-3 for
         # the stddev/var path (quantified in BASELINE.md); the audit
@@ -263,15 +284,27 @@ def bench_fused(data):
     """Configs 1-3 fused: the headline number."""
     args = [jax.device_put(a) for a in data]
 
-    # window-bound audit (ADVICE r1): the static MAX_WINDOW_ROWS cap must
-    # cover every real window or min/max silently degrade
-    start, end = rk.range_window_bounds(
-        jnp.asarray(data[1]), jnp.asarray(WINDOW_SECS)
+    # window-bound audit (ADVICE r1): the static MAX_WINDOW_ROWS /
+    # MAX_TIE_ROWS caps must cover every real window or stats silently
+    # degrade.  Host numpy: K searchsorted rows, negligible.
+    l_secs = data[1]
+    w = int(WINDOW_SECS)
+    behind = max(
+        int((np.arange(L) - np.searchsorted(l_secs[k], l_secs[k] - w,
+                                            side="left")).max())
+        for k in range(K)
     )
-    real_max = int(jax.device_get(jnp.max(end - start)))
-    assert real_max + 16 <= MAX_WINDOW_ROWS, (
-        f"data windows span {real_max} rows (+16 jitter headroom) > "
-        f"MAX_WINDOW_ROWS={MAX_WINDOW_ROWS}; min/max would degrade"
+    ahead = max(
+        int((np.searchsorted(l_secs[k], l_secs[k], side="right") - 1
+             - np.arange(L)).max())
+        for k in range(K)
+    )
+    assert behind + 8 <= MAX_WINDOW_ROWS, (
+        f"data windows span {behind} rows (+8 jitter headroom) > "
+        f"MAX_WINDOW_ROWS={MAX_WINDOW_ROWS}; stats would degrade"
+    )
+    assert ahead <= MAX_TIE_ROWS, (
+        f"tie runs span {ahead} rows > MAX_TIE_ROWS={MAX_TIE_ROWS}"
     )
 
     def body(scale, l_ts, l_secs, x, valid, r_ts, r_valids, r_values):
@@ -280,7 +313,7 @@ def bench_fused(data):
         return _forward_step(l_ts + ns, l_secs + js, x * scale, valid,
                              r_ts + ns, r_valids, r_values)
 
-    return _loop_rate(body, args, K * L, label="fused")
+    return _loop_rate(body, args, K * L, label="fused", want_outputs=True)
 
 
 def bench_asof(data):
@@ -290,12 +323,10 @@ def bench_asof(data):
 
     def body(scale, l_ts, r_ts, r_valids, r_values):
         ns = _jitter_secs(scale) * 1_000_000_000
-        _, col_idx = asof_ops.asof_indices_searchsorted(
-            l_ts + ns, r_ts + ns, r_valids, n_cols=N_RIGHT_COLS
+        vals, found, _ = sm.asof_merge_values(
+            l_ts + ns, r_ts + ns, r_valids, r_values * scale
         )
-        vals = jnp.take_along_axis(r_values * scale,
-                                   jnp.maximum(col_idx, 0), axis=-1)
-        return {"joined": jnp.where(col_idx >= 0, vals, jnp.nan)}
+        return {"joined": vals}
 
     return _loop_rate(body, args, K * L, label="asof")
 
@@ -307,10 +338,10 @@ def bench_range_stats(data):
 
     def body(scale, l_secs, x, valid):
         js = _jitter_secs(scale)
-        start, end = rk.range_window_bounds(l_secs + js,
-                                            jnp.asarray(WINDOW_SECS))
-        return rk.windowed_stats(x * scale, valid, start, end,
-                                 max_window=MAX_WINDOW_ROWS)
+        return sm.range_stats_shifted(
+            l_secs + js, x * scale, valid, jnp.asarray(WINDOW_SECS),
+            max_behind=MAX_WINDOW_ROWS, max_ahead=MAX_TIE_ROWS,
+        )
 
     return _loop_rate(body, args, K * L, label="range_stats")
 
@@ -368,12 +399,10 @@ def bench_nbbo(seed=1):
 
     def body(scale, t_ts, q_ts, q_valid, q_vals):
         ns = _jitter_secs(scale) * 1_000_000
-        _, col_idx = asof_ops.asof_indices_searchsorted(
-            t_ts + ns, q_ts + ns, q_valid, n_cols=2
+        vals, found, _ = sm.asof_merge_values(
+            t_ts + ns, q_ts + ns, q_valid, q_vals * scale
         )
-        vals = jnp.take_along_axis(q_vals * scale,
-                                   jnp.maximum(col_idx, 0), axis=-1)
-        return {"joined": jnp.where(col_idx >= 0, vals, jnp.nan)}
+        return {"joined": vals}
 
     rate, bw, _ = _loop_rate(body, args, n_rows, label="nbbo")
     return rate, bw
@@ -431,13 +460,12 @@ def bench_pandas(data):
 
 def main():
     data = make_data()
-    fused_rows_sec, implied_bw, t_iter_fused = bench_fused(data)
+    fused_rows_sec, implied_bw, t_iter_fused, out_small = bench_fused(data)
 
     print("value audit (TPU f32 vs numpy f64 oracle)...", file=sys.stderr,
           flush=True)
-    out = jax.jit(_forward_step)(*[jax.device_put(a) for a in data])
-    _value_audit(out, data)
-    del out
+    _value_audit(out_small, data)
+    del out_small
 
     asof_rs, _, _ = bench_asof(data)
     stats_rs, _, _ = bench_range_stats(data)

@@ -48,7 +48,16 @@ if jax.config.jax_compilation_cache_dir is None:
 
 from tempo_tpu.frame import TSDF  # noqa: E402
 from tempo_tpu.utils import display  # noqa: E402
-from tempo_tpu.dist import DistributedTSDF  # noqa: E402
 
 __version__ = "0.1.0"
 __all__ = ["TSDF", "DistributedTSDF", "display"]
+
+
+def __getattr__(name):  # PEP 562: keep the mesh/shard_map stack lazy —
+    # host-only users never pay for it (frame.on_mesh imports it lazily
+    # for the same reason)
+    if name == "DistributedTSDF":
+        from tempo_tpu.dist import DistributedTSDF
+
+        return DistributedTSDF
+    raise AttributeError(f"module 'tempo_tpu' has no attribute {name!r}")

@@ -57,6 +57,7 @@ from tempo_tpu.freq import (
 )
 from tempo_tpu.ops import asof as asof_ops
 from tempo_tpu.ops import rolling as rk
+from tempo_tpu.ops.sortmerge import use_sort_kernels as _use_sort_kernels
 from tempo_tpu.parallel import halo as ph
 from tempo_tpu.parallel.halo import shard_map
 from tempo_tpu.parallel.mesh import make_mesh
@@ -81,6 +82,11 @@ class DistCol:
     # int64-ns timestamp — three such planes recompose the ts EXACTLY
     # at collect even when the compute dtype is float32 (2^21 < 2^24)
     ts_chunk: Optional[Tuple[str, int]] = None
+    # (flat host values [n_right_rows], right starts [K_r+1], perm
+    # [K_dev] left->right series map): ``values`` holds matched right
+    # ROW indices (f32-exact below 2^24) and collect() gathers the
+    # host-resident (non-numeric) data — device never sees object dtypes
+    host_gather: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
 
 
 def _spec(mesh: Mesh, series_axis: str, time_axis: Optional[str],
@@ -216,7 +222,46 @@ class DistributedTSDF:
         return DistributedTSDF(**base)
 
     def numeric_columns(self) -> List[str]:
-        return [c for c, col in self.cols.items() if col.ts_chunk is None]
+        return [c for c, col in self.cols.items()
+                if col.ts_chunk is None and col.host_gather is None]
+
+    def _window_rowbounds(self, window_secs: float) -> Optional[Tuple[int, int]]:
+        """Static (max rows back, max tie rows ahead) any rangeBetween
+        (-window_secs, 0) frame spans, from the host layout.  Cached per
+        window size; O(n) numpy per series.
+
+        Returns None when the layout's timestamps cannot vouch for the
+        device timestamps — resampled frames (device ts are bucket
+        floors, layout still holds raw ts) and ingest-assembled frames
+        (layout carries offsets only, ts_ns is empty) — so callers fall
+        back to the data-independent exact kernels."""
+        lay = self.layout
+        if (self.resampled or lay.n_rows == 0
+                or int(lay.starts[-1]) != lay.n_rows):
+            return None
+        # cached on the layout so chained frames sharing it reuse bounds
+        cache = lay.__dict__.setdefault("_rowbound_cache", {})
+        key = float(window_secs)
+        if key not in cache:
+            secs = lay.ts_ns // packing.NS_PER_S
+            w = np.int64(window_secs)
+            behind = 0
+            ahead = 0
+            for k in range(lay.n_series):
+                s = secs[lay.starts[k]: lay.starts[k + 1]]
+                if len(s) == 0:
+                    continue
+                idx = np.arange(len(s))
+                behind = max(
+                    behind,
+                    int((idx - np.searchsorted(s, s - w, side="left")).max()),
+                )
+                ahead = max(
+                    ahead,
+                    int((np.searchsorted(s, s, side="right") - 1 - idx).max()),
+                )
+            cache[key] = (behind, ahead)
+        return cache[key]
 
     def _halo(self, L: int) -> int:
         shard = L // self.n_time
@@ -247,6 +292,15 @@ class DistributedTSDF:
         w = float(rangeBackWindowSecs)
         new_cols = dict(self.cols)
         audits = list(self.audits)
+        # on TPU, row-boundable windows run gather-free as shifted
+        # masked accumulations (ops/sortmerge.py); bounds come from the
+        # host layout once per window size
+        sort_kernels = _use_sort_kernels()
+        rowbounds = None
+        if sort_kernels and strategy == "exact":
+            rb = self._window_rowbounds(w)
+            if rb is not None and rb[0] + rb[1] <= 512:
+                rowbounds = rb
         for c in cols:
             col = self.cols[c]
             if self.n_time > 1 and strategy == "halo":
@@ -262,10 +316,11 @@ class DistributedTSDF:
             elif self.n_time > 1:
                 stats = _range_stats_a2a(
                     self.mesh, self.series_axis, self.time_axis, w,
+                    rowbounds, sort_kernels,
                 )(self.ts, col.values, col.valid)
             else:
                 stats = _range_stats_local(
-                    self.mesh, self.series_axis, w,
+                    self.mesh, self.series_axis, w, rowbounds, sort_kernels,
                 )(self.ts, col.values, col.valid)
             for stat in ("mean", "count", "min", "max", "sum", "stddev",
                          "zscore"):
@@ -281,11 +336,14 @@ class DistributedTSDF:
     # ------------------------------------------------------------------
 
     def EMA(self, colName: str, window: int = 30, exp_factor: float = 0.2,
-            exact: bool = True) -> "DistributedTSDF":
-        """Distributed EMA.  The exact infinite-horizon scan composes
-        across time shards (associative carry stitch); the reference's
-        truncated-lag approximation (window taps) is only available on
-        meshes without a time axis."""
+            exact: bool = False,
+            inclusive_window: bool = False) -> "DistributedTSDF":
+        """Distributed EMA.  Defaults mirror ``TSDF.EMA`` (truncated-lag
+        reference parity, tsdf.py:615-635) so the same call gives the
+        same numbers on or off the mesh.  The exact infinite-horizon
+        scan composes across time shards (associative carry stitch); the
+        truncated-lag approximation does not, so time-sharded meshes
+        require ``exact=True``."""
         col = self.cols[colName]
         if self.n_time > 1:
             if not exact:
@@ -298,8 +356,9 @@ class DistributedTSDF:
                                     time_axis=self.time_axis,
                                     series_axis=self.series_axis)
         else:
+            n_taps = int(window) + (1 if inclusive_window else 0)
             y = _ema_local(self.mesh, self.series_axis, float(exp_factor),
-                           bool(exact), int(window))(col.values, col.valid)
+                           bool(exact), n_taps)(col.values, col.valid)
         new_cols = dict(self.cols)
         new_cols["EMA_" + colName] = DistCol(y, self.mask)
         return self._with(cols=new_cols)
@@ -318,6 +377,11 @@ class DistributedTSDF:
         co-partitioning shuffle analog), then joined shard-locally with
         a trailing halo on time-sharded meshes.
 
+        Right-side non-numeric (host-resident) columns join by carrying
+        the matched right *row index* as a value plane (exact in f32 up
+        to 2^24 rows/series) and gathering the strings host-side at
+        ``collect()`` — the device never touches object data.
+
         sequence_col tie-break / maxLookback need the merge kernel and
         are host-path-only for now (``TSDF.asofJoin``)."""
         if right.mesh is not self.mesh and right.mesh != self.mesh:
@@ -333,12 +397,22 @@ class DistributedTSDF:
         align2 = _align_fn(self.mesh, self.series_axis, self.time_axis)
 
         r_names = right.numeric_columns()
+        h_names = [c for c in right.host_cols
+                   if right._source_df is not None]
         r_ts_al = align2(right.ts, perm, ok, packing.TS_PAD)
 
         dt = packing.compute_dtype()
-        # value stack: numeric cols + the right timestamp as three
-        # 21-bit ns chunks (exact in f32) + (for skipNulls=False)
-        # per-col validity planes to recover nulls
+        sharding_r = right._sharding(2)
+        # value stack layout (offsets named below):
+        #   [0, n)              numeric col values
+        #   [n, n+3)            right ts as three 21-bit ns chunks (f32-exact)
+        #   skipNulls=True:
+        #     [n+3, n+3+H)      host-col row-index planes (validity = the
+        #                       host col's non-null mask -> per-col ffill)
+        #   skipNulls=False:
+        #     [n+3, 2n+3)       numeric validity planes (to recover nulls)
+        #     [2n+3, 2n+3+H)    host-col row-index planes (validity = mask)
+        #     [2n+3+H, 2n+3+2H) host-col non-null planes
         planes = [right.cols[c].values for c in r_names]
         valid_planes = [right.cols[c].valid for c in r_names]
         chunk_mask = jnp.int64((1 << 21) - 1)
@@ -347,10 +421,33 @@ class DistributedTSDF:
             for shift in (42, 21, 0)
         ]
         planes.extend(ts_chunks)
+
+        host_flat: Dict[str, np.ndarray] = {}
+        h_notna_dev = []
+        if h_names:
+            ridx_plane = jnp.broadcast_to(
+                jnp.arange(right.L, dtype=dt), (right.K_dev, right.L)
+            )
+            for c in h_names:
+                src = right.host_cols[c]
+                flat = right._source_df[src].to_numpy()[right.layout.order]
+                host_flat[c] = flat
+                pm = packing.pack_column(
+                    ~pd.isna(flat), right.layout, right.L, fill=False
+                )
+                h_notna_dev.append(jax.device_put(
+                    _pad_k(pm, right.K_dev, False), sharding_r
+                ))
         if skipNulls:
-            vstack = jnp.stack(valid_planes + [right.mask] * 3)
+            if h_names:
+                planes.extend([ridx_plane] * len(h_names))
+            vstack = jnp.stack(valid_planes + [right.mask] * 3
+                               + h_notna_dev)
         else:
             planes.extend(v.astype(dt) for v in valid_planes)
+            if h_names:
+                planes.extend([ridx_plane] * len(h_names))
+                planes.extend(v.astype(dt) for v in h_notna_dev)
             vstack = jnp.stack([right.mask] * len(planes))
         pstack = jnp.stack(planes)
 
@@ -358,17 +455,19 @@ class DistributedTSDF:
         pstack = align3(pstack, perm, ok, np.nan)
         vstack = align3(vstack, perm, ok, False)
 
+        sort_kernels = _use_sort_kernels()
         if self.n_time > 1:
             # joins are *global* per series (unbounded lookback), so the
             # time-sharded layout switches to series-local full rows
             # with one all_to_all each way (reshard.py pattern), joins
             # exactly, and switches back — no halo approximation
             vals, found = _asof_a2a(self.mesh, self.series_axis,
-                                    self.time_axis)(
+                                    self.time_axis, sort_kernels)(
                 self.ts, r_ts_al, vstack, pstack
             )
         else:
-            vals, found = _asof_local(self.mesh, self.series_axis)(
+            vals, found = _asof_local(self.mesh, self.series_axis,
+                                      sort_kernels)(
                 self.ts, r_ts_al, vstack, pstack
             )
         audits = list(self.audits)
@@ -377,6 +476,7 @@ class DistributedTSDF:
         new_cols = {rename(c): col for c, col in self.cols.items()}
         new_host = {rename(c): src for c, src in self.host_cols.items()}
         n = len(r_names)
+        H = len(h_names)
         for i, c in enumerate(r_names):
             if skipNulls:
                 v, f = vals[i], found[i]
@@ -390,6 +490,17 @@ class DistributedTSDF:
         for j, shift in enumerate((42, 21, 0)):
             new_cols[f"__{rts_name}__c{j}"] = DistCol(
                 vals[n + j], found[n + j], ts_chunk=(rts_name, shift)
+            )
+        for i, c in enumerate(h_names):
+            if skipNulls:
+                v, f = vals[n + 3 + i], found[n + 3 + i]
+            else:
+                v = vals[2 * n + 3 + i]
+                f = found[2 * n + 3 + i] & (vals[2 * n + 3 + H + i] > 0.5)
+            new_cols[f"{right_prefix}_{c}"] = DistCol(
+                v, f, host_gather=(
+                    host_flat[c], right.layout.starts, perm,
+                ),
             )
         # the left ts column itself is the frame's time axis (renamed
         # when left_prefix is set, tsdf.py:529-531)
@@ -419,7 +530,8 @@ class DistributedTSDF:
         ]
 
         kernel = _resample_fn(self.mesh, self.series_axis, self.time_axis,
-                              int(step), fkey, len(cols))
+                              int(step), fkey, len(cols),
+                              _use_sort_kernels())
         vals = jnp.stack([self.cols[c].values for c in cols])
         valids = jnp.stack([self.cols[c].valid for c in cols])
         new_ts, head, out_vals, out_valid = kernel(self.ts, self.mask,
@@ -482,6 +594,17 @@ class DistributedTSDF:
                 part["ns"] = part["ns"] + (
                     np.round(np.where(okv, v, 0.0)).astype(np.int64) << shift
                 )
+            elif col.host_gather is not None:
+                flat_vals, r_starts, perm = col.host_gather
+                ridx = np.round(np.where(okv, v, 0.0)).astype(np.int64)
+                pos = r_starts[perm[key_ids]] + ridx
+                pos = np.clip(pos, 0, max(len(flat_vals) - 1, 0))
+                gathered = (flat_vals[pos] if len(flat_vals)
+                            else np.full(len(pos), None, object))
+                res = np.empty(len(pos), dtype=object)
+                res[:] = gathered
+                res[~okv] = None
+                out[c] = res
             elif col.int64:
                 out[c] = np.where(okv, v, 0).astype(np.int64)
             else:
@@ -522,12 +645,13 @@ def _canon_func(func: str) -> str:
 def _key_perm(left_kf: pd.DataFrame, right_kf: pd.DataFrame,
               pcols: List[str], K_dev: int):
     """For each left series id, the right series id with the same
-    partition-key tuple (-1 when absent)."""
+    partition-key tuple (-1 when absent).  Host numpy (K-sized metadata
+    consumed both by the jitted align fns and collect-time gathers)."""
     if not pcols:
         perm = np.zeros(K_dev, np.int32)
         ok = np.zeros(K_dev, bool)
         ok[0] = len(right_kf.index) > 0
-        return jnp.asarray(perm), jnp.asarray(ok)
+        return perm, ok
     rk_idx = right_kf.reset_index().rename(columns={"index": "__rid__"})
     merged = left_kf.merge(rk_idx, on=pcols, how="left")
     rid = merged["__rid__"].to_numpy()
@@ -535,7 +659,7 @@ def _key_perm(left_kf: pd.DataFrame, right_kf: pd.DataFrame,
     perm = np.where(ok, rid, 0).astype(np.int32)
     perm = np.concatenate([perm, np.zeros(K_dev - len(perm), np.int32)])
     okp = np.concatenate([ok, np.zeros(K_dev - len(ok), bool)])
-    return jnp.asarray(perm), jnp.asarray(okp)
+    return perm, okp
 
 
 # ----------------------------------------------------------------------
@@ -554,15 +678,30 @@ def _range_stats_halo(mesh, series_axis, time_axis, window_secs, halo):
     return fn
 
 
+def _range_stats_block(ts, x, valid, w, rowbounds):
+    """Shard-local range stats: shifted gather-free form when static row
+    bounds are known (TPU), else bounds + prefix/RMQ form."""
+    from tempo_tpu.ops import sortmerge as sm
+
+    secs = ts // packing.NS_PER_S
+    if rowbounds is not None:
+        behind, ahead = rowbounds
+        return sm.range_stats_shifted(
+            secs, x, valid, jnp.asarray(w),
+            max_behind=int(behind), max_ahead=int(ahead),
+        )
+    start, end = rk.range_window_bounds(secs, jnp.asarray(w))
+    return rk.windowed_stats(x, valid, start, end)
+
+
 @functools.lru_cache(maxsize=256)
-def _range_stats_local(mesh, series_axis, window_secs):
+def _range_stats_local(mesh, series_axis, window_secs, rowbounds=None,
+                       sort_kernels=False):
     sp = _spec(mesh, series_axis, None)
     w = window_secs
 
     def kernel(ts, x, valid):
-        secs = ts // packing.NS_PER_S
-        start, end = rk.range_window_bounds(secs, jnp.asarray(w))
-        return rk.windowed_stats(x, valid, start, end)
+        return _range_stats_block(ts, x, valid, w, rowbounds)
 
     stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
                                   "stddev", "zscore")}
@@ -571,7 +710,8 @@ def _range_stats_local(mesh, series_axis, window_secs):
 
 
 @functools.lru_cache(maxsize=256)
-def _range_stats_a2a(mesh, series_axis, time_axis, window_secs):
+def _range_stats_a2a(mesh, series_axis, time_axis, window_secs,
+                     rowbounds=None, sort_kernels=False):
     """Exact range stats on a time-sharded mesh via the series-local
     layout switch (all_to_all in, compute full rows, all_to_all out)."""
     sp = _spec(mesh, series_axis, time_axis)
@@ -583,9 +723,7 @@ def _range_stats_a2a(mesh, series_axis, time_axis, window_secs):
         rev = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=1, concat_axis=0, tiled=True)
         ts, x, valid = fwd(ts), fwd(x), fwd(valid)
-        secs = ts // packing.NS_PER_S
-        start, end = rk.range_window_bounds(secs, jnp.asarray(w))
-        stats = rk.windowed_stats(x, valid, start, end)
+        stats = _range_stats_block(ts, x, valid, w, rowbounds)
         return {k: rev(v) for k, v in stats.items()}
 
     stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
@@ -609,19 +747,29 @@ def _ema_local(mesh, series_axis, alpha, exact, window):
                              out_specs=sp))
 
 
+def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels):
+    """Per-plane AS-OF fill: on TPU the sort-and-scan join (no gathers,
+    ops/sortmerge.py timings); elsewhere searchsorted + index gathers."""
+    from tempo_tpu.ops import sortmerge as sm
+
+    if sort_kernels:
+        vals, found, _ = sm.asof_merge_values(l_ts, r_ts, r_valids, r_values)
+        return vals, found
+    _, col_idx = asof_ops.asof_indices_searchsorted(
+        l_ts, r_ts, r_valids, n_cols=int(r_values.shape[0])
+    )
+    found = col_idx >= 0
+    vals = jnp.take_along_axis(r_values, jnp.maximum(col_idx, 0), axis=-1)
+    return jnp.where(found, vals, jnp.nan), found
+
+
 @functools.lru_cache(maxsize=256)
-def _asof_local(mesh, series_axis):
+def _asof_local(mesh, series_axis, sort_kernels=False):
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
     def kernel(l_ts, r_ts, r_valids, r_values):
-        _, col_idx = asof_ops.asof_indices_searchsorted(
-            l_ts, r_ts, r_valids, n_cols=int(r_values.shape[0])
-        )
-        found = col_idx >= 0
-        vals = jnp.take_along_axis(r_values, jnp.maximum(col_idx, 0),
-                                   axis=-1)
-        return jnp.where(found, vals, jnp.nan), found
+        return _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp3, sp3),
@@ -629,7 +777,7 @@ def _asof_local(mesh, series_axis):
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_a2a(mesh, series_axis, time_axis):
+def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False):
     """Exact AS-OF join on a time-sharded mesh: switch both sides to a
     series-local layout (full rows per device, one ``all_to_all`` per
     array), join locally, switch the [n_cols, K, Ll] results back."""
@@ -645,13 +793,8 @@ def _asof_a2a(mesh, series_axis, time_axis):
             tiled=True)
         l_full, r_full = fwd(l_ts), fwd(r_ts)
         rv_full, rx_full = fwd(r_valids), fwd(r_values)
-        _, col_idx = asof_ops.asof_indices_searchsorted(
-            l_full, r_full, rv_full, n_cols=int(rv_full.shape[0])
-        )
-        found = col_idx >= 0
-        vals = jnp.take_along_axis(rx_full, jnp.maximum(col_idx, 0),
-                                   axis=-1)
-        vals = jnp.where(found, vals, jnp.nan)
+        vals, found = _asof_planes(l_full, r_full, rv_full, rx_full,
+                                   sort_kernels)
         return rev(vals), rev(found)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
@@ -684,7 +827,8 @@ def _align3_fn(mesh, series_axis, time_axis):
 
 
 @functools.lru_cache(maxsize=256)
-def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols):
+def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
+                 sort_kernels=False):
     """Bucket-head resample kernel.  On a time-sharded mesh the blocks
     all_to_all to a series-local layout (full rows per device), compute,
     and switch back — the reference's groupBy shuffle as two ICI

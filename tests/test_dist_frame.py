@@ -108,15 +108,29 @@ class TestDistributedOps:
 
     def test_ema(self, frames, axes, ta):
         l, _ = frames
-        host = _sorted(l.EMA("price", exact=True).df)
         mesh = make_mesh(axes)
+        host = _sorted(l.EMA("price", exact=True).df)
         got = _sorted(
-            l.on_mesh(mesh, time_axis=ta).EMA("price").collect().df
+            l.on_mesh(mesh, time_axis=ta).EMA("price", exact=True)
+            .collect().df
         )
         np.testing.assert_allclose(
             got["EMA_price"].to_numpy(float),
             host["EMA_price"].to_numpy(float), rtol=1e-9, atol=1e-12,
         )
+        if ta is None:
+            # defaults mirror the host API (truncated-lag parity form)
+            host_d = _sorted(l.EMA("price").df)
+            got_d = _sorted(
+                l.on_mesh(mesh, time_axis=ta).EMA("price").collect().df
+            )
+            np.testing.assert_allclose(
+                got_d["EMA_price"].to_numpy(float),
+                host_d["EMA_price"].to_numpy(float), rtol=1e-9, atol=1e-12,
+            )
+        else:
+            with pytest.raises(ValueError, match="exact=True"):
+                l.on_mesh(mesh, time_axis=ta).EMA("price")
 
     @pytest.mark.parametrize("func", ["mean", "floor", "ceil", "min", "max"])
     def test_resample(self, frames, axes, ta, func):
@@ -152,7 +166,7 @@ class TestChaining:
         got = _sorted(
             l.on_mesh(mesh, time_axis="time")
             .asofJoin(r.on_mesh(mesh, time_axis="time"))
-            .EMA("right_bid")
+            .EMA("right_bid", exact=True)
             .withRangeStats(colsToSummarize=["price"], rangeBackWindowSecs=30)
             .collect().df
         )
@@ -174,7 +188,8 @@ class TestChaining:
         )
         mesh = make_mesh({"series": 4})
         got = _sorted(
-            l.on_mesh(mesh).resample("1 minute", "mean").EMA("price")
+            l.on_mesh(mesh).resample("1 minute", "mean")
+            .EMA("price", exact=True)
             .collect().df
         )
         np.testing.assert_allclose(
@@ -233,3 +248,57 @@ class TestHaloStrategy:
                 b[f"{stat}_price"].to_numpy(float),
                 rtol=1e-9, equal_nan=True, err_msg=stat,
             )
+
+
+@pytest.mark.parametrize("axes,ta", MESHES)
+def test_sort_kernel_path_matches_host(frames, axes, ta, monkeypatch):
+    """TEMPO_TPU_SORT_KERNELS=1 forces the TPU sort-and-scan forms
+    (asof merge join, shifted range stats) through the distributed
+    frame ops on the CPU mesh — results must match the host path."""
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+    lt, rt = frames
+    mesh = make_mesh(axes)
+    chain = lambda L, R: (
+        L.asofJoin(R)
+        .withRangeStats(colsToSummarize=["price"], rangeBackWindowSecs=30)
+        .EMA("price", exact=True)
+    )
+    got = _sorted(chain(lt.on_mesh(mesh, time_axis=ta),
+                        rt.on_mesh(mesh, time_axis=ta)).collect().df)
+    want = _sorted(chain(lt, rt).df)
+    for c in ["right_bid", "right_ask", "EMA_price"] + [
+        f"{s}_price" for s in STATS
+    ]:
+        np.testing.assert_allclose(
+            got[c].to_numpy(np.float64), want[c].to_numpy(np.float64),
+            rtol=1e-6, atol=1e-9, equal_nan=True, err_msg=c,
+        )
+
+
+@pytest.mark.parametrize("skip", [True, False], ids=["skipNulls", "keepNulls"])
+@pytest.mark.parametrize("axes,ta", MESHES)
+def test_asof_join_right_host_columns(frames, axes, ta, skip):
+    """Right-side non-numeric columns must survive the distributed join
+    with the host path's schema and values (review r2 finding: they were
+    silently dropped)."""
+    lt, rt = frames
+    venue = np.where(
+        np.arange(len(rt.df)) % 7 == 0, None,
+        np.array([f"v{i % 3}" for i in range(len(rt.df))], object),
+    )
+    rdf = rt.df.assign(venue=venue)
+    rt2 = TSDF(rdf, "event_ts", ["symbol"])
+    mesh = make_mesh(axes)
+    got = _sorted(
+        lt.on_mesh(mesh, time_axis=ta)
+        .asofJoin(rt2.on_mesh(mesh, time_axis=ta), skipNulls=skip)
+        .collect().df
+    )
+    want = _sorted(lt.asofJoin(rt2, skipNulls=skip).df)
+    assert "right_venue" in got.columns
+    gv = got["right_venue"].to_numpy(object)
+    wv = want["right_venue"].to_numpy(object)
+    same = np.array([
+        (pd.isna(a) and pd.isna(b)) or a == b for a, b in zip(gv, wv)
+    ])
+    assert same.all(), f"{(~same).sum()} right_venue mismatches"

@@ -1,0 +1,185 @@
+"""Parity tests for the sort-and-scan kernels (ops/sortmerge.py).
+
+The sort forms must agree exactly with the search-and-gather forms they
+replace on TPU (merge_rank vs np.searchsorted; asof_merge_values vs the
+asof_indices_* kernels; range_stats_shifted vs windowed_stats), because
+frame-level goldens only run the CPU path — these tests pin the
+equivalence on randomized fixtures with ties, pads, and nulls.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tempo_tpu.ops import asof as asof_ops
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.ops import sortmerge as sm
+from tempo_tpu.packing import TS_PAD
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_merge_rank_matches_numpy(side, seed):
+    rng = np.random.default_rng(seed)
+    K, Lk, Lq = 5, 37, 23
+    keys = np.sort(rng.integers(0, 30, size=(K, Lk)), axis=-1).astype(np.int64)
+    qs = np.sort(rng.integers(-4, 34, size=(K, Lq)), axis=-1).astype(np.int64)
+    got = np.asarray(sm.merge_rank(jnp.asarray(keys), jnp.asarray(qs), side=side))
+    want = np.stack(
+        [np.searchsorted(keys[k], qs[k], side=side) for k in range(K)]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_merge_rank_with_pads():
+    # TS_PAD slots sort last on both sides; ranks for pad queries land at
+    # the key pad boundary, exactly like np.searchsorted would
+    keys = np.array([[1, 5, 9, TS_PAD, TS_PAD]], dtype=np.int64)
+    qs = np.array([[0, 5, 12, TS_PAD]], dtype=np.int64)
+    got = np.asarray(sm.merge_rank(jnp.asarray(keys), jnp.asarray(qs), side="right"))
+    want = np.searchsorted(keys[0], qs[0], side="right")[None]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_merge_rank_single_row_and_width_one():
+    keys = np.array([[7]], dtype=np.int64)
+    qs = np.array([[3, 7, 11]], dtype=np.int64)
+    for side in ("left", "right"):
+        got = np.asarray(sm.merge_rank(jnp.asarray(keys), jnp.asarray(qs), side=side))
+        want = np.searchsorted(keys[0], qs[0], side=side)[None]
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("skip", [True, False])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_asof_merge_values_matches_index_kernel(skip, seed):
+    rng = np.random.default_rng(seed)
+    K, Ll, Lr, C = 4, 41, 37, 3
+    l_ts = np.sort(rng.integers(0, 80, size=(K, Ll)), axis=-1).astype(np.int64)
+    r_ts = np.sort(rng.integers(0, 80, size=(K, Lr)), axis=-1).astype(np.int64)
+    r_vals = rng.standard_normal((C, K, Lr))
+    r_valid = rng.random((C, K, Lr)) > 0.35
+
+    vals, found, idx = sm.asof_merge_values(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid),
+        jnp.asarray(r_vals), skip_nulls=skip,
+    )
+    vals, found, idx = map(np.asarray, (vals, found, idx))
+
+    last_idx, col_idx = asof_ops.asof_indices_searchsorted(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid), n_cols=C
+    )
+    last_idx, col_idx = np.asarray(last_idx), np.asarray(col_idx)
+
+    np.testing.assert_array_equal(idx, last_idx)
+    if skip:
+        want_found = col_idx >= 0
+        want_vals = np.where(
+            want_found,
+            np.take_along_axis(r_vals, np.maximum(col_idx, 0), axis=-1),
+            np.nan,
+        )
+    else:
+        ok = last_idx >= 0
+        row_vals = np.take_along_axis(
+            r_vals, np.broadcast_to(np.maximum(last_idx, 0), (C, K, Ll)), axis=-1
+        )
+        row_valid = np.take_along_axis(
+            r_valid, np.broadcast_to(np.maximum(last_idx, 0), (C, K, Ll)), axis=-1
+        )
+        want_found = ok & row_valid
+        want_vals = np.where(want_found, row_vals, np.nan)
+    np.testing.assert_array_equal(found, want_found)
+    np.testing.assert_allclose(vals, want_vals, equal_nan=True)
+
+
+def test_asof_merge_values_sequence_tiebreak():
+    """On timestamp ties the sequence key orders right rows; the last
+    right row at-or-before each (ts, seq) left row wins — mirrored
+    against asof_indices_merge which is golden-pinned upstream."""
+    rng = np.random.default_rng(7)
+    K, Ll, Lr = 3, 17, 19
+    base = np.sort(rng.integers(0, 12, size=(K, Ll)), axis=-1)
+    l_ts = base.astype(np.int64)
+    r_ts = np.sort(rng.integers(0, 12, size=(K, Lr)), axis=-1).astype(np.int64)
+    l_seq = rng.integers(0, 5, size=(K, Ll)).astype(np.float64)
+    r_seq = rng.integers(0, 5, size=(K, Lr)).astype(np.float64)
+    # sequence must ascend within tied timestamps for the merge form
+    order_l = np.lexsort((l_seq, l_ts), axis=-1)
+    order_r = np.lexsort((r_seq, r_ts), axis=-1)
+    l_ts = np.take_along_axis(l_ts, order_l, axis=-1)
+    l_seq = np.take_along_axis(l_seq, order_l, axis=-1)
+    r_ts = np.take_along_axis(r_ts, order_r, axis=-1)
+    r_seq = np.take_along_axis(r_seq, order_r, axis=-1)
+    r_vals = rng.standard_normal((1, K, Lr))
+    r_valid = np.ones((1, K, Lr), bool)
+
+    vals, found, idx = sm.asof_merge_values(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid),
+        jnp.asarray(r_vals), l_seq=jnp.asarray(l_seq),
+        r_seq=jnp.asarray(r_seq),
+    )
+    last_idx, col_idx = asof_ops.asof_indices_merge(
+        jnp.asarray(l_ts), jnp.asarray(l_seq), jnp.asarray(r_ts),
+        jnp.asarray(r_seq), jnp.asarray(r_valid), n_cols=1,
+    )
+    np.testing.assert_array_equal(np.asarray(idx), np.asarray(last_idx))
+    want = np.where(
+        np.asarray(col_idx) >= 0,
+        np.take_along_axis(r_vals, np.maximum(np.asarray(col_idx), 0), axis=-1),
+        np.nan,
+    )
+    np.testing.assert_allclose(np.asarray(vals), want, equal_nan=True)
+
+
+@pytest.mark.parametrize("seed", [0, 5])
+def test_range_stats_shifted_matches_windowed_stats(seed):
+    rng = np.random.default_rng(seed)
+    K, L, W = 4, 96, 9
+    secs = np.sort(rng.integers(0, 60, size=(K, L)), axis=-1).astype(np.int64)
+    x = rng.standard_normal((K, L))
+    valid = rng.random((K, L)) > 0.25
+
+    start = np.stack(
+        [np.searchsorted(secs[k], secs[k] - W, side="left") for k in range(K)]
+    ).astype(np.int32)
+    end = np.stack(
+        [np.searchsorted(secs[k], secs[k], side="right") for k in range(K)]
+    ).astype(np.int32)
+    behind = int((np.arange(L)[None] - start).max())
+    ahead = int((end - 1 - np.arange(L)[None]).max())
+
+    ref = rk.windowed_stats(
+        jnp.asarray(x), jnp.asarray(valid), jnp.asarray(start), jnp.asarray(end)
+    )
+    got = sm.range_stats_shifted(
+        jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+        jnp.asarray(float(W)), max_behind=behind, max_ahead=ahead,
+    )
+    for k in ("mean", "count", "min", "max", "sum", "stddev", "zscore"):
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(ref[k]),
+            rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=k,
+        )
+
+
+def test_searchsorted_batched_sort_dispatch():
+    """With TEMPO_TPU_SORT_KERNELS=1 the shared wrapper runs merge_rank
+    and must agree with the binary-search form."""
+    import os
+
+    from tempo_tpu.ops import window_utils as wu
+
+    rng = np.random.default_rng(11)
+    keys = np.sort(rng.integers(0, 50, size=(6, 40)), axis=-1).astype(np.int64)
+    qs = np.sort(rng.integers(0, 50, size=(6, 40)), axis=-1).astype(np.int64)
+    want = np.asarray(wu.searchsorted_batched(jnp.asarray(keys), jnp.asarray(qs), side="right"))
+    os.environ["TEMPO_TPU_SORT_KERNELS"] = "1"
+    try:
+        got = np.asarray(
+            wu.searchsorted_batched(jnp.asarray(keys), jnp.asarray(qs), side="right")
+        )
+    finally:
+        del os.environ["TEMPO_TPU_SORT_KERNELS"]
+    np.testing.assert_array_equal(got, want)

@@ -1,0 +1,178 @@
+"""SQL expression engine tests (tempo_tpu/sql.py) and its wiring into
+TSDF.selectExpr / filter (reference selectExpr TSDF.scala:226-229,
+filter/where TSDF.scala:232-238 — Spark parses the same strings through
+Catalyst; here the grammar is implemented directly)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, sql
+
+
+@pytest.fixture
+def df():
+    return pd.DataFrame({
+        "a": [1, 2, 3, 4],
+        "b": [10.0, np.nan, 30.0, 40.0],
+        "s": ["foo", "Bar", None, "baz"],
+        "t": pd.to_datetime(
+            ["2024-01-01 10:30:15", "2024-01-02 11:00:00",
+             "2024-06-15 23:59:59", "2025-03-01 00:00:01"]
+        ),
+    })
+
+
+# ----------------------------------------------------------------------
+# expression evaluation
+# ----------------------------------------------------------------------
+
+def test_arithmetic_and_precedence(df):
+    out = sql.eval_expr(df, "a * 2 + 1")
+    np.testing.assert_array_equal(out.to_numpy(), [3, 5, 7, 9])
+    out = sql.eval_expr(df, "(a + 1) * (a - 1)")
+    np.testing.assert_array_equal(out.to_numpy(), [0, 3, 8, 15])
+    out = sql.eval_expr(df, "a % 2")
+    np.testing.assert_array_equal(out.to_numpy(), [1, 0, 1, 0])
+    # SQL division is fractional
+    out = sql.eval_expr(df, "a / 2")
+    np.testing.assert_allclose(out.to_numpy(), [0.5, 1.0, 1.5, 2.0])
+
+
+def test_comparisons_propagate_null(df):
+    out = sql.eval_expr(df, "b > 15")
+    assert out.tolist() == [False, pd.NA, True, True]
+    # null-safe equality has no null output
+    out = sql.eval_expr(df, "b <=> b")
+    assert out.tolist() == [True, True, True, True]
+
+
+def test_boolean_logic_and_filtering(df):
+    out = sql.filter_mask(df, "a >= 2 AND b IS NOT NULL")
+    np.testing.assert_array_equal(out.to_numpy(), [False, False, True, True])
+    out = sql.filter_mask(df, "a = 1 OR s = 'baz'")
+    np.testing.assert_array_equal(out.to_numpy(), [True, False, False, True])
+    # NULL predicate rows drop (three-valued logic)
+    out = sql.filter_mask(df, "b > 0")
+    np.testing.assert_array_equal(out.to_numpy(), [True, False, True, True])
+    out = sql.filter_mask(df, "NOT a = 2")
+    np.testing.assert_array_equal(out.to_numpy(), [True, False, True, True])
+
+
+def test_in_between_like(df):
+    np.testing.assert_array_equal(
+        sql.filter_mask(df, "a IN (1, 3)").to_numpy(), [True, False, True, False])
+    np.testing.assert_array_equal(
+        sql.filter_mask(df, "a NOT IN (1, 3)").to_numpy(),
+        [False, True, False, True])
+    np.testing.assert_array_equal(
+        sql.filter_mask(df, "a BETWEEN 2 AND 3").to_numpy(),
+        [False, True, True, False])
+    np.testing.assert_array_equal(
+        sql.filter_mask(df, "s LIKE 'ba%'").to_numpy(),
+        [False, False, False, True])
+    np.testing.assert_array_equal(
+        sql.filter_mask(df, "s RLIKE '^[bB]a'").to_numpy(),
+        [False, True, False, True])
+
+
+def test_case_when(df):
+    out = sql.eval_expr(
+        df, "CASE WHEN a < 2 THEN 'lo' WHEN a < 4 THEN 'mid' ELSE 'hi' END"
+    )
+    assert out.tolist() == ["lo", "mid", "mid", "hi"]
+    out = sql.eval_expr(df, "CASE a WHEN 1 THEN 100 WHEN 4 THEN 400 END")
+    assert out.tolist()[0] == 100 and out.tolist()[3] == 400
+
+
+def test_cast(df):
+    out = sql.eval_expr(df, "CAST(b AS int)")
+    assert out.tolist()[0] == 10 and pd.isna(out.tolist()[1])
+    out = sql.eval_expr(df, "CAST(a AS string)")
+    assert out.tolist() == ["1", "2", "3", "4"]
+    out = sql.eval_expr(df, "CAST(a AS double)")
+    assert out.dtype == np.float64
+
+
+def test_functions(df):
+    np.testing.assert_allclose(
+        sql.eval_expr(df, "sqrt(a)").to_numpy(), np.sqrt([1, 2, 3, 4]))
+    np.testing.assert_allclose(
+        sql.eval_expr(df, "coalesce(b, 0)").to_numpy(), [10.0, 0.0, 30.0, 40.0])
+    assert sql.eval_expr(df, "concat(s, '_x')").tolist()[0] == "foo_x"
+    assert sql.eval_expr(df, "upper(s)").tolist()[1] == "BAR"
+    assert sql.eval_expr(df, "substring(s, 1, 2)").tolist()[0] == "fo"
+    assert sql.eval_expr(df, "lpad(a, 3, '0')").tolist() == [
+        "001", "002", "003", "004"]
+    np.testing.assert_array_equal(
+        sql.eval_expr(df, "if(a > 2, 1, 0)").to_numpy(), [0, 0, 1, 1])
+    np.testing.assert_array_equal(
+        sql.eval_expr(df, "greatest(a, 2)").to_numpy(), [2, 2, 3, 4])
+
+
+def test_datetime_functions(df):
+    assert sql.eval_expr(df, "year(t)").tolist() == [2024, 2024, 2024, 2025]
+    assert sql.eval_expr(df, "minute(t)").tolist() == [30, 0, 59, 0]
+    trunc = sql.eval_expr(df, "date_trunc('day', t)")
+    assert trunc.dt.hour.tolist() == [0, 0, 0, 0]
+    secs = sql.eval_expr(df, "unix_timestamp(t)")
+    assert secs.tolist()[0] == int(pd.Timestamp("2024-01-01 10:30:15").value // 1e9)
+
+
+def test_string_concat_operator(df):
+    out = sql.eval_expr(df, "s || '!'")
+    assert out.tolist()[0] == "foo!"
+
+
+def test_unsupported_function_lists_alternatives(df):
+    with pytest.raises(sql.SqlError, match="unsupported SQL function"):
+        sql.eval_expr(df, "no_such_fn(a)")
+
+
+def test_trailing_tokens_rejected(df):
+    with pytest.raises(sql.SqlError):
+        sql.eval_expr(df, "a + 1 oops")
+
+
+# ----------------------------------------------------------------------
+# TSDF wiring
+# ----------------------------------------------------------------------
+
+def _tsdf():
+    return TSDF(pd.DataFrame({
+        "symbol": ["A", "A", "B", "B"],
+        "event_ts": pd.to_datetime([1, 2, 1, 2], unit="s"),
+        "price": [10.0, 20.0, 30.0, np.nan],
+        "qty": [1, 2, 3, 4],
+    }), "event_ts", ["symbol"])
+
+
+def test_select_expr_projection_and_alias():
+    out = _tsdf().selectExpr(
+        "symbol", "event_ts", "price * qty AS notional",
+        "CASE WHEN qty > 2 THEN 'big' ELSE 'small' END as size",
+    ).df
+    assert list(out.columns) == ["symbol", "event_ts", "notional", "size"]
+    np.testing.assert_allclose(
+        out["notional"].to_numpy(float), [10.0, 40.0, 90.0, np.nan])
+    assert out["size"].tolist() == ["small", "small", "big", "big"]
+
+
+def test_filter_sql_and_pandas_fallback():
+    t = _tsdf()
+    assert len(t.filter("price > 15 AND qty <= 3").df) == 2
+    # NULL price row drops under SQL three-valued logic
+    assert len(t.filter("price > 0").df) == 3
+    # pandas-query-only syntax still works via fallback
+    assert len(t.filter("qty == 4").df) == 1
+
+
+def test_case_when_preserves_numeric_looking_strings(df):
+    out = sql.eval_expr(df, "CASE WHEN a > 2 THEN '01' ELSE '002' END")
+    assert out.tolist() == ["002", "002", "01", "01"]
+
+
+def test_select_expr_pandas_eval_fallback():
+    out = _tsdf().selectExpr("symbol", "event_ts", "price ** 2 as p2").df
+    np.testing.assert_allclose(
+        out["p2"].to_numpy(float), [100.0, 400.0, 900.0, np.nan])
