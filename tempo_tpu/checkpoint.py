@@ -1,0 +1,257 @@
+"""Checkpoint / resume for distributed pipelines.
+
+The reference has no checkpoint mechanism of its own — its
+transformations are stateless Spark plans and recovery is task re-run
+(SURVEY.md §5 "Checkpoint / resume: none").  tempo-tpu's distributed
+frames DO carry state worth snapshotting: the packed, sharded device
+arrays of a :class:`~tempo_tpu.dist.DistributedTSDF` mid-pipeline (a
+chain may have executed several expensive device ops since ingest).
+This module adds the elasticity story the rebuild was asked to
+first-class (driver spec "failure detection, checkpoint/resume"):
+
+* :func:`save` — fetch the frame's device state (one stacked transfer,
+  same path as ``collect``) and write a self-describing directory:
+  ``manifest.json`` + ``arrays.npz`` (+ ``host.parquet`` for
+  host-resident columns and the key frame).
+* :func:`load` — restore a device-resident ``DistributedTSDF`` onto a
+  caller-provided mesh (the mesh may have a different device count than
+  the one that saved — re-placement is just a new NamedSharding).
+
+Checkpoints are atomic (write to ``<dir>.tmp`` then rename) so a crash
+mid-save never corrupts the previous checkpoint, and versioned so
+future layout changes can refuse gracefully.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+import jax
+
+FORMAT_VERSION = 1
+
+
+def save(frame, path: str) -> None:
+    """Snapshot a :class:`DistributedTSDF` (or host :class:`TSDF`) to
+    ``path`` (a directory).  Atomic: the directory appears fully
+    written or not at all."""
+    from tempo_tpu.dist import DistributedTSDF
+    from tempo_tpu.frame import TSDF
+
+    tmp = path + ".tmp"
+    bak = path + ".bak"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        if isinstance(frame, DistributedTSDF):
+            _save_dist(frame, tmp)
+        elif isinstance(frame, TSDF):
+            _save_host(frame, tmp)
+        else:
+            raise TypeError(f"cannot checkpoint {type(frame)}")
+        # three-step swap: at every crash point either ``path`` or
+        # ``path.bak`` holds a complete previous/new checkpoint (load()
+        # falls back to .bak), so the guarantee survives a crash between
+        # the renames — rmtree(path) before replace would not
+        if os.path.exists(bak):
+            shutil.rmtree(bak)
+        if os.path.exists(path):
+            os.replace(path, bak)
+        os.replace(tmp, path)
+        shutil.rmtree(bak, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load(path: str, mesh=None, series_axis: str = "series",
+         time_axis: Optional[str] = None):
+    """Restore a checkpoint.  Distributed checkpoints need a ``mesh``
+    (any device count — resume elsewhere is a re-placement); host
+    checkpoints ignore it."""
+    if not os.path.exists(os.path.join(path, "manifest.json")) \
+            and os.path.exists(os.path.join(path + ".bak", "manifest.json")):
+        path = path + ".bak"   # crash mid-swap: previous checkpoint
+    with open(os.path.join(path, "manifest.json")) as f:
+        man = json.load(f)
+    if man["format_version"] > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format {man['format_version']} is newer than "
+            f"this library understands ({FORMAT_VERSION})"
+        )
+    if man["kind"] == "host":
+        return _load_host(path, man)
+    if mesh is None:
+        raise ValueError("distributed checkpoint needs a mesh to resume on")
+    return _load_dist(path, man, mesh, series_axis, time_axis)
+
+
+# ----------------------------------------------------------------------
+# host TSDF
+# ----------------------------------------------------------------------
+
+def _save_host(tsdf, d: str) -> None:
+    tsdf.df.to_parquet(os.path.join(d, "host.parquet"))
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({
+            "format_version": FORMAT_VERSION,
+            "kind": "host",
+            "ts_col": tsdf.ts_col,
+            "partition_cols": tsdf.partitionCols,
+            "sequence_col": tsdf.sequence_col or None,
+        }, f, indent=2)
+
+
+def _load_host(d: str, man: dict):
+    from tempo_tpu.frame import TSDF
+
+    df = pd.read_parquet(os.path.join(d, "host.parquet"))
+    return TSDF(df, man["ts_col"], man["partition_cols"],
+                man.get("sequence_col"))
+
+
+# ----------------------------------------------------------------------
+# DistributedTSDF
+# ----------------------------------------------------------------------
+
+def _save_dist(frame, d: str) -> None:
+    import jax.numpy as jnp
+
+    names = list(frame.cols)
+    # ONE stacked fetch for all column planes (collect()'s transfer
+    # discipline: values + valids ride a single [2C, K, L] transfer),
+    # plus ts/mask
+    arrays = {
+        "ts": np.asarray(frame.ts),
+        "mask": np.asarray(frame.mask),
+        "layout_ts_ns": frame.layout.ts_ns,
+        "layout_starts": frame.layout.starts,
+        "layout_key_ids": frame.layout.key_ids,
+        "layout_order": frame.layout.order,
+    }
+    if names:
+        cdt = frame.cols[names[0]].values.dtype
+        stacked = np.asarray(jnp.stack(
+            [frame.cols[c].values.astype(cdt) for c in names]
+            + [frame.cols[c].valid.astype(cdt) for c in names]
+        ))
+        val_block, ok_block = stacked[: len(names)], stacked[len(names):]
+    col_meta = {}
+    hg_idx = 0
+    for i, c in enumerate(names):
+        col = frame.cols[c]
+        arrays[f"col_{i}_values"] = val_block[i]
+        arrays[f"col_{i}_valid"] = ok_block[i] > 0.5
+        meta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
+        if col.host_gather is not None:
+            flat_vals, r_starts, perm = col.host_gather
+            arrays[f"hg_{hg_idx}_vals"] = np.asarray(flat_vals, dtype=object) \
+                if flat_vals.dtype == object else flat_vals
+            arrays[f"hg_{hg_idx}_starts"] = r_starts
+            arrays[f"hg_{hg_idx}_perm"] = perm
+            meta["host_gather"] = hg_idx
+            meta["host_gather_len"] = int(len(flat_vals))
+            hg_idx += 1
+        col_meta[str(i)] = meta
+    np.savez(os.path.join(d, "arrays.npz"),
+             **{k: v for k, v in arrays.items() if v.dtype != object})
+    obj_arrays = {k: v for k, v in arrays.items() if v.dtype == object}
+    if obj_arrays:
+        pd.DataFrame({k: pd.Series(v) for k, v in obj_arrays.items()}) \
+            .to_parquet(os.path.join(d, "objects.parquet"))
+
+    frame.layout.key_frame.to_parquet(os.path.join(d, "keys.parquet"))
+    if frame._source_df is not None and frame.host_cols:
+        frame._source_df[sorted(set(frame.host_cols.values()))].to_parquet(
+            os.path.join(d, "host.parquet")
+        )
+    audits = [(msg, int(np.asarray(cnt))) for msg, cnt in frame.audits]
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({
+            "format_version": FORMAT_VERSION,
+            "kind": "dist",
+            "ts_col": frame.ts_col,
+            "partition_cols": frame.partitionCols,
+            "ts_dtype": str(frame._ts_dtype),
+            "host_cols": frame.host_cols,
+            "halo_fraction": frame.halo_fraction,
+            "resampled": frame.resampled,
+            "audits": audits,
+            "columns": col_meta,
+            "n_cols": len(names),
+        }, f, indent=2)
+
+
+def _load_dist(d: str, man: dict, mesh, series_axis: str,
+               time_axis: Optional[str]):
+    from jax.sharding import NamedSharding
+
+    from tempo_tpu import packing
+    from tempo_tpu.dist import DistCol, DistributedTSDF, _pad_k, _spec
+
+    z = np.load(os.path.join(d, "arrays.npz"), allow_pickle=False)
+    obj_path = os.path.join(d, "objects.parquet")
+    objs = pd.read_parquet(obj_path) if os.path.exists(obj_path) else None
+    key_frame = pd.read_parquet(os.path.join(d, "keys.parquet"))
+    host_path = os.path.join(d, "host.parquet")
+    source_df = pd.read_parquet(host_path) if os.path.exists(host_path) \
+        else None
+
+    layout = packing.FlatLayout(
+        key_ids=z["layout_key_ids"], ts_ns=z["layout_ts_ns"],
+        order=z["layout_order"], starts=z["layout_starts"],
+        key_frame=key_frame,
+    )
+
+    n_s = mesh.shape[series_axis]
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    K, L = (int(s) for s in z["ts"].shape)
+    # a finer time axis than the saver's needs more row padding; pads
+    # carry TS_PAD / invalid and are inert in every kernel
+    mult = 8 * n_t
+    L_new = -(-L // mult) * mult
+    k_mult = n_s * n_t
+    K_dev = max(1, -(-K // k_mult)) * k_mult
+    sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis))
+
+    def put2(a, fill):
+        if L_new != L:
+            pad = np.full(a.shape[:-1] + (L_new - L,), fill, dtype=a.dtype)
+            a = np.concatenate([a, pad], axis=-1)
+        return jax.device_put(_pad_k(a, K_dev, fill), sharding)
+
+    ts_d = put2(z["ts"], packing.TS_PAD)
+    mask_d = put2(z["mask"], False)
+    cols = {}
+    for i in range(man["n_cols"]):
+        meta = man["columns"][str(i)]
+        hg = None
+        if "host_gather" in meta:
+            j = meta["host_gather"]
+            key = f"hg_{j}_vals"
+            vals = (objs[key].to_numpy(object) if objs is not None
+                    and key in objs.columns else z[key])
+            vals = vals[: meta["host_gather_len"]]
+            hg = (vals, z[f"hg_{j}_starts"], z[f"hg_{j}_perm"])
+        v = z[f"col_{i}_values"]
+        fill = np.nan if np.issubdtype(v.dtype, np.floating) else 0
+        cols[meta["name"]] = DistCol(
+            put2(v, fill), put2(z[f"col_{i}_valid"], False),
+            int64=meta["int64"],
+            ts_chunk=tuple(meta["ts_chunk"]) if meta["ts_chunk"] else None,
+            host_gather=hg,
+        )
+    audits = [(msg, np.int64(cnt)) for msg, cnt in man["audits"]]
+    return DistributedTSDF(
+        mesh, series_axis, time_axis, ts_d, mask_d, cols, layout,
+        man["ts_col"], man["partition_cols"], np.dtype(man["ts_dtype"]),
+        source_df, man["host_cols"], man["halo_fraction"],
+        audits=audits, resampled=man["resampled"],
+    )

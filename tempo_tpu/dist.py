@@ -396,7 +396,11 @@ class DistributedTSDF:
                              self.partitionCols, self.K_dev)
         align2 = _align_fn(self.mesh, self.series_axis, self.time_axis)
 
-        r_names = right.numeric_columns()
+        # every device-resident right column joins — plain numerics,
+        # ts-chunk planes from earlier joins, and host-gather index
+        # planes from earlier joins (chained a.asofJoin(b.asofJoin(c))
+        # must not lose the inner join's columns)
+        r_recs = list(right.cols.items())
         h_names = [c for c in right.host_cols
                    if right._source_df is not None]
         r_ts_al = align2(right.ts, perm, ok, packing.TS_PAD)
@@ -404,17 +408,17 @@ class DistributedTSDF:
         dt = packing.compute_dtype()
         sharding_r = right._sharding(2)
         # value stack layout (offsets named below):
-        #   [0, n)              numeric col values
+        #   [0, n)              right col values (all kinds)
         #   [n, n+3)            right ts as three 21-bit ns chunks (f32-exact)
         #   skipNulls=True:
         #     [n+3, n+3+H)      host-col row-index planes (validity = the
         #                       host col's non-null mask -> per-col ffill)
         #   skipNulls=False:
-        #     [n+3, 2n+3)       numeric validity planes (to recover nulls)
+        #     [n+3, 2n+3)       per-col validity planes (to recover nulls)
         #     [2n+3, 2n+3+H)    host-col row-index planes (validity = mask)
         #     [2n+3+H, 2n+3+2H) host-col non-null planes
-        planes = [right.cols[c].values for c in r_names]
-        valid_planes = [right.cols[c].valid for c in r_names]
+        planes = [col.values for _, col in r_recs]
+        valid_planes = [col.valid for _, col in r_recs]
         chunk_mask = jnp.int64((1 << 21) - 1)
         ts_chunks = [
             ((right.ts >> shift) & chunk_mask).astype(dt)
@@ -475,17 +479,34 @@ class DistributedTSDF:
         rename = (lambda c: f"{left_prefix}_{c}") if left_prefix else (lambda c: c)
         new_cols = {rename(c): col for c, col in self.cols.items()}
         new_host = {rename(c): src for c, src in self.host_cols.items()}
-        n = len(r_names)
+        n = len(r_recs)
         H = len(h_names)
-        for i, c in enumerate(r_names):
+        hidx_off = (n + 3) if skipNulls else (2 * n + 3)
+        for i, (c, rcol) in enumerate(r_recs):
             if skipNulls:
                 v, f = vals[i], found[i]
             else:
                 v = vals[i]
                 f = found[i] & (vals[n + 3 + i] > 0.5)
-            new_cols[f"{right_prefix}_{c}"] = DistCol(
-                jnp.where(f, v, jnp.nan), f
-            )
+            if rcol.ts_chunk is not None:
+                # a joined-timestamp chunk from an earlier join: re-target
+                # its recompose name under this join's prefix
+                target, shift = rcol.ts_chunk
+                nt = f"{right_prefix}_{target}"
+                j = {42: 0, 21: 1, 0: 2}[shift]
+                new_cols[f"__{nt}__c{j}"] = DistCol(v, f, ts_chunk=(nt, shift))
+            elif rcol.host_gather is not None:
+                # an earlier join's host-col index plane: compose this
+                # join's series permutation into its gather map
+                fv, st, pm = rcol.host_gather
+                pm2 = pm[np.clip(perm, 0, max(len(pm) - 1, 0))]
+                new_cols[f"{right_prefix}_{c}"] = DistCol(
+                    v, f, host_gather=(fv, st, pm2)
+                )
+            else:
+                new_cols[f"{right_prefix}_{c}"] = DistCol(
+                    jnp.where(f, v, jnp.nan), f, int64=rcol.int64
+                )
         rts_name = f"{right_prefix}_{right.ts_col}"
         for j, shift in enumerate((42, 21, 0)):
             new_cols[f"__{rts_name}__c{j}"] = DistCol(
@@ -493,10 +514,10 @@ class DistributedTSDF:
             )
         for i, c in enumerate(h_names):
             if skipNulls:
-                v, f = vals[n + 3 + i], found[n + 3 + i]
+                v, f = vals[hidx_off + i], found[hidx_off + i]
             else:
-                v = vals[2 * n + 3 + i]
-                f = found[2 * n + 3 + i] & (vals[2 * n + 3 + H + i] > 0.5)
+                v = vals[hidx_off + i]
+                f = found[hidx_off + i] & (vals[hidx_off + H + i] > 0.5)
             new_cols[f"{right_prefix}_{c}"] = DistCol(
                 v, f, host_gather=(
                     host_flat[c], right.layout.starts, perm,

@@ -130,7 +130,19 @@ def _numeric_binop(op: str, a, b):
     if op == "/":
         return _to_float(a) / _to_float(b)
     if op == "%":
-        return a % b
+        # Spark % is the truncated remainder (sign of the dividend),
+        # not Python's floored modulo: -7 % 3 = -1, not 2
+        r = np.fmod(np.asarray(a) if not isinstance(a, pd.Series) else a, b)
+        int_in = all(
+            (isinstance(x, pd.Series)
+             and pd.api.types.is_integer_dtype(x))
+            or isinstance(x, (int, np.integer))
+            for x in (a, b)
+        )
+        if isinstance(r, pd.Series):
+            return r.astype("int64") if int_in else r
+        r = r.item() if isinstance(r, np.ndarray) else r
+        return int(r) if int_in else r
     raise SqlError(f"unknown arithmetic op {op}")  # pragma: no cover
 
 
@@ -321,16 +333,22 @@ def _f_if(cond, a, b):
     return a if (cond is not pd.NA and cond) else b
 
 
-def _minmax(fn):
+def _minmax(npf, pyf):
+    """Spark greatest/least SKIP nulls (null only when all args null) —
+    np.fmax/fmin give exactly that for numerics."""
+
     def f(*args):
-        out = args[0]
-        for nxt in args[1:]:
-            if isinstance(out, pd.Series) or isinstance(nxt, pd.Series):
-                out = fn(pd.Series(out) if not isinstance(out, pd.Series) else out,
-                         nxt)
-            else:
-                out = fn(out, nxt)
-        return out
+        series = [a for a in args if isinstance(a, pd.Series)]
+        if series:
+            idx = series[0].index
+            out = None
+            for a in args:
+                arr = (pd.to_numeric(a, errors="coerce").to_numpy(float)
+                       if isinstance(a, pd.Series) else a)
+                out = arr if out is None else npf(out, arr)
+            return pd.Series(out, index=idx)
+        vals = [a for a in args if a is not None and not pd.isna(a)]
+        return pyf(vals) if vals else None
     return f
 
 
@@ -355,10 +373,8 @@ _FUNCTIONS: Dict[str, Callable] = {
                               lambda v: float(np.sign(v))),
     "signum": _series_or_scalar(lambda s: np.sign(_to_float(s)),
                                 lambda v: float(np.sign(v))),
-    "greatest": _minmax(lambda a, b: a.combine(b, max) if isinstance(a, pd.Series)
-                        else max(a, b)),
-    "least": _minmax(lambda a, b: a.combine(b, min) if isinstance(a, pd.Series)
-                     else min(a, b)),
+    "greatest": _minmax(np.fmax, max),
+    "least": _minmax(np.fmin, min),
     "coalesce": _f_coalesce,
     "nvl": _f_coalesce,
     "nanvl": lambda a, b: (a.where(~a.isna(), b) if isinstance(a, pd.Series)

@@ -1,0 +1,155 @@
+"""Checkpoint/resume roundtrips (tempo_tpu/checkpoint.py).
+
+The elasticity subsystem the reference lacks (SURVEY.md §5): snapshot a
+device-resident DistributedTSDF mid-pipeline, resume on a *different*
+mesh shape, and continue the chain — results must match the
+uninterrupted run."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, checkpoint
+from tempo_tpu.parallel import make_mesh
+
+
+@pytest.fixture
+def frames():
+    rng = np.random.default_rng(21)
+    n, m = 240, 200
+    lt = TSDF(pd.DataFrame({
+        "sym": rng.choice(["a", "b", "c"], n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 600, n)) * 1_000_000_000),
+        "px": rng.standard_normal(n) + 10,
+        "tag": [f"t{i % 4}" for i in range(n)],
+    }), "event_ts", ["sym"])
+    rt = TSDF(pd.DataFrame({
+        "sym": rng.choice(["a", "b"], m),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 600, m)) * 1_000_000_000),
+        "bid": np.where(rng.random(m) > 0.2, rng.standard_normal(m), np.nan),
+        "venue": np.where(rng.random(m) > 0.1,
+                          np.array([f"v{i % 3}" for i in range(m)], object),
+                          None),
+    }), "event_ts", ["sym"])
+    return lt, rt
+
+
+def _key(df):
+    return df.sort_values(["sym", "event_ts"], kind="stable").reset_index(
+        drop=True
+    )
+
+
+def test_host_roundtrip(tmp_path, frames):
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_host")
+    checkpoint.save(lt, p)
+    back = checkpoint.load(p)
+    pd.testing.assert_frame_equal(back.df, lt.df)
+    assert back.ts_col == lt.ts_col
+    assert back.partitionCols == lt.partitionCols
+
+
+def test_dist_roundtrip_same_mesh(tmp_path, frames):
+    lt, _ = frames
+    mesh = make_mesh({"series": 4})
+    d = lt.on_mesh(mesh)
+    p = str(tmp_path / "ckpt_dist")
+    checkpoint.save(d, p)
+    back = checkpoint.load(p, mesh=mesh)
+    got = _key(back.collect().df)
+    want = _key(d.collect().df)
+    np.testing.assert_allclose(got["px"].to_numpy(float),
+                               want["px"].to_numpy(float))
+    assert (got["tag"] == want["tag"]).all()
+
+
+def test_mid_pipeline_resume_on_different_mesh(tmp_path, frames):
+    """Save after the join on a 4-device series mesh, resume on a 2x4
+    series x time mesh, continue with EMA + range stats."""
+    lt, rt = frames
+    mesh_a = make_mesh({"series": 4})
+    joined = lt.on_mesh(mesh_a).asofJoin(rt.on_mesh(mesh_a))
+    p = str(tmp_path / "ckpt_mid")
+    checkpoint.save(joined, p)
+
+    mesh_b = make_mesh({"series": 2, "time": 4})
+    resumed = checkpoint.load(p, mesh=mesh_b, time_axis="time")
+    got = _key(
+        resumed.EMA("px", exact=True)
+        .withRangeStats(colsToSummarize=["px"], rangeBackWindowSecs=60)
+        .collect().df
+    )
+    want = _key(
+        lt.asofJoin(rt).EMA("px", exact=True)
+        .withRangeStats(colsToSummarize=["px"], rangeBackWindowSecs=60)
+        .df
+    )
+    for c in ("right_bid", "EMA_px", "mean_px", "stddev_px"):
+        np.testing.assert_allclose(
+            got[c].to_numpy(float), want[c].to_numpy(float),
+            rtol=1e-6, atol=1e-9, equal_nan=True, err_msg=c,
+        )
+    # joined host (string) column survives the checkpoint boundary
+    wv = want["right_venue"].to_numpy(object)
+    gv = got["right_venue"].to_numpy(object)
+    assert all((pd.isna(a) and pd.isna(b)) or a == b for a, b in zip(gv, wv))
+    # joined right timestamp survives exactly
+    assert (got["right_event_ts"].isna() == want["right_event_ts"].isna()).all()
+    assert (got["right_event_ts"].dropna().to_numpy()
+            == want["right_event_ts"].dropna().to_numpy()).all()
+
+
+def test_atomic_save_never_corrupts_previous(tmp_path, frames, monkeypatch):
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_atomic")
+    checkpoint.save(lt, p)
+    before = checkpoint.load(p).df
+
+    def boom(*a, **k):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(checkpoint, "_save_host", boom)
+    with pytest.raises(RuntimeError, match="disk full"):
+        checkpoint.save(lt, p)
+    pd.testing.assert_frame_equal(checkpoint.load(p).df, before)
+
+
+def test_future_format_version_refused(tmp_path, frames):
+    import json
+    import os
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_ver")
+    checkpoint.save(lt, p)
+    man = json.load(open(os.path.join(p, "manifest.json")))
+    man["format_version"] = 99
+    json.dump(man, open(os.path.join(p, "manifest.json"), "w"))
+    with pytest.raises(ValueError, match="newer than"):
+        checkpoint.load(p)
+
+
+def test_dist_load_requires_mesh(tmp_path, frames):
+    lt, _ = frames
+    mesh = make_mesh({"series": 4})
+    p = str(tmp_path / "ckpt_nomesh")
+    checkpoint.save(lt.on_mesh(mesh), p)
+    with pytest.raises(ValueError, match="needs a mesh"):
+        checkpoint.load(p)
+
+
+def test_crash_between_swap_renames_leaves_bak_loadable(tmp_path, frames):
+    """If a crash lands between the old->bak and tmp->path renames,
+    load() falls back to the .bak checkpoint."""
+    import os
+    import shutil
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_swap")
+    checkpoint.save(lt, p)
+    before = checkpoint.load(p).df
+    os.replace(p, p + ".bak")   # simulate the mid-swap crash state
+    pd.testing.assert_frame_equal(checkpoint.load(p).df, before)
+    shutil.rmtree(p + ".bak")

@@ -302,3 +302,37 @@ def test_asof_join_right_host_columns(frames, axes, ta, skip):
         (pd.isna(a) and pd.isna(b)) or a == b for a, b in zip(gv, wv)
     ])
     assert same.all(), f"{(~same).sum()} right_venue mismatches"
+
+
+def test_chained_asof_join_carries_inner_columns(frames):
+    """a.asofJoin(b.asofJoin(c)) must keep the inner join's columns —
+    joined values, joined timestamp, and host (string) columns — exactly
+    like the host path (review r2 finding: they were silently dropped)."""
+    lt, rt = frames
+    rng = np.random.default_rng(13)
+    m = 150
+    ct = TSDF(pd.DataFrame({
+        "symbol": rng.choice(["a", "b", "c"], m),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 500, m)) * 1_000_000_000),
+        "ref": rng.standard_normal(m),
+        "src": np.array([f"s{i % 2}" for i in range(m)], object),
+    }), "event_ts", ["symbol"])
+    mesh = make_mesh({"series": 4})
+    inner_d = rt.on_mesh(mesh).asofJoin(ct.on_mesh(mesh))
+    got = _sorted(lt.on_mesh(mesh).asofJoin(inner_d).collect().df)
+    want = _sorted(lt.asofJoin(TSDF(rt.asofJoin(ct).df, "event_ts",
+                                    ["symbol"])).df)
+    assert "right_right_ref" in got.columns
+    assert "right_right_src" in got.columns
+    np.testing.assert_allclose(
+        got["right_right_ref"].to_numpy(float),
+        want["right_right_ref"].to_numpy(float),
+        rtol=1e-6, atol=1e-9, equal_nan=True,
+    )
+    gv = got["right_right_src"].to_numpy(object)
+    wv = want["right_right_src"].to_numpy(object)
+    assert all((pd.isna(a) and pd.isna(b)) or a == b for a, b in zip(gv, wv))
+    th, tg = want["right_right_event_ts"], got["right_right_event_ts"]
+    assert (th.isna() == tg.isna()).all()
+    assert (th.dropna().to_numpy() == tg.dropna().to_numpy()).all()

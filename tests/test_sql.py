@@ -176,3 +176,18 @@ def test_select_expr_pandas_eval_fallback():
     out = _tsdf().selectExpr("symbol", "event_ts", "price ** 2 as p2").df
     np.testing.assert_allclose(
         out["p2"].to_numpy(float), [100.0, 400.0, 900.0, np.nan])
+
+
+def test_modulo_truncated_like_spark():
+    d = pd.DataFrame({"x": [-7, 7, -6, 5]})
+    out = sql.eval_expr(d, "x % 3")
+    assert out.tolist() == [-1, 1, 0, 2]
+    assert sql.eval_expr(d, "-7 % 3") == -1
+
+
+def test_greatest_least_skip_nulls():
+    d = pd.DataFrame({"x": [1.0, np.nan, 3.0]})
+    np.testing.assert_array_equal(
+        sql.eval_expr(d, "greatest(x, 0)").to_numpy(), [1.0, 0.0, 3.0])
+    np.testing.assert_array_equal(
+        sql.eval_expr(d, "least(x, 2)").to_numpy(), [1.0, 2.0, 2.0])
