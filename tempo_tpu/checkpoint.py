@@ -136,6 +136,8 @@ def _save_dist(frame, d: str) -> None:
         "layout_key_ids": frame.layout.key_ids,
         "layout_order": frame.layout.order,
     }
+    if frame.seq is not None:
+        arrays["seq"] = np.asarray(frame.seq)
     if names:
         cdt = frame.cols[names[0]].values.dtype
         stacked = np.asarray(jnp.stack(
@@ -183,6 +185,7 @@ def _save_dist(frame, d: str) -> None:
             "host_cols": frame.host_cols,
             "halo_fraction": frame.halo_fraction,
             "resampled": frame.resampled,
+            "seq_col": frame.seq_col,
             "audits": audits,
             "columns": col_meta,
             "n_cols": len(names),
@@ -249,9 +252,11 @@ def _load_dist(d: str, man: dict, mesh, series_axis: str,
             host_gather=hg,
         )
     audits = [(msg, np.int64(cnt)) for msg, cnt in man["audits"]]
+    seq_d = put2(z["seq"], -np.inf) if "seq" in z.files else None
     return DistributedTSDF(
         mesh, series_axis, time_axis, ts_d, mask_d, cols, layout,
         man["ts_col"], man["partition_cols"], np.dtype(man["ts_dtype"]),
         source_df, man["host_cols"], man["halo_fraction"],
         audits=audits, resampled=man["resampled"],
+        seq=seq_d, seq_col=man.get("seq_col", ""),
     )

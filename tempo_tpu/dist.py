@@ -105,7 +105,7 @@ class DistributedTSDF:
                  partition_cols: List[str], ts_dtype, source_df,
                  host_cols: Dict[str, str], halo_fraction: float,
                  audits: Optional[List[Tuple[str, jax.Array]]] = None,
-                 resampled: bool = False):
+                 resampled: bool = False, seq=None, seq_col: str = ""):
         self.mesh = mesh
         self.series_axis = series_axis
         self.time_axis = time_axis
@@ -121,6 +121,8 @@ class DistributedTSDF:
         self.halo_fraction = halo_fraction
         self.audits = list(audits or [])
         self.resampled = resampled
+        self.seq = seq                    # [K_dev, L] sort key or None
+        self.seq_col = seq_col
 
     # ------------------------------------------------------------------
     # Construction
@@ -180,14 +182,37 @@ class DistributedTSDF:
         cols: Dict[str, DistCol] = {}
         host_cols: Dict[str, str] = {}
         structural = {tsdf.ts_col, *tsdf.partitionCols}
+        seq_p = None
         if tsdf.sequence_col:
             structural.add(tsdf.sequence_col)
+            # the sequence column is both an output column (it rides the
+            # host row-identity path like any structural col) and a
+            # device-resident join sort key.  A null RIGHT sequence
+            # sorts LAST (NaN in lax.sort's float total order), exactly
+            # like the host merge path packing NaN (join.py:137-139);
+            # values beyond 2^24 lose exactness under the f32 policy.
+            host_cols[tsdf.sequence_col] = tsdf.sequence_col
+            sv, sm_ = tsdf.numeric_flat(tsdf.sequence_col)
+            sv = np.where(sm_, sv, np.nan).astype(dt)
+            seq_p = _pad_k(
+                packing.pack_column(sv, layout, L, fill=np.inf),
+                K_dev, np.inf,
+            )
         for c in tsdf.df.columns:
             if c in structural:
                 continue
-            if pd.api.types.is_numeric_dtype(tsdf.df[c].dtype) and not \
-                    pd.api.types.is_bool_dtype(tsdf.df[c].dtype):
+            dtype = tsdf.df[c].dtype
+            if pd.api.types.is_numeric_dtype(dtype) and not \
+                    pd.api.types.is_bool_dtype(dtype):
                 vals, valid = tsdf.numeric_flat(c)
+                if pd.api.types.is_integer_dtype(dtype) and valid.any() \
+                        and np.abs(vals[valid]).max() >= 2.0 ** 53:
+                    # integers beyond float64's exact range (2^53) can't
+                    # ride the float compute planes without corruption —
+                    # they stay host-resident (exact row-identity /
+                    # join-index gather), like non-numeric columns
+                    host_cols[c] = c
+                    continue
                 pv = packing.pack_column(vals.astype(dt), layout, L, fill=np.nan)
                 pm = packing.pack_column(valid, layout, L, fill=False)
                 cols[c] = DistCol(_pad_k(pv, K_dev, np.nan),
@@ -203,10 +228,13 @@ class DistributedTSDF:
                        jax.device_put(col.valid, sharding))
             for c, col in cols.items()
         }
+        seq_d = (jax.device_put(seq_p, sharding)
+                 if seq_p is not None else None)
         _PACK_EVENTS += 1
         return cls(mesh, series_axis, time_axis, ts_d, mask_d, cols_d,
                    layout, tsdf.ts_col, tsdf.partitionCols,
-                   tsdf.ts_dtype(), tsdf.df, host_cols, halo_fraction)
+                   tsdf.ts_dtype(), tsdf.df, host_cols, halo_fraction,
+                   seq=seq_d, seq_col=tsdf.sequence_col or "")
 
     def _with(self, **kw) -> "DistributedTSDF":
         base = dict(
@@ -216,7 +244,7 @@ class DistributedTSDF:
             partition_cols=self.partitionCols, ts_dtype=self._ts_dtype,
             source_df=self._source_df, host_cols=self.host_cols,
             halo_fraction=self.halo_fraction, audits=self.audits,
-            resampled=self.resampled,
+            resampled=self.resampled, seq=self.seq, seq_col=self.seq_col,
         )
         base.update(kw)
         return DistributedTSDF(**base)
@@ -382,8 +410,11 @@ class DistributedTSDF:
         to 2^24 rows/series) and gathering the strings host-side at
         ``collect()`` — the device never touches object data.
 
-        sequence_col tie-break / maxLookback need the merge kernel and
-        are host-path-only for now (``TSDF.asofJoin``)."""
+        Sequence-number tie-break runs device-resident when the RIGHT
+        frame was built with a ``sequence_col`` — only the right's
+        sequence orders the merge, mirroring the reference (left rows
+        carry NULL in it and sort first on ties, tsdf.py:117-121);
+        ``maxLookback`` remains host-path-only (``TSDF.asofJoin``)."""
         if right.mesh is not self.mesh and right.mesh != self.mesh:
             raise ValueError("both frames must live on the same mesh")
         if self.partitionCols != right.partitionCols:
@@ -460,7 +491,27 @@ class DistributedTSDF:
         vstack = align3(vstack, perm, ok, False)
 
         sort_kernels = _use_sort_kernels()
-        if self.n_time > 1:
+        # sequence-number tie-break (tsdf.py:117-121): the reference
+        # sorts the merged stream by (combined_ts, RIGHT's sequence col,
+        # rec_ind) — left rows carry NULL in the right's seq column and
+        # sort FIRST on ties (Spark asc_nulls_first), so a tied-ts right
+        # row is invisible to tied-ts left rows.  The left frame's own
+        # sequence never orders the merge.
+        has_seq = right.seq is not None
+        if has_seq:
+            # left rows ride the kernel-synthesized -inf fill (sorting
+            # first on ties) — no constant plane to shard or transpose
+            r_seq_al = align2(right.seq, perm, ok, np.inf)
+            if self.n_time > 1:
+                vals, found = _asof_a2a_seq(self.mesh, self.series_axis,
+                                            self.time_axis)(
+                    self.ts, r_ts_al, r_seq_al, vstack, pstack
+                )
+            else:
+                vals, found = _asof_local_seq(self.mesh, self.series_axis)(
+                    self.ts, r_ts_al, r_seq_al, vstack, pstack
+                )
+        elif self.n_time > 1:
             # joins are *global* per series (unbounded lookback), so the
             # time-sharded layout switches to series-local full rows
             # with one all_to_all each way (reshard.py pattern), joins
@@ -524,9 +575,14 @@ class DistributedTSDF:
                 ),
             )
         # the left ts column itself is the frame's time axis (renamed
-        # when left_prefix is set, tsdf.py:529-531)
+        # when left_prefix is set, tsdf.py:529-531).  The join result
+        # has no sequence column (the host path returns a TSDF without
+        # one, join.py:285) — chained joins must not re-apply the
+        # tie-break, and the left seq stays available as a data column
+        # via host_cols.
         return self._with(cols=new_cols, audits=audits,
-                          host_cols=new_host, ts_col=rename(self.ts_col))
+                          host_cols=new_host, ts_col=rename(self.ts_col),
+                          seq=None, seq_col="")
 
     # ------------------------------------------------------------------
     # resample (resample.py:38-117), device-resident representation
@@ -561,7 +617,7 @@ class DistributedTSDF:
             c: DistCol(out_vals[i], out_valid[i]) for i, c in enumerate(cols)
         }
         return self._with(ts=new_ts, mask=head, cols=new_cols,
-                          resampled=True)
+                          resampled=True, seq=None, seq_col="")
 
     # ------------------------------------------------------------------
     # Materialisation
@@ -620,6 +676,23 @@ class DistributedTSDF:
                 ridx = np.round(np.where(okv, v, 0.0)).astype(np.int64)
                 pos = r_starts[perm[key_ids]] + ridx
                 pos = np.clip(pos, 0, max(len(flat_vals) - 1, 0))
+                if len(flat_vals) and np.issubdtype(flat_vals.dtype,
+                                                    np.integer):
+                    # integer host col (e.g. a joined sequence column):
+                    # keep int exactness — values near 2^63 must not
+                    # round through float64; unmatched rows are NA
+                    # (Spark nullable int join output)
+                    g = flat_vals[pos].astype(np.int64)
+                    arr = pd.array(g, dtype="Int64")
+                    arr[~okv] = pd.NA
+                    out[c] = arr
+                    continue
+                if len(flat_vals) and np.issubdtype(flat_vals.dtype,
+                                                    np.number):
+                    out[c] = np.where(okv,
+                                      flat_vals[pos].astype(np.float64),
+                                      np.nan)
+                    continue
                 gathered = (flat_vals[pos] if len(flat_vals)
                             else np.full(len(pos), None, object))
                 res = np.empty(len(pos), dtype=object)
@@ -794,6 +867,52 @@ def _asof_local(mesh, series_axis, sort_kernels=False):
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp3, sp3),
+                             out_specs=(sp3, sp3)))
+
+
+@functools.lru_cache(maxsize=256)
+def _asof_local_seq(mesh, series_axis):
+    """AS-OF with sequence tie-break: the merge join is the only exact
+    form (reference union-sort semantics, tsdf.py:117-121), so it runs
+    on every backend."""
+    from tempo_tpu.ops import sortmerge as sm
+
+    sp2 = _spec(mesh, series_axis, None)
+    sp3 = _spec(mesh, series_axis, None, ndim=3)
+
+    def kernel(l_ts, r_ts, r_seq, r_valids, r_values):
+        vals, found, _ = sm.asof_merge_values(
+            l_ts, r_ts, r_valids, r_values, r_seq=r_seq
+        )
+        return vals, found
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp2, sp3, sp3),
+                             out_specs=(sp3, sp3)))
+
+
+@functools.lru_cache(maxsize=256)
+def _asof_a2a_seq(mesh, series_axis, time_axis):
+    from tempo_tpu.ops import sortmerge as sm
+
+    sp2 = _spec(mesh, series_axis, time_axis)
+    sp3 = _spec(mesh, series_axis, time_axis, 3)
+
+    def kernel(l_ts, r_ts, r_seq, r_valids, r_values):
+        fwd = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+            tiled=True)
+        rev = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
+            tiled=True)
+        vals, found, _ = sm.asof_merge_values(
+            fwd(l_ts), fwd(r_ts), fwd(r_valids), fwd(r_values),
+            r_seq=fwd(r_seq),
+        )
+        return rev(vals), rev(found)
+
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 

@@ -336,3 +336,124 @@ def test_chained_asof_join_carries_inner_columns(frames):
     th, tg = want["right_right_event_ts"], got["right_right_event_ts"]
     assert (th.isna() == tg.isna()).all()
     assert (th.dropna().to_numpy() == tg.dropna().to_numpy()).all()
+
+
+@pytest.mark.parametrize("axes,ta", MESHES)
+def test_asof_join_sequence_tiebreak(axes, ta):
+    """Device-resident sequence-number tie-break: frames built with a
+    sequence_col join on (ts, seq, side) order exactly like the host
+    merge path (reference tsdf.py:117-121)."""
+    rng = np.random.default_rng(31)
+    n = 160
+    # coarse timestamps force ties; seq breaks them
+    base_l = np.sort(rng.integers(0, 40, n))
+    base_r = np.sort(rng.integers(0, 40, n))
+    ldf = pd.DataFrame({
+        "symbol": rng.choice(["a", "b"], n),
+        "event_ts": pd.to_datetime(base_l * 1_000_000_000),
+        "seq": rng.integers(0, 6, n),
+        "px": rng.standard_normal(n),
+    })
+    rdf = pd.DataFrame({
+        "symbol": rng.choice(["a", "b"], n),
+        "event_ts": pd.to_datetime(base_r * 1_000_000_000),
+        "seq": rng.integers(0, 6, n),
+        "bid": rng.standard_normal(n),
+    })
+    lt = TSDF(ldf, "event_ts", ["symbol"], sequence_col="seq")
+    rt = TSDF(rdf, "event_ts", ["symbol"], sequence_col="seq")
+    host = lt.asofJoin(rt).df
+    mesh = make_mesh(axes)
+    got = (lt.on_mesh(mesh, time_axis=ta)
+           .asofJoin(rt.on_mesh(mesh, time_axis=ta)).collect().df)
+    key = ["symbol", "event_ts", "seq", "px"]
+    h = host.sort_values(key, kind="stable").reset_index(drop=True)
+    g = got.sort_values(key, kind="stable").reset_index(drop=True)
+    np.testing.assert_allclose(
+        g["right_bid"].to_numpy(float), h["right_bid"].to_numpy(float),
+        rtol=1e-6, atol=1e-9, equal_nan=True,
+    )
+    np.testing.assert_allclose(
+        g["right_seq"].to_numpy(float), h["right_seq"].to_numpy(float),
+        rtol=0, atol=0, equal_nan=True,
+    )
+    assert (g["seq"].to_numpy(np.int64) == h["seq"].to_numpy(np.int64)).all()
+
+
+def test_seq_join_null_right_seq_sorts_last():
+    """A null RIGHT sequence sorts last (host packs NaN), so a
+    tied-timestamp right row with null seq is still invisible to the
+    tied left row only per the (ts, seq, side) order — device must
+    match the host exactly (review r2 finding: -inf vs NaN)."""
+    ldf = pd.DataFrame({
+        "symbol": ["a"] * 3,
+        "event_ts": pd.to_datetime([10, 20, 30], unit="s"),
+        "seq": [1, 1, 1],
+        "px": [1.0, 2.0, 3.0],
+    })
+    rdf = pd.DataFrame({
+        "symbol": ["a"] * 3,
+        "event_ts": pd.to_datetime([10, 20, 25], unit="s"),
+        "seq": [0.0, np.nan, 2.0],
+        "bid": [10.0, 20.0, 30.0],
+    })
+    lt = TSDF(ldf, "event_ts", ["symbol"], sequence_col="seq")
+    rt = TSDF(rdf, "event_ts", ["symbol"], sequence_col="seq")
+    host = _sorted(lt.asofJoin(rt).df)
+    mesh = make_mesh({"series": 4})
+    got = _sorted(lt.on_mesh(mesh).asofJoin(rt.on_mesh(mesh)).collect().df)
+    np.testing.assert_allclose(
+        got["right_bid"].to_numpy(float), host["right_bid"].to_numpy(float),
+        equal_nan=True,
+    )
+
+
+def test_chained_join_does_not_reapply_tiebreak(frames):
+    """The join result has no sequence column (host parity), so a
+    chained join on the result must NOT order by the stale seq plane."""
+    lt, rt = frames
+    rng = np.random.default_rng(41)
+    n = 120
+    sdf = pd.DataFrame({
+        "symbol": rng.choice(["a", "b"], n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 500, n)) * 1_000_000_000),
+        "seq": rng.integers(0, 4, n),
+        "extra": rng.standard_normal(n),
+    })
+    st = TSDF(sdf, "event_ts", ["symbol"], sequence_col="seq")
+    mesh = make_mesh({"series": 4})
+    inner_d = st.on_mesh(mesh).asofJoin(rt.on_mesh(mesh))
+    assert inner_d.seq is None and inner_d.seq_col == ""
+    got = _sorted(lt.on_mesh(mesh).asofJoin(inner_d).collect().df)
+    inner_h = TSDF(st.asofJoin(rt).df, "event_ts", ["symbol"])
+    want = _sorted(lt.asofJoin(inner_h).df)
+    np.testing.assert_allclose(
+        got["right_extra"].to_numpy(float),
+        want["right_extra"].to_numpy(float),
+        rtol=1e-6, atol=1e-9, equal_nan=True,
+    )
+
+
+def test_collect_keeps_big_int64_host_values_exact():
+    """Joined int64 host values near 2^63 must not round through
+    float64 at collect (review r2 finding)."""
+    big = 2**62 + np.arange(3, dtype=np.int64)  # distinct only in int64
+    ldf = pd.DataFrame({
+        "symbol": ["a"] * 3,
+        "event_ts": pd.to_datetime([10, 20, 30], unit="s"),
+        "px": [1.0, 2.0, 3.0],
+    })
+    rdf = pd.DataFrame({
+        "symbol": ["a"] * 3,
+        "event_ts": pd.to_datetime([5, 15, 25], unit="s"),
+        "big_id": big,
+        "bid": [1.0, 2.0, 3.0],
+    })
+    lt = TSDF(ldf, "event_ts", ["symbol"])
+    rt = TSDF(rdf, "event_ts", ["symbol"])
+    mesh = make_mesh({"series": 2})
+    got = _sorted(lt.on_mesh(mesh).asofJoin(rt.on_mesh(mesh)).collect().df)
+    # compare as PYTHON ints: numpy scalar comparison would round both
+    # sides through float64 and hide a corrupted value
+    assert [int(v) for v in got["right_big_id"]] == [int(v) for v in big]
