@@ -186,6 +186,7 @@ def _save_dist(frame, d: str) -> None:
             "halo_fraction": frame.halo_fraction,
             "resampled": frame.resampled,
             "seq_col": frame.seq_col,
+            "resample_freq": frame._resample_freq,
             "audits": audits,
             "columns": col_meta,
             "n_cols": len(names),
@@ -259,4 +260,5 @@ def _load_dist(d: str, man: dict, mesh, series_axis: str,
         source_df, man["host_cols"], man["halo_fraction"],
         audits=audits, resampled=man["resampled"],
         seq=seq_d, seq_col=man.get("seq_col", ""),
+        resample_freq=man.get("resample_freq"),
     )

@@ -105,7 +105,8 @@ class DistributedTSDF:
                  partition_cols: List[str], ts_dtype, source_df,
                  host_cols: Dict[str, str], halo_fraction: float,
                  audits: Optional[List[Tuple[str, jax.Array]]] = None,
-                 resampled: bool = False, seq=None, seq_col: str = ""):
+                 resampled: bool = False, seq=None, seq_col: str = "",
+                 resample_freq: Optional[str] = None):
         self.mesh = mesh
         self.series_axis = series_axis
         self.time_axis = time_axis
@@ -123,6 +124,7 @@ class DistributedTSDF:
         self.resampled = resampled
         self.seq = seq                    # [K_dev, L] sort key or None
         self.seq_col = seq_col
+        self._resample_freq = resample_freq
 
     # ------------------------------------------------------------------
     # Construction
@@ -245,6 +247,7 @@ class DistributedTSDF:
             source_df=self._source_df, host_cols=self.host_cols,
             halo_fraction=self.halo_fraction, audits=self.audits,
             resampled=self.resampled, seq=self.seq, seq_col=self.seq_col,
+            resample_freq=self._resample_freq,
         )
         base.update(kw)
         return DistributedTSDF(**base)
@@ -617,7 +620,162 @@ class DistributedTSDF:
             c: DistCol(out_vals[i], out_valid[i]) for i, c in enumerate(cols)
         }
         return self._with(ts=new_ts, mask=head, cols=new_cols,
-                          resampled=True, seq=None, seq_col="")
+                          resampled=True, seq=None, seq_col="",
+                          resample_freq=freq)
+
+    # ------------------------------------------------------------------
+    # withGroupedStats (tsdf.py:723-759) / vwap (TSDF.scala:378-401)
+    # ------------------------------------------------------------------
+
+    def withGroupedStats(self, metricCols=None,
+                         freq: str = None) -> "DistributedTSDF":
+        """Distributed tumbling-window grouped statistics: six
+        aggregates per metric column per epoch-aligned bucket, emitted
+        as a bucket-head view (one valid row per bucket, ts = bucket
+        start — the reference's groupBy output shape)."""
+        step = freq_to_seconds(freq) * packing.NS_PER_S
+        cols = metricCols or self.numeric_columns()
+        kernel = _bucket_stats_fn(self.mesh, self.series_axis,
+                                  self.time_axis, int(step), len(cols),
+                                  _use_sort_kernels())
+        vals = jnp.stack([self.cols[c].values for c in cols])
+        valids = jnp.stack([self.cols[c].valid for c in cols])
+        new_ts, head, stats = kernel(self.ts, self.mask, vals, valids)
+        new_cols = {}
+        for i, c in enumerate(cols):
+            for j, stat in enumerate(("mean", "count", "min", "max",
+                                      "sum", "stddev")):
+                new_cols[f"{stat}_{c}"] = DistCol(
+                    stats[j, i], head, int64=(stat == "count")
+                )
+        return self._with(ts=new_ts, mask=head, cols=new_cols,
+                          resampled=True, seq=None, seq_col="",
+                          resample_freq=freq)
+
+    def vwap(self, frequency: str = "m", volume_col: str = "volume",
+             price_col: str = "price") -> "DistributedTSDF":
+        """Distributed VWAP (Scala spec): per (series, truncated-ts)
+        bucket — dllr_value = sum(price*volume), total volume,
+        max price, vwap = dllr_value / volume."""
+        from tempo_tpu.freq import UNIT_SECONDS
+        from tempo_tpu.rolling import _VWAP_TRUNC
+
+        if frequency not in _VWAP_TRUNC:
+            raise ValueError("vwap frequency must be one of 'm', 'H', 'D'")
+        step = UNIT_SECONDS[_VWAP_TRUNC[frequency]] * packing.NS_PER_S
+        price = self.cols[price_col]
+        vol = self.cols[volume_col]
+        both = price.valid & vol.valid
+        vals = jnp.stack([
+            jnp.where(both, price.values * vol.values, 0.0),
+            vol.values, price.values,
+        ])
+        valids = jnp.stack([both, vol.valid, price.valid])
+        kernel = _bucket_stats_fn(self.mesh, self.series_axis,
+                                  self.time_axis, int(step), 3,
+                                  _use_sort_kernels())
+        new_ts, head, stats = kernel(self.ts, self.mask, vals, valids)
+        dllr = stats[4, 0]     # sum of price*volume
+        vsum = stats[4, 1]     # sum of volume
+        pmax = stats[3, 2]     # max price
+        new_cols = {
+            "dllr_value": DistCol(dllr, head),
+            volume_col: DistCol(vsum, head),
+            "max_" + price_col: DistCol(pmax, head),
+            "vwap": DistCol(dllr / vsum, head),
+        }
+        bucket_freq = {"m": "1 minute", "H": "1 hour", "D": "1 day"}[frequency]
+        return self._with(ts=new_ts, mask=head, cols=new_cols,
+                          resampled=True, seq=None, seq_col="",
+                          resample_freq=bucket_freq)
+
+    # ------------------------------------------------------------------
+    # interpolate (interpol.py; tsdf.py:778-811)
+    # ------------------------------------------------------------------
+
+    def interpolate(self, freq: str = None, func: str = None,
+                    method: str = None, target_cols=None,
+                    show_interpolated: bool = False) -> "DistributedTSDF":
+        """Distributed resample + gap fill.  Aggregates to ``freq``
+        buckets (device resample), then generates each series' dense
+        bucket grid [min_bucket, max_bucket] and fills missing values
+        with ``method`` (zero / null / ffill / bfill / linear) — the
+        prev/next scaffolds are two gather-free merge joins of the grid
+        against the bucket heads (ops/sortmerge.py), with linear weights
+        computed on exact f32 bucket indices.
+
+        The result is a NEW dense frame (series-sharded; a time-sharded
+        input is regathered series-local first).  ``show_interpolated``
+        adds the reference's ``is_ts_interpolated`` /
+        ``is_interpolated_<col>`` flag columns (interpol.py:330-364).
+        """
+        if method not in ("zero", "null", "ffill", "bfill", "linear"):
+            raise ValueError(
+                f"Please select from one of the following fill options: "
+                f"['zero', 'null', 'bfill', 'ffill', 'linear']: got {method}"
+            )
+        if self.resampled:
+            freq = freq or self._resample_freq
+            if freq != self._resample_freq:
+                raise ValueError(
+                    f"interpolate freq {freq!r} must match the resample "
+                    f"freq {self._resample_freq!r} on a resampled frame"
+                )
+        if freq is None:
+            raise ValueError("interpolate requires freq")
+        cols = target_cols or self.numeric_columns()
+        if not self.resampled:
+            validateFuncExists(func)
+        res = self if self.resampled else self.resample(
+            freq, func, metricCols=cols
+        )
+        step = int(freq_to_seconds(freq) * packing.NS_PER_S)
+
+        # static grid bound: bucket span from the host layout when it
+        # can vouch for the device ts, else one tiny [K] device fetch
+        lay = self.layout
+        if lay.n_rows > 0 and int(lay.starts[-1]) == lay.n_rows:
+            spans = []
+            for k in range(lay.n_series):
+                s = lay.ts_ns[lay.starts[k]: lay.starts[k + 1]]
+                if len(s):
+                    spans.append(int(s[-1] - s[0]))
+            span = max(spans, default=0)
+        else:
+            first = jnp.min(jnp.where(res.mask, res.ts, packing.TS_PAD),
+                            axis=1)
+            last = jnp.max(jnp.where(res.mask, res.ts, -1), axis=1)
+            span = int(np.asarray(jnp.max(
+                jnp.where(last >= 0, last - first, 0)
+            )))
+        G = span // step + 2
+        G = max(8, -(-G // 8) * 8)
+
+        mkey = ("zero", "null", "ffill", "bfill", "linear").index(method)
+        kernel = _interp_fn(self.mesh, res.series_axis, res.time_axis,
+                            step, G, mkey, len(cols),
+                            bool(show_interpolated))
+        vals = jnp.stack([res.cols[c].values for c in cols])
+        valids = jnp.stack([res.cols[c].valid for c in cols])
+        out = kernel(res.ts, res.mask, vals, valids)
+        grid_ts, grid_mask, out_vals, out_valid = out[:4]
+        new_cols = {
+            c: DistCol(out_vals[i], out_valid[i]) for i, c in enumerate(cols)
+        }
+        if show_interpolated:
+            ts_interp, col_interp = out[4], out[5]
+            new_cols["is_ts_interpolated"] = DistCol(
+                ts_interp.astype(vals.dtype), grid_mask, int64=True
+            )
+            for i, c in enumerate(cols):
+                new_cols[f"is_interpolated_{c}"] = DistCol(
+                    col_interp[i].astype(vals.dtype), grid_mask, int64=True
+                )
+        # interpolated frames are dense series-local grids: the time
+        # axis (if any) was consumed by the regather inside the kernel
+        return self._with(ts=grid_ts, mask=grid_mask, cols=new_cols,
+                          time_axis=None, resampled=True,
+                          seq=None, seq_col="", resample_freq=freq)
 
     # ------------------------------------------------------------------
     # Materialisation
@@ -966,6 +1124,184 @@ def _align3_fn(mesh, series_axis, time_axis):
     return jax.jit(fn, out_shardings=sharding, static_argnums=(3,))
 
 
+def _bucket_heads(ts, mask, step_ns):
+    """Shared tumbling-bucket scaffolding: absolute bucket key ``b``,
+    bucket-head mask, and per-row [start, end) row bounds of the row's
+    bucket (used by resample, grouped stats, and vwap)."""
+    step = jnp.int64(step_ns)
+    b = jnp.where(mask, (ts // step) * step, packing.TS_PAD)
+    prev_b = jnp.concatenate(
+        [jnp.full_like(b[:, :1], -1), b[:, :-1]], axis=-1
+    )
+    head = mask & (b != prev_b)
+    start = rk.wu.searchsorted_batched(b, b, side="left").astype(jnp.int32)
+    end = rk.wu.searchsorted_batched(b, b + step,
+                                     side="left").astype(jnp.int32)
+    return b, head, start, end
+
+
+@functools.lru_cache(maxsize=256)
+def _bucket_stats_fn(mesh, series_axis, time_axis, step_ns, n_cols,
+                     sort_kernels=False):
+    """Six aggregates per epoch-aligned tumbling bucket, emitted at
+    bucket-head rows (withGroupedStats tsdf.py:723-759 / vwap
+    aggregation).  Time-sharded meshes switch to a series-local layout
+    around the bucket reduction, like _resample_fn."""
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    sp2 = _spec(mesh, series_axis, time_axis)
+    sp3 = _spec(mesh, series_axis, time_axis, 3)
+
+    def local(ts, mask, vals, valids):
+        b, head, start, end = _bucket_heads(ts, mask, step_ns)
+        outs = []
+        for i in range(n_cols):
+            stats = rk.windowed_stats(vals[i], valids[i], start, end)
+            outs.append(jnp.stack([
+                stats["mean"], stats["count"], stats["min"], stats["max"],
+                stats["sum"], stats["stddev"],
+            ]))
+        new_ts = jnp.where(mask, b, packing.TS_PAD)
+        # [6, n_cols, K, L]
+        return new_ts, head, jnp.stack(outs, axis=1)
+
+    def kernel(ts, mask, vals, valids):
+        if n_t > 1:
+            a2a_in = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+                tiled=True)
+            a2a_out = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
+                tiled=True)
+            ts, mask, vals, valids = (a2a_in(a) for a in
+                                      (ts, mask, vals, valids))
+            new_ts, head, stats = local(ts, mask, vals, valids)
+            return a2a_out(new_ts), a2a_out(head), a2a_out(stats)
+        return local(ts, mask, vals, valids)
+
+    sp_stats = _spec(mesh, series_axis, time_axis, 4)
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2, sp2, sp3, sp3),
+                             out_specs=(sp2, sp2, sp_stats)))
+
+
+@functools.lru_cache(maxsize=256)
+def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
+               flags):
+    """Dense-grid gap fill (interpol.py semantics): generate each
+    series' bucket grid and fill via prev/next merge joins.
+
+    Inputs are a bucket-head resample view [K, L]; outputs are dense
+    [K, G] grids, series-sharded (``P(series, None)``) — on a
+    time-sharded mesh the inputs regather series-local first (the grid
+    length G has no relation to the input shard width)."""
+    from tempo_tpu.ops import sortmerge as sm
+
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    sp2_in = _spec(mesh, series_axis, time_axis)
+    sp3_in = _spec(mesh, series_axis, time_axis, 3)
+    if n_t > 1:
+        out_axes = (series_axis, time_axis)
+        sp2_out = P(out_axes, None)
+        sp3_out = P(None, out_axes, None)
+    else:
+        sp2_out = _spec(mesh, series_axis, None)
+        sp3_out = _spec(mesh, series_axis, None, 3)
+
+    def kernel(ts, head, vals, valids):
+        if n_t > 1:
+            # series-local full rows: each device takes K/(ns*nt) series
+            a2a = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+                tiled=True)
+            ts, head, vals, valids = (a2a(a) for a in
+                                      (ts, head, vals, valids))
+        step = jnp.int64(step_ns)
+        dt = vals.dtype
+
+        ts_j = jnp.where(head, ts, packing.TS_PAD)
+        first_b = jnp.min(ts_j, axis=1, keepdims=True)         # [K, 1]
+        last_b = jnp.max(jnp.where(head, ts, jnp.int64(-1)), axis=1,
+                         keepdims=True)
+        has_any = last_b >= 0
+        gridj = jnp.arange(G, dtype=jnp.int64)[None, :]        # [1, G]
+        grid_ts = jnp.where(
+            has_any, first_b + gridj * step, packing.TS_PAD
+        )
+        grid_mask = has_any & (grid_ts <= last_b)
+        grid_ts = jnp.where(grid_mask, grid_ts, packing.TS_PAD)
+
+        # per-col planes: value + exact bucket index; plus one row plane
+        bidx = jnp.where(head, (ts - jnp.where(has_any, first_b, 0))
+                         // step, -1).astype(dt)
+        planes = jnp.concatenate([
+            vals,
+            jnp.broadcast_to(bidx, (n_cols,) + bidx.shape),
+            bidx[None],
+        ])
+        pvalid = jnp.concatenate([
+            valids, valids, head[None],
+        ])
+        prev_v, prev_f, _ = sm.asof_merge_values(
+            grid_ts, ts_j, pvalid, planes
+        )
+        neg = lambda a: -a[..., ::-1]
+        flip = lambda a: a[..., ::-1]
+        next_v_r, next_f_r, _ = sm.asof_merge_values(
+            neg(grid_ts), neg(ts_j), flip(pvalid), flip(planes)
+        )
+        next_v = flip(next_v_r)
+        next_f = flip(next_f_r)
+
+        gj = gridj.astype(dt)
+        out_vals = []
+        out_valid = []
+        col_interp = []
+        for i in range(n_cols):
+            pv, pf = prev_v[i], prev_f[i]
+            pi = prev_v[n_cols + i]
+            nv, nf = next_v[i], next_f[i]
+            ni = next_v[n_cols + i]
+            exact = pf & (pi == gj)
+            if mkey == 0:        # zero
+                filled = jnp.where(exact, pv, 0.0)
+                ok = grid_mask
+            elif mkey == 1:      # null
+                filled = jnp.where(exact, pv, jnp.nan)
+                ok = grid_mask & exact
+            elif mkey == 2:      # ffill
+                filled = jnp.where(pf, pv, jnp.nan)
+                ok = grid_mask & pf
+            elif mkey == 3:      # bfill
+                filled = jnp.where(nf, nv, jnp.nan)
+                ok = grid_mask & nf
+            else:                # linear
+                both = pf & nf & (ni > pi)
+                w = jnp.where(both, (gj - pi) / jnp.maximum(ni - pi, 1), 0.0)
+                lerp = pv + (nv - pv) * w
+                filled = jnp.where(exact, pv,
+                                   jnp.where(both, lerp, jnp.nan))
+                ok = grid_mask & (exact | both)
+            out_vals.append(jnp.where(grid_mask, filled, jnp.nan))
+            out_valid.append(ok)
+            col_interp.append(grid_mask & ~exact)
+
+        row_pi = prev_v[2 * n_cols]
+        row_pf = prev_f[2 * n_cols]
+        ts_interp = grid_mask & ~(row_pf & (row_pi == gj))
+        out = (grid_ts, grid_mask, jnp.stack(out_vals),
+               jnp.stack(out_valid))
+        if flags:
+            out = out + (ts_interp, jnp.stack(col_interp))
+        return out
+
+    out_specs = (sp2_out, sp2_out, sp3_out, sp3_out)
+    if flags:
+        out_specs = out_specs + (sp2_out, sp3_out)
+    return jax.jit(shard_map(kernel, mesh=mesh,
+                             in_specs=(sp2_in, sp2_in, sp3_in, sp3_in),
+                             out_specs=out_specs))
+
+
 @functools.lru_cache(maxsize=256)
 def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
                  sort_kernels=False):
@@ -979,15 +1315,7 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
 
     def local(ts, mask, vals, valids):
         step = jnp.int64(step_ns)
-        b = jnp.where(mask, (ts // step) * step, packing.TS_PAD)
-        prev_b = jnp.concatenate(
-            [jnp.full_like(b[:, :1], -1), b[:, :-1]], axis=-1
-        )
-        head = mask & (b != prev_b)
-        start = rk.wu.searchsorted_batched(b, b, side="left")
-        end = rk.wu.searchsorted_batched(b, b + step, side="left")
-        start = start.astype(jnp.int32)
-        end = end.astype(jnp.int32)
+        b, head, start, end = _bucket_heads(ts, mask, step_ns)
 
         outs = []
         oks = []

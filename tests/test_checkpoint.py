@@ -153,3 +153,17 @@ def test_crash_between_swap_renames_leaves_bak_loadable(tmp_path, frames):
     os.replace(p, p + ".bak")   # simulate the mid-swap crash state
     pd.testing.assert_frame_equal(checkpoint.load(p).df, before)
     shutil.rmtree(p + ".bak")
+
+
+def test_resampled_frame_roundtrip_keeps_freq(tmp_path, frames):
+    """A resampled frame's bucket freq survives the checkpoint so a
+    chained interpolate still works after resume."""
+    lt, _ = frames
+    mesh = make_mesh({"series": 4})
+    d = lt.on_mesh(mesh).resample("1 minute", "mean")
+    p = str(tmp_path / "ckpt_freq")
+    checkpoint.save(d, p)
+    back = checkpoint.load(p, mesh=mesh)
+    assert back._resample_freq == "1 minute"
+    out = back.interpolate(method="ffill", target_cols=["px"]).collect().df
+    assert len(out) > 0

@@ -457,3 +457,105 @@ def test_collect_keeps_big_int64_host_values_exact():
     # compare as PYTHON ints: numpy scalar comparison would round both
     # sides through float64 and hide a corrupted value
     assert [int(v) for v in got["right_big_id"]] == [int(v) for v in big]
+
+
+@pytest.mark.parametrize("axes,ta", MESHES)
+class TestDistributedBucketOps:
+    def test_grouped_stats(self, frames, axes, ta):
+        l, _ = frames
+        host = l.withGroupedStats(metricCols=["price"], freq="1 minute").df
+        mesh = make_mesh(axes)
+        got = (l.on_mesh(mesh, time_axis=ta)
+               .withGroupedStats(metricCols=["price"], freq="1 minute")
+               .collect().df)
+        key = ["symbol", "event_ts"]
+        h = host.sort_values(key).reset_index(drop=True)
+        g = got.sort_values(key).reset_index(drop=True)
+        assert len(g) == len(h)
+        for stat in ("mean", "count", "min", "max", "sum", "stddev"):
+            np.testing.assert_allclose(
+                g[f"{stat}_price"].to_numpy(float),
+                h[f"{stat}_price"].to_numpy(float),
+                rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=stat,
+            )
+
+    def test_vwap(self, frames, axes, ta):
+        l, _ = frames
+        df = l.df.assign(volume=np.arange(1, len(l.df) + 1, dtype=float))
+        t = TSDF(df, "event_ts", ["symbol"])
+        host = t.vwap(frequency="m", volume_col="volume",
+                      price_col="price").df
+        mesh = make_mesh(axes)
+        got = (t.on_mesh(mesh, time_axis=ta)
+               .vwap(frequency="m", volume_col="volume", price_col="price")
+               .collect().df)
+        key = ["symbol", "event_ts"]
+        h = host.sort_values(key).reset_index(drop=True)
+        g = got.sort_values(key).reset_index(drop=True)
+        assert len(g) == len(h)
+        for c in ("dllr_value", "volume", "max_price", "vwap"):
+            np.testing.assert_allclose(
+                g[c].to_numpy(float), h[c].to_numpy(float),
+                rtol=1e-9, equal_nan=True, err_msg=c,
+            )
+
+    @pytest.mark.parametrize("method",
+                             ["zero", "null", "ffill", "bfill", "linear"])
+    def test_interpolate(self, frames, axes, ta, method):
+        _, r = frames
+        host = r.interpolate(freq="30 seconds", func="mean",
+                             target_cols=["bid"], method=method).df
+        mesh = make_mesh(axes)
+        got = (r.on_mesh(mesh, time_axis=ta)
+               .interpolate(freq="30 seconds", func="mean",
+                            target_cols=["bid"], method=method)
+               .collect().df)
+        key = ["symbol", "event_ts"]
+        h = host.sort_values(key).reset_index(drop=True)
+        g = got.sort_values(key).reset_index(drop=True)
+        assert len(g) == len(h), f"{method}: row count"
+        np.testing.assert_allclose(
+            g["bid"].to_numpy(float), h["bid"].to_numpy(float),
+            rtol=1e-9, atol=1e-12, equal_nan=True, err_msg=method,
+        )
+
+    def test_interpolate_flags(self, frames, axes, ta):
+        _, r = frames
+        host = r.interpolate(freq="30 seconds", func="mean",
+                             target_cols=["bid"], method="linear",
+                             show_interpolated=True).df
+        mesh = make_mesh(axes)
+        got = (r.on_mesh(mesh, time_axis=ta)
+               .interpolate(freq="30 seconds", func="mean",
+                            target_cols=["bid"], method="linear",
+                            show_interpolated=True)
+               .collect().df)
+        key = ["symbol", "event_ts"]
+        h = host.sort_values(key).reset_index(drop=True)
+        g = got.sort_values(key).reset_index(drop=True)
+        np.testing.assert_array_equal(
+            g["is_ts_interpolated"].to_numpy(np.int64),
+            h["is_ts_interpolated"].to_numpy(np.int64),
+        )
+        np.testing.assert_array_equal(
+            g["is_interpolated_bid"].to_numpy(np.int64),
+            h["is_interpolated_bid"].to_numpy(np.int64),
+        )
+
+
+def test_bucket_ops_carry_their_freq_for_interpolate(frames):
+    """withGroupedStats/vwap/interpolate mark their own bucket freq so a
+    chained interpolate works (or errors on a mismatch) instead of using
+    a stale upstream freq (review r2 finding)."""
+    l, _ = frames
+    mesh = make_mesh({"series": 4})
+    d = l.on_mesh(mesh)
+    gs = d.withGroupedStats(metricCols=["price"], freq="1 minute")
+    out = gs.interpolate(method="ffill", target_cols=["mean_price"]).collect().df
+    assert len(out) > 0
+    with pytest.raises(ValueError, match="must match the resample freq"):
+        gs.interpolate(freq="30 seconds", method="ffill",
+                       target_cols=["mean_price"])
+    # host parity: interpolate without func on a raw frame raises
+    with pytest.raises(ValueError):
+        d.interpolate(freq="30 seconds", method="linear")
