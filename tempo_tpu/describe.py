@@ -19,6 +19,76 @@ def _fmt(v):
     return None if v is None or (isinstance(v, float) and np.isnan(v)) else str(v)
 
 
+def col_describe_series(s: pd.Series) -> dict:
+    """count/mean/stddev/min/max of one column, Spark describe style
+    (strings get count + lexicographic min/max)."""
+    n = int(s.notna().sum())
+    if pd.api.types.is_numeric_dtype(s.dtype) and not \
+            pd.api.types.is_bool_dtype(s.dtype):
+        vals = pd.to_numeric(s, errors="coerce")
+        return {
+            "count": str(n),
+            "mean": _fmt(float(vals.mean())) if n else None,
+            "stddev": _fmt(float(vals.std(ddof=1))) if n > 1 else None,
+            "min": _fmt(vals.min()) if n else None,
+            "max": _fmt(vals.max()) if n else None,
+        }
+    non_null = s.dropna()
+    return {
+        "count": str(n),
+        "mean": None,
+        "stddev": None,
+        "min": _fmt(non_null.min()) if n else None,
+        "max": _fmt(non_null.max()) if n else None,
+    }
+
+
+def classify_granularity(has_frac, sub_minute, sub_hour, sub_day) -> str:
+    """The reference's finest-unit classifier (tsdf.py:409-413) from
+    precomputed any() flags."""
+    if has_frac:
+        return "millis"
+    if sub_minute:
+        return "seconds"
+    if sub_hour:
+        return "minutes"
+    if sub_day:
+        return "hours"
+    return "days"
+
+
+def assemble_table(stat_cols, stats, missing, unique_ts, min_ts, max_ts,
+                   granularity) -> pd.DataFrame:
+    """The 7-row describe table from precomputed per-column stats —
+    shared by the host path and the device-reduced distributed path."""
+    rows = [{
+        "summary": "global",
+        "unique_ts_count": str(unique_ts),
+        "min_ts": str(min_ts),
+        "max_ts": str(max_ts),
+        "granularity": granularity,
+        **{c: " " for c in stat_cols},
+    }]
+    for stat in ("count", "mean", "stddev", "min", "max"):
+        rows.append({
+            "summary": stat,
+            "unique_ts_count": " ",
+            "min_ts": " ",
+            "max_ts": " ",
+            "granularity": " ",
+            **{c: stats[c][stat] for c in stat_cols},
+        })
+    rows.append({
+        "summary": "missing_vals_pct",
+        "unique_ts_count": " ",
+        "min_ts": " ",
+        "max_ts": " ",
+        "granularity": " ",
+        **{c: str(round(missing[c], 2)) for c in stat_cols},
+    })
+    return pd.DataFrame(rows)
+
+
 def describe(tsdf) -> pd.DataFrame:
     df = tsdf.df
     ts_col = tsdf.ts_col
@@ -31,81 +101,24 @@ def describe(tsdf) -> pd.DataFrame:
     work[double_ts_col] = ts_sec
     stat_cols = list(work.columns)
 
-    def col_describe(c):
-        s = work[c]
-        n = int(s.notna().sum())
-        if pd.api.types.is_numeric_dtype(s.dtype) and not pd.api.types.is_bool_dtype(s.dtype):
-            vals = pd.to_numeric(s, errors="coerce")
-            return {
-                "count": str(n),
-                "mean": _fmt(float(vals.mean())) if n else None,
-                "stddev": _fmt(float(vals.std(ddof=1))) if n > 1 else None,
-                "min": _fmt(vals.min()) if n else None,
-                "max": _fmt(vals.max()) if n else None,
-            }
-        # Spark describe on strings: count + lexicographic min/max
-        non_null = s.dropna()
-        return {
-            "count": str(n),
-            "mean": None,
-            "stddev": None,
-            "min": _fmt(non_null.min()) if n else None,
-            "max": _fmt(non_null.max()) if n else None,
-        }
-
-    stats = {c: col_describe(c) for c in stat_cols}
+    stats = {c: col_describe_series(work[c]) for c in stat_cols}
     missing = {
         c: 100.0 * float(work[c].isna().sum()) / max(len(work), 1) for c in stat_cols
     }
 
     # granularity classifier (tsdf.py:409-413): finest unit present
     frac = ts_sec - np.floor(ts_sec)
-    if (frac > 0).any():
-        gran = "millis"
-    elif (np.mod(ts_sec, 60) != 0).any():
-        gran = "seconds"
-    elif (np.mod(ts_sec, 3600) != 0).any():
-        gran = "minutes"
-    elif (np.mod(ts_sec, 86400) != 0).any():
-        gran = "hours"
-    else:
-        gran = "days"
+    gran = classify_granularity(
+        (frac > 0).any(),
+        (np.mod(ts_sec, 60) != 0).any(),
+        (np.mod(ts_sec, 3600) != 0).any(),
+        (np.mod(ts_sec, 86400) != 0).any(),
+    )
 
     if tsdf.partitionCols:
         unique_ts = int(df[tsdf.partitionCols].drop_duplicates().shape[0])
     else:
         unique_ts = 1
 
-    rows = []
-    rows.append(
-        {
-            "summary": "global",
-            "unique_ts_count": str(unique_ts),
-            "min_ts": str(df[ts_col].min()),
-            "max_ts": str(df[ts_col].max()),
-            "granularity": gran,
-            **{c: " " for c in stat_cols},
-        }
-    )
-    for stat in ("count", "mean", "stddev", "min", "max"):
-        rows.append(
-            {
-                "summary": stat,
-                "unique_ts_count": " ",
-                "min_ts": " ",
-                "max_ts": " ",
-                "granularity": " ",
-                **{c: stats[c][stat] for c in stat_cols},
-            }
-        )
-    rows.append(
-        {
-            "summary": "missing_vals_pct",
-            "unique_ts_count": " ",
-            "min_ts": " ",
-            "max_ts": " ",
-            "granularity": " ",
-            **{c: str(round(missing[c], 2)) for c in stat_cols},
-        }
-    )
-    return pd.DataFrame(rows)
+    return assemble_table(stat_cols, stats, missing, unique_ts,
+                          df[ts_col].min(), df[ts_col].max(), gran)

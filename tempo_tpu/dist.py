@@ -778,6 +778,152 @@ class DistributedTSDF:
                           seq=None, seq_col="", resample_freq=freq)
 
     # ------------------------------------------------------------------
+    # describe (tsdf.py:384-431) / autocorr (tsdf.py:192-316)
+    # ------------------------------------------------------------------
+
+    def describe(self) -> pd.DataFrame:
+        """Distributed describe: numeric columns reduce device-resident
+        (XLA partitions the sharded sums/mins/maxes and inserts the
+        cross-shard collectives; only [C, 5] scalars leave the device);
+        host-resident columns (strings, huge ints) and the table
+        assembly share the host implementation (describe.py)."""
+        from tempo_tpu.describe import (
+            assemble_table, classify_granularity, col_describe_series,
+        )
+
+        names = self.numeric_columns()
+        secs = self.ts / packing.NS_PER_S
+        vals = (jnp.stack([self.cols[c].values for c in names]) if names
+                else jnp.zeros((0,) + self.ts.shape,
+                               packing.compute_dtype()))
+        valids = (jnp.stack([self.cols[c].valid for c in names]) if names
+                  else jnp.zeros((0,) + self.ts.shape, bool))
+        r = {k: np.asarray(v) for k, v in _describe_reduce()(
+            self.ts, self.mask, secs, vals, valids).items()}
+
+        n = int(r["n_rows"])
+        gran = classify_granularity(r["has_frac"], r["sub_min"],
+                                    r["sub_hr"], r["sub_day"])
+        unique_ts = (len(self.layout.key_frame)
+                     if self.partitionCols else 1)
+        fmt = lambda x: None if x is None or (isinstance(x, float)
+                                              and np.isnan(x)) else str(x)
+
+        def reduced_stats(cnt, s1, s2, mn, mx):
+            cnt = int(cnt)
+            if cnt == 0:
+                return {"count": "0", "mean": None, "stddev": None,
+                        "min": None, "max": None}
+            mean = s1 / cnt
+            var = (s2 - s1 ** 2 / cnt) / max(cnt - 1, 1)
+            return {
+                "count": str(cnt),
+                "mean": fmt(float(mean)),
+                "stddev": fmt(float(np.sqrt(max(var, 0.0))))
+                if cnt > 1 else None,
+                "min": fmt(float(mn)),
+                "max": fmt(float(mx)),
+            }
+
+        host_names = [c for c in self.host_cols
+                      if self._source_df is not None
+                      and not self.resampled]
+        stat_cols = list(self.partitionCols) + names + host_names \
+            + [self.ts_col + "_dbl"]
+        stats = {}
+        missing = {}
+        kf = self.layout.key_frame
+        lengths = self.layout.lengths
+        for c in self.partitionCols:
+            sv = kf[c].dropna().astype(str)
+            na_rows = int(lengths[kf[c].isna().to_numpy()].sum()) \
+                if len(kf) else 0
+            stats[c] = {"count": str(n - na_rows), "mean": None,
+                        "stddev": None,
+                        "min": fmt(sv.min()) if len(sv) else None,
+                        "max": fmt(sv.max()) if len(sv) else None}
+            missing[c] = 100.0 * na_rows / max(n, 1)
+        for i, c in enumerate(names):
+            stats[c] = reduced_stats(r["count"][i], r["sum"][i],
+                                     r["sumsq"][i], r["min"][i],
+                                     r["max"][i])
+            missing[c] = 100.0 * (n - int(r["count"][i])) / max(n, 1)
+        for c in host_names:
+            s = pd.Series(
+                self._source_df[self.host_cols[c]].to_numpy()
+                [self.layout.order]
+            )
+            stats[c] = col_describe_series(s)
+            missing[c] = 100.0 * float(s.isna().sum()) / max(n, 1)
+        stats[self.ts_col + "_dbl"] = reduced_stats(
+            n, r["ts_sum"], r["ts_sumsq"], r["ts_min"], r["ts_max"]
+        )
+        missing[self.ts_col + "_dbl"] = 0.0
+
+        min_ts = packing.ns_to_original(np.int64(r["min_ts"]),
+                                        self._ts_dtype)
+        max_ts = packing.ns_to_original(np.int64(r["max_ts"]),
+                                        self._ts_dtype)
+        if np.issubdtype(np.asarray(min_ts).dtype, np.datetime64):
+            min_ts, max_ts = pd.Timestamp(min_ts), pd.Timestamp(max_ts)
+        return assemble_table(stat_cols, stats, missing, unique_ts,
+                              min_ts, max_ts, gran)
+
+    def autocorr(self, col: str, lag: int = 1) -> pd.DataFrame:
+        """Distributed lag-k autocorrelation per series (reference
+        tsdf.py:192-316 semantics via the host kernel's pair rule).
+        Returns a bare DataFrame (host parity); only [K] scalars leave
+        the device.  Bucket-head views (resampled frames) compact their
+        scattered valid rows with one stable lane sort first, so the
+        physical lag pairing sees consecutive observations."""
+        dcol = self.cols[col]
+        if self.n_time > 1:
+            # positions must be series-contiguous for the lag pairing
+            fwd = _to_series_local_fn(self.mesh, self.series_axis,
+                                      self.time_axis, 3)
+            v, ok, mask = fwd(dcol.values, dcol.valid, self.mask)
+        else:
+            v, ok, mask = dcol.values, dcol.valid, self.mask
+        ac, cnt, lengths = _autocorr_fn(int(lag), bool(self.resampled))(
+            v, ok, mask
+        )
+        K = self.layout.n_series
+        ac_h = np.asarray(ac).astype(np.float64)[:K]
+        cnt_h = np.asarray(cnt)[:K]
+        len_h = np.asarray(lengths)[:K]
+        # a series only yields a row when the numerator join is non-empty
+        # (reference tsdf.py:248-253 inner joins drop pairless series)
+        present = (len_h > lag) & (cnt_h > lag)
+        out = self.layout.key_frame.copy()
+        if not self.partitionCols:
+            out = pd.DataFrame({"_dummy_group_col": ["dummy"]})
+        out[f"autocorr_lag_{lag}"] = ac_h
+        return out[present].reset_index(drop=True)
+
+    def fourier_transform(self, timestep: float, valueCol: str):
+        """Fourier transform via the host frame path.  The reference's
+        own implementation ships every group's rows to Python workers
+        over Arrow (applyInPandas, tsdf.py:865-899) — a materialisation
+        boundary by design — so the distributed form collects once,
+        runs the device-FFT host path (spectral.py), and re-meshes."""
+        host = self.collect().fourier_transform(timestep, valueCol)
+        return host.on_mesh(self.mesh, series_axis=self.series_axis,
+                            time_axis=self.time_axis)
+
+    def withLookbackFeatures(self, featureCols, lookbackWindowSize: int,
+                             exactSize: bool = True,
+                             featureColName: str = "features"):
+        """Lookback feature tensors via the host frame path.  The
+        reference materialises these as array-of-array columns through
+        a shuffle (collect_list, tsdf.py:637-671) — inherently a
+        row-materialisation op — so the distributed form collects once
+        and runs the device shifted-stack path; the dense device-side
+        form is ``tempo_tpu.rolling.lookback_tensor``."""
+        return self.collect().withLookbackFeatures(
+            featureCols, lookbackWindowSize, exactSize, featureColName
+        )
+
+    # ------------------------------------------------------------------
     # Materialisation
     # ------------------------------------------------------------------
 
@@ -1124,6 +1270,103 @@ def _align3_fn(mesh, series_axis, time_axis):
     return jax.jit(fn, out_shardings=sharding, static_argnums=(3,))
 
 
+@functools.lru_cache(maxsize=256)
+def _to_series_local_fn(mesh, series_axis, time_axis, n_arrays):
+    """[K, L] arrays -> series-local full rows (each device owns
+    K/(ns*nt) whole series), via one all_to_all per array.  Keyed on
+    arity so the jitted callable is built (and compiled) once."""
+    sp_in = _spec(mesh, series_axis, time_axis)
+    sp_out = P((series_axis, time_axis), None)
+
+    def kernel(*arrays):
+        a2a = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+            tiled=True)
+        return tuple(a2a(a) for a in arrays)
+
+    return jax.jit(shard_map(
+        kernel, mesh=mesh, in_specs=(sp_in,) * n_arrays,
+        out_specs=(sp_out,) * n_arrays,
+    ))
+
+
+@functools.lru_cache(maxsize=8)
+def _describe_reduce():
+    """Jitted global reductions for describe(); cached so repeated
+    describe() calls retrace nothing."""
+
+    @jax.jit
+    def reduce_cols(ts, mask, secs, vals, valids):
+        out = {}
+        out["min_ts"] = jnp.min(jnp.where(mask, ts, packing.TS_PAD))
+        out["max_ts"] = jnp.max(jnp.where(mask, ts, jnp.int64(-2 ** 62)))
+        out["n_rows"] = jnp.sum(mask)
+        s = jnp.where(mask, secs, 0.0)
+        out["has_frac"] = jnp.any(mask & (s - jnp.floor(s) > 0))
+        out["sub_min"] = jnp.any(mask & (jnp.mod(s, 60) != 0))
+        out["sub_hr"] = jnp.any(mask & (jnp.mod(s, 3600) != 0))
+        out["sub_day"] = jnp.any(mask & (jnp.mod(s, 86400) != 0))
+        ok = valids & mask[None]
+        v = jnp.where(ok, vals, 0.0)
+        out["count"] = jnp.sum(ok, axis=(1, 2))
+        out["sum"] = jnp.sum(v, axis=(1, 2))
+        out["sumsq"] = jnp.sum(v * v, axis=(1, 2))
+        out["min"] = jnp.min(jnp.where(ok, vals, jnp.inf), axis=(1, 2))
+        out["max"] = jnp.max(jnp.where(ok, vals, -jnp.inf), axis=(1, 2))
+        # seconds view of the ts column (tsdf.py:393-400)
+        out["ts_sum"] = jnp.sum(jnp.where(mask, secs, 0.0))
+        out["ts_sumsq"] = jnp.sum(jnp.where(mask, secs * secs, 0.0))
+        out["ts_min"] = jnp.min(jnp.where(mask, secs, jnp.inf))
+        out["ts_max"] = jnp.max(jnp.where(mask, secs, -jnp.inf))
+        return out
+
+    return reduce_cols
+
+
+@functools.lru_cache(maxsize=64)
+def _autocorr_fn(lag, compact):
+    """Jitted per-series lag-k autocorrelation; ``compact`` stable-sorts
+    scattered valid rows (bucket-head views) to the front first so the
+    physical lag pairing matches the host path's compacted layout."""
+
+    @jax.jit
+    def per_series(v, ok, mask):
+        ok = ok & mask
+        if compact:
+            # stable sort by (invalid, position): valid rows keep order
+            # at the front; the frame's row set becomes the valid rows
+            key = (~ok).astype(jnp.int32)
+            _, v, ok = jax.lax.sort(
+                (key, v, ok), dimension=-1, num_keys=1, is_stable=True
+            )
+            mask2 = ok
+        else:
+            mask2 = mask
+        Lh = v.shape[-1]
+        cnt = jnp.sum(ok, axis=-1)
+        mean = jnp.sum(jnp.where(ok, v, 0.0), axis=-1) \
+            / jnp.maximum(cnt, 1)
+        sub = jnp.where(ok, v - mean[:, None], 0.0)
+        denom = jnp.sum(sub * sub, axis=-1)
+        lengths = jnp.sum(mask2, axis=-1)
+        if lag >= Lh:
+            return jnp.full(denom.shape, jnp.nan), cnt, lengths
+        left = sub[:, :-lag]
+        right = sub[:, lag:]
+        pos = jnp.arange(Lh - lag)
+        keep = (
+            (pos[None, :] + 1 <= cnt[:, None] - lag)
+            & (pos[None, :] + lag < lengths[:, None])
+            & ok[:, :-lag] & ok[:, lag:]
+        )
+        num = jnp.sum(jnp.where(keep, left * right, 0.0), axis=-1)
+        any_pair = jnp.any(keep, axis=-1)
+        ac = jnp.where(any_pair, num, jnp.nan) / denom
+        return ac, cnt, lengths
+
+    return per_series
+
+
 def _bucket_heads(ts, mask, step_ns):
     """Shared tumbling-bucket scaffolding: absolute bucket key ``b``,
     bucket-head mask, and per-row [start, end) row bounds of the row's
@@ -1314,7 +1557,6 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
     def local(ts, mask, vals, valids):
-        step = jnp.int64(step_ns)
         b, head, start, end = _bucket_heads(ts, mask, step_ns)
 
         outs = []
