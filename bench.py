@@ -468,15 +468,22 @@ def bench_pandas(data):
     left = left.sort_values(["ts", "key"], kind="stable")
     right = right.sort_values(["ts", "key"], kind="stable")
 
-    t0 = time.perf_counter()
-    joined = pd.merge_asof(left, right, on="ts", by="key")
-    g = joined.sort_values(["key", "ts"]).set_index("ts").groupby("key")["x"]
-    roll = g.rolling("10s")
-    _ = roll.mean()
-    _ = roll.std()
-    _ = joined.groupby("key")["x"].transform(lambda s: s.ewm(alpha=0.2).mean())
-    dt = time.perf_counter() - t0
-    return (sub * L) / dt
+    # best of 3: the denominator must reflect pandas, not whatever else
+    # the host happened to be running (observed 5x swings under load)
+    best = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        joined = pd.merge_asof(left, right, on="ts", by="key")
+        g = joined.sort_values(["key", "ts"]).set_index("ts") \
+            .groupby("key")["x"]
+        roll = g.rolling("10s")
+        _ = roll.mean()
+        _ = roll.std()
+        _ = joined.groupby("key")["x"].transform(
+            lambda s: s.ewm(alpha=0.2).mean()
+        )
+        best = min(best, time.perf_counter() - t0)
+    return (sub * L) / best
 
 
 def main():

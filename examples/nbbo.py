@@ -12,10 +12,14 @@ of tickers carry most of the volume — exactly the shape Spark needs the
 Run: python examples/nbbo.py  (TPU or JAX_PLATFORMS=cpu)
 """
 
+import os
+import sys
 import time
 
 import numpy as np
 import pandas as pd
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
 from tempo_tpu import TSDF
 

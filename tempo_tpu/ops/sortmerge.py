@@ -102,7 +102,6 @@ def _ffill_scan(has: jnp.ndarray, val: jnp.ndarray, axis: int = -1):
     )
 
 
-@functools.partial(jax.jit, static_argnames=("skip_nulls",))
 def asof_merge_values(
     l_ts: jnp.ndarray,            # [K, Ll] int64 ns (TS_PAD padded)
     r_ts: jnp.ndarray,            # [K, Lr] int64 ns
@@ -126,20 +125,58 @@ def asof_merge_values(
 
     One merge sort (ts [, seq], side) carrying C value planes, one
     batched forward-fill scan, one routing sort.  No gathers.
+
+    Dispatches OUTSIDE jit so the ``TEMPO_TPU_NAN_ASOF`` opt-in (a
+    leaner NaN-encoded variant — the axon remote compiler hung >30 min
+    on the fused pipeline built that way, measured 2026-07-30, so it is
+    off by default) takes effect per call, not per first-trace.
     """
+    if skip_nulls and jnp.issubdtype(r_values.dtype, jnp.floating) \
+            and _nan_encoding_enabled():
+        return _asof_merge_nan_encoded(l_ts, r_ts, r_valids, r_values,
+                                       l_seq, r_seq)
+    return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
+                                l_seq, r_seq, skip_nulls=skip_nulls)
+
+
+def _merge_sides(l_ts, r_ts, l_seq, r_seq):
+    """Shared merged sort-key construction: (ts [, seq], side), right
+    rows sorting before left rows on full ties (rec_ind -1 < 1), null
+    seq sides riding the dtype minimum (NULLS FIRST)."""
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[-1]
+    ts = jnp.concatenate([l_ts, r_ts], axis=-1)
+    is_left = jnp.concatenate(
+        [jnp.ones((K, Ll), jnp.int32), jnp.zeros((K, Lr), jnp.int32)],
+        axis=-1,
+    )
+    keys = [ts]
+    if l_seq is not None or r_seq is not None:
+        sdt = (l_seq if l_seq is not None else r_seq).dtype
+        neg = (
+            jnp.finfo(sdt).min
+            if jnp.issubdtype(sdt, jnp.floating)
+            else jnp.iinfo(sdt).min
+        )
+        ls = l_seq if l_seq is not None else jnp.full((K, Ll), neg, sdt)
+        rs = r_seq if r_seq is not None else jnp.full((K, Lr), neg, sdt)
+        keys.append(jnp.concatenate([ls, rs], axis=-1))
+    keys.append(is_left)
+    return keys, is_left
+
+
+@functools.partial(jax.jit, static_argnames=("skip_nulls",))
+def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
+                         r_seq=None, skip_nulls=True):
+    """Default form: validity rides as explicit bool planes."""
     C = int(r_values.shape[0])
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]
     Lc = Ll + Lr
     vdt = r_values.dtype
 
-    ts = jnp.concatenate([l_ts, r_ts], axis=-1)
-    # right rows sort before left rows on full ties so the running last
-    # at a left row includes a tied right row
-    is_left = jnp.concatenate(
-        [jnp.ones((K, Ll), jnp.int32), jnp.zeros((K, Lr), jnp.int32)],
-        axis=-1,
-    )
+    keys, is_left = _merge_sides(l_ts, r_ts, l_seq, r_seq)
+
     ridx = jnp.concatenate(
         [
             jnp.full((K, Ll), -1, jnp.int32),
@@ -154,19 +191,6 @@ def asof_merge_values(
     planes = jnp.concatenate([zeros_l, r_values], axis=-1)      # [C, K, Lc]
     falses_l = jnp.zeros((C, K, Ll), jnp.bool_)
     vplanes = jnp.concatenate([falses_l, r_valids], axis=-1)    # [C, K, Lc]
-
-    keys = [ts]
-    if l_seq is not None or r_seq is not None:
-        sdt = (l_seq if l_seq is not None else r_seq).dtype
-        neg = (
-            jnp.finfo(sdt).min
-            if jnp.issubdtype(sdt, jnp.floating)
-            else jnp.iinfo(sdt).min
-        )
-        ls = l_seq if l_seq is not None else jnp.full((K, Ll), neg, sdt)
-        rs = r_seq if r_seq is not None else jnp.full((K, Lr), neg, sdt)
-        keys.append(jnp.concatenate([ls, rs], axis=-1))
-    keys.append(is_left)
 
     ops = tuple(keys) + (ridx,) + tuple(planes[c] for c in range(C)) \
         + tuple(vplanes[c] for c in range(C))
@@ -225,6 +249,60 @@ def asof_merge_values(
     found_l = jnp.stack([routed[2 + C + c][..., :Ll] for c in range(C)]) \
         if C else jnp.zeros((0, K, Ll), jnp.bool_)
     vals_l = jnp.where(found_l, vals_l, jnp.nan)
+    return vals_l, found_l, idx_l
+
+
+def _nan_encoding_enabled() -> bool:
+    import os
+
+    return os.environ.get("TEMPO_TPU_NAN_ASOF", "0") not in ("0", "false",
+                                                             "no")
+
+
+@jax.jit
+def _asof_merge_nan_encoded(l_ts, r_ts, r_valids, r_values, l_seq=None,
+                            r_seq=None):
+    """skipNulls float fast path of :func:`asof_merge_values`: null and
+    not-found states are NaN inside the value planes themselves, so the
+    merge and routing sorts move C+1 payload operands instead of 2C+2.
+    Requires valid slots to hold finite values (the packing invariant:
+    NaN source values are null by definition)."""
+    C = int(r_values.shape[0])
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[-1]
+    vdt = r_values.dtype
+
+    keys, is_left = _merge_sides(l_ts, r_ts, l_seq, r_seq)
+
+    planes = jnp.concatenate(
+        [jnp.full((C, K, Ll), jnp.nan, vdt),
+         jnp.where(r_valids, r_values, jnp.nan)], axis=-1,
+    )
+    ridx_f = jnp.concatenate(
+        [jnp.full((K, Ll), jnp.nan, vdt),
+         jnp.broadcast_to(jnp.arange(Lr, dtype=vdt), (K, Lr))],
+        axis=-1,
+    )
+
+    ops = tuple(keys) + tuple(planes[c] for c in range(C)) + (ridx_f,)
+    sorted_ops = jax.lax.sort(
+        ops, dimension=-1, num_keys=len(keys), is_stable=True
+    )
+    nk = len(keys)
+    is_left_s = sorted_ops[nk - 1]
+    payload = jnp.stack(sorted_ops[nk:])          # [C+1, K, Lc]
+
+    has = ~jnp.isnan(payload)
+    has_f, val_f = _ffill_scan(has, jnp.where(has, payload, 0.0))
+    filled = jnp.where(has_f, val_f, jnp.nan)     # NaN == never found
+
+    route = (1 - is_left_s,) + tuple(filled[i] for i in range(C + 1))
+    routed = jax.lax.sort(route, dimension=-1, num_keys=1, is_stable=True)
+    vals_l = jnp.stack([routed[1 + c][..., :Ll] for c in range(C)]) if C \
+        else jnp.zeros((0, K, Ll), vdt)
+    idx_f = routed[1 + C][..., :Ll]
+    found_l = ~jnp.isnan(vals_l)
+    idx_l = jnp.where(jnp.isnan(idx_f), -1, idx_f).astype(jnp.int32)
     return vals_l, found_l, idx_l
 
 

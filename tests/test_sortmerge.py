@@ -51,9 +51,12 @@ def test_merge_rank_single_row_and_width_one():
         np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("nan_enc", ["0", "1"])
 @pytest.mark.parametrize("skip", [True, False])
 @pytest.mark.parametrize("seed", [0, 3])
-def test_asof_merge_values_matches_index_kernel(skip, seed):
+def test_asof_merge_values_matches_index_kernel(skip, seed, nan_enc,
+                                                monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_NAN_ASOF", nan_enc)
     rng = np.random.default_rng(seed)
     K, Ll, Lr, C = 4, 41, 37, 3
     l_ts = np.sort(rng.integers(0, 80, size=(K, Ll)), axis=-1).astype(np.int64)
