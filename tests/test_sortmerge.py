@@ -57,6 +57,9 @@ def test_merge_rank_single_row_and_width_one():
 def test_asof_merge_values_matches_index_kernel(skip, seed, nan_enc,
                                                 monkeypatch):
     monkeypatch.setenv("TEMPO_TPU_NAN_ASOF", nan_enc)
+    # pin the reference to the search form: on TPU backends the index
+    # kernel otherwise dispatches to the same merge machinery under test
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "0")
     rng = np.random.default_rng(seed)
     K, Ll, Lr, C = 4, 41, 37, 3
     l_ts = np.sort(rng.integers(0, 80, size=(K, Ll)), axis=-1).astype(np.int64)
@@ -186,3 +189,25 @@ def test_searchsorted_batched_sort_dispatch():
     finally:
         del os.environ["TEMPO_TPU_SORT_KERNELS"]
     np.testing.assert_array_equal(got, want)
+
+
+def test_asof_indices_merge_form_matches_search_form(monkeypatch):
+    """On TPU asof_indices_searchsorted rides the merge join; both forms
+    must agree exactly (incl. all-null columns and pad slots)."""
+    rng = np.random.default_rng(17)
+    K, Ll, Lr, C = 5, 33, 29, 3
+    l_ts = np.sort(rng.integers(0, 70, size=(K, Ll)), axis=-1).astype(np.int64)
+    r_ts = np.sort(rng.integers(0, 70, size=(K, Lr)), axis=-1).astype(np.int64)
+    r_ts[:, -3:] = TS_PAD
+    r_valid = rng.random((C, K, Lr)) > 0.4
+    r_valid[0, 2] = False          # one all-null column/series
+    r_valid[:, :, -3:] = False     # pads are never valid
+
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "0")
+    want = asof_ops.asof_indices_searchsorted(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid), n_cols=C)
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+    got = asof_ops.asof_indices_searchsorted(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid), n_cols=C)
+    np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(want[0]))
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
