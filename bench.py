@@ -486,22 +486,50 @@ def bench_pandas(data):
     return (sub * L) / best
 
 
+def _attempt(label, fn):
+    """Per-config fault isolation: the axon TPU worker intermittently
+    crashes mid-run ('worker process crashed or restarted', observed
+    once across four otherwise-identical runs); a flaky secondary
+    config must not zero the whole bench.  Returns None on failure."""
+    try:
+        return fn()
+    except BaseException as e:   # worker crashes raise RuntimeError subtypes
+        if isinstance(e, KeyboardInterrupt):
+            raise
+        print(f"[{label}] FAILED ({type(e).__name__}): {e}",
+              file=sys.stderr, flush=True)
+        return None
+
+
 def main():
     data = make_data()
-    fused_rows_sec, implied_bw, t_iter_fused, out_small = bench_fused(data)
+    # host-only denominator first: immune to device-worker state
+    cpu_rows_sec = bench_pandas(data)
+
+    fused = _attempt("fused", lambda: bench_fused(data))
+    if fused is None:
+        # headline config failed — emit an explicit-failure record (one
+        # JSON line contract) rather than dying silently
+        print(json.dumps({
+            "metric": "asof_join+range_stats+ema rows/sec (1 chip)",
+            "value": 0, "unit": "rows/sec", "vs_baseline": 0,
+            "error": "fused pipeline failed; see stderr",
+        }))
+        return
+    fused_rows_sec, implied_bw, t_iter_fused, out_small = fused
 
     print("value audit (TPU f32 vs numpy f64 oracle)...", file=sys.stderr,
           flush=True)
     _value_audit(out_small, data)
     del out_small
 
-    asof_rs, _, _ = bench_asof(data)
-    stats_rs, _, _ = bench_range_stats(data)
-    res_rs, _, _ = bench_resample_ema(data)
-    nbbo_rs, _ = bench_nbbo()
+    asof = _attempt("asof", lambda: bench_asof(data))
+    stats = _attempt("range_stats", lambda: bench_range_stats(data))
+    res = _attempt("resample_ema", lambda: bench_resample_ema(data))
+    nbbo = _attempt("nbbo", lambda: bench_nbbo())
     skew_rs = bench_skew_1b(t_iter_fused)
-    cpu_rows_sec = bench_pandas(data)
 
+    rate = lambda r, i=0: round(r[i]) if r is not None else None
     print(json.dumps({
         "metric": "asof_join+range_stats+ema rows/sec (1 chip)",
         "value": round(fused_rows_sec),
@@ -510,10 +538,10 @@ def main():
         "hbm_gbps": round(implied_bw / 1e9, 1),
         "hbm_frac_of_spec": round(implied_bw / V5E_HBM_BYTES_PER_SEC, 3),
         "configs": {
-            "1_quickstart_asof": round(asof_rs),
-            "2_range_stats_10s": round(stats_rs),
-            "3_resample_ema": round(res_rs),
-            "4_nbbo_skew_asof": round(nbbo_rs),
+            "1_quickstart_asof": rate(asof),
+            "2_range_stats_10s": rate(stats),
+            "3_resample_ema": rate(res),
+            "4_nbbo_skew_asof": rate(nbbo),
             "5_skew_1b_bracketed": round(skew_rs),
         },
         "denominator": "pandas single-core (pyspark absent; see BASELINE.md)",

@@ -1024,6 +1024,19 @@ class DistributedTSDF:
     def count(self) -> int:
         return int(np.asarray(jnp.sum(self.mask)))
 
+    def show(self, n: int = 20, truncate: bool = True) -> None:
+        """Materialise and display (host TSDF.show semantics)."""
+        self.collect().show(n, truncate)
+
+    def __repr__(self) -> str:
+        axes = dict(self.mesh.shape)
+        return (
+            f"DistributedTSDF(mesh={axes}, series={self.layout.n_series}, "
+            f"packed=[{self.K_dev}, {self.L}], "
+            f"cols={self.numeric_columns()}, host_cols={list(self.host_cols)}, "
+            f"ts_col={self.ts_col!r}, partition_cols={self.partitionCols})"
+        )
+
 
 def _pad_k(arr: np.ndarray, K_dev: int, fill) -> np.ndarray:
     K = arr.shape[0]

@@ -46,80 +46,33 @@ from tempo_tpu.ops import window_utils as wu
 def asof_indices_searchsorted(
     l_ts: jnp.ndarray,          # [K, Ll] int64, padded with TS_PAD
     r_ts: jnp.ndarray,          # [K, Lr] int64, padded with TS_PAD
-    r_valids: jnp.ndarray,      # [n_cols, K, Lr] bool per right column
-    n_cols: int,
+    r_valids: jnp.ndarray,      # [C, K, Lr] bool per right column
+    n_cols: Optional[int] = None,   # kept for API compat; C comes from
+                                    # r_valids.shape (static under jit)
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (last_row_idx [K, Ll], per_col_idx [n_cols, K, Ll]).
+    """Returns (last_row_idx [K, Ll], per_col_idx [C, K, Ll]).
 
     last_row_idx: index of the last right row with r_ts <= l_ts (-1 none)
     per_col_idx:  index of the last right row at-or-before l_ts whose
                   column value is non-null (-1 none) - skipNulls=True.
 
-    On TPU (sort kernels active) the per-column indices ride the merge
-    join — the binary search and the per-column last-valid gathers both
-    lower to dynamic gathers there, each costing more than a full lane
-    sort (ops/sortmerge.py timings).  The merge form additionally
-    REQUIRES ``l_ts`` ascending per row (every packed-layout caller
-    guarantees it; the searchsorted form queries rows independently and
-    does not care).
+    On TPU (sort kernels active) this dispatches to
+    :func:`tempo_tpu.ops.sortmerge.asof_merge_indices` — the binary
+    search and the per-column last-valid gathers both lower to dynamic
+    gathers there, each costing more than a full lane sort.  The merge
+    form additionally REQUIRES ``l_ts`` ascending per row (every
+    packed-layout caller guarantees it; the searchsorted form queries
+    rows independently and does not care).
     """
     from tempo_tpu.ops import sortmerge as sm
 
     if sm.use_sort_kernels():
-        return _asof_indices_merge_form(l_ts, r_ts, r_valids)
-    return _asof_indices_search_form(l_ts, r_ts, r_valids, n_cols=n_cols)
+        return sm.asof_merge_indices(l_ts, r_ts, r_valids)
+    return _asof_indices_search_form(l_ts, r_ts, r_valids)
 
 
 @jax.jit
-def _asof_indices_merge_form(l_ts, r_ts, r_valids):
-    """Per-column last-valid-row indices through the sort-and-scan
-    merge: the single sorted ridx channel is forward-filled once per
-    column keyed on that column's validity, so the merge sort carries
-    only 3+C operands (keys + ridx + C bool planes)."""
-    from tempo_tpu.ops import sortmerge as sm
-
-    C, K, Lr = r_valids.shape
-    Ll = l_ts.shape[-1]
-    Lc = Ll + Lr
-
-    keys, is_left = sm._merge_sides(l_ts, r_ts, None, None)
-    ridx = jnp.concatenate(
-        [jnp.full((K, Ll), -1, jnp.int32),
-         jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.int32), (K, Lr))],
-        axis=-1,
-    )
-    vplanes = jnp.concatenate(
-        [jnp.zeros((C, K, Ll), jnp.bool_), r_valids], axis=-1
-    )
-    ops = tuple(keys) + (ridx,) + tuple(vplanes[c] for c in range(C))
-    sorted_ops = jax.lax.sort(
-        ops, dimension=-1, num_keys=len(keys), is_stable=True
-    )
-    nk = len(keys)
-    is_right_s = sorted_ops[nk - 1] == 0
-    ridx_s = sorted_ops[nk]
-    vplanes_s = jnp.stack(sorted_ops[nk + 1:]) if C else \
-        jnp.zeros((0, K, Lc), jnp.bool_)
-
-    has = jnp.concatenate(
-        [is_right_s[None] & vplanes_s,
-         jnp.broadcast_to(is_right_s, (1, K, Lc))], axis=0
-    )
-    val = jnp.broadcast_to(ridx_s, (C + 1, K, Lc))
-    has_f, val_f = sm._ffill_scan(has, jnp.where(has, val, 0))
-    idx_sorted = jnp.where(has_f, val_f, -1)
-
-    route = (1 - sorted_ops[nk - 1],) + tuple(idx_sorted[i]
-                                              for i in range(C + 1))
-    routed = jax.lax.sort(route, dimension=-1, num_keys=1, is_stable=True)
-    per_col = jnp.stack([routed[1 + c][..., :Ll] for c in range(C)]) if C \
-        else jnp.zeros((0, K, Ll), jnp.int32)
-    last_idx = routed[1 + C][..., :Ll]
-    return last_idx, per_col
-
-
-@functools.partial(jax.jit, static_argnames=("n_cols",))
-def _asof_indices_search_form(l_ts, r_ts, r_valids, n_cols):
+def _asof_indices_search_form(l_ts, r_ts, r_valids):
     pos = wu.searchsorted_batched(r_ts, l_ts, side="right")  # [K, Ll]
     last_row_idx = (pos - 1).astype(jnp.int32)               # -1 when none
 
@@ -129,7 +82,8 @@ def _asof_indices_search_form(l_ts, r_ts, r_valids, n_cols):
         g = jnp.take_along_axis(lv, jnp.maximum(last_row_idx, 0).astype(jnp.int32), axis=-1)
         return jnp.where(last_row_idx >= 0, g, -1)
 
-    per_col_idx = jax.vmap(per_col)(r_valids) if n_cols else jnp.zeros((0,) + l_ts.shape, jnp.int32)
+    per_col_idx = (jax.vmap(per_col)(r_valids) if int(r_valids.shape[0])
+                   else jnp.zeros((0,) + l_ts.shape, jnp.int32))
     return last_row_idx, per_col_idx
 
 

@@ -252,6 +252,55 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
     return vals_l, found_l, idx_l
 
 
+@jax.jit
+def asof_merge_indices(l_ts, r_ts, r_valids):
+    """Index-returning sibling of :func:`asof_merge_values` (same
+    skipNulls semantics, same sort/ffill/route skeleton): returns
+    ``(last_row_idx [K, Ll], per_col_idx [C, K, Ll])``, -1 for no
+    match.  The single sorted row-index channel is forward-filled once
+    per column keyed on that column's validity, so the merge sort
+    carries only 3+C operands.  REQUIRES ``l_ts`` ascending per row
+    (the packed-layout invariant)."""
+    C, K, Lr = r_valids.shape
+    Ll = l_ts.shape[-1]
+    Lc = Ll + Lr
+
+    keys, is_left = _merge_sides(l_ts, r_ts, None, None)
+    ridx = jnp.concatenate(
+        [jnp.full((K, Ll), -1, jnp.int32),
+         jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.int32), (K, Lr))],
+        axis=-1,
+    )
+    vplanes = jnp.concatenate(
+        [jnp.zeros((C, K, Ll), jnp.bool_), r_valids], axis=-1
+    )
+    ops = tuple(keys) + (ridx,) + tuple(vplanes[c] for c in range(C))
+    sorted_ops = jax.lax.sort(
+        ops, dimension=-1, num_keys=len(keys), is_stable=True
+    )
+    nk = len(keys)
+    is_right_s = sorted_ops[nk - 1] == 0
+    ridx_s = sorted_ops[nk]
+    vplanes_s = jnp.stack(sorted_ops[nk + 1:]) if C else \
+        jnp.zeros((0, K, Lc), jnp.bool_)
+
+    has = jnp.concatenate(
+        [is_right_s[None] & vplanes_s,
+         jnp.broadcast_to(is_right_s, (1, K, Lc))], axis=0
+    )
+    val = jnp.broadcast_to(ridx_s, (C + 1, K, Lc))
+    has_f, val_f = _ffill_scan(has, jnp.where(has, val, 0))
+    idx_sorted = jnp.where(has_f, val_f, -1)
+
+    route = (1 - sorted_ops[nk - 1],) + tuple(idx_sorted[i]
+                                              for i in range(C + 1))
+    routed = jax.lax.sort(route, dimension=-1, num_keys=1, is_stable=True)
+    per_col = jnp.stack([routed[1 + c][..., :Ll] for c in range(C)]) if C \
+        else jnp.zeros((0, K, Ll), jnp.int32)
+    last_idx = routed[1 + C][..., :Ll]
+    return last_idx, per_col
+
+
 def _nan_encoding_enabled() -> bool:
     import os
 

@@ -303,12 +303,16 @@ def asof_time_sharded(
     n_time = _check_halo(mesh, int(r_ts.shape[-1]), halo, time_axis)
     if l_ts.shape[-1] % n_time != 0:
         raise ValueError(f"left time axis {l_ts.shape[-1]} not divisible by {n_time}")
-    fn = _build_asof(mesh, int(halo), time_axis, series_axis)
+    from tempo_tpu.ops.sortmerge import use_sort_kernels
+
+    fn = _build_asof(mesh, int(halo), time_axis, series_axis,
+                     use_sort_kernels())
     return fn(l_ts, r_ts, r_valids, r_values)
 
 
 @functools.lru_cache(maxsize=256)
-def _build_asof(mesh: Mesh, halo: int, time_axis: str, series_axis: str):
+def _build_asof(mesh: Mesh, halo: int, time_axis: str, series_axis: str,
+                sort_kernels: bool = False):
     spec2 = _specs(mesh, 2, time_axis, series_axis)
     spec3 = _specs(mesh, 3, time_axis, series_axis)
     n_time = mesh.shape[time_axis]
@@ -327,12 +331,21 @@ def _build_asof(mesh: Mesh, halo: int, time_axis: str, series_axis: str):
         ext_x = jnp.concatenate([rx, g_x], axis=-1)
         L_ext = ext_ts.shape[-1]
 
-        last_idx, col_idx = asof_ops.asof_indices_searchsorted(
-            lts, ext_ts, ext_val, n_cols=int(rval.shape[0])
-        )
-        found = col_idx >= 0
-        safe = jnp.maximum(col_idx, 0)
-        vals = jnp.take_along_axis(ext_x, safe, axis=-1)
+        if sort_kernels:
+            # gather-free shard-local join (the value gather below is
+            # the single most expensive op on TPU — sortmerge.py)
+            from tempo_tpu.ops import sortmerge as sm
+
+            vals, found, last_idx = sm.asof_merge_values(
+                lts, ext_ts, ext_val, ext_x
+            )
+        else:
+            last_idx, col_idx = asof_ops.asof_indices_searchsorted(
+                lts, ext_ts, ext_val
+            )
+            found = col_idx >= 0
+            safe = jnp.maximum(col_idx, 0)
+            vals = jnp.take_along_axis(ext_x, safe, axis=-1)
 
         if n_time > 1:
             # cross-shard carry: this shard's last non-null value per
