@@ -1,0 +1,46 @@
+"""The bench contract: ``python bench.py`` must print ONE valid JSON
+line with the driver-recorded fields, whatever else happens.
+
+The driver runs bench.py once at round end and records the line as the
+round's official number — a refactor that breaks it silently costs the
+round its benchmark, so the full code path runs here in smoke mode
+(tiny shapes, CPU) on every test run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_prints_one_json_line():
+    env = dict(os.environ)
+    env.update({
+        "TEMPO_BENCH_SMOKE": "1",
+        "JAX_PLATFORMS": "cpu",
+        # isolate from the suite's 8-device flag: the bench is a
+        # single-chip program
+        "XLA_FLAGS": "",
+    })
+    out = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {out.stdout!r}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, key
+    assert rec["unit"] == "rows/sec"
+    assert rec["value"] > 0
+    cfgs = rec["configs"]
+    assert set(cfgs) == {
+        "1_quickstart_asof", "2_range_stats_10s", "3_resample_ema",
+        "4_nbbo_skew_asof", "5_skew_1b_bracketed",
+    }
+    # physics sanity survives even in smoke: implied bandwidth is a
+    # fraction of spec, never above it
+    assert rec["hbm_frac_of_spec"] <= 1.0
