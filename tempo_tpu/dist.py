@@ -401,7 +401,10 @@ class DistributedTSDF:
     def asofJoin(self, right: "DistributedTSDF",
                  left_prefix: Optional[str] = None,
                  right_prefix: str = "right",
+                 tsPartitionVal: Optional[int] = None,
+                 fraction: float = 0.5,
                  skipNulls: bool = True,
+                 sql_join_opt: bool = False,
                  suppress_null_warning: bool = False) -> "DistributedTSDF":
         """Distributed AS-OF join.  The right frame is aligned to the
         left's series-id space with one device gather (the
@@ -417,7 +420,18 @@ class DistributedTSDF:
         frame was built with a ``sequence_col`` — only the right's
         sequence orders the merge, mirroring the reference (left rows
         carry NULL in it and sort first on ties, tsdf.py:117-121);
-        ``maxLookback`` remains host-path-only (``TSDF.asofJoin``)."""
+        ``maxLookback`` remains host-path-only (``TSDF.asofJoin``).
+
+        ``tsPartitionVal``/``fraction``/``sql_join_opt`` are accepted
+        for migration compatibility and ignored: they tune Spark's skew
+        brackets and broadcast-range fast path (tsdf.py:463-509), both
+        of which this join replaces — the packed layout is skew-free by
+        construction and the merge join is already shuffle-free."""
+        if tsPartitionVal is not None:
+            logger.info(
+                "asofJoin: tsPartitionVal ignored on the mesh — the "
+                "packed layout needs no skew brackets"
+            )
         if right.mesh is not self.mesh and right.mesh != self.mesh:
             raise ValueError("both frames must live on the same mesh")
         if self.partitionCols != right.partitionCols:

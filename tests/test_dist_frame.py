@@ -644,3 +644,22 @@ def test_describe_includes_host_columns(frames):
         gv = got.loc[got["summary"] == stat, "note"].iloc[0]
         hv = host.loc[host["summary"] == stat, "note"].iloc[0]
         assert gv == hv, (stat, gv, hv)
+
+
+def test_asof_join_accepts_reference_tuning_kwargs(frames):
+    """Spark-era tuning knobs (tsPartitionVal/fraction/sql_join_opt)
+    are accepted and ignored — a migrated call site must not TypeError,
+    and results must equal the plain join."""
+    lt, rt = frames
+    mesh = make_mesh({"series": 4})
+    got = _sorted(
+        lt.on_mesh(mesh)
+        .asofJoin(rt.on_mesh(mesh), tsPartitionVal=300, fraction=0.1,
+                  sql_join_opt=True)
+        .collect().df
+    )
+    want = _sorted(lt.on_mesh(mesh).asofJoin(rt.on_mesh(mesh)).collect().df)
+    np.testing.assert_allclose(
+        got["right_bid"].to_numpy(float), want["right_bid"].to_numpy(float),
+        equal_nan=True,
+    )
