@@ -253,7 +253,13 @@ def _load_dist(d: str, man: dict, mesh, series_axis: str,
             host_gather=hg,
         )
     audits = [(msg, np.int64(cnt)) for msg, cnt in man["audits"]]
-    seq_d = put2(z["seq"], -np.inf) if "seq" in z.files else None
+    # +inf pad matches from_tsdf's seq packing (padding must sort after
+    # real rows; the ts key dominates at pad slots either way).  Null
+    # seq values from pre-NULLS-FIRST checkpoints were packed as NaN —
+    # normalise to the -inf encoding so restored frames join like fresh
+    # ones (idempotent: current-format planes carry no NaN).
+    seq_d = (put2(np.where(np.isnan(z["seq"]), -np.inf, z["seq"]), np.inf)
+             if "seq" in z.files else None)
     return DistributedTSDF(
         mesh, series_axis, time_axis, ts_d, mask_d, cols, layout,
         man["ts_col"], man["partition_cols"], np.dtype(man["ts_dtype"]),

@@ -190,12 +190,13 @@ class DistributedTSDF:
             # the sequence column is both an output column (it rides the
             # host row-identity path like any structural col) and a
             # device-resident join sort key.  A null RIGHT sequence
-            # sorts LAST (NaN in lax.sort's float total order), exactly
-            # like the host merge path packing NaN (join.py:137-139);
-            # values beyond 2^24 lose exactness under the f32 policy.
+            # sorts FIRST (-inf in the float total order) per Spark's
+            # ASC NULLS FIRST (tsdf.py:117-121), matching the host merge
+            # path (join.py); values beyond 2^24 lose exactness under
+            # the f32 policy.
             host_cols[tsdf.sequence_col] = tsdf.sequence_col
             sv, sm_ = tsdf.numeric_flat(tsdf.sequence_col)
-            sv = np.where(sm_, sv, np.nan).astype(dt)
+            sv = np.where(sm_, sv, -np.inf).astype(dt)
             seq_p = _pad_k(
                 packing.pack_column(sv, layout, L, fill=np.inf),
                 K_dev, np.inf,
@@ -509,15 +510,20 @@ class DistributedTSDF:
 
         sort_kernels = _use_sort_kernels()
         # sequence-number tie-break (tsdf.py:117-121): the reference
-        # sorts the merged stream by (combined_ts, RIGHT's sequence col,
-        # rec_ind) — left rows carry NULL in the right's seq column and
-        # sort FIRST on ties (Spark asc_nulls_first), so a tied-ts right
-        # row is invisible to tied-ts left rows.  The left frame's own
+        # sorts the merged stream by (combined_ts, RIGHT's sequence col
+        # ASC NULLS FIRST, rec_ind).  Left rows carry NULL in the
+        # right's seq column; a tied-ts NON-null-seq right row sorts
+        # after them (invisible to them), while a tied-ts NULL-seq right
+        # row (packed as -inf, from_tsdf) ties on seq and wins via
+        # rec_ind — visible to the tied left rows.  The left frame's own
         # sequence never orders the merge.
         has_seq = right.seq is not None
         if has_seq:
-            # left rows ride the kernel-synthesized -inf fill (sorting
-            # first on ties) — no constant plane to shard or transpose
+            # left rows ride the kernel-synthesized seq fill
+            # (finfo.min in _merge_sides — above the -inf null-seq
+            # encoding, below any real seq, so the order is
+            # right-null < left < right-non-null on ts ties) — no
+            # constant plane to shard or transpose
             r_seq_al = align2(right.seq, perm, ok, np.inf)
             if self.n_time > 1:
                 vals, found = _asof_a2a_seq(self.mesh, self.series_axis,

@@ -139,6 +139,13 @@ def asof_join(
         if right.sequence_col
         else None
     )
+    if r_seq_vals is not None:
+        # Spark orders the merged stream by (ts, seq ASC NULLS FIRST,
+        # rec_ind) — tsdf.py:117-121: a null-seq right row sorts before
+        # tied-ts left rows (visible to them) and loses the tie to
+        # non-null-seq right rows.  -inf realises NULLS FIRST in the
+        # float total order both for the layout sort and the merge key.
+        r_seq_vals = np.where(np.isnan(r_seq_vals), -np.inf, r_seq_vals)
 
     # --- skew variant: compose key with overlapping time brackets ------
     l_take = np.arange(len(left.df), dtype=np.int64)

@@ -121,12 +121,16 @@ def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
 def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: str = "left") -> jnp.ndarray:
     """Batched searchsorted over the leading (series) axis.
 
-    Every caller in tempo-tpu passes *sorted* queries (shifted/bucketed
-    versions of an already-sorted time axis), so on TPU this runs as the
+    API CONTRACT: ``queries`` MUST be ascending along the last axis (per
+    row), in addition to ``sorted_keys``.  On TPU this dispatches to the
     sort-and-scan merge (:func:`tempo_tpu.ops.sortmerge.merge_rank`) —
     measured ~25x faster than binary search there, which lowers to a
-    per-step dynamic gather.  CPU keeps the vmapped binary search (fast
-    native searchsorted, no sort cost).
+    per-step dynamic gather — and the merge returns ranks in
+    sorted-query order: unsorted queries get silently wrong ranks for
+    the whole row, not an error.  Every tempo-tpu caller passes
+    shifted/bucketed versions of an already-sorted time axis.  CPU keeps
+    the vmapped binary search (fast native searchsorted, no sort cost),
+    which happens to tolerate unsorted queries — do not rely on that.
     """
     from tempo_tpu.ops import sortmerge as sm
 

@@ -557,12 +557,18 @@ class _Parser:
             if self.kw("null"):
                 node = lambda env, l=left: _is_null(l(env))
             elif self.kw("true"):
-                node = lambda env, l=left: _as_bool(l(env)).fillna(False) \
-                    if isinstance(l(env), pd.Series) else l(env) is True
+                def node(env, l=left):
+                    v = l(env)
+                    if isinstance(v, pd.Series):
+                        return _as_bool(v).fillna(False)
+                    # bool() also accepts np.bool_, which `is True` does not
+                    return (not pd.isna(v)) and bool(v)
             elif self.kw("false"):
-                node = lambda env, l=left: (~_as_bool(l(env)).fillna(True)
-                                            if isinstance(l(env), pd.Series)
-                                            else l(env) is False)
+                def node(env, l=left):
+                    v = l(env)
+                    if isinstance(v, pd.Series):
+                        return ~_as_bool(v).fillna(True)
+                    return (not pd.isna(v)) and not bool(v)
             else:
                 raise SqlError("expected NULL/TRUE/FALSE after IS")
             if negate:

@@ -159,6 +159,34 @@ def test_sequence_number_sort():
     )
 
 
+def test_sequence_nulls_first():
+    """Spark sorts the merged stream by (ts, seq ASC NULLS FIRST,
+    rec_ind) — tsdf.py:117-121: a tied-ts right row with NULL seq is
+    visible to that timestamp's left rows and LOSES the tie to
+    non-null-seq right rows for later left rows (ADVICE r2 medium)."""
+    left_cols = ["symbol", "event_ts", "trade_pr"]
+    right_cols = ["symbol", "event_ts", "bid_pr", "seq_nb"]
+    left_data = [
+        ["S1", "2020-08-01 00:00:10", 349.21],
+        ["S1", "2020-08-01 00:00:20", 351.32],
+    ]
+    right_data = [
+        ["S1", "2020-08-01 00:00:10", 100.0, None],
+        ["S1", "2020-08-01 00:00:10", 200.0, 1],
+    ]
+    left = build_df(left_cols, left_data, ts_cols=["event_ts"])
+    right = build_df(right_cols, right_data, ts_cols=["event_ts"])
+    tl = TSDF(left, partition_cols=["symbol"])
+    tr = TSDF(right, partition_cols=["symbol"], sequence_col="seq_nb")
+    joined = tl.asofJoin(tr, right_prefix="right").df
+
+    # left@10: merged order is (null-seq right, left, seq-1 right) — the
+    # last right at-or-before is the NULL-seq row
+    assert joined["right_bid_pr"].tolist() == [100.0, 200.0]
+    assert np.isnan(joined["right_seq_nb"].to_numpy(np.float64)[0])
+    assert joined["right_seq_nb"].to_numpy(np.float64)[1] == 1.0
+
+
 def test_partitioned_asof_join():
     """tsdf_tests.py:343-394 - skew variant must match the plain join
     when the overlap fraction covers the lookback."""
