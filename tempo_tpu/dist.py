@@ -346,14 +346,25 @@ class DistributedTSDF:
                     f"halo_fraction or shard count", clipped,
                 ))
             elif self.n_time > 1:
-                stats = _range_stats_a2a(
+                stats, rb_clipped = _range_stats_a2a(
                     self.mesh, self.series_axis, self.time_axis, w,
                     rowbounds, sort_kernels,
                 )(self.ts, col.values, col.valid)
             else:
-                stats = _range_stats_local(
+                stats, rb_clipped = _range_stats_local(
                     self.mesh, self.series_axis, w, rowbounds, sort_kernels,
                 )(self.ts, col.values, col.valid)
+            if strategy == "exact" and rowbounds is not None:
+                # deferred truncation audit of the shifted-window form:
+                # the host-derived row bounds must cover every frame
+                # (they do by construction — this catches bound-
+                # derivation bugs and device/layout ts divergence)
+                audits.append((
+                    f"withRangeStats({c}): %d rows had window frames "
+                    f"extending past the static row bounds {rowbounds}; "
+                    f"this is a tempo-tpu bug — please report it",
+                    rb_clipped,
+                ))
             for stat in ("mean", "count", "min", "max", "sum", "stddev",
                          "zscore"):
                 new_cols[f"{stat}_{c}"] = DistCol(
@@ -1111,18 +1122,22 @@ def _range_stats_halo(mesh, series_axis, time_axis, window_secs, halo):
 
 def _range_stats_block(ts, x, valid, w, rowbounds):
     """Shard-local range stats: shifted gather-free form when static row
-    bounds are known (TPU), else bounds + prefix/RMQ form."""
+    bounds are known (TPU), else bounds + prefix/RMQ form.  Returns
+    (stats dict, clipped row count) — clipped is the shifted kernel's
+    truncation audit (zero by construction for the exact form)."""
     from tempo_tpu.ops import sortmerge as sm
 
     secs = ts // packing.NS_PER_S
     if rowbounds is not None:
         behind, ahead = rowbounds
-        return sm.range_stats_shifted(
+        stats = sm.range_stats_shifted(
             secs, x, valid, jnp.asarray(w),
             max_behind=int(behind), max_ahead=int(ahead),
         )
+        clipped = jnp.sum(stats.pop("clipped")).astype(jnp.int64)
+        return stats, clipped
     start, end = rk.range_window_bounds(secs, jnp.asarray(w))
-    return rk.windowed_stats(x, valid, start, end)
+    return rk.windowed_stats(x, valid, start, end), jnp.int64(0)
 
 
 @functools.lru_cache(maxsize=256)
@@ -1132,12 +1147,13 @@ def _range_stats_local(mesh, series_axis, window_secs, rowbounds=None,
     w = window_secs
 
     def kernel(ts, x, valid):
-        return _range_stats_block(ts, x, valid, w, rowbounds)
+        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds)
+        return stats, jax.lax.psum(clipped, series_axis)
 
     stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
                                   "stddev", "zscore")}
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
-                             out_specs=stats_spec))
+                             out_specs=(stats_spec, P())))
 
 
 @functools.lru_cache(maxsize=256)
@@ -1154,13 +1170,16 @@ def _range_stats_a2a(mesh, series_axis, time_axis, window_secs,
         rev = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=1, concat_axis=0, tiled=True)
         ts, x, valid = fwd(ts), fwd(x), fwd(valid)
-        stats = _range_stats_block(ts, x, valid, w, rowbounds)
-        return {k: rev(v) for k, v in stats.items()}
+        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds)
+        # after the a2a each (series, time) device owns disjoint full
+        # rows, so a psum over both axes counts every series once
+        clipped = jax.lax.psum(clipped, (series_axis, time_axis))
+        return {k: rev(v) for k, v in stats.items()}, clipped
 
     stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
                                   "stddev", "zscore")}
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
-                             out_specs=stats_spec))
+                             out_specs=(stats_spec, P())))
 
 
 @functools.lru_cache(maxsize=256)

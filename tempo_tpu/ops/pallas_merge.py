@@ -1,0 +1,375 @@
+"""Pallas VMEM merge-join kernel: the AS-OF join in one HBM pass.
+
+The XLA form of the join (``ops/sortmerge.py:asof_merge_values``) runs
+three full ``lax.sort`` ladders over the concatenated streams.  Each
+ladder is a bitonic *sort* network — O(log^2 Lc) compare-exchange
+stages — and every stage is an HBM round-trip of every operand plane,
+which is why the flagship op measured ~0.2% of the chip's HBM bandwidth
+(round-2 verdict).  But the two sides are *already sorted per row* (the
+packed-layout invariant, packing.py:33-41): merging them needs only a
+bitonic *merge* network — O(log Lc) stages — and none of the stages
+needs to leave VMEM.
+
+This kernel runs the whole join on a [bk, Lc] block resident in VMEM:
+
+1. **Bitonic merge** of ``[left ascending, reversed(right)]`` (a bitonic
+   sequence) under the total order (ts, side, pos): log2(Lc) stages of
+   ``pltpu.roll`` + compare-exchange.  Timestamps are int64 ns split
+   into two i32 planes (hi, bias-corrected lo) because lane arithmetic
+   is i32-native on TPU; ``pos`` (the within-side lane index) makes the
+   order total, which both emulates the reference's stable sort and
+   lets the compare-exchange ignore ties.  Right rows carry side-keys
+   below left rows, reproducing the reference's rec_ind tie-break
+   (right wins full ties — tsdf.py:119,546).
+2. **Forward-fill ladder** over the merged stream, NaN-encoded per
+   column (skipNulls=True semantics: each right column independently
+   takes its last non-null value, tsdf.py:139), plus a row-index plane
+   giving the last right row regardless of validity.
+3. **Routing**: each element's destination lane is a *known permutation*
+   (left rows -> their original lane, right rows -> the tail), so an
+   in-VMEM bitonic sort on that single i32 key restores left-row order.
+   This is the O(log^2) part, but it moves only C+2 planes and never
+   touches HBM.
+
+HBM traffic: one read of the input planes, one write of the output —
+independent of the number of network stages.
+
+Engages for float32 values, no sequence column, skipNulls=True (the
+reference's default join); the XLA forms remain for every other case
+(sequence tie-break, skipNulls=False, float64 golden runs, CPU).
+Reference semantics preserved: python/tempo/tsdf.py:111-162.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tempo_tpu.ops import pallas_kernels as pk
+
+# left/right side marker added to the within-side position to form the
+# tie-break key: right rows (sec = pos) sort before left rows
+# (sec = _SIDE + pos) on full ts ties, like rec_ind -1 < 1
+_SIDE = 1 << 24
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _pad_plan(Ll: int, Lr: int):
+    """(Lrp, Lc2, Llp): lane-align the right side, then pad the left so
+    the merged length is a power of two (the network requirement).
+    Shared by the kernel wrapper and the feasibility gate — they must
+    agree or the gate admits shapes the kernel plans differently."""
+    Lrp = -(-Lr // 128) * 128
+    Lc2 = _next_pow2(max(Ll + Lrp, 256))
+    return Lrp, Lc2, Lc2 - Lrp
+
+
+def _lane(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, dimension=1)
+
+
+def _partner(p, span: int, in_lower):
+    """Value at lane ^ span (the compare-exchange partner).  The rolls
+    wrap, but a lane only reads the direction that stays in range.
+    Negative roll shifts SIGABRT the Mosaic compiler (probed on v5e) —
+    the forward roll rides the circular equivalent L - span."""
+    L = p.shape[1]
+    fwd = pltpu.roll(p, shift=jnp.int32(L - span), axis=1)  # lane + span
+    bwd = pltpu.roll(p, shift=jnp.int32(span), axis=1)      # lane - span
+    return jnp.where(in_lower, fwd, bwd)
+
+
+def _gtn(a_keys, b_keys):
+    """Strict lexicographic compare over an arbitrary key-plane list."""
+    gt = None
+    eq = None
+    for a, b in zip(a_keys, b_keys):
+        term = (a > b) if eq is None else eq & (a > b)
+        gt = term if gt is None else gt | term
+        eq = (a == b) if eq is None else eq & (a == b)
+    return gt
+
+
+def _exchange(planes, take):
+    return [jnp.where(take, pp, p) for p, pp in planes]
+
+
+def _merge_stage(keys, payload, span: int, shape):
+    """One ascending bitonic-merge stage over all planes; the
+    lexicographic key-plane list decides the swap."""
+    in_lower = (_lane(shape) & span) == 0
+    pkeys = [_partner(k, span, in_lower) for k in keys]
+    gt = _gtn(keys, pkeys)
+    # lower lane keeps the min, upper the max (ascending network)
+    take = jnp.logical_xor(gt, ~in_lower)
+    keys = _exchange(list(zip(keys, pkeys)), take)
+    payload = _exchange(
+        [(p, _partner(p, span, in_lower)) for p in payload], take
+    )
+    return keys, payload
+
+
+def _sort_stage(key, payload, j: int, k: int, shape):
+    """One stage of a full bitonic sort on a single i32 key (the routing
+    permutation): block size k, partner distance j."""
+    lane = _lane(shape)
+    in_lower = (lane & j) == 0
+    ascending = (lane & k) == 0
+    pkey = _partner(key, j, in_lower)
+    take = jnp.logical_xor(
+        jnp.logical_xor(key > pkey, ~in_lower), ~ascending
+    )
+    (key,) = _exchange([(key, pkey)], take)
+    payload = _exchange(
+        [(p, _partner(p, j, in_lower)) for p in payload], take
+    )
+    return key, payload
+
+
+def _ffill_stage(planes, span: int, shape, sid=None):
+    """planes[i] <- planes[i] if non-NaN else planes[i - span].  With
+    ``sid`` (bin-packed rows: multiple series per lane row) the fill is
+    *segmented* — a previous value is taken only when it belongs to the
+    same series; series are contiguous runs, so a matching sid at
+    distance ``span`` implies the whole gap is one series."""
+    ok = _lane(shape) >= span
+    if sid is not None:
+        ok = ok & (pltpu.roll(sid, shift=jnp.int32(span), axis=1) == sid)
+    out = []
+    for p in planes:
+        prev = pltpu.roll(p, shift=jnp.int32(span), axis=1)
+        prev = jnp.where(ok, prev, jnp.nan)
+        out.append(jnp.where(jnp.isnan(p), prev, p))
+    return out
+
+
+def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
+    """Kernel closure: merge + ffill + route on [bk, Lc2] blocks.  With
+    ``segmented``, a leading series-id key plane both orders the merge
+    (so bin-packed series never interleave) and fences the fill."""
+
+    def kernel(*refs):
+        n_keys = 4 if segmented else 3
+        key_refs = refs[:n_keys]
+        payload_refs = refs[n_keys: n_keys + n_payload]
+        out_refs = refs[n_keys + n_payload:]
+        shape = key_refs[0].shape
+        keys = [r[:] for r in key_refs]
+        payload = [r[:] for r in payload_refs]
+
+        span = Lc2 // 2
+        while span >= 1:
+            keys, payload = _merge_stage(keys, payload, span, shape)
+            span //= 2
+
+        sid = keys[0] if segmented else None
+        span = 1
+        while span < Lc2:
+            payload = _ffill_stage(payload, span, shape, sid=sid)
+            span *= 2
+
+        # destination lanes: left row pos p -> p, right row pos p ->
+        # Llp + p; a permutation of [0, Lc2), so sorting by it routes
+        # every filled left slot back to its original lane
+        sec = keys[-1]
+        route = jnp.where(sec >= _SIDE, sec - _SIDE, Llp + sec)
+        k = 2
+        while k <= Lc2:
+            j = k // 2
+            while j >= 1:
+                route, payload = _sort_stage(route, payload, j, k, shape)
+                j //= 2
+            k *= 2
+
+        for r, p in zip(out_refs, payload):
+            r[:] = p[:, :Llp]
+
+    return kernel
+
+
+_VMEM_CAP = 90 * 2**20  # headroom under the raised 100M scoped limit
+
+
+def _plan_merge(K: int, Lc2: int, n_payload: int, n_keys: int):
+    """(grid, bk=8, K_pad) or None.  Footprint calibrated against the
+    compiler's own accounting: at [8, 16384] blocks with 3 payloads and
+    3 keys the stack peaked at 21.6M ≈ 42 plane-slots (pipelined I/O
+    double buffers + network temporaries), i.e. ~6x the
+    (n_payload + n_keys + 1) resident planes (the +1 is the route
+    key).  The segmented path adds a 4th (sid) key plane and must be
+    counted, or the gate admits shapes Mosaic then rejects."""
+    bk = 8
+    if bk * Lc2 * 4 * 6 * (n_payload + n_keys + 1) > _VMEM_CAP:
+        return None
+    K_pad = -(-K // bk) * bk
+    return (K_pad // bk,), bk, K_pad
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_payload", "Lc2", "Llp", "interpret")
+)
+def _merge_call(keys, payload, n_payload, Lc2, Llp, interpret=False):
+    K = keys[0].shape[0]
+    n_keys = len(keys)
+    plan = _plan_merge(K, Lc2, n_payload, n_keys)
+    if plan is None:
+        # callers are expected to consult merge_join_supported first; a
+        # silent whole-array block here would be strictly larger than
+        # the block the planner just rejected
+        raise ValueError(
+            f"asof merge kernel infeasible: [{8}, {Lc2}] blocks with "
+            f"{n_payload + n_keys + 1} planes exceed the VMEM budget; "
+            f"use the XLA sortmerge forms for this shape"
+        )
+    grid, bk, K_pad = plan
+    args = [pk._pad_rows(a, K_pad) for a in (*keys, *payload)]
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, Lc2), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        ospec = pl.BlockSpec((bk, Llp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            _make_kernel(n_payload, Lc2, Llp, segmented=n_keys == 4),
+            grid=grid,
+            in_specs=[spec] * (n_keys + n_payload),
+            out_specs=[ospec] * n_payload,
+            out_shape=[jax.ShapeDtypeStruct((K_pad, Llp), jnp.float32)]
+            * n_payload,
+            # the network temporaries + pipelined I/O buffers exceed the
+            # 16M default scoped-vmem cap at [8, 16384] blocks; v5e has
+            # 128M physical VMEM per core — raise the cap instead of
+            # shrinking blocks below Mosaic's 8-sublane minimum
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+            interpret=interpret,
+        )(*args)
+    return tuple(o[:K] for o in out)
+
+
+def _split_ts(ts):
+    """int64 ns -> (hi, lo) i32 planes preserving order under
+    lexicographic signed compare (lo bias-corrected)."""
+    ts = ts.astype(jnp.int64)
+    hi = (ts >> 32).astype(jnp.int32)
+    lo = ((ts & 0xFFFFFFFF) - (1 << 31)).astype(jnp.int32)
+    return hi, lo
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
+                             l_sid=None, r_sid=None,
+                             interpret: bool = False):
+    """skipNulls float path of ``asof_merge_values`` as one Pallas
+    kernel; same contract: ``(vals [C, K, Ll], found, last_row_idx)``.
+    REQUIRES both ts arrays ascending per row (packed-layout invariant).
+
+    ``l_sid``/``r_sid`` ([K, L] int32, non-decreasing per row) engage
+    the *bin-packed* form: each lane row holds several series
+    back-to-back (the skew/NBBO layout, packing.py:bin_pack_series —
+    the TPU answer to the reference's tsPartitionVal skew machinery,
+    tsdf.py:164-190).  The series id becomes the leading merge key and
+    fences the forward fill, so co-packed series join independently;
+    ``last_row_idx`` stays a within-lane-row position (callers convert
+    with the per-series offsets they packed with).  REQUIRES the same
+    series to occupy the same lane row on both sides.
+    """
+    C = int(r_values.shape[0])
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[-1]
+    segmented = l_sid is not None
+
+    # pad keys are i32-max so pads sort after every real row
+    Lrp, Lc2, Llp = _pad_plan(Ll, Lr)
+
+    hi_l, lo_l = _split_ts(l_ts)
+    hi_r, lo_r = _split_ts(r_ts)
+    imax = jnp.int32(2**31 - 1)
+
+    def padl(p, n, fill):
+        return jnp.pad(p, ((0, 0), (0, n)), constant_values=fill)
+
+    hi_l = padl(hi_l, Llp - Ll, imax)
+    lo_l = padl(lo_l, Llp - Ll, imax)
+    hi_r = padl(hi_r, Lrp - Lr, imax)
+    lo_r = padl(lo_r, Lrp - Lr, imax)
+    sec_l = _SIDE + _lane((K, Llp))
+    sec_r = _lane((K, Lrp))
+
+    rev = lambda p: jnp.flip(p, axis=-1)
+    keys = []
+    if segmented:
+        sid_l = padl(l_sid.astype(jnp.int32), Llp - Ll, imax)
+        sid_r = padl(r_sid.astype(jnp.int32), Lrp - Lr, imax)
+        keys.append(jnp.concatenate([sid_l, rev(sid_r)], axis=-1))
+    keys.append(jnp.concatenate([hi_l, rev(hi_r)], axis=-1))
+    keys.append(jnp.concatenate([lo_l, rev(lo_r)], axis=-1))
+    keys.append(jnp.concatenate([sec_l, rev(sec_r)], axis=-1))
+
+    nanl = jnp.full((K, Llp), jnp.nan, jnp.float32)
+    payload = []
+    for c in range(C):
+        v = jnp.where(r_valids[c], r_values[c].astype(jnp.float32),
+                      jnp.nan)
+        payload.append(
+            jnp.concatenate([nanl, rev(padl(v, Lrp - Lr, jnp.nan))],
+                            axis=-1)
+        )
+    ridx = jnp.broadcast_to(
+        jnp.arange(Lr, dtype=jnp.float32), (K, Lr)
+    )
+    payload.append(
+        jnp.concatenate([nanl, rev(padl(ridx, Lrp - Lr, jnp.nan))],
+                        axis=-1)
+    )
+
+    out = _merge_call(tuple(keys), tuple(payload), n_payload=C + 1,
+                      Lc2=Lc2, Llp=Llp, interpret=interpret)
+    vals = (jnp.stack([o[:, :Ll] for o in out[:C]]) if C
+            else jnp.zeros((0, K, Ll), jnp.float32))
+    found = ~jnp.isnan(vals)
+    idx_f = out[C][:, :Ll]
+    idx = jnp.where(jnp.isnan(idx_f), -1, idx_f).astype(jnp.int32)
+    return vals, found, idx
+
+
+def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
+                         skip_nulls: bool,
+                         segmented: bool = False) -> bool:
+    """Gate for the Pallas path: reference-default join shape
+    (skipNulls, no sequence tie-break), f32 values, TPU backend, and a
+    feasible VMEM plan.
+
+    NaN semantics: the kernel NaN-encodes validity, so a slot that is
+    marked valid but holds NaN is treated as null.  That is the
+    framework's packing invariant (pandas ingest maps float NaN to
+    null before values reach any kernel — frame.py:numeric_flat,
+    dist.py packing), so no public-API caller can observe the
+    difference; direct kernel callers must honour it.
+    """
+    import os
+
+    env = os.environ.get("TEMPO_TPU_PALLAS_ASOF")
+    if env is not None and env in ("0", "false", "no"):
+        return False
+    if not skip_nulls or l_seq is not None or r_seq is not None:
+        return False
+    if r_values.dtype != jnp.float32:
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[-1]
+    _, Lc2, _ = _pad_plan(Ll, Lr)
+    C = int(r_values.shape[0])
+    return _plan_merge(K, Lc2, C + 1, 4 if segmented else 3) is not None

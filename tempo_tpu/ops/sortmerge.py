@@ -130,7 +130,19 @@ def asof_merge_values(
     leaner NaN-encoded variant — the axon remote compiler hung >30 min
     on the fused pipeline built that way, measured 2026-07-30, so it is
     off by default) takes effect per call, not per first-trace.
+
+    On TPU the reference-default shape of the join (skipNulls, no
+    sequence tie-break, f32 values) runs as ONE Pallas kernel — bitonic
+    *merge* network + ffill ladder + routing sort, all VMEM-resident
+    (``ops/pallas_merge.py``) — measured 7.5x this module's lax.sort
+    form at [1024, 8192]: the sort ladders pay an HBM round-trip per
+    compare-exchange stage, the kernel touches HBM twice total.
     """
+    from tempo_tpu.ops import pallas_merge as pm
+
+    if pm.merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
+                               skip_nulls):
+        return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values)
     if skip_nulls and jnp.issubdtype(r_values.dtype, jnp.floating) \
             and _nan_encoding_enabled():
         return _asof_merge_nan_encoded(l_ts, r_ts, r_valids, r_values,
@@ -167,8 +179,16 @@ def _merge_sides(l_ts, r_ts, l_seq, r_seq):
 
 @functools.partial(jax.jit, static_argnames=("skip_nulls",))
 def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
-                         r_seq=None, skip_nulls=True):
-    """Default form: validity rides as explicit bool planes."""
+                         r_seq=None, skip_nulls=True,
+                         l_sid=None, r_sid=None):
+    """Default form: validity rides as explicit bool planes.  With
+    ``l_sid``/``r_sid`` (bin-packed rows) the series id leads the sort
+    keys and the fill is fenced at series boundaries (skipNulls only).
+    """
+    if l_sid is not None and not skip_nulls:
+        raise NotImplementedError(
+            "bin-packed rows support skipNulls=True only"
+        )
     C = int(r_values.shape[0])
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]
@@ -176,6 +196,11 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
     vdt = r_values.dtype
 
     keys, is_left = _merge_sides(l_ts, r_ts, l_seq, r_seq)
+    if l_sid is not None:
+        sid = jnp.concatenate(
+            [l_sid.astype(jnp.int32), r_sid.astype(jnp.int32)], axis=-1
+        )
+        keys = [sid] + keys
 
     ridx = jnp.concatenate(
         [
@@ -217,7 +242,17 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             [jnp.where(vplanes_s, planes_s, 0.0),
              ridx_s[None].astype(vdt)], axis=0
         )
-        has_f, val_f = _ffill_scan(has, val)
+        if l_sid is not None:
+            sid_s = sorted_ops[0]
+            head = jnp.concatenate(
+                [jnp.ones((K, 1), jnp.bool_),
+                 sid_s[:, 1:] != sid_s[:, :-1]], axis=-1
+            )
+            _, has_f, val_f = _ffill_scan_seg(
+                jnp.broadcast_to(head, has.shape), has, val
+            )
+        else:
+            has_f, val_f = _ffill_scan(has, val)
         vals_sorted = val_f[:C]
         found_sorted = has_f[:C]
         idx_sorted = jnp.where(has_f[C], val_f[C].astype(jnp.int32), -1)
@@ -250,6 +285,44 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
         if C else jnp.zeros((0, K, Ll), jnp.bool_)
     vals_l = jnp.where(found_l, vals_l, jnp.nan)
     return vals_l, found_l, idx_l
+
+
+def asof_merge_values_binpacked(l_ts, r_ts, r_valids, r_values,
+                                l_sid, r_sid):
+    """AS-OF join over *bin-packed* rows: each [K, L] lane row holds
+    several series back-to-back, identified by the non-decreasing
+    ``sid`` planes (packing.py:bin_pack_series).  skipNulls semantics
+    per column, right rows winning full ties — the same contract as
+    :func:`asof_merge_values`, with ``last_row_idx`` a within-lane-row
+    position.  The TPU answer to Zipf-skewed key distributions (the
+    reference's tsPartitionVal machinery, tsdf.py:164-190): instead of
+    padding every series to the longest (96% padding on NBBO-shaped
+    data, round-2 verdict), short series share lane rows at ~full
+    occupancy and one compiled program serves every skew shape.
+    """
+    from tempo_tpu.ops import pallas_merge as pm
+
+    if pm.merge_join_supported(l_ts, r_ts, r_values, None, None, True,
+                               segmented=True):
+        return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids,
+                                           r_values, l_sid, r_sid)
+    return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
+                                l_sid=l_sid, r_sid=r_sid)
+
+
+def _ffill_scan_seg(f, has, val, axis: int = -1):
+    """Segmented last-valid carry (Blelloch segmented-scan monoid):
+    ``f`` flags segment heads; fills never cross a head."""
+
+    def combine(a, b):
+        fa, ha, va = a
+        fb, hb, vb = b
+        h = jnp.where(fb, hb, ha | hb)
+        v = jnp.where(fb, vb, jnp.where(hb, vb, va))
+        return fa | fb, h, v
+
+    return jax.lax.associative_scan(combine, (f, has, val),
+                                    axis=axis % has.ndim)
 
 
 @jax.jit
@@ -386,9 +459,14 @@ def range_stats_shifted(
     frame is a union of static shifts, and each aggregate is a masked
     accumulation over those shifts: O(W·KL) elementwise work, no
     searchsorted, no prefix-sum boundary gathers, no RMQ tables.  Sums
-    accumulate mean-centred per series (f32-safe).  Bounds too small
-    silently truncate frames, exactly like the sparse-table
-    ``max_window`` cap — callers must derive them from real data.
+    accumulate mean-centred per series (f32-safe).
+
+    Bounds too small TRUNCATE frames; the returned ``clipped`` entry
+    ([K, 1] per-series count of rows whose true frame extends past
+    ``max_behind``/``max_ahead``) audits exactly that — the same
+    contract as the halo layer's clipped counts (parallel/halo.py).
+    Callers derive bounds from real data and assert the audit is zero
+    (frame layer: deferred collect-time audit; bench.py: hard assert).
     """
     dt = x.dtype
     xz = jnp.where(valid, x, 0.0)
@@ -425,6 +503,28 @@ def range_stats_shifted(
     )
     std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, 0.0)), jnp.nan)
     zscore = (x - mean) / std
+
+    # truncation audit: a row is clipped when the first row beyond
+    # either static bound still falls inside its frame's key range and
+    # either end of that extension is a valid row.  Requiring only the
+    # *beyond* row valid would undercount when a null row sits exactly
+    # at the boundary with valid rows behind it; requiring neither
+    # would count all-pad tie runs (pads share one clamped key, so a
+    # pad "extends ahead" into its neighbour pad).  Real-row false
+    # positives from pads are impossible: pad keys sit >= window above
+    # any real key (TS_PAD / INT32_MAX headroom), so real rows fail
+    # ``sj >= lo`` against them and pads ahead fail ``sj <= secs``.
+    # Shifts are clamped to the row length (a bound >= L has nothing
+    # beyond it — shifting further is all-fill, and _shift_back cannot
+    # represent |j| > L).
+    L = secs.shape[-1]
+    clipped = jnp.zeros_like(x, jnp.bool_)
+    for j in (min(max_behind + 1, L), -min(max_ahead + 1, L)):
+        sj = _shift_back(secs, j, big)
+        clipped = clipped | (
+            (sj >= lo) & (sj <= secs)
+            & (valid | _shift_back(valid, j, False))
+        )
     return {
         "mean": mean,
         "count": cnt,
@@ -433,6 +533,7 @@ def range_stats_shifted(
         "sum": jnp.where(cnt > 0, total, jnp.nan),
         "stddev": std,
         "zscore": jnp.where(valid, zscore, jnp.nan),
+        "clipped": jnp.sum(clipped, axis=-1, keepdims=True).astype(dt),
     }
 
 
