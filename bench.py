@@ -442,10 +442,8 @@ def bench_nbbo(seed=1):
         pkg.binpack_rows(q_vals[c], lengths, bp.row, bp.r_off, K2, L, 0.0)
         for c in range(2)
     ])
-    qm2 = np.stack([
-        pkg.binpack_rows(mask, lengths, bp.row, bp.r_off, K2, L, False)
-        for _ in range(2)
-    ])
+    m2 = pkg.binpack_rows(mask, lengths, bp.row, bp.r_off, K2, L, False)
+    qm2 = np.stack([m2, m2])
     occupancy = 2 * n_rows / (K2 * 2 * L)
 
     def body(scale, l_ts, r_ts, r_valids, r_values, lsid, rsid):
@@ -505,43 +503,14 @@ def bench_skew_1b(t_iter_fused, overlap=1.5):
     return total_rows / (n_iter * t_iter_fused)
 
 
-def bench_pandas(data):
-    import pandas as pd
+def bench_cpu_denominator(data):
+    """Strongest available CPU oracle for the same op set
+    (bench_baseline.py: pandas + hand-vectorised numpy/scipy; best-of-3
+    each, numpy output asserted against pandas).  Returns
+    (name, rows/sec, all rates)."""
+    import bench_baseline
 
-    l_ts, l_secs, x, valid, r_ts, r_valids, r_values = data
-    sub = 32
-    ks = np.repeat(np.arange(sub), L)
-    left = pd.DataFrame({
-        "key": ks,
-        "ts": pd.to_datetime(l_ts[:sub].ravel()),
-        "x": x[:sub].ravel().astype(np.float64),
-    })
-    rv = [np.where(r_valids[c, :sub], r_values[c, :sub], np.nan).ravel()
-          for c in range(N_RIGHT_COLS)]
-    right = pd.DataFrame({
-        "key": ks,
-        "ts": pd.to_datetime(r_ts[:sub].ravel()),
-        **{f"v{c}": rv[c] for c in range(N_RIGHT_COLS)},
-    })
-    left = left.sort_values(["ts", "key"], kind="stable")
-    right = right.sort_values(["ts", "key"], kind="stable")
-
-    # best of 3: the denominator must reflect pandas, not whatever else
-    # the host happened to be running (observed 5x swings under load)
-    best = float("inf")
-    for _rep in range(3):
-        t0 = time.perf_counter()
-        joined = pd.merge_asof(left, right, on="ts", by="key")
-        g = joined.sort_values(["key", "ts"]).set_index("ts") \
-            .groupby("key")["x"]
-        roll = g.rolling("10s")
-        _ = roll.mean()
-        _ = roll.std()
-        _ = joined.groupby("key")["x"].transform(
-            lambda s: s.ewm(alpha=0.2).mean()
-        )
-        best = min(best, time.perf_counter() - t0)
-    return (sub * L) / best
+    return bench_baseline.strongest(data)
 
 
 def _attempt(label, fn):
@@ -573,7 +542,7 @@ def main():
 
     data = make_data()
     # host-only denominator first: immune to device-worker state
-    cpu_rows_sec = bench_pandas(data)
+    cpu_name, cpu_rows_sec, cpu_rates = bench_cpu_denominator(data)
 
     fused = _attempt("fused", lambda: bench_fused(data))
     if fused is None:
@@ -622,7 +591,9 @@ def main():
             "5_skew_1b_bracketed": round(skew_rs),
         },
         "nbbo_slot_occupancy": (round(nbbo[2], 3) if nbbo else None),
-        "denominator": "pandas single-core (pyspark absent; see BASELINE.md)",
+        "denominator": f"{cpu_name} (strongest of "
+                       f"{ {k: round(v) for k, v in cpu_rates.items()} }; "
+                       f"pyspark absent, 1 cpu in image — BASELINE.md)",
     }))
 
 

@@ -48,3 +48,31 @@ def test_bench_smoke_prints_one_json_line():
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)
+    # occupancy of the bin-packed NBBO config must be reported
+    assert rec["nbbo_slot_occupancy"] and rec["nbbo_slot_occupancy"] > 0.5
+    # the denominator must name the winning oracle (strongest-of)
+    assert "strongest of" in rec["denominator"]
+
+
+def test_bench_baseline_oracles_agree_and_report():
+    """bench_baseline measures every CPU oracle, asserts numpy==pandas,
+    and the strongest is at least as fast as pandas."""
+    import bench_baseline
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    K, L, C = 4, 256, 2
+    gaps = rng.integers(1, 3, size=(K, L)).astype(np.int64)
+    l_secs = np.cumsum(gaps, axis=-1)
+    l_ts = l_secs * np.int64(1_000_000_000)
+    r_ts = np.cumsum(rng.integers(1, 3, size=(K, L)).astype(np.int64),
+                     axis=-1) * np.int64(1_000_000_000)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = np.ones((K, L), bool)
+    r_values = rng.standard_normal((C, K, L)).astype(np.float32)
+    r_valids = rng.random((C, K, L)) > 0.1
+    data = (l_ts, l_secs, x, valid, r_ts, r_valids, r_values)
+
+    name, rate, rates = bench_baseline.strongest(data, sub=K)
+    assert set(rates) == {"pandas", "numpy_vectorized"}
+    assert rate == max(rates.values()) > 0
