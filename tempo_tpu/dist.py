@@ -417,7 +417,8 @@ class DistributedTSDF:
                  fraction: float = 0.5,
                  skipNulls: bool = True,
                  sql_join_opt: bool = False,
-                 suppress_null_warning: bool = False) -> "DistributedTSDF":
+                 suppress_null_warning: bool = False,
+                 maxLookback: int = 0) -> "DistributedTSDF":
         """Distributed AS-OF join.  The right frame is aligned to the
         left's series-id space with one device gather (the
         co-partitioning shuffle analog), then joined shard-locally with
@@ -431,8 +432,11 @@ class DistributedTSDF:
         Sequence-number tie-break runs device-resident when the RIGHT
         frame was built with a ``sequence_col`` — only the right's
         sequence orders the merge, mirroring the reference (left rows
-        carry NULL in it and sort first on ties, tsdf.py:117-121);
-        ``maxLookback`` remains host-path-only (``TSDF.asofJoin``).
+        carry NULL in it and sort first on ties, tsdf.py:117-121).
+        ``maxLookback`` > 0 caps the fill at the trailing maxLookback+1
+        merged (left+right) rows, Scala's rowsBetween window on the
+        union stream (asofJoin.scala:64-88), computed device-side via
+        the windowed argmax ladder.
 
         ``tsPartitionVal``/``fraction``/``sql_join_opt`` are accepted
         for migration compatibility and ignored: they tune Spark's skew
@@ -528,6 +532,7 @@ class DistributedTSDF:
         # row (packed as -inf, from_tsdf) ties on seq and wins via
         # rec_ind — visible to the tied left rows.  The left frame's own
         # sequence never orders the merge.
+        ml = int(maxLookback or 0)
         has_seq = right.seq is not None
         if has_seq:
             # left rows ride the kernel-synthesized seq fill
@@ -538,11 +543,12 @@ class DistributedTSDF:
             r_seq_al = align2(right.seq, perm, ok, np.inf)
             if self.n_time > 1:
                 vals, found = _asof_a2a_seq(self.mesh, self.series_axis,
-                                            self.time_axis)(
+                                            self.time_axis, ml)(
                     self.ts, r_ts_al, r_seq_al, vstack, pstack
                 )
             else:
-                vals, found = _asof_local_seq(self.mesh, self.series_axis)(
+                vals, found = _asof_local_seq(self.mesh, self.series_axis,
+                                              ml)(
                     self.ts, r_ts_al, r_seq_al, vstack, pstack
                 )
         elif self.n_time > 1:
@@ -551,12 +557,12 @@ class DistributedTSDF:
             # with one all_to_all each way (reshard.py pattern), joins
             # exactly, and switches back — no halo approximation
             vals, found = _asof_a2a(self.mesh, self.series_axis,
-                                    self.time_axis, sort_kernels)(
+                                    self.time_axis, sort_kernels, ml)(
                 self.ts, r_ts_al, vstack, pstack
             )
         else:
             vals, found = _asof_local(self.mesh, self.series_axis,
-                                      sort_kernels)(
+                                      sort_kernels, ml)(
                 self.ts, r_ts_al, vstack, pstack
             )
         audits = list(self.audits)
@@ -653,6 +659,34 @@ class DistributedTSDF:
         return self._with(ts=new_ts, mask=head, cols=new_cols,
                           resampled=True, seq=None, seq_col="",
                           resample_freq=freq)
+
+    def calc_bars(self, freq: str, func=None, metricCols=None,
+                  fill=None) -> "DistributedTSDF":
+        """OHLC bars (tsdf.py:813-826) device-resident.  The reference
+        runs four resamples and joins them on key+ts; here the four
+        resample results land on identical bucket grids (bucket heads
+        depend only on ts and freq), so their columns combine by name
+        with no join.  Each resample still runs its own kernel — on a
+        time-sharded mesh that is four a2a round-trips where a fused
+        four-aggregate kernel would need one; fuse if bars become hot."""
+        if fill:
+            raise NotImplementedError(
+                "calc_bars(fill=True) is host-path-only; call "
+                "collect() and use TSDF.calc_bars"
+            )
+        mc = metricCols or self.numeric_columns()
+        new_cols: Dict[str, DistCol] = {}
+        base = None
+        for prefix, f in (("open", "floor"), ("low", "min"),
+                          ("high", "max"), ("close", "ceil")):
+            r = self.resample(freq, f, metricCols=mc)
+            base = r
+            for c in mc:
+                new_cols[f"{prefix}_{c}"] = r.cols[c]
+        # host column order parity: prefixed metrics sorted by name
+        # (resample.py:calc_bars sorts the non-partition columns)
+        new_cols = {c: new_cols[c] for c in sorted(new_cols)}
+        return base._with(cols=new_cols)
 
     # ------------------------------------------------------------------
     # withGroupedStats (tsdf.py:723-759) / vwap (TSDF.scala:378-401)
@@ -1197,29 +1231,41 @@ def _ema_local(mesh, series_axis, alpha, exact, window):
                              out_specs=sp))
 
 
-def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels):
+def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
+                 max_lookback=0):
     """Per-plane AS-OF fill: on TPU the sort-and-scan join (no gathers,
-    ops/sortmerge.py timings); elsewhere searchsorted + index gathers."""
+    ops/sortmerge.py timings); elsewhere searchsorted + index gathers.
+    ``max_lookback`` > 0 caps the merged-stream fill (Scala
+    asofJoin.scala:64-88)."""
     from tempo_tpu.ops import sortmerge as sm
 
     if sort_kernels:
-        vals, found, _ = sm.asof_merge_values(l_ts, r_ts, r_valids, r_values)
+        vals, found, _ = sm.asof_merge_values(
+            l_ts, r_ts, r_valids, r_values, max_lookback=max_lookback
+        )
         return vals, found
-    _, col_idx = asof_ops.asof_indices_searchsorted(
-        l_ts, r_ts, r_valids, n_cols=int(r_values.shape[0])
-    )
+    if max_lookback:
+        _, col_idx = asof_ops.asof_indices_merge(
+            l_ts, None, r_ts, None, r_valids,
+            n_cols=int(r_values.shape[0]), max_lookback=int(max_lookback),
+        )
+    else:
+        _, col_idx = asof_ops.asof_indices_searchsorted(
+            l_ts, r_ts, r_valids, n_cols=int(r_values.shape[0])
+        )
     found = col_idx >= 0
     vals = jnp.take_along_axis(r_values, jnp.maximum(col_idx, 0), axis=-1)
     return jnp.where(found, vals, jnp.nan), found
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_local(mesh, series_axis, sort_kernels=False):
+def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0):
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
     def kernel(l_ts, r_ts, r_valids, r_values):
-        return _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels)
+        return _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
+                            max_lookback)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp3, sp3),
@@ -1227,7 +1273,7 @@ def _asof_local(mesh, series_axis, sort_kernels=False):
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_local_seq(mesh, series_axis):
+def _asof_local_seq(mesh, series_axis, max_lookback=0):
     """AS-OF with sequence tie-break: the merge join is the only exact
     form (reference union-sort semantics, tsdf.py:117-121), so it runs
     on every backend."""
@@ -1238,7 +1284,8 @@ def _asof_local_seq(mesh, series_axis):
 
     def kernel(l_ts, r_ts, r_seq, r_valids, r_values):
         vals, found, _ = sm.asof_merge_values(
-            l_ts, r_ts, r_valids, r_values, r_seq=r_seq
+            l_ts, r_ts, r_valids, r_values, r_seq=r_seq,
+            max_lookback=max_lookback,
         )
         return vals, found
 
@@ -1248,7 +1295,7 @@ def _asof_local_seq(mesh, series_axis):
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_a2a_seq(mesh, series_axis, time_axis):
+def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0):
     from tempo_tpu.ops import sortmerge as sm
 
     sp2 = _spec(mesh, series_axis, time_axis)
@@ -1263,7 +1310,7 @@ def _asof_a2a_seq(mesh, series_axis, time_axis):
             tiled=True)
         vals, found, _ = sm.asof_merge_values(
             fwd(l_ts), fwd(r_ts), fwd(r_valids), fwd(r_values),
-            r_seq=fwd(r_seq),
+            r_seq=fwd(r_seq), max_lookback=max_lookback,
         )
         return rev(vals), rev(found)
 
@@ -1273,7 +1320,8 @@ def _asof_a2a_seq(mesh, series_axis, time_axis):
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False):
+def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
+              max_lookback=0):
     """Exact AS-OF join on a time-sharded mesh: switch both sides to a
     series-local layout (full rows per device, one ``all_to_all`` per
     array), join locally, switch the [n_cols, K, Ll] results back."""
@@ -1290,7 +1338,7 @@ def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False):
         l_full, r_full = fwd(l_ts), fwd(r_ts)
         rv_full, rx_full = fwd(r_valids), fwd(r_values)
         vals, found = _asof_planes(l_full, r_full, rv_full, rx_full,
-                                   sort_kernels)
+                                   sort_kernels, max_lookback)
         return rev(vals), rev(found)
 
     return jax.jit(shard_map(kernel, mesh=mesh,

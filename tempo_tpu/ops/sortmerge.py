@@ -110,6 +110,7 @@ def asof_merge_values(
     l_seq: Optional[jnp.ndarray] = None,   # [K, Ll] sortable seq key
     r_seq: Optional[jnp.ndarray] = None,   # [K, Lr]
     skip_nulls: bool = True,
+    max_lookback: int = 0,        # merged-stream row cap; 0 = unbounded
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """AS-OF join returning values directly: ``(vals [C, K, Ll],
     found [C, K, Ll], last_row_idx [K, Ll])``.
@@ -140,15 +141,17 @@ def asof_merge_values(
     """
     from tempo_tpu.ops import pallas_merge as pm
 
-    if pm.merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
-                               skip_nulls):
+    if not max_lookback and pm.merge_join_supported(
+            l_ts, r_ts, r_values, l_seq, r_seq, skip_nulls):
         return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values)
-    if skip_nulls and jnp.issubdtype(r_values.dtype, jnp.floating) \
+    if not max_lookback and skip_nulls \
+            and jnp.issubdtype(r_values.dtype, jnp.floating) \
             and _nan_encoding_enabled():
         return _asof_merge_nan_encoded(l_ts, r_ts, r_valids, r_values,
                                        l_seq, r_seq)
     return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
-                                l_seq, r_seq, skip_nulls=skip_nulls)
+                                l_seq, r_seq, skip_nulls=skip_nulls,
+                                max_lookback=int(max_lookback))
 
 
 def _merge_sides(l_ts, r_ts, l_seq, r_seq):
@@ -177,17 +180,25 @@ def _merge_sides(l_ts, r_ts, l_seq, r_seq):
     return keys, is_left
 
 
-@functools.partial(jax.jit, static_argnames=("skip_nulls",))
+@functools.partial(jax.jit,
+                   static_argnames=("skip_nulls", "max_lookback"))
 def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
                          r_seq=None, skip_nulls=True,
-                         l_sid=None, r_sid=None):
+                         l_sid=None, r_sid=None, max_lookback=0):
     """Default form: validity rides as explicit bool planes.  With
     ``l_sid``/``r_sid`` (bin-packed rows) the series id leads the sort
     keys and the fill is fenced at series boundaries (skipNulls only).
+    ``max_lookback`` > 0 caps the fill at the trailing ``max_lookback``
+    + 1 merged rows (Scala's rowsBetween(-maxLookback, 0) on the
+    union stream, asofJoin.scala:64-88) via the windowed argmax ladder.
     """
     if l_sid is not None and not skip_nulls:
         raise NotImplementedError(
             "bin-packed rows support skipNulls=True only"
+        )
+    if max_lookback and l_sid is not None:
+        raise NotImplementedError(
+            "maxLookback on bin-packed rows is not supported"
         )
     C = int(r_values.shape[0])
     K, Ll = l_ts.shape
@@ -251,6 +262,12 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             _, has_f, val_f = _ffill_scan_seg(
                 jnp.broadcast_to(head, has.shape), has, val
             )
+        elif max_lookback:
+            from tempo_tpu.ops import window_utils as wu
+
+            val_f, has_f = wu.windowed_last_valid(
+                has, val, max_lookback + 1
+            )
         else:
             has_f, val_f = _ffill_scan(has, val)
         vals_sorted = val_f[:C]
@@ -264,7 +281,14 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             [planes_s, vplanes_s.astype(vdt), ridx_s[None].astype(vdt)],
             axis=0,
         )
-        has_f, val_f = _ffill_scan(has, val)
+        if max_lookback:
+            from tempo_tpu.ops import window_utils as wu
+
+            val_f, has_f = wu.windowed_last_valid(
+                has, val, max_lookback + 1
+            )
+        else:
+            has_f, val_f = _ffill_scan(has, val)
         vals_sorted = val_f[:C]
         found_sorted = has_f[:C] & (val_f[C: 2 * C] > 0.5)
         idx_sorted = jnp.where(has_f[2 * C], val_f[2 * C].astype(jnp.int32),

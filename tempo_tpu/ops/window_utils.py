@@ -101,6 +101,10 @@ def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
     """
     if window <= 0:
         raise ValueError("window must be >= 1")
+    # a window covering the whole axis equals the axis length (and
+    # _shift_right cannot represent longer shifts): callers may pass
+    # caps larger than the data (asofJoin maxLookback)
+    window = min(int(window), int(x.shape[-1]))
     neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
     # doubling table: level k covers 2^k trailing elements
     levels = [x]
@@ -116,6 +120,49 @@ def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
     half = 1 << (k - 1)
     lo = levels[k - 1]
     return jnp.maximum(lo, _shift_right(lo, window - half, neg))
+
+
+def windowed_last_valid(has: jnp.ndarray, val: jnp.ndarray, window: int):
+    """(value at the last ``has``-True position within the trailing
+    ``window`` elements inclusive, found flag) per position.
+
+    The bounded-lookback sibling of the unbounded forward-fill scan:
+    the same log-doubling construction as :func:`windowed_max_last`
+    (argmax is idempotent, so two overlapping power-of-two spans
+    combine exactly) carrying the value as an argmax payload.  This is
+    the engine of Scala's ``maxLookback`` rowsBetween(-W+1, 0) merged-
+    stream cap (scala asofJoin.scala:64-88) in packed form.
+    """
+    if window <= 0:
+        raise ValueError("window must be >= 1")
+    # a window covering the whole axis is equivalent to the axis length
+    # (and _shift_right cannot represent longer shifts)
+    window = min(int(window), int(has.shape[-1]))
+    lane = jnp.broadcast_to(
+        jnp.arange(has.shape[-1], dtype=jnp.int32), has.shape
+    )
+    pos = jnp.where(has, lane, -1)
+
+    def combine(p, v, ps, vs):
+        take = ps > p
+        return jnp.where(take, ps, p), jnp.where(take, vs, v)
+
+    levels = [(pos, val)]
+    span = 1
+    while span < window:
+        p, v = levels[-1]
+        levels.append(combine(p, v, _shift_right(p, span, -1),
+                              _shift_right(v, span, jnp.zeros((), v.dtype))))
+        span *= 2
+    p, v = levels[-1]
+    if span != window:
+        k = len(levels) - 1
+        half = 1 << (k - 1)
+        p, v = levels[k - 1]
+        p, v = combine(p, v, _shift_right(p, window - half, -1),
+                       _shift_right(v, window - half,
+                                    jnp.zeros((), v.dtype)))
+    return v, p >= 0
 
 
 def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: str = "left") -> jnp.ndarray:

@@ -91,6 +91,45 @@ class TestDistributedOps:
         assert (ts_h.isna() == ts_g.isna()).all()
         assert (ts_h.dropna().to_numpy() == ts_g.dropna().to_numpy()).all()
 
+    def test_asof_join_max_lookback(self, frames, axes, ta):
+        """Scala's maxLookback merged-stream row cap (asofJoin.scala:
+        64-88), device-side (VERDICT r2 item 5) — host path oracle."""
+        l, r = frames
+        for ml in (1, 3):
+            host = _sorted(l.asofJoin(r, maxLookback=ml).df)
+            mesh = make_mesh(axes)
+            got = _sorted(
+                l.on_mesh(mesh, time_axis=ta)
+                .asofJoin(r.on_mesh(mesh, time_axis=ta), maxLookback=ml)
+                .collect().df
+            )
+            for c in ("right_bid", "right_ask"):
+                np.testing.assert_allclose(
+                    got[c].to_numpy(float), host[c].to_numpy(float),
+                    rtol=1e-6, atol=1e-9, equal_nan=True,
+                    err_msg=f"{c} ml={ml}",
+                )
+            ts_h, ts_g = host["right_event_ts"], got["right_event_ts"]
+            assert (ts_h.isna() == ts_g.isna()).all(), f"ml={ml}"
+            assert (ts_h.dropna().to_numpy()
+                    == ts_g.dropna().to_numpy()).all(), f"ml={ml}"
+
+    def test_calc_bars(self, frames, axes, ta):
+        """OHLC bars on the mesh (VERDICT r2 item 5) vs host oracle."""
+        l, _ = frames
+        host = _sorted(l.calc_bars("5 minutes", metricCols=["price"]).df)
+        mesh = make_mesh(axes)
+        got = _sorted(
+            l.on_mesh(mesh, time_axis=ta)
+            .calc_bars("5 minutes", metricCols=["price"]).collect().df
+        )
+        assert len(got) == len(host)
+        for c in ("open_price", "low_price", "high_price", "close_price"):
+            np.testing.assert_allclose(
+                got[c].to_numpy(float), host[c].to_numpy(float),
+                rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=c,
+            )
+
     def test_asof_join_keep_nulls(self, frames, axes, ta):
         l, r = frames
         host = _sorted(l.asofJoin(r, skipNulls=False).df)

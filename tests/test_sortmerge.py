@@ -225,6 +225,63 @@ def test_range_stats_shifted_clipped_audit():
     assert float(np.asarray(pad["clipped"]).sum()) == 0
 
 
+def test_asof_merge_values_max_lookback():
+    """Values-path maxLookback vs the index-path oracle
+    (asof_indices_merge, itself pinned by the host golden tests)."""
+    from tempo_tpu.ops import asof as asof_ops
+    from tempo_tpu.packing import TS_PAD
+
+    rng = np.random.default_rng(2)
+    K, Ll, Lr, C = 5, 64, 48, 2
+    l_ts = np.sort(rng.integers(0, 40, (K, Ll)), axis=-1) * 10**9
+    r_ts = np.sort(rng.integers(0, 40, (K, Lr)), axis=-1) * 10**9
+    l_ts[0, 50:] = TS_PAD
+    r_ts[0, 30:] = TS_PAD
+    r_values = rng.standard_normal((C, K, Lr))
+    r_valids = rng.random((C, K, Lr)) > 0.3
+    r_valids[:, 0, 30:] = False
+    for ml in (1, 2, 7):
+        vals, found, _ = sm.asof_merge_values(
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+            jnp.asarray(r_values), max_lookback=ml,
+        )
+        _, col_idx = asof_ops.asof_indices_merge(
+            jnp.asarray(l_ts), None, jnp.asarray(r_ts), None,
+            jnp.asarray(r_valids), n_cols=C, max_lookback=ml,
+        )
+        idx = np.asarray(col_idx)
+        want_f = idx >= 0
+        want_v = np.where(
+            want_f,
+            np.take_along_axis(r_values, np.maximum(idx, 0), axis=-1),
+            np.nan,
+        )
+        np.testing.assert_array_equal(np.asarray(found), want_f,
+                                      err_msg=f"ml={ml}")
+        np.testing.assert_allclose(np.asarray(vals), want_v,
+                                   equal_nan=True, err_msg=f"ml={ml}")
+
+
+def test_windowed_last_valid_oracle():
+    from tempo_tpu.ops import window_utils as wu
+
+    rng = np.random.default_rng(1)
+    K, L = 4, 70
+    has = rng.random((K, L)) > 0.4
+    val = rng.standard_normal((K, L))
+    for W in (1, 3, 8, 70, 200):
+        v, f = wu.windowed_last_valid(jnp.asarray(has), jnp.asarray(val),
+                                      W)
+        v, f = np.asarray(v), np.asarray(f)
+        for k in range(K):
+            for i in range(L):
+                lo = max(0, i - min(W, L) + 1)
+                js = [j for j in range(lo, i + 1) if has[k, j]]
+                assert f[k, i] == bool(js), (W, k, i)
+                if js:
+                    assert v[k, i] == val[k, js[-1]], (W, k, i)
+
+
 def test_searchsorted_batched_sort_dispatch():
     """With TEMPO_TPU_SORT_KERNELS=1 the shared wrapper runs merge_rank
     and must agree with the binary-search form."""
