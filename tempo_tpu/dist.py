@@ -468,6 +468,7 @@ class DistributedTSDF:
         h_names = [c for c in right.host_cols
                    if right._source_df is not None]
         r_ts_al = align2(right.ts, perm, ok, packing.TS_PAD)
+        r_mask_al = align2(right.mask, perm, ok, False)
 
         dt = packing.compute_dtype()
         sharding_r = right._sharding(2)
@@ -533,6 +534,17 @@ class DistributedTSDF:
         # rec_ind — visible to the tied left rows.  The left frame's own
         # sequence never orders the merge.
         ml = int(maxLookback or 0)
+        if ml and self.resampled:
+            raise NotImplementedError(
+                "maxLookback with a resampled (bucket-head) LEFT frame "
+                "is not supported on the mesh: masked lane rows would "
+                "consume merged-stream window slots; collect() and use "
+                "the host TSDF.asofJoin"
+            )
+        # a resampled RIGHT frame keeps real-looking ts at masked lane
+        # rows; maxLookback must count real rows only, so those lanes
+        # are sort-compacted to the tail inside the kernel
+        compact = bool(ml and right.resampled)
         has_seq = right.seq is not None
         if has_seq:
             # left rows ride the kernel-synthesized seq fill
@@ -557,13 +569,14 @@ class DistributedTSDF:
             # with one all_to_all each way (reshard.py pattern), joins
             # exactly, and switches back — no halo approximation
             vals, found = _asof_a2a(self.mesh, self.series_axis,
-                                    self.time_axis, sort_kernels, ml)(
-                self.ts, r_ts_al, vstack, pstack
+                                    self.time_axis, sort_kernels, ml,
+                                    compact)(
+                self.ts, r_ts_al, r_mask_al, vstack, pstack
             )
         else:
             vals, found = _asof_local(self.mesh, self.series_axis,
-                                      sort_kernels, ml)(
-                self.ts, r_ts_al, vstack, pstack
+                                      sort_kernels, ml, compact)(
+                self.ts, r_ts_al, r_mask_al, vstack, pstack
             )
         audits = list(self.audits)
 
@@ -1231,6 +1244,23 @@ def _ema_local(mesh, series_axis, alpha, exact, window):
                              out_specs=sp))
 
 
+def _compact_right_lanes(r_ts, r_mask, vstack, pstack):
+    """Stable per-row sort pushing non-existent (masked-out) right rows
+    to the lane tail as TS_PAD, restoring the ascending packed
+    invariant that bucket-head (resample) views lack.  Needed only when
+    maxLookback counts merged-stream rows: a masked lane row with a
+    real-looking ts would consume a window slot Spark's stream never
+    contains.  One multi-operand lax.sort carrying every plane."""
+    nv, npl = int(vstack.shape[0]), int(pstack.shape[0])
+    key = jnp.where(r_mask, r_ts, packing.TS_PAD)
+    ops = jax.lax.sort(
+        (key,) + tuple(vstack[i] for i in range(nv))
+        + tuple(pstack[i] for i in range(npl)),
+        dimension=-1, num_keys=1, is_stable=True,
+    )
+    return ops[0], jnp.stack(ops[1: 1 + nv]), jnp.stack(ops[1 + nv:])
+
+
 def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
                  max_lookback=0):
     """Per-plane AS-OF fill: on TPU the sort-and-scan join (no gathers,
@@ -1259,16 +1289,21 @@ def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0):
+def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0,
+                compact=False):
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
-    def kernel(l_ts, r_ts, r_valids, r_values):
+    def kernel(l_ts, r_ts, r_mask, r_valids, r_values):
+        if compact:
+            r_ts, r_valids, r_values = _compact_right_lanes(
+                r_ts, r_mask, r_valids, r_values
+            )
         return _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
                             max_lookback)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 
@@ -1321,14 +1356,14 @@ def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0):
 
 @functools.lru_cache(maxsize=256)
 def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
-              max_lookback=0):
+              max_lookback=0, compact=False):
     """Exact AS-OF join on a time-sharded mesh: switch both sides to a
     series-local layout (full rows per device, one ``all_to_all`` per
     array), join locally, switch the [n_cols, K, Ll] results back."""
     sp2 = _spec(mesh, series_axis, time_axis)
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
-    def kernel(l_ts, r_ts, r_valids, r_values):
+    def kernel(l_ts, r_ts, r_mask, r_valids, r_values):
         fwd = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
             tiled=True)
@@ -1337,12 +1372,16 @@ def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
             tiled=True)
         l_full, r_full = fwd(l_ts), fwd(r_ts)
         rv_full, rx_full = fwd(r_valids), fwd(r_values)
+        if compact:
+            r_full, rv_full, rx_full = _compact_right_lanes(
+                r_full, fwd(r_mask), rv_full, rx_full
+            )
         vals, found = _asof_planes(l_full, r_full, rv_full, rx_full,
                                    sort_kernels, max_lookback)
         return rev(vals), rev(found)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 

@@ -242,6 +242,18 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
         jnp.zeros((0, K, Lc), jnp.bool_)
     is_right_s = is_left_s == 0
 
+    def fill(has, val):
+        """Unbounded ffill, or the windowed argmax ladder when the
+        merged-stream row cap is active."""
+        if max_lookback:
+            from tempo_tpu.ops import window_utils as wu
+
+            val_f, has_f = wu.windowed_last_valid(
+                has, val, max_lookback + 1
+            )
+            return has_f, val_f
+        return _ffill_scan(has, val)
+
     # batched forward fill: stack [C+1] problems and scan once.
     # channel C is the last-right-row index (validity = any right row)
     if skip_nulls:
@@ -262,14 +274,8 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             _, has_f, val_f = _ffill_scan_seg(
                 jnp.broadcast_to(head, has.shape), has, val
             )
-        elif max_lookback:
-            from tempo_tpu.ops import window_utils as wu
-
-            val_f, has_f = wu.windowed_last_valid(
-                has, val, max_lookback + 1
-            )
         else:
-            has_f, val_f = _ffill_scan(has, val)
+            has_f, val_f = fill(has, val)
         vals_sorted = val_f[:C]
         found_sorted = has_f[:C]
         idx_sorted = jnp.where(has_f[C], val_f[C].astype(jnp.int32), -1)
@@ -281,14 +287,7 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             [planes_s, vplanes_s.astype(vdt), ridx_s[None].astype(vdt)],
             axis=0,
         )
-        if max_lookback:
-            from tempo_tpu.ops import window_utils as wu
-
-            val_f, has_f = wu.windowed_last_valid(
-                has, val, max_lookback + 1
-            )
-        else:
-            has_f, val_f = _ffill_scan(has, val)
+        has_f, val_f = fill(has, val)
         vals_sorted = val_f[:C]
         found_sorted = has_f[:C] & (val_f[C: 2 * C] > 0.5)
         idx_sorted = jnp.where(has_f[2 * C], val_f[2 * C].astype(jnp.int32),

@@ -1,0 +1,89 @@
+"""Worker for the REAL multi-process multihost test (not collected by
+pytest — spawned by tests/test_multihost.py with a process id).
+
+Each OS process initialises jax.distributed against a localhost
+coordinator, owns half the global device mesh (4 forced CPU devices
+each, 8 global), routes its series slice with process_series_range,
+assembles the global array through the true
+make_array_from_process_local_data branch of shard_series_global, and
+runs sharded computations whose replicated results are checked against
+the full-data ground truth.  Exit code communicates pass/fail.
+"""
+
+import os
+import sys
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nproc,
+    process_id=pid,
+)
+
+import tempo_tpu  # noqa: E402,F401
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from tempo_tpu.parallel import (  # noqa: E402
+    make_mesh, process_series_range, shard_series_global,
+)
+from tempo_tpu.parallel import multihost as mh  # noqa: E402
+
+assert jax.process_count() == nproc, jax.process_count()
+assert len(jax.devices()) == 4 * nproc
+assert jax.process_index() == pid
+
+mesh = make_mesh({"series": 4 * nproc})
+
+# the device->process grid must reflect the real multi-process layout
+grid = mh.mesh_shard_process_ids(mesh)
+assert sorted(set(grid.ravel().tolist())) == list(range(nproc)), grid
+
+K, L = 16, 64
+rng = np.random.default_rng(0)          # same seed -> shared ground truth
+full = rng.standard_normal((K, L))
+
+lo, hi = process_series_range(K, mesh)
+block = K // nproc
+assert (lo, hi) == (pid * block, (pid + 1) * block), (lo, hi)
+
+garr = shard_series_global(full[lo:hi], mesh, K)
+assert garr.shape == (K, L)
+assert not garr.is_fully_addressable    # really spans processes
+
+# 1) global reduction: replicated scalar must equal the full-data sum
+total = jax.jit(lambda a: a.sum(), out_shardings=NamedSharding(mesh, P()))(
+    garr
+)
+np.testing.assert_allclose(float(total), full.sum(), rtol=1e-9)
+
+# 2) sharded elementwise + collective: per-series mean, fetched via
+# a replicated output (all_gather induced by the out sharding)
+row_mean = jax.jit(
+    lambda a: a.mean(axis=1), out_shardings=NamedSharding(mesh, P())
+)(garr)
+np.testing.assert_allclose(np.asarray(row_mean), full.mean(axis=1),
+                           rtol=1e-9)
+
+# 3) a real tempo kernel across the process boundary: exact EMA over
+# the series-sharded array (pure vmap over series — shards stay local)
+from tempo_tpu.ops import rolling as rk  # noqa: E402
+
+valid = shard_series_global(np.ones((block, L), bool), mesh, K)
+ema = jax.jit(
+    lambda a, v: rk.ema_exact(a, v, 0.2),
+    out_shardings=NamedSharding(mesh, P()),
+)(garr, valid)
+acc = np.zeros(K)
+expect = np.empty((K, L))
+for i in range(L):
+    acc = 0.8 * acc + 0.2 * full[:, i]
+    expect[:, i] = acc
+np.testing.assert_allclose(np.asarray(ema), expect, rtol=1e-6, atol=1e-9)
+
+print(f"proc {pid}/{nproc} OK", flush=True)

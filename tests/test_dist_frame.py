@@ -130,6 +130,28 @@ class TestDistributedOps:
                 rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=c,
             )
 
+    def test_asof_join_resampled_right(self, frames, axes, ta):
+        """Bucket-head views keep real-looking ts at masked lane rows;
+        the join must treat those rows as NON-existent — they must not
+        consume maxLookback window slots or win skipNulls=False fills
+        (code-review r3 finding).  Oracle: collect the resample, join
+        on the host."""
+        l, r = frames
+        mesh = make_mesh(axes)
+        dl = l.on_mesh(mesh, time_axis=ta)
+        dr = r.on_mesh(mesh, time_axis=ta).resample("5 minutes", "mean",
+                                                    metricCols=["bid", "ask"])
+        host_r = r.resample("5 minutes", "mean", metricCols=["bid", "ask"])
+        for kw in ({"maxLookback": 2}, {"skipNulls": False}):
+            host = _sorted(l.asofJoin(host_r, **kw).df)
+            got = _sorted(dl.asofJoin(dr, **kw).collect().df)
+            for c in ("right_bid", "right_ask"):
+                np.testing.assert_allclose(
+                    got[c].to_numpy(float), host[c].to_numpy(float),
+                    rtol=1e-6, atol=1e-9, equal_nan=True,
+                    err_msg=f"{c} {kw}",
+                )
+
     def test_asof_join_keep_nulls(self, frames, axes, ta):
         l, r = frames
         host = _sorted(l.asofJoin(r, skipNulls=False).df)
