@@ -1,0 +1,124 @@
+"""Frame-level end-to-end benchmark at quickstart scale (VERDICT r2
+item 7).
+
+Times what a user actually calls — pandas in -> ``TSDF.on_mesh`` ->
+``asofJoin`` -> ``withRangeStats`` -> ``EMA`` -> ``collect`` — on an
+HHAR-shaped workload (the reference quickstart's 13,062,475-row
+phone<->watch accelerometer join, `Tempo QuickStart - Python.ipynb`
+cell 3), reporting the three phases separately so the environment's
+device<->host tunnel bound is quantified rather than asserted:
+
+* ``t_pack``   — host packing + upload (``on_mesh`` + a forcing probe);
+* ``t_device`` — the full op chain on-device, forced by fetching a
+  data-dependent scalar (this backend materialises lazily — an
+  un-fetched result may never execute, BASELINE.md round-2 notes);
+* ``t_fetch``  — ``collect()``: ONE stacked device->host transfer plus
+  host assembly back to pandas.
+
+On this axon-tunnelled chip the transfer phases are bounded by the
+~5-10 MB/s tunnel, three orders of magnitude below a TPU-VM host's
+PCIe; ``rows_per_sec_device`` is the hardware-meaningful number,
+``rows_per_sec_end_to_end`` is this environment's.  Scale with
+TEMPO_BENCH_FRAME_ROWS (default the full 13M; CI smoke uses ~100k).
+
+Prints ONE json line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+import tempo_tpu  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu import TSDF
+from tempo_tpu.parallel import make_mesh
+
+N_ROWS = int(os.environ.get("TEMPO_BENCH_FRAME_ROWS", 13_062_475))
+N_SERIES = 128
+
+
+def make_frames(n_rows=N_ROWS, n_series=N_SERIES, seed=0):
+    """HHAR-shaped: n_series (user, device) keys, ~1-2 Hz accelerometer
+    ticks, phone (left) joined against watch (right)."""
+    rng = np.random.default_rng(seed)
+    per = n_rows // n_series
+    n = per * n_series
+    keys = np.repeat(np.arange(n_series), per)
+    gaps = rng.integers(1, 3, size=n).astype(np.int64)
+    secs = np.concatenate(
+        [np.cumsum(gaps[i * per: (i + 1) * per]) for i in range(n_series)]
+    )
+    ts = pd.to_datetime(secs * np.int64(1_000_000_000))
+    left = pd.DataFrame({
+        "user": keys, "event_ts": ts,
+        "x": rng.standard_normal(n).astype(np.float64),
+    })
+    right = pd.DataFrame({
+        "user": keys,
+        "event_ts": pd.to_datetime(
+            (secs - rng.integers(0, 3, size=n)) * np.int64(1_000_000_000)
+        ),
+        "wx": np.where(rng.random(n) > 0.05,
+                       rng.standard_normal(n), np.nan),
+    })
+    return left, right, n
+
+
+def main():
+    left, right, n = make_frames()
+    mesh = make_mesh({"series": len(jax.devices())})
+
+    t0 = time.perf_counter()
+    dl = TSDF(left, "event_ts", ["user"]).on_mesh(mesh)
+    dr = TSDF(right, "event_ts", ["user"]).on_mesh(mesh)
+    # force the uploads: a data-dependent scalar fetch (lazy backend)
+    float(jnp.asarray(dl.ts).sum() + jnp.asarray(dr.ts).sum())
+    t_pack = time.perf_counter() - t0
+
+    def chain():
+        t0 = time.perf_counter()
+        out = (
+            dl.asofJoin(dr)
+            .withRangeStats(colsToSummarize=["x"], rangeBackWindowSecs=10)
+            .EMA("x", exact=True)
+        )
+        # force the whole chain without fetching the planes
+        float(jnp.nan_to_num(out.cols["EMA_x"].values).sum()
+              + jnp.nan_to_num(out.cols["mean_x"].values).sum()
+              + jnp.nan_to_num(out.cols["right_wx"].values).sum())
+        return out, time.perf_counter() - t0
+
+    out, t_device = chain()          # cold: includes jit compiles
+    _, t_device_warm = chain()       # warm: compiled programs cached
+
+    t0 = time.perf_counter()
+    df = out.collect().df
+    t_fetch = time.perf_counter() - t0
+    assert len(df) == n, (len(df), n)
+
+    fetched_mb = sum(
+        df[c].to_numpy().nbytes for c in df.columns
+    ) / 1e6
+    print(json.dumps({
+        "metric": "frame-level pandas->mesh->asofJoin+rangeStats+EMA->collect",
+        "rows": n,
+        "t_pack_s": round(t_pack, 2),
+        "t_device_s": round(t_device, 2),
+        "t_device_warm_s": round(t_device_warm, 2),
+        "t_fetch_s": round(t_fetch, 2),
+        "rows_per_sec_device": round(n / t_device_warm),
+        "rows_per_sec_end_to_end": round(n / (t_pack + t_device + t_fetch)),
+        "collect_mb": round(fetched_mb),
+        "tunnel_note": "pack/fetch ride the axon tunnel (~5-10 MB/s); "
+                       "on a TPU-VM host these phases are PCIe-bound",
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -468,7 +468,6 @@ class DistributedTSDF:
         h_names = [c for c in right.host_cols
                    if right._source_df is not None]
         r_ts_al = align2(right.ts, perm, ok, packing.TS_PAD)
-        r_mask_al = align2(right.mask, perm, ok, False)
 
         dt = packing.compute_dtype()
         sharding_r = right._sharding(2)
@@ -543,8 +542,12 @@ class DistributedTSDF:
             )
         # a resampled RIGHT frame keeps real-looking ts at masked lane
         # rows; maxLookback must count real rows only, so those lanes
-        # are sort-compacted to the tail inside the kernel
+        # are sort-compacted to the tail inside the kernel.  The mask
+        # plane is only gathered when that path is active; otherwise a
+        # derived placeholder fills the kernel operand slot (unread).
         compact = bool(ml and right.resampled)
+        r_mask_al = (align2(right.mask, perm, ok, False) if compact
+                     else r_ts_al < packing.TS_REAL_MAX)
         has_seq = right.seq is not None
         if has_seq:
             # left rows ride the kernel-synthesized seq fill
@@ -1251,11 +1254,11 @@ def _compact_right_lanes(r_ts, r_mask, vstack, pstack):
     maxLookback counts merged-stream rows: a masked lane row with a
     real-looking ts would consume a window slot Spark's stream never
     contains.  One multi-operand lax.sort carrying every plane."""
-    nv, npl = int(vstack.shape[0]), int(pstack.shape[0])
+    nv = int(vstack.shape[0])
     key = jnp.where(r_mask, r_ts, packing.TS_PAD)
     ops = jax.lax.sort(
         (key,) + tuple(vstack[i] for i in range(nv))
-        + tuple(pstack[i] for i in range(npl)),
+        + tuple(pstack[i] for i in range(int(pstack.shape[0]))),
         dimension=-1, num_keys=1, is_stable=True,
     )
     return ops[0], jnp.stack(ops[1: 1 + nv]), jnp.stack(ops[1 + nv:])

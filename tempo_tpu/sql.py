@@ -470,14 +470,24 @@ def _cast(v, typ: str):
 
 
 def _like_to_regex(pat: str) -> str:
+    """LIKE pattern -> anchored regex.  ``\\`` escapes the next char
+    (Spark's default LIKE escape): ``\\%`` and ``\\_`` match literally;
+    a trailing lone backslash matches itself."""
     out = []
-    for ch in pat:
+    i = 0
+    while i < len(pat):
+        ch = pat[i]
+        if ch == "\\" and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
         if ch == "%":
             out.append(".*")
         elif ch == "_":
             out.append(".")
         else:
             out.append(re.escape(ch))
+        i += 1
     return "^" + "".join(out) + "$"
 
 

@@ -191,3 +191,163 @@ def test_greatest_least_skip_nulls():
         sql.eval_expr(d, "greatest(x, 0)").to_numpy(), [1.0, 0.0, 3.0])
     np.testing.assert_array_equal(
         sql.eval_expr(d, "least(x, 2)").to_numpy(), [1.0, 2.0, 2.0])
+
+
+# ----------------------------------------------------------------------
+# Fuzz tier (VERDICT r2 item 8): operator semantics vs independent
+# oracles — 3-valued NULL logic, LIKE escapes, CAST truncation
+# ----------------------------------------------------------------------
+
+def _tvl(x):
+    """Map a pandas scalar/NA to Spark's 3-valued domain."""
+    return None if pd.isna(x) else bool(x)
+
+
+def test_three_valued_logic_truth_tables():
+    """AND/OR/NOT over {TRUE, FALSE, NULL} must match Spark's 3VL
+    exactly (NULL AND FALSE = FALSE, NULL OR TRUE = TRUE, ...)."""
+    lits = {"true": True, "false": False, "null": None}
+
+    def expect_and(a, b):
+        if a is False or b is False:
+            return False
+        if a is None or b is None:
+            return None
+        return True
+
+    def expect_or(a, b):
+        if a is True or b is True:
+            return True
+        if a is None or b is None:
+            return None
+        return False
+
+    d = pd.DataFrame({"_": [0]})
+    for la, va in lits.items():
+        for lb, vb in lits.items():
+            got = sql.eval_expr(d, f"{la} AND {lb}")
+            assert _tvl(got) == expect_and(va, vb), f"{la} AND {lb}"
+            got = sql.eval_expr(d, f"{la} OR {lb}")
+            assert _tvl(got) == expect_or(va, vb), f"{la} OR {lb}"
+        got = sql.eval_expr(d, f"NOT {la}")
+        assert _tvl(got) == (None if va is None else not va), f"NOT {la}"
+
+
+def test_null_propagation_fuzz():
+    """Random arithmetic/comparison expressions over columns with
+    nulls: any operand NULL -> result NULL (Spark), and non-null rows
+    must match the pure-numpy evaluation."""
+    rng = np.random.default_rng(0)
+    n = 64
+    d = pd.DataFrame({
+        "a": np.where(rng.random(n) > 0.3, rng.integers(-20, 20, n),
+                      np.nan),
+        "b": np.where(rng.random(n) > 0.3, rng.integers(1, 9, n), np.nan),
+    })
+    ops = ["+", "-", "*", "/", ">", "<", ">=", "<=", "=", "!="]
+    np_ops = {
+        "+": lambda x, y: x + y, "-": lambda x, y: x - y,
+        "*": lambda x, y: x * y, "/": lambda x, y: x / y,
+        ">": lambda x, y: x > y, "<": lambda x, y: x < y,
+        ">=": lambda x, y: x >= y, "<=": lambda x, y: x <= y,
+        "=": lambda x, y: x == y, "!=": lambda x, y: x != y,
+    }
+    a = d["a"].to_numpy()
+    b = d["b"].to_numpy()
+    null = np.isnan(a) | np.isnan(b)
+    for op in ops:
+        out = sql.eval_expr(d, f"a {op} b")
+        got_null = pd.isna(out).to_numpy()
+        np.testing.assert_array_equal(got_null, null, err_msg=f"null a{op}b")
+        want = np_ops[op](a[~null], b[~null])
+        got = out[~null].to_numpy()
+        if op in ("+", "-", "*", "/"):
+            np.testing.assert_allclose(got.astype(float),
+                                       want.astype(float), err_msg=op)
+        else:
+            np.testing.assert_array_equal(got.astype(bool), want, err_msg=op)
+
+
+def _like_oracle(s, pat):
+    """Independent LIKE matcher: backtracking over %/_ with backslash
+    escapes."""
+    # tokenize pattern
+    toks = []
+    i = 0
+    while i < len(pat):
+        if pat[i] == "\\" and i + 1 < len(pat):
+            toks.append(("lit", pat[i + 1])); i += 2
+        elif pat[i] == "%":
+            toks.append(("any",)); i += 1
+        elif pat[i] == "_":
+            toks.append(("one",)); i += 1
+        else:
+            toks.append(("lit", pat[i])); i += 1
+
+    from functools import lru_cache
+
+    @lru_cache(maxsize=None)
+    def match(ti, si):
+        if ti == len(toks):
+            return si == len(s)
+        t = toks[ti]
+        if t[0] == "any":
+            return any(match(ti + 1, sj) for sj in range(si, len(s) + 1))
+        if si >= len(s):
+            return False
+        if t[0] == "one":
+            return match(ti + 1, si + 1)
+        return s[si] == t[1] and match(ti + 1, si + 1)
+
+    return match(0, 0)
+
+
+def test_like_fuzz_incl_escapes_and_metachars():
+    rng = np.random.default_rng(1)
+    alphabet = list("ab%_\\.*[()|+?^$")
+    strings = ["".join(rng.choice(alphabet, rng.integers(0, 8)))
+               for _ in range(40)]
+    pats = ["".join(rng.choice(alphabet, rng.integers(0, 6)))
+            for _ in range(60)] + ["a\\%b", "\\_x", "%\\%%", "a.c", "[ab]"]
+    d = pd.DataFrame({"s": strings})
+    for pat in pats:
+        sql_pat = pat.replace("'", "")
+        expr = "s LIKE '" + sql_pat.replace("\\", "\\\\") + "'"
+        try:
+            got = sql.eval_expr(d, expr)
+        except sql.SqlError:
+            continue   # the tokenizer may reject some junk patterns
+        want = [_like_oracle(s, sql_pat) for s in strings]
+        np.testing.assert_array_equal(
+            got.to_numpy(bool), np.array(want), err_msg=repr(sql_pat)
+        )
+
+
+def test_cast_truncation_and_null_propagation():
+    d = pd.DataFrame({"x": [1.9, -1.9, np.nan, 2.0e9, -2.0e9]})
+    out = sql.eval_expr(d, "CAST(x AS INT)")
+    # truncation toward zero; null stays null; 2e9 fits int64 plane
+    assert out.iloc[0] == 1 and out.iloc[1] == -1
+    assert pd.isna(out.iloc[2])
+    assert out.iloc[3] == 2_000_000_000 and out.iloc[4] == -2_000_000_000
+    s = sql.eval_expr(d, "CAST('12' AS INT)")
+    assert s == 12
+    assert pd.isna(sql.eval_expr(d, "CAST(null AS INT)"))
+    # non-numeric strings coerce to null, not an exception
+    d2 = pd.DataFrame({"s": ["3", "x", None]})
+    out2 = sql.eval_expr(d2, "CAST(s AS INT)")
+    assert out2.iloc[0] == 3 and pd.isna(out2.iloc[1]) and pd.isna(out2.iloc[2])
+
+
+def test_select_expr_alias_split_respects_quotes():
+    """The fallback alias split must use the LAST top-level ' as '
+    outside quotes/backticks (VERDICT r2 weak #5)."""
+    from tempo_tpu.frame import _split_alias
+
+    assert _split_alias("price ** 2 as sq") == ("price ** 2", "sq")
+    assert _split_alias("x as y as z") == ("x as y", "z")
+    assert _split_alias("'literal as text' as col") == \
+        ("'literal as text'", "col")
+    assert _split_alias("x as `weird name`") == ("x", "weird name")
+    assert _split_alias("no alias here") is None
+    assert _split_alias("x as 'not an identifier'") is None
