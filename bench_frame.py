@@ -40,7 +40,11 @@ from tempo_tpu import TSDF
 from tempo_tpu.parallel import make_mesh
 
 N_ROWS = int(os.environ.get("TEMPO_BENCH_FRAME_ROWS", 13_062_475))
-N_SERIES = 128
+# 1024 integer partition keys (one 'user' column): ~12.8k rows/series
+# keeps the merged join length inside the Pallas kernel's VMEM plan; at
+# 128 keys the ~205k-lane XLA sort program OOM-killed the remote
+# compile helper (measured 2026-07-30)
+N_SERIES = 1024
 
 
 def make_frames(n_rows=N_ROWS, n_series=N_SERIES, seed=0):

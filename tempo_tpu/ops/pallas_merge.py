@@ -25,11 +25,13 @@ This kernel runs the whole join on a [bk, Lc] block resident in VMEM:
    column (skipNulls=True semantics: each right column independently
    takes its last non-null value, tsdf.py:139), plus a row-index plane
    giving the last right row regardless of validity.
-3. **Routing**: each element's destination lane is a *known permutation*
-   (left rows -> their original lane, right rows -> the tail), so an
-   in-VMEM bitonic sort on that single i32 key restores left-row order.
-   This is the O(log^2) part, but it moves only C+2 planes and never
-   touches HBM.
+3. **Unmerge**: the merge stages are involutions over disjoint lane
+   pairs, so replaying their recorded swap masks in reverse order
+   inverts the merge permutation exactly — every filled slot returns
+   to its input lane (left rows at [0, Llp)) in log2(Lc) stages.  The
+   first kernel revision sorted a destination-key permutation instead
+   (log^2 stages, ~105 at Lc=16K); the recorded-mask unmerge replaced
+   ~80% of the kernel's stage work.
 
 HBM traffic: one read of the input planes, one write of the output —
 independent of the number of network stages.
@@ -106,34 +108,32 @@ def _exchange(planes, take):
 
 def _merge_stage(keys, payload, span: int, shape):
     """One ascending bitonic-merge stage over all planes; the
-    lexicographic key-plane list decides the swap."""
+    lexicographic key-plane list decides the swap.  Returns the swap
+    mask too: each stage exchanges disjoint lane pairs, so it is an
+    involution — replaying the recorded masks in reverse order inverts
+    the whole merge permutation (the O(log) unmerge that replaces an
+    O(log^2) routing sort)."""
     in_lower = (_lane(shape) & span) == 0
     pkeys = [_partner(k, span, in_lower) for k in keys]
     gt = _gtn(keys, pkeys)
-    # lower lane keeps the min, upper the max (ascending network)
+    # lower lane keeps the min, upper the max (ascending network).
+    # take is symmetric across each pair (strict total order): both
+    # lanes of a swapped pair have take=True
     take = jnp.logical_xor(gt, ~in_lower)
     keys = _exchange(list(zip(keys, pkeys)), take)
     payload = _exchange(
         [(p, _partner(p, span, in_lower)) for p in payload], take
     )
-    return keys, payload
+    return keys, payload, take
 
 
-def _sort_stage(key, payload, j: int, k: int, shape):
-    """One stage of a full bitonic sort on a single i32 key (the routing
-    permutation): block size k, partner distance j."""
-    lane = _lane(shape)
-    in_lower = (lane & j) == 0
-    ascending = (lane & k) == 0
-    pkey = _partner(key, j, in_lower)
-    take = jnp.logical_xor(
-        jnp.logical_xor(key > pkey, ~in_lower), ~ascending
+def _unmerge_stage(payload, take, span: int, shape):
+    """Apply one recorded merge exchange to the payload planes (its own
+    inverse): lanes with take=True swap with their span-partner."""
+    in_lower = (_lane(shape) & span) == 0
+    return _exchange(
+        [(p, _partner(p, span, in_lower)) for p in payload], take
     )
-    (key,) = _exchange([(key, pkey)], take)
-    payload = _exchange(
-        [(p, _partner(p, j, in_lower)) for p in payload], take
-    )
-    return key, payload
 
 
 def _ffill_stage(planes, span: int, shape, sid=None):
@@ -154,9 +154,15 @@ def _ffill_stage(planes, span: int, shape, sid=None):
 
 
 def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
-    """Kernel closure: merge + ffill + route on [bk, Lc2] blocks.  With
-    ``segmented``, a leading series-id key plane both orders the merge
-    (so bin-packed series never interleave) and fences the fill."""
+    """Kernel closure: merge + ffill + unmerge on [bk, Lc2] blocks.
+    With ``segmented``, a leading series-id key plane both orders the
+    merge (so bin-packed series never interleave) and fences the fill.
+
+    Routing back to input lanes replays the merge's recorded swap masks
+    in reverse (each stage is an involution over disjoint pairs), which
+    lands every filled slot exactly where it started — the left rows at
+    lanes [0, Llp).  log2(Lc2) stages instead of the log^2 bitonic sort
+    a destination-keyed route would need."""
 
     def kernel(*refs):
         n_keys = 4 if segmented else 3
@@ -167,9 +173,11 @@ def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
         keys = [r[:] for r in key_refs]
         payload = [r[:] for r in payload_refs]
 
+        takes = []
         span = Lc2 // 2
         while span >= 1:
-            keys, payload = _merge_stage(keys, payload, span, shape)
+            keys, payload, take = _merge_stage(keys, payload, span, shape)
+            takes.append((span, take))
             span //= 2
 
         sid = keys[0] if segmented else None
@@ -178,18 +186,8 @@ def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
             payload = _ffill_stage(payload, span, shape, sid=sid)
             span *= 2
 
-        # destination lanes: left row pos p -> p, right row pos p ->
-        # Llp + p; a permutation of [0, Lc2), so sorting by it routes
-        # every filled left slot back to its original lane
-        sec = keys[-1]
-        route = jnp.where(sec >= _SIDE, sec - _SIDE, Llp + sec)
-        k = 2
-        while k <= Lc2:
-            j = k // 2
-            while j >= 1:
-                route, payload = _sort_stage(route, payload, j, k, shape)
-                j //= 2
-            k *= 2
+        for span, take in reversed(takes):
+            payload = _unmerge_stage(payload, take, span, shape)
 
         for r, p in zip(out_refs, payload):
             r[:] = p[:, :Llp]
@@ -201,15 +199,19 @@ _VMEM_CAP = 90 * 2**20  # headroom under the raised 100M scoped limit
 
 
 def _plan_merge(K: int, Lc2: int, n_payload: int, n_keys: int):
-    """(grid, bk=8, K_pad) or None.  Footprint calibrated against the
-    compiler's own accounting: at [8, 16384] blocks with 3 payloads and
-    3 keys the stack peaked at 21.6M ≈ 42 plane-slots (pipelined I/O
-    double buffers + network temporaries), i.e. ~6x the
-    (n_payload + n_keys + 1) resident planes (the +1 is the route
-    key).  The segmented path adds a 4th (sid) key plane and must be
-    counted, or the gate admits shapes Mosaic then rejects."""
+    """(grid, bk=8, K_pad) or None.  Footprint model: ~6x the resident
+    (payload + key) planes — calibrated against the compiler's own
+    accounting of the first kernel revision (21.6M peak at [8, 16384]
+    with 3+3 planes ≈ pipelined I/O double buffers + network
+    temporaries) — PLUS one plane-slot per recorded unmerge swap mask
+    (log2(Lc2) of them stay live across the ffill and unmerge ladders;
+    bools, but budgeted at vreg width).  The segmented path adds a 4th
+    (sid) key plane; every term must be counted or the gate admits
+    shapes Mosaic then rejects."""
     bk = 8
-    if bk * Lc2 * 4 * 6 * (n_payload + n_keys + 1) > _VMEM_CAP:
+    n_masks = max(Lc2.bit_length() - 1, 0)
+    planes = 6 * (n_payload + n_keys) + n_masks
+    if bk * Lc2 * 4 * planes > _VMEM_CAP:
         return None
     K_pad = -(-K // bk) * bk
     return (K_pad // bk,), bk, K_pad
@@ -227,9 +229,10 @@ def _merge_call(keys, payload, n_payload, Lc2, Llp, interpret=False):
         # silent whole-array block here would be strictly larger than
         # the block the planner just rejected
         raise ValueError(
-            f"asof merge kernel infeasible: [{8}, {Lc2}] blocks with "
-            f"{n_payload + n_keys + 1} planes exceed the VMEM budget; "
-            f"use the XLA sortmerge forms for this shape"
+            f"asof merge kernel infeasible: [8, {Lc2}] blocks with "
+            f"~{6 * (n_payload + n_keys)} buffered plane-slots plus "
+            f"{max(Lc2.bit_length() - 1, 0)} unmerge masks exceed the "
+            f"VMEM budget; use the XLA sortmerge forms for this shape"
         )
     grid, bk, K_pad = plan
     args = [pk._pad_rows(a, K_pad) for a in (*keys, *payload)]
@@ -343,6 +346,52 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
     return vals, found, idx
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def asof_merge_indices_pallas(l_ts, r_ts, r_valids, interpret=False):
+    """Index-returning sibling of :func:`asof_merge_values_pallas` —
+    the engine of the host frame path's ``asof_indices_merge`` (value
+    gathering happens host-side so string columns ride the same join,
+    ops/asof.py).  Same kernel, position-encoded payloads: plane c is
+    ``where(valid_c, lane, NaN)``, so the ffill produces each column's
+    last-valid right row index directly; the value wrapper's own ridx
+    channel doubles as the unconditional last-row index.  Returns
+    ``(last_row_idx [K, Ll], per_col_idx [C, K, Ll])``, -1 for none.
+    Positions are exact in f32 up to 2^24 rows/series."""
+    C = int(r_valids.shape[0])
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[-1]
+    pos = jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.float32), (K, Lr))
+    planes = jnp.where(r_valids, pos[None], jnp.nan)
+    out, _, last_idx = asof_merge_values_pallas(
+        l_ts, r_ts, r_valids, planes, interpret=interpret
+    )
+    per_col = jnp.where(jnp.isnan(out), -1, out).astype(jnp.int32)
+    return last_idx, per_col
+
+
+def _pallas_enabled() -> bool:
+    """Shared kill-switch + backend gate for every Pallas join path."""
+    import os
+
+    env = os.environ.get("TEMPO_TPU_PALLAS_ASOF")
+    if env is not None and env in ("0", "false", "no"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def merge_indices_supported(l_ts, r_ts, r_valids) -> bool:
+    """Gate for the index kernel: the value-kernel conditions with C
+    position payloads (+ the wrapper's ridx channel)."""
+    if not _pallas_enabled():
+        return False
+    if int(r_ts.shape[-1]) >= (1 << 24):
+        return False
+    K, Ll = l_ts.shape
+    _, Lc2, _ = _pad_plan(Ll, int(r_ts.shape[-1]))
+    C = int(r_valids.shape[0])
+    return _plan_merge(K, Lc2, C + 1, 3) is not None
+
+
 def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
                          skip_nulls: bool,
                          segmented: bool = False) -> bool:
@@ -357,16 +406,11 @@ def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
     dist.py packing), so no public-API caller can observe the
     difference; direct kernel callers must honour it.
     """
-    import os
-
-    env = os.environ.get("TEMPO_TPU_PALLAS_ASOF")
-    if env is not None and env in ("0", "false", "no"):
+    if not _pallas_enabled():
         return False
     if not skip_nulls or l_seq is not None or r_seq is not None:
         return False
     if r_values.dtype != jnp.float32:
-        return False
-    if jax.default_backend() != "tpu":
         return False
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]

@@ -348,15 +348,23 @@ def _ffill_scan_seg(f, has, val, axis: int = -1):
                                     axis=axis % has.ndim)
 
 
-@jax.jit
 def asof_merge_indices(l_ts, r_ts, r_valids):
     """Index-returning sibling of :func:`asof_merge_values` (same
-    skipNulls semantics, same sort/ffill/route skeleton): returns
-    ``(last_row_idx [K, Ll], per_col_idx [C, K, Ll])``, -1 for no
-    match.  The single sorted row-index channel is forward-filled once
-    per column keyed on that column's validity, so the merge sort
-    carries only 3+C operands.  REQUIRES ``l_ts`` ascending per row
-    (the packed-layout invariant)."""
+    skipNulls semantics): returns ``(last_row_idx [K, Ll],
+    per_col_idx [C, K, Ll])``, -1 for no match.  On TPU this runs as
+    the Pallas merge kernel with position-encoded payloads
+    (ops/pallas_merge.py); the XLA form below merges with 3+C operands
+    and forward-fills the row-index channel per column.  REQUIRES
+    ``l_ts`` ascending per row (the packed-layout invariant)."""
+    from tempo_tpu.ops import pallas_merge as pm
+
+    if pm.merge_indices_supported(l_ts, r_ts, r_valids):
+        return pm.asof_merge_indices_pallas(l_ts, r_ts, r_valids)
+    return _asof_merge_indices_xla(l_ts, r_ts, r_valids)
+
+
+@jax.jit
+def _asof_merge_indices_xla(l_ts, r_ts, r_valids):
     C, K, Lr = r_valids.shape
     Ll = l_ts.shape[-1]
     Lc = Ll + Lr

@@ -471,13 +471,21 @@ def _cast(v, typ: str):
 
 def _like_to_regex(pat: str) -> str:
     """LIKE pattern -> anchored regex.  ``\\`` escapes the next char
-    (Spark's default LIKE escape): ``\\%`` and ``\\_`` match literally;
-    a trailing lone backslash matches itself."""
+    (Spark's default LIKE escape).  Spark only permits the escape
+    before ``%``, ``_`` or another escape char and rejects a trailing
+    lone escape (ParseException); the same inputs raise here so a
+    migrated query fails loudly instead of silently matching
+    differently."""
     out = []
     i = 0
     while i < len(pat):
         ch = pat[i]
-        if ch == "\\" and i + 1 < len(pat):
+        if ch == "\\":
+            if i + 1 >= len(pat) or pat[i + 1] not in ("%", "_", "\\"):
+                raise SqlError(
+                    f"invalid LIKE escape sequence in {pat!r}: the "
+                    "escape character must precede '%', '_' or itself"
+                )
             out.append(re.escape(pat[i + 1]))
             i += 2
             continue

@@ -351,3 +351,17 @@ def test_select_expr_alias_split_respects_quotes():
     assert _split_alias("x as `weird name`") == ("x", "weird name")
     assert _split_alias("no alias here") is None
     assert _split_alias("x as 'not an identifier'") is None
+
+
+def test_like_invalid_escape_rejected_like_spark():
+    """Spark raises on an escape before a non-wildcard and on a
+    trailing lone escape; so do we (loud parity over silent
+    divergence)."""
+    d = pd.DataFrame({"s": ["ab"]})
+    for pat in (r"a\b", "abc\\"):
+        with pytest.raises(sql.SqlError, match="escape"):
+            sql.eval_expr(d, "s LIKE '" + pat.replace("\\", "\\\\") + "'")
+    # valid escapes still work
+    assert sql.eval_expr(
+        pd.DataFrame({"s": ["a%b"]}), r"s LIKE 'a\\%b'"
+    ).tolist() == [True]
