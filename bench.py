@@ -365,7 +365,8 @@ def bench_range_stats(data):
     def body(scale, l_secs, x, valid):
         js = _jitter_secs(scale)
         return sm.range_stats_shifted(
-            l_secs + js, x * scale, valid, jnp.asarray(WINDOW_SECS),
+            (l_secs + js).astype(jnp.int32), x * scale, valid,
+            jnp.asarray(WINDOW_SECS).astype(jnp.int32),
             max_behind=MAX_WINDOW_ROWS, max_ahead=MAX_TIE_ROWS,
         )
 

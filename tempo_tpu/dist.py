@@ -279,6 +279,7 @@ class DistributedTSDF:
             w = np.int64(window_secs)
             behind = 0
             ahead = 0
+            span_i32 = True
             for k in range(lay.n_series):
                 s = secs[lay.starts[k]: lay.starts[k + 1]]
                 if len(s) == 0:
@@ -292,7 +293,15 @@ class DistributedTSDF:
                     ahead,
                     int((np.searchsorted(s, s, side="right") - 1 - idx).max()),
                 )
-            cache[key] = (behind, ahead)
+                # per-series seconds span PLUS the window must fit
+                # int32 for the VMEM kernel's rebased keys: the pads
+                # clamp to INT32_MAX and the truncation audit's
+                # pad-immunity needs >= window of headroom above every
+                # real key (a >68-year series or a decades-wide window
+                # falls back to the exact path)
+                if int(s[-1] - s[0]) + int(w) >= 2**31 - 2:
+                    span_i32 = False
+            cache[key] = (behind, ahead) if span_i32 else None
         return cache[key]
 
     def _halo(self, L: int) -> int:
@@ -1180,8 +1189,16 @@ def _range_stats_block(ts, x, valid, w, rowbounds):
     secs = ts // packing.NS_PER_S
     if rowbounds is not None:
         behind, ahead = rowbounds
+        # per-series int32 rebase for the VMEM kernel.  _window_rowbounds
+        # guarantees span + window < 2^31 host-side, so the window casts
+        # exactly (no narrowing clamp — one would silently shrink
+        # frames) and the INT32_MAX pad clamp keeps >= window of
+        # headroom above every real key (the truncation audit's
+        # pad-immunity condition)
+        rb = jnp.minimum(secs - secs[:, :1], 2**31 - 1).astype(jnp.int32)
+        w32 = jnp.asarray(w).astype(jnp.int32)
         stats = sm.range_stats_shifted(
-            secs, x, valid, jnp.asarray(w),
+            rb, x, valid, w32,
             max_behind=int(behind), max_ahead=int(ahead),
         )
         clipped = jnp.sum(stats.pop("clipped")).astype(jnp.int64)

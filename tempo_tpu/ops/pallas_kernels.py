@@ -180,7 +180,8 @@ def _supported(x: jax.Array, arrays: int = 12, bk_max: int = _BK) -> bool:
 _VMEM_BUDGET = 14 * 2**20  # headroom under the 16M scoped-vmem limit
 
 
-def _plan(K: int, L: int, arrays: int = 12, bk_max: int = _BK):
+def _plan(K: int, L: int, arrays: int = 12, bk_max: int = _BK,
+          budget: int = _VMEM_BUDGET):
     """(grid, bk, K_padded) row-blocking plan fitting the scoped-VMEM
     cap, or None when no legal block fits.  ``arrays`` is a conservative
     count of simultaneously-live [bk, L] f32 buffers (carries + roll
@@ -193,9 +194,9 @@ def _plan(K: int, L: int, arrays: int = 12, bk_max: int = _BK):
     8-row block exceeds the budget (huge L) there is no feasible plan
     and callers must stay on the XLA path.
     """
-    if K * L * 4 * arrays <= _VMEM_BUDGET:
+    if K * L * 4 * arrays <= budget:
         return (1,), K, K          # whole array in one block
-    cap = _VMEM_BUDGET // (L * 4 * arrays)
+    cap = budget // (L * 4 * arrays)
     if cap < 8:
         return None                # not even [8, L] fits: infeasible
     bk = 1 << min(bk_max, cap).bit_length() - 1

@@ -1,0 +1,184 @@
+"""Pallas VMEM kernel for the shifted-window range stats.
+
+``ops/sortmerge.py:range_stats_shifted`` computes Spark's
+rangeBetween(-window, 0) aggregates as W static shifted masked
+accumulations.  XLA fuses the passes, but the operand still crosses HBM
+several times per aggregate; here the whole pass structure runs on a
+[bk, L] block resident in VMEM — one HBM read of (secs, x, valid), one
+write of the eight outputs, with every shift a ``pltpu.roll``.
+
+Engages for f32 values with an int32-expressible seconds axis (the
+frame layer already rebases per series, packing.py:rebase_seconds; the
+wrapper rebases otherwise) on lane-aligned blocks; the XLA form remains
+for CPU/f64 and infeasible shapes.  Semantics identical to
+``range_stats_shifted`` including the ``clipped`` truncation audit —
+parity pinned in tests/test_pallas_stats.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tempo_tpu.ops import pallas_kernels as pk
+
+_I32_BIG = 2**31 - 1  # python int: jnp scalars capture as consts in kernels
+
+
+def _shift(p, j: int, fill, shape):
+    """out[:, i] = p[:, i-j] (j<0 looks ahead); rolled lanes become
+    ``fill`` (negative roll shifts SIGABRT Mosaic — use L-|j|)."""
+    if j == 0:
+        return p
+    L = shape[1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, shape, dimension=1)
+    if j > 0:
+        rolled = pltpu.roll(p, shift=jnp.int32(j), axis=1)
+        return jnp.where(lane >= j, rolled, fill)
+    rolled = pltpu.roll(p, shift=jnp.int32(L + j), axis=1)
+    return jnp.where(lane < L + j, rolled, fill)
+
+
+def _make_kernel(max_behind: int, max_ahead: int):
+    def kernel(w_ref, secs_ref, x_ref, valid_ref,
+               mean_ref, cnt_ref, mn_ref, mx_ref, sum_ref, std_ref,
+               z_ref, clip_ref):
+        w = w_ref[0]
+        secs = secs_ref[:]
+        x = x_ref[:]
+        valid = valid_ref[:]
+        shape = secs.shape
+
+        # bool planes cannot ride pltpu.roll: shift an f32 image
+        validf = valid.astype(jnp.float32)
+        xz = jnp.where(valid, x, 0.0)
+        nv = jnp.sum(validf, axis=1, keepdims=True)
+        center = jnp.sum(xz, axis=1, keepdims=True) / jnp.maximum(nv, 1.0)
+        xc = jnp.where(valid, x - center, 0.0)
+
+        lo = secs - w
+        pinf = jnp.float32(jnp.inf)
+        cnt = jnp.zeros(shape, jnp.float32)
+        s1 = jnp.zeros(shape, jnp.float32)
+        s2 = jnp.zeros(shape, jnp.float32)
+        mn = jnp.full(shape, pinf)
+        mx = jnp.full(shape, -pinf)
+        for j in range(-max_ahead, max_behind + 1):
+            sj = _shift(secs, j, _I32_BIG, shape)
+            inw = (sj >= lo) & (sj <= secs) & (
+                _shift(validf, j, 0.0, shape) > 0.0
+            )
+            xj = _shift(xc, j, 0.0, shape)
+            xr = _shift(x, j, 0.0, shape)
+            cnt = cnt + inw.astype(jnp.float32)
+            s1 = s1 + jnp.where(inw, xj, 0.0)
+            s2 = s2 + jnp.where(inw, xj * xj, 0.0)
+            mn = jnp.minimum(mn, jnp.where(inw, xr, pinf))
+            mx = jnp.maximum(mx, jnp.where(inw, xr, -pinf))
+
+        nan = jnp.float32(jnp.nan)
+        mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1.0) + center, nan)
+        total = s1 + cnt * center
+        var = jnp.where(
+            cnt > 1,
+            (s2 - s1 * s1 / jnp.maximum(cnt, 1.0))
+            / jnp.maximum(cnt - 1.0, 1.0),
+            nan,
+        )
+        std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, 0.0)), nan)
+
+        # truncation audit: mirrors range_stats_shifted exactly
+        L = shape[1]
+        clipped = jnp.zeros(shape, jnp.bool_)
+        for j in (min(max_behind + 1, L), -min(max_ahead + 1, L)):
+            sj = _shift(secs, j, _I32_BIG, shape)
+            clipped = clipped | (
+                (sj >= lo) & (sj <= secs)
+                & (valid | (_shift(validf, j, 0.0, shape) > 0.0))
+            )
+
+        mean_ref[:] = mean
+        cnt_ref[:] = cnt
+        mn_ref[:] = jnp.where(cnt > 0, mn, nan)
+        mx_ref[:] = jnp.where(cnt > 0, mx, nan)
+        sum_ref[:] = jnp.where(cnt > 0, total, nan)
+        std_ref[:] = std
+        z_ref[:] = jnp.where(valid, (x - mean) / std, nan)
+        clip_ref[:] = clipped.astype(jnp.float32)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_behind", "max_ahead", "interpret")
+)
+def _stats_call(secs, x, valid, window, max_behind, max_ahead,
+                interpret=False):
+    K, L = x.shape
+    # 3 in + 8 out with double-buffered I/O + ~8 accumulator/temp planes
+    plan = pk._plan(K, L, arrays=32, bk_max=32,
+                    budget=90 * 2**20)
+    if plan is None:
+        # callers consult range_stats_supported first; a whole-array
+        # block here would be strictly larger than the one the planner
+        # just rejected
+        raise ValueError(
+            f"range-stats kernel infeasible at L={L}: even an [8, {L}] "
+            f"block exceeds the VMEM budget; use the XLA shifted form"
+        )
+    grid, bk, K_pad = plan
+    secs = pk._pad_rows(secs, K_pad)
+    x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            _make_kernel(max_behind, max_ahead),
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            + [spec] * 3,
+            out_specs=[spec] * 8,
+            out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 8,
+            # measured 18.9M at [8, 8192] blocks: over the 16M default
+            # scoped cap; v5e has 128M physical VMEM (same treatment as
+            # the merge kernel)
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+            interpret=interpret,
+        )(jnp.asarray([window], jnp.int32), secs, x, valid)
+    return tuple(o[:K] for o in out)
+
+
+def range_stats_supported(secs, x, valid) -> bool:
+    return (
+        x.dtype == jnp.float32
+        and x.ndim == 2
+        and x.shape[1] % 128 == 0
+        and jax.default_backend() == "tpu"
+        and pk._plan(int(x.shape[0]), int(x.shape[1]), arrays=32,
+                     bk_max=32, budget=90 * 2**20) is not None
+    )
+
+
+def range_stats_pallas(secs, x, valid, window, max_behind: int,
+                       max_ahead: int = 0, interpret: bool = False):
+    """Drop-in VMEM form of ``range_stats_shifted``; same output dict.
+    ``secs`` must fit int32 after the caller's per-series rebase (the
+    wrapper in sortmerge casts and falls back when it cannot)."""
+    outs = _stats_call(
+        secs.astype(jnp.int32), x, valid,
+        jnp.asarray(window).astype(jnp.int32),
+        max_behind=int(max_behind), max_ahead=int(max_ahead),
+        interpret=interpret,
+    )
+    mean, cnt, mn, mx, total, std, z, clip = outs
+    return {
+        "mean": mean, "count": cnt, "min": mn, "max": mx, "sum": total,
+        "stddev": std, "zscore": z,
+        "clipped": jnp.sum(clip, axis=-1, keepdims=True),
+    }

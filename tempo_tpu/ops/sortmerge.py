@@ -470,7 +470,6 @@ def _shift_back(x: jnp.ndarray, j: int, fill) -> jnp.ndarray:
     return jnp.concatenate([x[..., -j:], pad], axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("max_behind", "max_ahead"))
 def range_stats_shifted(
     secs: jnp.ndarray,       # [K, L] sorted window-order key (int)
     x: jnp.ndarray,          # [K, L] float values
@@ -478,6 +477,31 @@ def range_stats_shifted(
     window: jnp.ndarray,     # scalar window size in key units
     max_behind: int,         # static bound: rows any window reaches back
     max_ahead: int = 0,      # static bound: longest tie run ahead
+) -> Dict[str, jnp.ndarray]:
+    """Dispatcher: on TPU with int32 keys and f32 values the whole
+    shifted-pass structure runs VMEM-resident as one Pallas kernel
+    (ops/pallas_stats.py) — an int32 ``secs`` dtype is the caller's
+    assertion that per-series key spans fit (rebase_seconds or
+    equivalent); int64 keys keep the XLA form below."""
+    from tempo_tpu.ops import pallas_stats as ps
+
+    if secs.dtype == jnp.int32 and ps.range_stats_supported(secs, x,
+                                                            valid):
+        return ps.range_stats_pallas(secs, x, valid, window,
+                                     max_behind, max_ahead)
+    return _range_stats_shifted_xla(secs, x, valid, window,
+                                    max_behind=max_behind,
+                                    max_ahead=max_ahead)
+
+
+@functools.partial(jax.jit, static_argnames=("max_behind", "max_ahead"))
+def _range_stats_shifted_xla(
+    secs: jnp.ndarray,
+    x: jnp.ndarray,
+    valid: jnp.ndarray,
+    window: jnp.ndarray,
+    max_behind: int,
+    max_ahead: int = 0,
 ) -> Dict[str, jnp.ndarray]:
     """``withRangeStats`` for row-bounded windows, gather-free.
 

@@ -1,0 +1,69 @@
+"""Pallas range-stats kernel: interpret-mode parity vs the XLA shifted
+form (itself oracle-tested against windowed_stats/pandas)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tempo_tpu.ops import sortmerge as sm
+from tempo_tpu.ops.pallas_stats import range_stats_pallas
+
+KEYS = ("mean", "count", "min", "max", "sum", "stddev", "zscore",
+        "clipped")
+
+
+def _case(seed, K=6, L=256, ties=False):
+    rng = np.random.default_rng(seed)
+    span = 40 if ties else 600
+    secs = np.sort(rng.integers(0, span, (K, L)), axis=-1).astype(np.int64)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.25
+    valid[1] = False
+    # ragged tail: i32-max clamped pads (the dist rebase contract)
+    cut = rng.integers(L // 2, L, K)
+    for k in range(K):
+        secs[k, cut[k]:] = 2**31 - 1
+        valid[k, cut[k]:] = False
+    return secs, x, valid
+
+
+@pytest.mark.parametrize("seed,ties", [(0, False), (1, True), (2, False)])
+def test_matches_xla_shifted(seed, ties):
+    secs, x, valid = _case(seed, ties=ties)
+    W, behind, ahead = 25, 24, 12
+    want = sm._range_stats_shifted_xla(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), jnp.asarray(np.int32(W)),
+        max_behind=behind, max_ahead=ahead,
+    )
+    got = range_stats_pallas(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), jnp.asarray(np.int32(W)),
+        behind, ahead, interpret=True,
+    )
+    assert set(got) == set(KEYS)
+    for k in KEYS:
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float64),
+            np.asarray(want[k], dtype=np.float64),
+            rtol=1e-5, atol=1e-5, equal_nan=True, err_msg=k,
+        )
+
+
+def test_clipped_parity_when_truncating():
+    secs, x, valid = _case(3)
+    W = 50
+    want = sm._range_stats_shifted_xla(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), jnp.asarray(np.int32(W)),
+        max_behind=3, max_ahead=0,
+    )
+    got = range_stats_pallas(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), jnp.asarray(np.int32(W)), 3, 0,
+        interpret=True,
+    )
+    assert float(np.asarray(want["clipped"]).sum()) > 0
+    np.testing.assert_allclose(
+        np.asarray(got["clipped"]), np.asarray(want["clipped"])
+    )
