@@ -77,6 +77,73 @@ def _gather(values: np.ndarray, idx: np.ndarray, ok: np.ndarray):
     return out
 
 
+def _binpack_worthwhile(l_layout, r_layout) -> bool:
+    """Engage the bin-packed layout when one-series-per-row padding
+    would waste most of the slot grid (Zipf-skewed key distributions).
+    TEMPO_TPU_BINPACK=1/0 forces/forbids."""
+    import os
+
+    K = l_layout.n_series
+    Ll = int(l_layout.lengths.max(initial=0))
+    Lr = int(r_layout.lengths.max(initial=0))
+    # the kernel's position payloads are exact in f32 up to 2^24 lanes:
+    # a longer single series keeps the dense layout's exact int32
+    # channels (this bound also caps SID_PAD collisions: series ids
+    # stay far below 2^31)
+    if max(Ll, Lr) >= (1 << 24) - 128:
+        return False
+    env = os.environ.get("TEMPO_TPU_BINPACK")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    slots = K * (Ll + Lr)
+    if slots == 0:
+        return False
+    return (l_layout.n_rows + r_layout.n_rows) / slots < 0.35
+
+
+def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
+                       valid_cols):
+    """Join indices through the bin-packed segmented kernel: short
+    series share lane rows (packing.bin_pack_series), one program for
+    any skew shape.  ``valid_cols`` empty = skipNulls=False (only the
+    last-row channel is consumed)."""
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops import sortmerge as sm
+
+    Wl = packing.pad_length(
+        max(int(l_layout.lengths.max(initial=0)), 1), 128)
+    Wr = packing.pad_length(
+        max(int(r_layout.lengths.max(initial=0)), 1), 128)
+    bp = packing.bin_pack_series(
+        l_layout.lengths, r_layout.lengths, Wl, Wr)
+    K2 = packing.pad_length(bp.n_rows)
+    # destination slots computed once, reused for every plane
+    dest_l = packing.binpack_dest(l_layout.starts, bp.row, bp.l_off, Wl)
+    dest_r = packing.binpack_dest(r_layout.starts, bp.row, bp.r_off, Wr)
+    lt = packing.binpack_scatter(
+        l_layout.ts_ns, dest_l, K2, Wl, packing.TS_PAD)
+    rt = packing.binpack_scatter(
+        r_layout.ts_ns, dest_r, K2, Wr, packing.TS_PAD)
+    lsid = packing.binpack_scatter(
+        l_layout.key_ids.astype(np.int32), dest_l, K2, Wl,
+        packing.SID_PAD)
+    rsid = packing.binpack_scatter(
+        r_layout.key_ids.astype(np.int32), dest_r, K2, Wr,
+        packing.SID_PAD)
+    rv = np.stack([
+        packing.binpack_scatter(
+            (~pd.isna(right.df[c])).to_numpy()[r_sorted_take],
+            dest_r, K2, Wr, False)
+        for c in valid_cols
+    ]) if valid_cols else np.zeros((0, K2, Wr), bool)
+
+    last_idx, per_col = sm.asof_indices_binpacked(
+        jnp.asarray(lt), jnp.asarray(rt), jnp.asarray(rv),
+        jnp.asarray(lsid), jnp.asarray(rsid))
+    return np.asarray(last_idx), np.asarray(per_col), bp
+
+
 def _time_brackets(ts_ns: np.ndarray, ts_partition_val: float):
     """Bracket id + remainder fraction, double-seconds math mirroring
     tsdf.py:176-180 (cast to double, truncate toward zero)."""
@@ -182,24 +249,57 @@ def asof_join(
     l_layout = packing.build_layout_from_codes(l_codes_j, l_ts_ns, None, n_series)
     r_layout = packing.build_layout_from_codes(r_codes_j, r_ts_j, r_seq_j, n_series)
 
+    r_sorted_take = r_take[r_layout.order]
+
+    # --- layout strategy: bin-pack Zipf-skewed key distributions ------
+    # One-series-per-row padding pays for the LONGEST series at every
+    # key (a real NBBO day is ~96% padding); when slot occupancy is low
+    # the series bin-pack into shared lane rows and the segmented merge
+    # kernel joins them independently (the packed-layout answer to the
+    # reference's tsPartitionVal skew machinery, tsdf.py:164-190 —
+    # which remains available explicitly).  Bounded-feature paths
+    # (sequence tie-break, maxLookback, skew brackets, broadcast) keep
+    # the dense layout.
+    use_binpack = (
+        not broadcast_path
+        and tsPartitionVal is None
+        and r_seq_j is None
+        and not maxLookback
+        and n_series > 1
+        and _binpack_worthwhile(l_layout, r_layout)
+    )
+    if use_binpack:
+        last_row_idx, per_col_idx, bp = _binpacked_indices(
+            right, l_layout, r_layout, r_sorted_take,
+            right_value_cols if skipNulls else [],
+        )
+        keep_mask_packed = None
+    else:
+        bp = None
+
     Ll = packing.pad_length(int(l_layout.lengths.max(initial=0)))
     Lr = packing.pad_length(int(r_layout.lengths.max(initial=0)))
-    l_ts_p = packing.pack_column(l_layout.ts_ns, l_layout, Ll, fill=packing.TS_PAD)
-    r_ts_p = packing.pack_column(r_layout.ts_ns, r_layout, Lr, fill=packing.TS_PAD)
+    if not use_binpack:
+        l_ts_p = packing.pack_column(
+            l_layout.ts_ns, l_layout, Ll, fill=packing.TS_PAD)
+        r_ts_p = packing.pack_column(
+            r_layout.ts_ns, r_layout, Lr, fill=packing.TS_PAD)
 
-    # validity masks per right column (order: right_value_cols)
-    r_sorted_take = r_take[r_layout.order]
-    r_valid_packed = []
-    for c in right_value_cols:
-        valid = (~pd.isna(right.df[c])).to_numpy()[r_sorted_take]
-        r_valid_packed.append(
-            packing.pack_column(valid, r_layout, Lr, fill=False)
-        )
-    r_valids = np.stack(r_valid_packed) if r_valid_packed else np.zeros((0, n_series, Lr), bool)
+        # validity masks per right column (order: right_value_cols)
+        r_valid_packed = []
+        for c in right_value_cols:
+            valid = (~pd.isna(right.df[c])).to_numpy()[r_sorted_take]
+            r_valid_packed.append(
+                packing.pack_column(valid, r_layout, Lr, fill=False)
+            )
+        r_valids = np.stack(r_valid_packed) if r_valid_packed else \
+            np.zeros((0, n_series, Lr), bool)
 
     # --- kernel dispatch ----------------------------------------------
     use_merge = strategy == "merge"
-    if broadcast_path:
+    if use_binpack:
+        pass
+    elif broadcast_path:
         idx, matched = asof_ops.asof_indices_inner(l_ts_p, r_ts_p)
         last_row_idx = np.asarray(idx)
         per_col_idx = None  # broadcast path is row-level, nulls included
@@ -231,11 +331,21 @@ def asof_join(
     pos = np.arange(l_layout.n_rows) - l_layout.starts[l_layout.key_ids]
     k_ids = l_layout.key_ids
 
-    def flat_right_indices(packed_idx):
-        ridx = packed_idx[k_ids, pos]
-        ok = ridx >= 0
-        flat = r_layout.starts[k_ids] + np.where(ok, ridx, 0)
-        return flat, ok
+    if use_binpack:
+        def flat_right_indices(packed_idx):
+            # bin-packed planes are indexed by (lane row, lane offset);
+            # returned positions are within-lane-row -> subtract the
+            # series' right-side offset for the per-series index
+            ridx = packed_idx[bp.row[k_ids], bp.l_off[k_ids] + pos]
+            ok = ridx >= 0
+            within = np.where(ok, ridx - bp.r_off[k_ids], 0)
+            return r_layout.starts[k_ids] + within, ok
+    else:
+        def flat_right_indices(packed_idx):
+            ridx = packed_idx[k_ids, pos]
+            ok = ridx >= 0
+            flat = r_layout.starts[k_ids] + np.where(ok, ridx, 0)
+            return flat, ok
 
     out = {}
     left_sorted = left.df.iloc[l_layout.order].reset_index(drop=True)

@@ -333,6 +333,23 @@ def asof_merge_values_binpacked(l_ts, r_ts, r_valids, r_values,
                                 l_sid=l_sid, r_sid=r_sid)
 
 
+def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid):
+    """Index-returning bin-packed join: same layout contract as
+    :func:`asof_merge_values_binpacked`, position-encoded payloads.
+    Returns ``(last_row_idx, per_col_idx)`` as WITHIN-LANE-ROW
+    positions (-1 none); callers convert to per-series indices with
+    the offsets they packed with (join.py does)."""
+    C, K, Lr = r_valids.shape
+    vdt = jnp.float32 if use_sort_kernels() else jnp.float64
+    pos = jnp.broadcast_to(jnp.arange(Lr, dtype=vdt), (K, Lr))
+    planes = jnp.broadcast_to(pos[None], (C, K, Lr))
+    vals, found, last_idx = asof_merge_values_binpacked(
+        l_ts, r_ts, r_valids, planes, l_sid, r_sid
+    )
+    per_col = jnp.where(found, vals, -1).astype(jnp.int32)
+    return last_idx, per_col
+
+
 def _ffill_scan_seg(f, has, val, axis: int = -1):
     """Segmented last-valid carry (Blelloch segmented-scan monoid):
     ``f`` flags segment heads; fills never cross a head."""

@@ -417,6 +417,44 @@ def binpack_rows(
     return out
 
 
+def binpack_dest(starts: np.ndarray, row: np.ndarray, off: np.ndarray,
+                 width: int) -> np.ndarray:
+    """Flat destination slot of every row of a flat per-series-sorted
+    column in the bin-packed [n_rows, width] grid — computed once and
+    reused for every plane (one vectorised scatter per plane instead of
+    a Python per-series loop)."""
+    n = int(starts[-1])
+    key_ids = np.repeat(np.arange(len(row), dtype=np.int64),
+                        np.diff(starts))
+    pos = np.arange(n, dtype=np.int64) - starts[key_ids]
+    return row[key_ids].astype(np.int64) * width + off[key_ids] + pos
+
+
+def binpack_scatter(flat: np.ndarray, dest: np.ndarray, n_rows: int,
+                    width: int, fill, dtype=None) -> np.ndarray:
+    """One fancy-index scatter of a flat column into the bin-packed
+    grid (``dest`` from :func:`binpack_dest`)."""
+    out = np.full(n_rows * width, fill, dtype=dtype or flat.dtype)
+    out[dest] = flat
+    return out.reshape(n_rows, width)
+
+
+def binpack_rows_flat(
+    flat: np.ndarray,
+    starts: np.ndarray,
+    row: np.ndarray,
+    off: np.ndarray,
+    n_rows: int,
+    width: int,
+    fill,
+    dtype=None,
+) -> np.ndarray:
+    """Scatter a flat per-series-sorted column (``starts`` offsets, the
+    FlatLayout form) into the bin-packed [n_rows, width] grid."""
+    dest = binpack_dest(starts, row, off, width)
+    return binpack_scatter(flat, dest, n_rows, width, fill, dtype)
+
+
 def binpack_sid(
     lengths: np.ndarray, row: np.ndarray, off: np.ndarray,
     n_rows: int, width: int,

@@ -187,6 +187,64 @@ def test_sequence_nulls_first():
     assert joined["right_seq_nb"].to_numpy(np.float64)[1] == 1.0
 
 
+def test_binpacked_join_matches_dense_layout(monkeypatch):
+    """Zipf-skewed keys: the bin-packed layout (auto-engaged at low
+    slot occupancy) must produce exactly the dense layout's frame, for
+    skipNulls on and off, numeric and string columns."""
+    rng = np.random.default_rng(4)
+    n_series = 24
+    lengths = np.maximum((400 / np.arange(1, n_series + 1) ** 1.2)
+                         .astype(int), 2)
+    rows_l, rows_r = [], []
+    for s, ln in enumerate(lengths):
+        secs = np.cumsum(rng.integers(1, 4, ln))
+        rows_l.append(pd.DataFrame({
+            "sym": f"S{s:02d}",
+            "event_ts": pd.to_datetime(secs * 10**9),
+            "x": rng.standard_normal(ln),
+        }))
+        rows_r.append(pd.DataFrame({
+            "sym": f"S{s:02d}",
+            "event_ts": pd.to_datetime(
+                (secs - rng.integers(0, 3, ln)) * 10**9),
+            "bid": np.where(rng.random(ln) > 0.25,
+                            rng.standard_normal(ln), np.nan),
+            "tag": [f"t{i % 5}" for i in range(ln)],
+        }))
+    left = pd.concat(rows_l, ignore_index=True)
+    right = pd.concat(rows_r, ignore_index=True)
+    tl = TSDF(left, partition_cols=["sym"])
+    tr = TSDF(right, partition_cols=["sym"])
+
+    from tempo_tpu import join as join_mod
+
+    # the occupancy heuristic must engage by itself on this skew
+    # (pin the env so an ambient override can't mask the heuristic)
+    monkeypatch.delenv("TEMPO_TPU_BINPACK", raising=False)
+    import tempo_tpu.packing as pkg
+    lay_l = pkg.build_flat_layout(left, "event_ts", ["sym"])
+    lay_r = pkg.build_flat_layout(right, "event_ts", ["sym"])
+    assert join_mod._binpack_worthwhile(lay_l, lay_r)
+
+    for skip in (True, False):
+        monkeypatch.setenv("TEMPO_TPU_BINPACK", "1")
+        packed = tl.asofJoin(tr, skipNulls=skip).df
+        monkeypatch.setenv("TEMPO_TPU_BINPACK", "0")
+        dense = tl.asofJoin(tr, skipNulls=skip).df
+        assert list(packed.columns) == list(dense.columns)
+        for c in packed.columns:
+            a, b = packed[c], dense[c]
+            assert (a.isna() == b.isna()).all(), (c, skip)
+            if pd.api.types.is_numeric_dtype(a):
+                np.testing.assert_allclose(
+                    a.to_numpy(np.float64), b.to_numpy(np.float64),
+                    equal_nan=True, err_msg=f"{c} skip={skip}",
+                )
+            else:   # strings, datetimes
+                assert (a.dropna().to_numpy()
+                        == b.dropna().to_numpy()).all(), (c, skip)
+
+
 def test_partitioned_asof_join():
     """tsdf_tests.py:343-394 - skew variant must match the plain join
     when the overlap fraction covers the lookback."""
