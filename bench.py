@@ -59,7 +59,10 @@ from tempo_tpu.packing import TS_PAD
 
 K = 1024          # series (partition keys)
 L = 8192          # rows per series  -> 8.4M left rows per step
-SUB_K = 8         # series subsample for the oracles
+SUB_K = 8         # series subsample for the oracles — STRIDED across
+                  # the key space (series 0, K/8, 2K/8, ...), not the
+                  # first 8, so per-key corner cases anywhere in the
+                  # grid can trip the audit (VERDICT r2 weak #4)
 ITERS = 3         # timing repeats per trip count (median)
 TARGET_SECS = 20  # wall budget for the long timing run: big enough to
                   # swamp dispatch overhead, small enough to stay way
@@ -128,8 +131,11 @@ def _make_run(body):
     and the nbbo config, both >25 min before being killed)."""
 
     def small(out):
-        return {k: v[..., :SUB_K, :].astype(jnp.float32)
-                for k, v in out.items()}
+        def sl(v):
+            stride = max(v.shape[-2] // SUB_K, 1)
+            return v[..., ::stride, :][..., :SUB_K, :]
+
+        return {k: sl(v).astype(jnp.float32) for k, v in out.items()}
 
     @jax.jit
     def run(n, scale0, *args):
@@ -223,8 +229,10 @@ def _loop_rate(body, args, n_rows, label, want_outputs=False, run=None):
 # ----------------------------------------------------------------------
 
 def _numpy_oracle(data, sub=SUB_K):
+    # the same strided series slice _make_run's carry threads out
+    stride = max(data[0].shape[-2] // sub, 1)
     l_ts, l_secs, x, valid, r_ts, r_valids, r_values = (
-        a[..., :sub, :] for a in data
+        a[..., ::stride, :][..., :sub, :] for a in data
     )
     x64 = x.astype(np.float64)
     Kx, Lx = x64.shape

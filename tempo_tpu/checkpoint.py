@@ -37,37 +37,76 @@ import jax
 FORMAT_VERSION = 1
 
 
-def save(frame, path: str) -> None:
+def save(frame, path: str, sharded: bool = False) -> None:
     """Snapshot a :class:`DistributedTSDF` (or host :class:`TSDF`) to
     ``path`` (a directory).  Atomic: the directory appears fully
-    written or not at all."""
+    written or not at all.
+
+    ``sharded=True`` (distributed frames): every process writes ONLY
+    its addressable device shards to its own ``shard_p<i>.npz`` — no
+    host ever materialises another host's data, the multi-host DCN
+    story the dense format (one stacked global fetch) cannot provide.
+    Resume works on any process count and mesh shape: ``load``
+    reassembles each process's slice from whichever shard files
+    overlap it.  Process 0 writes the manifest and host-side state;
+    multi-process runs synchronise around the final rename."""
     from tempo_tpu.dist import DistributedTSDF
     from tempo_tpu.frame import TSDF
 
+    pid = jax.process_index()
     tmp = path + ".tmp"
     bak = path + ".bak"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+    if pid == 0:
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tempo_ckpt_dir")
     try:
         if isinstance(frame, DistributedTSDF):
-            _save_dist(frame, tmp)
+            if sharded:
+                _save_dist_sharded(frame, tmp)
+            elif jax.process_count() > 1:
+                raise ValueError(
+                    "multi-process checkpoints must use sharded=True "
+                    "(the dense format fetches the global array)"
+                )
+            else:
+                _save_dist(frame, tmp)
         elif isinstance(frame, TSDF):
-            _save_host(frame, tmp)
+            if pid == 0:     # host frames are process-replicated state
+                _save_host(frame, tmp)
         else:
             raise TypeError(f"cannot checkpoint {type(frame)}")
-        # three-step swap: at every crash point either ``path`` or
-        # ``path.bak`` holds a complete previous/new checkpoint (load()
-        # falls back to .bak), so the guarantee survives a crash between
-        # the renames — rmtree(path) before replace would not
-        if os.path.exists(bak):
-            shutil.rmtree(bak)
-        if os.path.exists(path):
-            os.replace(path, bak)
-        os.replace(tmp, path)
-        shutil.rmtree(bak, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tempo_ckpt_written")
+        if pid == 0:
+            # three-step swap: at every crash point either ``path`` or
+            # ``path.bak`` holds a complete previous/new checkpoint
+            # (load() falls back to .bak), so the guarantee survives a
+            # crash between the renames
+            if os.path.exists(bak):
+                shutil.rmtree(bak)
+            if os.path.exists(path):
+                os.replace(path, bak)
+            os.replace(tmp, path)
+            shutil.rmtree(bak, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tempo_ckpt_swapped")
     except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
+        # single-process: clean up.  Multi-process: leave ``tmp`` in
+        # place (peers may still be writing into it; no swap happened,
+        # so the previous checkpoint is intact) and re-raise — peers
+        # blocked in the next barrier rely on the distributed runtime's
+        # failure detection, the same contract as any collective.
+        if pid == 0 and jax.process_count() == 1:
+            shutil.rmtree(tmp, ignore_errors=True)
         raise
 
 
@@ -90,6 +129,8 @@ def load(path: str, mesh=None, series_axis: str = "series",
         return _load_host(path, man)
     if mesh is None:
         raise ValueError("distributed checkpoint needs a mesh to resume on")
+    if man["kind"] == "dist_sharded":
+        return _load_dist_sharded(path, man, mesh, series_axis, time_axis)
     return _load_dist(path, man, mesh, series_axis, time_axis)
 
 
@@ -164,33 +205,252 @@ def _save_dist(frame, d: str) -> None:
         col_meta[str(i)] = meta
     np.savez(os.path.join(d, "arrays.npz"),
              **{k: v for k, v in arrays.items() if v.dtype != object})
-    obj_arrays = {k: v for k, v in arrays.items() if v.dtype == object}
-    if obj_arrays:
-        pd.DataFrame({k: pd.Series(v) for k, v in obj_arrays.items()}) \
-            .to_parquet(os.path.join(d, "objects.parquet"))
+    _write_host_side(frame, d,
+                     {k: v for k, v in arrays.items()
+                      if v.dtype == object})
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        man = _dist_manifest(frame)
+        man.update({"kind": "dist", "columns": col_meta,
+                    "n_cols": len(names)})
+        json.dump(man, f, indent=2)
 
+
+def _write_host_side(frame, d: str, obj_arrays: dict) -> None:
+    """Host-resident state both distributed formats share: object
+    planes, the key frame, and the host-column source."""
+    objs = {k: v for k, v in obj_arrays.items() if v.dtype == object}
+    if objs:
+        pd.DataFrame({k: pd.Series(v) for k, v in objs.items()}) \
+            .to_parquet(os.path.join(d, "objects.parquet"))
     frame.layout.key_frame.to_parquet(os.path.join(d, "keys.parquet"))
     if frame._source_df is not None and frame.host_cols:
-        frame._source_df[sorted(set(frame.host_cols.values()))].to_parquet(
-            os.path.join(d, "host.parquet")
+        frame._source_df[
+            sorted(set(frame.host_cols.values()))
+        ].to_parquet(os.path.join(d, "host.parquet"))
+
+
+def _read_host_gather(meta: dict, z, objs):
+    """Reconstruct a column's host_gather triple from saved arrays."""
+    if "host_gather" not in meta:
+        return None
+    j = meta["host_gather"]
+    key = f"hg_{j}_vals"
+    vals = (objs[key].to_numpy(object) if objs is not None
+            and key in objs.columns else z[key])
+    return (vals[: meta["host_gather_len"]], z[f"hg_{j}_starts"],
+            z[f"hg_{j}_perm"])
+
+
+def _dist_manifest(frame) -> dict:
+    """Shared manifest payload of both distributed formats."""
+    return {
+        "format_version": FORMAT_VERSION,
+        "ts_col": frame.ts_col,
+        "partition_cols": frame.partitionCols,
+        "ts_dtype": str(frame._ts_dtype),
+        "host_cols": frame.host_cols,
+        "halo_fraction": frame.halo_fraction,
+        "resampled": frame.resampled,
+        "seq_col": frame.seq_col,
+        "resample_freq": frame._resample_freq,
+        "audits": [(msg, int(np.asarray(cnt)))
+                   for msg, cnt in frame.audits],
+    }
+
+
+def _save_dist_sharded(frame, d: str) -> None:
+    """Per-process shard files: each device's addressable blocks of
+    every plane, written by the process that holds them."""
+    pid = jax.process_index()
+    names = list(frame.cols)
+    planes = {"ts": frame.ts, "mask": frame.mask}
+    if frame.seq is not None:
+        planes["seq"] = frame.seq
+    col_meta = {}
+    hg_arrays = {}
+    hg_idx = 0
+    for i, c in enumerate(names):
+        col = frame.cols[c]
+        planes[f"col_{i}_values"] = col.values
+        planes[f"col_{i}_valid"] = col.valid
+        meta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
+        if col.host_gather is not None:
+            flat_vals, r_starts, perm = col.host_gather
+            hg_arrays[f"hg_{hg_idx}_vals"] = flat_vals
+            hg_arrays[f"hg_{hg_idx}_starts"] = r_starts
+            hg_arrays[f"hg_{hg_idx}_perm"] = perm
+            meta["host_gather"] = hg_idx
+            meta["host_gather_len"] = int(len(flat_vals))
+            hg_idx += 1
+        col_meta[str(i)] = meta
+
+    local = {}
+    blocks = []
+    for name, arr in planes.items():
+        for j, sh in enumerate(arr.addressable_shards):
+            r, c = sh.index[-2], sh.index[-1]
+            blocks.append({
+                "plane": name, "key": f"{name}_b{j}",
+                "rows": [int(r.start or 0),
+                         int(r.stop if r.stop is not None
+                             else arr.shape[-2])],
+                "lanes": [int(c.start or 0),
+                          int(c.stop if c.stop is not None
+                              else arr.shape[-1])],
+            })
+            local[f"{name}_b{j}"] = np.asarray(sh.data)
+    np.savez(os.path.join(d, f"shard_p{pid}.npz"), **local)
+    with open(os.path.join(d, f"blocks_p{pid}.json"), "w") as f:
+        json.dump(blocks, f)
+
+    if pid == 0:
+        np.savez(
+            os.path.join(d, "host_arrays.npz"),
+            layout_ts_ns=frame.layout.ts_ns,
+            layout_starts=frame.layout.starts,
+            layout_key_ids=frame.layout.key_ids,
+            layout_order=frame.layout.order,
+            **{k: v for k, v in hg_arrays.items() if v.dtype != object},
         )
-    audits = [(msg, int(np.asarray(cnt))) for msg, cnt in frame.audits]
-    with open(os.path.join(d, "manifest.json"), "w") as f:
-        json.dump({
-            "format_version": FORMAT_VERSION,
-            "kind": "dist",
-            "ts_col": frame.ts_col,
-            "partition_cols": frame.partitionCols,
-            "ts_dtype": str(frame._ts_dtype),
-            "host_cols": frame.host_cols,
-            "halo_fraction": frame.halo_fraction,
-            "resampled": frame.resampled,
-            "seq_col": frame.seq_col,
-            "resample_freq": frame._resample_freq,
-            "audits": audits,
+        _write_host_side(frame, d, hg_arrays)
+        man = _dist_manifest(frame)
+        man.update({
+            "kind": "dist_sharded",
             "columns": col_meta,
             "n_cols": len(names),
-        }, f, indent=2)
+            "n_processes": jax.process_count(),
+            "shape": [int(s) for s in frame.ts.shape],
+            "has_seq": frame.seq is not None,
+        })
+        with open(os.path.join(d, "manifest.json"), "w") as f:
+            json.dump(man, f, indent=2)
+
+
+def _assemble_plane(all_blocks, name: str, shape, lo: int,
+                    hi: int, fill, dtype, shard_files):
+    """Rows [lo, hi) of a saved plane, stitched from whichever shard
+    files overlap them (every lane; the process-major layout keeps a
+    process's lanes local, parallel/multihost.py)."""
+    K, L = shape
+    out = np.full((hi - lo, L), fill, dtype=dtype)
+    for pid, blocks in all_blocks.items():
+        for b in blocks:
+            if b["plane"] != name:
+                continue
+            r0, r1 = b["rows"]
+            if r1 <= lo or r0 >= hi:
+                continue
+            c0, c1 = b["lanes"]
+            data = shard_files[pid][b["key"]]
+            s0, s1 = max(r0, lo), min(r1, hi)
+            out[s0 - lo: s1 - lo, c0:c1] = data[s0 - r0: s1 - r0]
+    return out
+
+
+def _load_dist_sharded(d: str, man: dict, mesh, series_axis: str,
+                       time_axis: Optional[str]):
+    import glob as _glob
+
+    from jax.sharding import NamedSharding
+
+    from tempo_tpu import packing
+    from tempo_tpu.dist import DistCol, DistributedTSDF, _spec
+    from tempo_tpu.parallel import multihost as mh
+
+    z = np.load(os.path.join(d, "host_arrays.npz"), allow_pickle=False)
+    obj_path = os.path.join(d, "objects.parquet")
+    objs = pd.read_parquet(obj_path) if os.path.exists(obj_path) else None
+    key_frame = pd.read_parquet(os.path.join(d, "keys.parquet"))
+    host_path = os.path.join(d, "host.parquet")
+    source_df = pd.read_parquet(host_path) if os.path.exists(host_path) \
+        else None
+    layout = packing.FlatLayout(
+        key_ids=z["layout_key_ids"], ts_ns=z["layout_ts_ns"],
+        order=z["layout_order"], starts=z["layout_starts"],
+        key_frame=key_frame,
+    )
+
+    all_blocks = {}
+    shard_files = {}
+    for bp in sorted(_glob.glob(os.path.join(d, "blocks_p*.json"))):
+        pid = int(os.path.basename(bp)[len("blocks_p"):-len(".json")])
+        with open(bp) as f:
+            all_blocks[pid] = json.load(f)
+        shard_files[pid] = np.load(
+            os.path.join(d, f"shard_p{pid}.npz"), allow_pickle=False
+        )
+    if len(all_blocks) != man["n_processes"]:
+        raise ValueError(
+            f"sharded checkpoint incomplete: manifest records "
+            f"{man['n_processes']} writer processes but "
+            f"{len(all_blocks)} shard file(s) are present — silently "
+            f"filling the gap would fabricate empty series"
+        )
+
+    K, L = man["shape"]
+    n_s = mesh.shape[series_axis]
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    mult = 8 * n_t
+    L_new = -(-L // mult) * mult
+    k_mult = n_s * n_t
+    K_dev = max(1, -(-K // k_mult)) * k_mult
+    sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis))
+    lo, hi = mh.series_range_for_process(
+        jax.process_index(),
+        mh.mesh_shard_process_ids(mesh, series_axis), K_dev,
+    )
+
+    def put(name, fill, dtype):
+        block = np.full((hi - lo, L_new), fill, dtype=dtype)
+        src_hi = min(hi, K)
+        if src_hi > lo:
+            block[: src_hi - lo, :L] = _assemble_plane(
+                all_blocks, name, (K, L), lo, src_hi, fill, dtype,
+                shard_files,
+            )
+        if jax.process_count() == 1:
+            return jax.device_put(block, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, block, (K_dev, L_new)
+        )
+
+    ts_d = put("ts", packing.TS_PAD, np.int64)
+    mask_d = put("mask", False, bool)
+    cols = {}
+    for i in range(man["n_cols"]):
+        meta = man["columns"][str(i)]
+        hg = _read_host_gather(meta, z, objs)
+        vdt = _plane_dtype(all_blocks, shard_files,
+                           f"col_{i}_values")
+        fill = np.nan if np.issubdtype(vdt, np.floating) else 0
+        cols[meta["name"]] = DistCol(
+            put(f"col_{i}_values", fill, vdt),
+            put(f"col_{i}_valid", False, bool),
+            int64=meta["int64"],
+            ts_chunk=tuple(meta["ts_chunk"]) if meta["ts_chunk"] else None,
+            host_gather=hg,
+        )
+    seq_d = None
+    if man.get("has_seq"):
+        sdt = _plane_dtype(all_blocks, shard_files, "seq")
+        seq_d = put("seq", np.inf, sdt)
+    audits = [(msg, np.int64(cnt)) for msg, cnt in man["audits"]]
+    return DistributedTSDF(
+        mesh, series_axis, time_axis, ts_d, mask_d, cols, layout,
+        man["ts_col"], man["partition_cols"], np.dtype(man["ts_dtype"]),
+        source_df, man["host_cols"], man["halo_fraction"],
+        audits=audits, resampled=man["resampled"],
+        seq=seq_d, seq_col=man.get("seq_col", ""),
+        resample_freq=man.get("resample_freq"),
+    )
+
+
+def _plane_dtype(all_blocks, shard_files, name):
+    for pid, blocks in all_blocks.items():
+        for b in blocks:
+            if b["plane"] == name:
+                return shard_files[pid][b["key"]].dtype
+    raise ValueError(f"plane {name!r} missing from every shard file")
 
 
 def _load_dist(d: str, man: dict, mesh, series_axis: str,
@@ -236,14 +496,7 @@ def _load_dist(d: str, man: dict, mesh, series_axis: str,
     cols = {}
     for i in range(man["n_cols"]):
         meta = man["columns"][str(i)]
-        hg = None
-        if "host_gather" in meta:
-            j = meta["host_gather"]
-            key = f"hg_{j}_vals"
-            vals = (objs[key].to_numpy(object) if objs is not None
-                    and key in objs.columns else z[key])
-            vals = vals[: meta["host_gather_len"]]
-            hg = (vals, z[f"hg_{j}_starts"], z[f"hg_{j}_perm"])
+        hg = _read_host_gather(meta, z, objs)
         v = z[f"col_{i}_values"]
         fill = np.nan if np.issubdtype(v.dtype, np.floating) else 0
         cols[meta["name"]] = DistCol(
