@@ -80,6 +80,19 @@ def _lane(shape):
     return jax.lax.broadcasted_iota(jnp.int32, shape, dimension=1)
 
 
+_I32_MAX = 2**31 - 1
+
+
+def _pad_lanes(p, n: int, fill):
+    """Append ``n`` fill lanes (the shared pad convention of the join
+    and rank wrappers: i32-max keys sort after every real row)."""
+    return jnp.pad(p, ((0, 0), (0, n)), constant_values=fill)
+
+
+def _rev(p):
+    return jnp.flip(p, axis=-1)
+
+
 def _partner(p, span: int, in_lower):
     """Value at lane ^ span (the compare-exchange partner).  The rolls
     wrap, but a lane only reads the direction that stays in range.
@@ -297,10 +310,8 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
 
     hi_l, lo_l = _split_ts(l_ts)
     hi_r, lo_r = _split_ts(r_ts)
-    imax = jnp.int32(2**31 - 1)
-
-    def padl(p, n, fill):
-        return jnp.pad(p, ((0, 0), (0, n)), constant_values=fill)
+    imax = jnp.int32(_I32_MAX)
+    padl = _pad_lanes
 
     hi_l = padl(hi_l, Llp - Ll, imax)
     lo_l = padl(lo_l, Llp - Ll, imax)
@@ -309,7 +320,7 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
     sec_l = _SIDE + _lane((K, Llp))
     sec_r = _lane((K, Lrp))
 
-    rev = lambda p: jnp.flip(p, axis=-1)
+    rev = _rev
     keys = []
     if segmented:
         sid_l = padl(l_sid.astype(jnp.int32), Llp - Ll, imax)
@@ -367,6 +378,156 @@ def asof_merge_indices_pallas(l_ts, r_ts, r_valids, interpret=False):
     )
     per_col = jnp.where(jnp.isnan(out), -1, out).astype(jnp.int32)
     return last_idx, per_col
+
+
+def _make_rank_kernel(n_keys: int, Lc2: int, Lqp: int):
+    """Searchsorted as merge + count + unmerge: merge the key and
+    query streams, prefix-count the key-indicator in VMEM, unmerge via
+    the recorded swap masks, and read the counts at the query lanes.
+    Replaces merge_rank's two lax.sort ladders with one HBM pass."""
+
+    def kernel(*refs):
+        key_refs = refs[:n_keys]
+        isk_ref, out_ref = refs[n_keys], refs[n_keys + 1]
+        shape = key_refs[0].shape
+        keys = [r[:] for r in key_refs]
+        isk = isk_ref[:]
+
+        takes = []
+        span = Lc2 // 2
+        while span >= 1:
+            keys, (isk,), take = _merge_stage(keys, [isk], span, shape)
+            takes.append((span, take))
+            span //= 2
+
+        # inclusive prefix count of keys along the merged stream: at a
+        # query slot this IS its searchsorted rank (tie order encoded
+        # in the sec key decides left/right bound)
+        cnt = isk
+        span = 1
+        while span < Lc2:
+            rolled = pltpu.roll(cnt, shift=jnp.int32(span), axis=1)
+            lane = _lane(shape)
+            cnt = cnt + jnp.where(lane >= span, rolled, 0.0)
+            span *= 2
+
+        for span, take in reversed(takes):
+            (cnt,) = _unmerge_stage([cnt], take, span, shape)
+
+        # query lanes sit reversed at the tail of the concat layout
+        out_ref[:] = cnt[:, Lc2 - Lqp:]
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_keys", "Lc2", "Lqp", "interpret")
+)
+def _rank_call(keys, isk, n_keys, Lc2, Lqp, interpret=False):
+    K = keys[0].shape[0]
+    plan = _plan_merge(K, Lc2, 1, n_keys)
+    if plan is None:
+        raise ValueError("merge_rank kernel infeasible for this shape")
+    grid, bk, K_pad = plan
+    args = [pk._pad_rows(a, K_pad) for a in (*keys, isk)]
+    with jax.enable_x64(False):
+        spec = pl.BlockSpec((bk, Lc2), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        ospec = pl.BlockSpec((bk, Lqp), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        out = pl.pallas_call(
+            _make_rank_kernel(n_keys, Lc2, Lqp),
+            grid=grid,
+            in_specs=[spec] * (n_keys + 1),
+            out_specs=ospec,
+            out_shape=jax.ShapeDtypeStruct((K_pad, Lqp), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=100 * 1024 * 1024,
+            ),
+            interpret=interpret,
+        )(*args)
+    return out[:K]
+
+
+def _rank_key_planes(vals):
+    """Order-preserving i32 plane list for a sorted operand row."""
+    if vals.dtype == jnp.int64:
+        hi, lo = _split_ts(vals)
+        return [hi, lo]
+    if vals.dtype == jnp.int32:
+        return [vals]
+    raise TypeError(f"unsupported rank key dtype {vals.dtype}")
+
+
+@functools.partial(jax.jit, static_argnames=("side", "interpret"))
+def merge_rank_pallas(sorted_keys, sorted_queries, side: str = "left",
+                      interpret: bool = False):
+    """Pallas form of :func:`tempo_tpu.ops.sortmerge.merge_rank` (same
+    contract: np.searchsorted of each query row into each key row; both
+    ascending).  int32/int64 keys; counts exact in f32 (gated to
+    Lk < 2^24 by the caller)."""
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    K, Lk = sorted_keys.shape
+    Lq = sorted_queries.shape[-1]
+    dt = jnp.promote_types(sorted_keys.dtype, sorted_queries.dtype)
+    keys_k = sorted_keys.astype(dt)
+    keys_q = sorted_queries.astype(dt)
+
+    # roles swap vs the join: keys take the "left" (ascending) slot,
+    # queries ride reversed; pad both with i32-max planes
+    Lqp, Lc2, Lkp = _pad_plan(Lk, Lq)
+    imax = jnp.int32(_I32_MAX)
+
+    kp = _rank_key_planes(keys_k)
+    qp = _rank_key_planes(keys_q)
+    kp = [_pad_lanes(p, Lkp - Lk, imax) for p in kp]
+    qp = [_pad_lanes(p, Lqp - Lq, imax) for p in qp]
+    # tie key: side='left' -> queries sort before equal keys (rank
+    # counts strictly-smaller keys); 'right' -> after.  pos keeps the
+    # order strictly total (and the swap masks symmetric).
+    if side == "left":
+        sec_k = _SIDE + _lane((K, Lkp))
+        sec_q = _lane((K, Lqp))
+    else:
+        sec_k = _lane((K, Lkp))
+        sec_q = _SIDE + _lane((K, Lqp))
+
+    rev = _rev
+    planes = [jnp.concatenate([a, rev(b)], axis=-1)
+              for a, b in zip(kp, qp)]
+    planes.append(jnp.concatenate([sec_k, rev(sec_q)], axis=-1))
+    isk = jnp.concatenate(
+        [
+            jnp.ones((K, Lkp), jnp.float32)
+            * (_lane((K, Lkp)) < Lk),
+            jnp.zeros((K, Lqp), jnp.float32),
+        ],
+        axis=-1,
+    )
+    out = _rank_call(tuple(planes), isk, n_keys=len(planes), Lc2=Lc2,
+                     Lqp=Lqp, interpret=interpret)
+    ranks = jnp.flip(out, axis=-1)[:, :Lq]
+    return ranks.astype(jnp.int32)
+
+
+def merge_rank_supported(sorted_keys, sorted_queries) -> bool:
+    if not _pallas_enabled():
+        return False
+    if sorted_keys.dtype not in (jnp.int32, jnp.int64):
+        return False
+    if jnp.promote_types(sorted_keys.dtype, sorted_queries.dtype) \
+            not in (jnp.int32, jnp.int64):
+        return False
+    K, Lk = sorted_keys.shape
+    if Lk >= (1 << 24):
+        return False
+    Lq = int(sorted_queries.shape[-1])
+    # MUST mirror merge_rank_pallas's call exactly (keys first)
+    _, Lc2, _ = _pad_plan(Lk, Lq)
+    n_keys = 3 if jnp.promote_types(
+        sorted_keys.dtype, sorted_queries.dtype) == jnp.int64 else 2
+    return _plan_merge(K, Lc2, 1, n_keys) is not None
 
 
 def _pallas_enabled() -> bool:

@@ -182,6 +182,12 @@ def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: s
     from tempo_tpu.ops import sortmerge as sm
 
     if sorted_keys.ndim == 2 and queries.ndim == 2 and sm.use_sort_kernels():
+        from tempo_tpu.ops import pallas_merge as pm
+
+        if pm.merge_rank_supported(sorted_keys, queries):
+            # one VMEM pass (merge + count + unmerge) instead of
+            # merge_rank's two lax.sort ladders
+            return pm.merge_rank_pallas(sorted_keys, queries, side=side)
         return sm.merge_rank(sorted_keys, queries, side=side)
     fn = lambda a, v: jnp.searchsorted(a, v, side=side)
     return jax.vmap(fn)(sorted_keys, queries)

@@ -257,6 +257,37 @@ def test_indices_kernel_matches_xla(K, Ll, Lr, C):
     )
 
 
+@pytest.mark.parametrize(
+    "K,Lk,Lq,dt",
+    [
+        (4, 128, 128, np.int32),
+        (3, 200, 136, np.int64),
+        (5, 384, 128, np.int32),
+        (2, 128, 300, np.int64),
+    ],
+)
+def test_merge_rank_kernel_matches_searchsorted(K, Lk, Lq, dt):
+    from tempo_tpu.ops.pallas_merge import merge_rank_pallas
+
+    rng = np.random.default_rng(K * 7 + Lk)
+    keys = np.sort(rng.integers(0, 300, (K, Lk)), -1).astype(dt)
+    qs = np.sort(rng.integers(-5, 310, (K, Lq)), -1).astype(dt)
+    if dt == np.int64:
+        keys, qs = keys * 10**9, qs * 10**9
+    # clamped pads like real callers (rebased i32 / TS-pad headroom)
+    big = np.iinfo(dt).max if dt == np.int32 else np.int64(2**62)
+    keys[0, Lk // 2:] = big
+    qs[0, Lq // 2:] = big
+    for side in ("left", "right"):
+        got = np.asarray(merge_rank_pallas(
+            jnp.asarray(keys), jnp.asarray(qs), side=side, interpret=True
+        ))
+        want = np.stack([
+            np.searchsorted(keys[k], qs[k], side=side) for k in range(K)
+        ])
+        np.testing.assert_array_equal(got, want, err_msg=side)
+
+
 def test_supported_gate():
     l_ts = jnp.zeros((4, 128), jnp.int64)
     r_ts = jnp.zeros((4, 128), jnp.int64)
