@@ -54,6 +54,18 @@ def save(frame, path: str, sharded: bool = False) -> None:
     from tempo_tpu.frame import TSDF
 
     pid = jax.process_index()
+    # fully-local validation happens BEFORE the tmp directory and the
+    # first barrier exist: every process raises the same error with
+    # nothing on disk to clean up (ADVICE r3 — the old order left
+    # ``path.tmp`` behind on every such failed save)
+    if isinstance(frame, DistributedTSDF):
+        if not sharded and jax.process_count() > 1:
+            raise ValueError(
+                "multi-process checkpoints must use sharded=True "
+                "(the dense format fetches the global array)"
+            )
+    elif not isinstance(frame, TSDF):
+        raise TypeError(f"cannot checkpoint {type(frame)}")
     tmp = path + ".tmp"
     bak = path + ".bak"
     if pid == 0:

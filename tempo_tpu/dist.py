@@ -271,38 +271,7 @@ class DistributedTSDF:
         if (self.resampled or lay.n_rows == 0
                 or int(lay.starts[-1]) != lay.n_rows):
             return None
-        # cached on the layout so chained frames sharing it reuse bounds
-        cache = lay.__dict__.setdefault("_rowbound_cache", {})
-        key = float(window_secs)
-        if key not in cache:
-            secs = lay.ts_ns // packing.NS_PER_S
-            w = np.int64(window_secs)
-            behind = 0
-            ahead = 0
-            span_i32 = True
-            for k in range(lay.n_series):
-                s = secs[lay.starts[k]: lay.starts[k + 1]]
-                if len(s) == 0:
-                    continue
-                idx = np.arange(len(s))
-                behind = max(
-                    behind,
-                    int((idx - np.searchsorted(s, s - w, side="left")).max()),
-                )
-                ahead = max(
-                    ahead,
-                    int((np.searchsorted(s, s, side="right") - 1 - idx).max()),
-                )
-                # per-series seconds span PLUS the window must fit
-                # int32 for the VMEM kernel's rebased keys: the pads
-                # clamp to INT32_MAX and the truncation audit's
-                # pad-immunity needs >= window of headroom above every
-                # real key (a >68-year series or a decades-wide window
-                # falls back to the exact path)
-                if int(s[-1] - s[0]) + int(w) >= 2**31 - 2:
-                    span_i32 = False
-            cache[key] = (behind, ahead) if span_i32 else None
-        return cache[key]
+        return packing.layout_rowbounds(lay, window_secs)
 
     def _halo(self, L: int) -> int:
         shard = L // self.n_time
@@ -340,7 +309,7 @@ class DistributedTSDF:
         rowbounds = None
         if sort_kernels and strategy == "exact":
             rb = self._window_rowbounds(w)
-            if rb is not None and rb[0] + rb[1] <= 512:
+            if rb is not None and rb[0] + rb[1] <= rk.SHIFTED_MAX_ROWS:
                 rowbounds = rb
         for c in cols:
             col = self.cols[c]
@@ -542,19 +511,15 @@ class DistributedTSDF:
         # rec_ind — visible to the tied left rows.  The left frame's own
         # sequence never orders the merge.
         ml = int(maxLookback or 0)
-        if ml and self.resampled:
-            raise NotImplementedError(
-                "maxLookback with a resampled (bucket-head) LEFT frame "
-                "is not supported on the mesh: masked lane rows would "
-                "consume merged-stream window slots; collect() and use "
-                "the host TSDF.asofJoin"
-            )
-        # a resampled RIGHT frame keeps real-looking ts at masked lane
-        # rows; maxLookback must count real rows only, so those lanes
-        # are sort-compacted to the tail inside the kernel.  The mask
-        # plane is only gathered when that path is active; otherwise a
-        # derived placeholder fills the kernel operand slot (unread).
+        # resampled (bucket-head) frames keep real-looking ts at masked
+        # lane rows; maxLookback must count real rows only, so those
+        # lanes are sort-compacted to the lane tail inside the kernel —
+        # on the right (carrying every value plane along) and, since
+        # round 4, on the LEFT too (outputs route back through the
+        # recorded source-lane plane, _uncompact_left).  The mask
+        # planes are only consulted when a compaction is active.
         compact = bool(ml and right.resampled)
+        compact_left = bool(ml and self.resampled)
         r_mask_al = (align2(right.mask, perm, ok, False) if compact
                      else r_ts_al < packing.TS_REAL_MAX)
         has_seq = right.seq is not None
@@ -567,13 +532,14 @@ class DistributedTSDF:
             r_seq_al = align2(right.seq, perm, ok, np.inf)
             if self.n_time > 1:
                 vals, found = _asof_a2a_seq(self.mesh, self.series_axis,
-                                            self.time_axis, ml)(
-                    self.ts, r_ts_al, r_seq_al, vstack, pstack
+                                            self.time_axis, ml,
+                                            compact_left)(
+                    self.ts, self.mask, r_ts_al, r_seq_al, vstack, pstack
                 )
             else:
                 vals, found = _asof_local_seq(self.mesh, self.series_axis,
-                                              ml)(
-                    self.ts, r_ts_al, r_seq_al, vstack, pstack
+                                              ml, compact_left)(
+                    self.ts, self.mask, r_ts_al, r_seq_al, vstack, pstack
                 )
         elif self.n_time > 1:
             # joins are *global* per series (unbounded lookback), so the
@@ -582,13 +548,14 @@ class DistributedTSDF:
             # exactly, and switches back — no halo approximation
             vals, found = _asof_a2a(self.mesh, self.series_axis,
                                     self.time_axis, sort_kernels, ml,
-                                    compact)(
-                self.ts, r_ts_al, r_mask_al, vstack, pstack
+                                    compact, compact_left)(
+                self.ts, self.mask, r_ts_al, r_mask_al, vstack, pstack
             )
         else:
             vals, found = _asof_local(self.mesh, self.series_axis,
-                                      sort_kernels, ml, compact)(
-                self.ts, r_ts_al, r_mask_al, vstack, pstack
+                                      sort_kernels, ml, compact,
+                                      compact_left)(
+                self.ts, self.mask, r_ts_al, r_mask_al, vstack, pstack
             )
         audits = list(self.audits)
 
@@ -693,12 +660,13 @@ class DistributedTSDF:
         depend only on ts and freq), so their columns combine by name
         with no join.  Each resample still runs its own kernel — on a
         time-sharded mesh that is four a2a round-trips where a fused
-        four-aggregate kernel would need one; fuse if bars become hot."""
-        if fill:
-            raise NotImplementedError(
-                "calc_bars(fill=True) is host-path-only; call "
-                "collect() and use TSDF.calc_bars"
-            )
+        four-aggregate kernel would need one; fuse if bars become hot.
+
+        ``fill=True`` upsamples the merged bars to each series' dense
+        bucket grid with zero-filled numerics (resample.py:102-116) —
+        realised as the device interpolate's zero fill over the merged
+        bucket-head view (round 4; the four grids are identical, so
+        fill-then-merge and merge-then-fill commute)."""
         mc = metricCols or self.numeric_columns()
         new_cols: Dict[str, DistCol] = {}
         base = None
@@ -711,7 +679,10 @@ class DistributedTSDF:
         # host column order parity: prefixed metrics sorted by name
         # (resample.py:calc_bars sorts the non-partition columns)
         new_cols = {c: new_cols[c] for c in sorted(new_cols)}
-        return base._with(cols=new_cols)
+        bars = base._with(cols=new_cols)
+        if fill:
+            bars = bars.interpolate(method="zero")
+        return bars
 
     # ------------------------------------------------------------------
     # withGroupedStats (tsdf.py:723-759) / vwap (TSDF.scala:378-401)
@@ -1281,6 +1252,32 @@ def _compact_right_lanes(r_ts, r_mask, vstack, pstack):
     return ops[0], jnp.stack(ops[1: 1 + nv]), jnp.stack(ops[1 + nv:])
 
 
+def _compact_left_rows(l_ts, l_mask):
+    """Stable sort pushing masked-out LEFT rows to the lane tail as
+    TS_PAD (they would otherwise consume maxLookback merged-stream
+    window slots Spark's stream never contains — the left-side mirror
+    of ``_compact_right_lanes``).  Returns the compacted keys and the
+    original-lane plane whose inverse routes outputs back."""
+    K, L = l_ts.shape
+    iota = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (K, L))
+    key = jnp.where(l_mask, l_ts, packing.TS_PAD)
+    return jax.lax.sort((key, iota), dimension=-1, num_keys=1,
+                        is_stable=True)
+
+
+def _uncompact_left(src, vals, found):
+    """Route [C, K, L] join outputs back to the original left lanes:
+    sorting on the carried source-lane plane inverts the compaction
+    permutation."""
+    C = int(vals.shape[0])
+    ops = (src,) + tuple(vals[c] for c in range(C)) \
+        + tuple(found[c] for c in range(C))
+    routed = jax.lax.sort(ops, dimension=-1, num_keys=1, is_stable=True)
+    vals2 = jnp.stack(routed[1:1 + C]) if C else vals
+    found2 = jnp.stack(routed[1 + C:]) if C else found
+    return vals2, found2
+
+
 def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
                  max_lookback=0):
     """Per-plane AS-OF fill: on TPU the sort-and-scan join (no gathers,
@@ -1310,80 +1307,98 @@ def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
 
 @functools.lru_cache(maxsize=256)
 def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0,
-                compact=False):
+                compact=False, compact_left=False):
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
-    def kernel(l_ts, r_ts, r_mask, r_valids, r_values):
+    def kernel(l_ts, l_mask, r_ts, r_mask, r_valids, r_values):
         if compact:
             r_ts, r_valids, r_values = _compact_right_lanes(
                 r_ts, r_mask, r_valids, r_values
             )
-        return _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
-                            max_lookback)
+        if compact_left:
+            l_ts, src = _compact_left_rows(l_ts, l_mask)
+        vals, found = _asof_planes(l_ts, r_ts, r_valids, r_values,
+                                   sort_kernels, max_lookback)
+        if compact_left:
+            vals, found = _uncompact_left(src, vals, found)
+        return vals, found
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_local_seq(mesh, series_axis, max_lookback=0):
+def _asof_local_seq(mesh, series_axis, max_lookback=0,
+                    compact_left=False):
     """AS-OF with sequence tie-break: the merge join is the only exact
     form (reference union-sort semantics, tsdf.py:117-121), so it runs
-    on every backend."""
+    on every backend.  (A resampled RIGHT frame never has a sequence
+    column — resample drops it — so only the left compaction exists
+    here.)"""
     from tempo_tpu.ops import sortmerge as sm
 
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
-    def kernel(l_ts, r_ts, r_seq, r_valids, r_values):
+    def kernel(l_ts, l_mask, r_ts, r_seq, r_valids, r_values):
+        if compact_left:
+            l_ts, src = _compact_left_rows(l_ts, l_mask)
         vals, found, _ = sm.asof_merge_values(
             l_ts, r_ts, r_valids, r_values, r_seq=r_seq,
             max_lookback=max_lookback,
         )
+        if compact_left:
+            vals, found = _uncompact_left(src, vals, found)
         return vals, found
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 
 @functools.lru_cache(maxsize=256)
-def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0):
+def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0,
+                  compact_left=False):
     from tempo_tpu.ops import sortmerge as sm
 
     sp2 = _spec(mesh, series_axis, time_axis)
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
-    def kernel(l_ts, r_ts, r_seq, r_valids, r_values):
+    def kernel(l_ts, l_mask, r_ts, r_seq, r_valids, r_values):
         fwd = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
             tiled=True)
         rev = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
             tiled=True)
+        l_full = fwd(l_ts)
+        if compact_left:
+            l_full, src = _compact_left_rows(l_full, fwd(l_mask))
         vals, found, _ = sm.asof_merge_values(
-            fwd(l_ts), fwd(r_ts), fwd(r_valids), fwd(r_values),
+            l_full, fwd(r_ts), fwd(r_valids), fwd(r_values),
             r_seq=fwd(r_seq), max_lookback=max_lookback,
         )
+        if compact_left:
+            vals, found = _uncompact_left(src, vals, found)
         return rev(vals), rev(found)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 
 @functools.lru_cache(maxsize=256)
 def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
-              max_lookback=0, compact=False):
+              max_lookback=0, compact=False, compact_left=False):
     """Exact AS-OF join on a time-sharded mesh: switch both sides to a
     series-local layout (full rows per device, one ``all_to_all`` per
     array), join locally, switch the [n_cols, K, Ll] results back."""
     sp2 = _spec(mesh, series_axis, time_axis)
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
-    def kernel(l_ts, r_ts, r_mask, r_valids, r_values):
+    def kernel(l_ts, l_mask, r_ts, r_mask, r_valids, r_values):
         fwd = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
             tiled=True)
@@ -1396,12 +1411,16 @@ def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
             r_full, rv_full, rx_full = _compact_right_lanes(
                 r_full, fwd(r_mask), rv_full, rx_full
             )
+        if compact_left:
+            l_full, src = _compact_left_rows(l_full, fwd(l_mask))
         vals, found = _asof_planes(l_full, r_full, rv_full, rx_full,
                                    sort_kernels, max_lookback)
+        if compact_left:
+            vals, found = _uncompact_left(src, vals, found)
         return rev(vals), rev(found)
 
     return jax.jit(shard_map(kernel, mesh=mesh,
-                             in_specs=(sp2, sp2, sp2, sp3, sp3),
+                             in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
                              out_specs=(sp3, sp3)))
 
 
@@ -1529,17 +1548,39 @@ def _autocorr_fn(lag, compact):
 def _bucket_heads(ts, mask, step_ns):
     """Shared tumbling-bucket scaffolding: absolute bucket key ``b``,
     bucket-head mask, and per-row [start, end) row bounds of the row's
-    bucket (used by resample, grouped stats, and vwap)."""
+    bucket (used by resample, grouped stats, and vwap).
+
+    The searchsorted bounds run over ``b_all`` (every row's bucket,
+    masked rows included) and NOT the TS_PAD-masked ``b``: a masked row
+    *between* two real rows of one bucket (any bucket-head view — e.g.
+    a chained resample) would make ``b`` non-monotone, and the TPU
+    sort-based searchsorted silently returns garbage on unsorted keys
+    (round-4 fix; the masked rows inside a range are harmless — their
+    validity planes are False).  ``head`` compares each real row's
+    bucket against the previous REAL row's bucket (a cummax carry —
+    buckets are monotone over the sorted ts), not the physically
+    previous row: comparing against a masked neighbour flagged every
+    real row after a gap as a head, duplicating buckets in chained
+    resamples (round-4 fix)."""
     step = jnp.int64(step_ns)
-    b = jnp.where(mask, (ts // step) * step, packing.TS_PAD)
-    prev_b = jnp.concatenate(
-        [jnp.full_like(b[:, :1], -1), b[:, :-1]], axis=-1
+    b_all = (ts // step) * step
+    b = jnp.where(mask, b_all, packing.TS_PAD)
+    neg = jnp.int64(-(2**62))
+    last_real = rk.wu.cummax(jnp.where(mask, b_all, neg))
+    prev_real = jnp.concatenate(
+        [jnp.full_like(b[:, :1], neg), last_real[:, :-1]], axis=-1
     )
-    head = mask & (b != prev_b)
-    start = rk.wu.searchsorted_batched(b, b, side="left").astype(jnp.int32)
-    end = rk.wu.searchsorted_batched(b, b + step,
+    head = mask & (b_all != prev_real)
+    start = rk.wu.searchsorted_batched(b_all, b_all,
+                                       side="left").astype(jnp.int32)
+    end = rk.wu.searchsorted_batched(b_all, b_all + step,
                                      side="left").astype(jnp.int32)
-    return b, head, start, end
+    # per-row rebased i32 bucket id for the VMEM segmented-reduction
+    # kernel (rk.bucket_stats); pads clamp to i32-max and form their
+    # own trailing bucket, masked downstream like the bound form
+    rel = (b_all - b_all[:, :1]) // step
+    bid = jnp.minimum(rel, 2**31 - 1).astype(jnp.int32)
+    return b, head, start, end, bid
 
 
 @functools.lru_cache(maxsize=256)
@@ -1554,10 +1595,10 @@ def _bucket_stats_fn(mesh, series_axis, time_axis, step_ns, n_cols,
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
     def local(ts, mask, vals, valids):
-        b, head, start, end = _bucket_heads(ts, mask, step_ns)
+        b, head, start, end, bid = _bucket_heads(ts, mask, step_ns)
         outs = []
         for i in range(n_cols):
-            stats = rk.windowed_stats(vals[i], valids[i], start, end)
+            stats = rk.bucket_stats(bid, vals[i], valids[i], start, end)
             outs.append(jnp.stack([
                 stats["mean"], stats["count"], stats["min"], stats["max"],
                 stats["sum"], stats["stddev"],
@@ -1624,6 +1665,13 @@ def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
         first_b = jnp.min(ts_j, axis=1, keepdims=True)         # [K, 1]
         last_b = jnp.max(jnp.where(head, ts, jnp.int64(-1)), axis=1,
                          keepdims=True)
+        # the merge joins below receive ``ts`` (sorted), NOT the
+        # TS_PAD-masked ``ts_j``: a bucket-head view has interior
+        # head=False rows, and masking them to TS_PAD breaks the
+        # ascending-per-row contract of the TPU merge kernels (silent
+        # wrong results; round-4 fix).  Non-head rows are excluded by
+        # their validity planes instead — identical semantics, the
+        # per-column fill skips invalid rows.
         has_any = last_b >= 0
         gridj = jnp.arange(G, dtype=jnp.int64)[None, :]        # [1, G]
         grid_ts = jnp.where(
@@ -1644,12 +1692,12 @@ def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
             valids, valids, head[None],
         ])
         prev_v, prev_f, _ = sm.asof_merge_values(
-            grid_ts, ts_j, pvalid, planes
+            grid_ts, ts, pvalid, planes
         )
         neg = lambda a: -a[..., ::-1]
         flip = lambda a: a[..., ::-1]
         next_v_r, next_f_r, _ = sm.asof_merge_values(
-            neg(grid_ts), neg(ts_j), flip(pvalid), flip(planes)
+            neg(grid_ts), neg(ts), flip(pvalid), flip(planes)
         )
         next_v = flip(next_v_r)
         next_f = flip(next_f_r)
@@ -1716,7 +1764,29 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
     def local(ts, mask, vals, valids):
-        b, head, start, end = _bucket_heads(ts, mask, step_ns)
+        b, head, start, end, bid = _bucket_heads(ts, mask, step_ns)
+
+        if fkey == 1:
+            # ceil reads each bucket's last REAL row: a bucket-head
+            # view can end its physical [start, end) run on a masked
+            # row, so the gather index comes from a segmented
+            # last-real-lane scan, not end-1 itself (round-4 fix;
+            # identical to end-1 on dense frames)
+            from tempo_tpu.ops.sortmerge import _ffill_scan_seg
+
+            K_l, L_l = mask.shape
+            lane = jnp.broadcast_to(
+                jnp.arange(L_l, dtype=jnp.int32), (K_l, L_l)
+            )
+            fence = jnp.concatenate(
+                [jnp.ones((K_l, 1), jnp.bool_),
+                 bid[:, 1:] != bid[:, :-1]], axis=-1
+            )
+            _, has_real, last_lane = _ffill_scan_seg(fence, mask, lane)
+            last_phys = jnp.maximum(end - 1, 0)
+            idx = jnp.take_along_axis(last_lane, last_phys, axis=-1)
+            has = jnp.take_along_axis(has_real, last_phys, axis=-1)
+            last = jnp.maximum(idx, 0)
 
         outs = []
         oks = []
@@ -1726,11 +1796,11 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
                 outs.append(x)
                 oks.append(head & v)
             elif fkey == 1:        # ceil: last record of the bucket
-                last = jnp.maximum(end - 1, 0)
                 outs.append(jnp.take_along_axis(x, last, axis=-1))
-                oks.append(head & jnp.take_along_axis(v, last, axis=-1))
+                oks.append(head & has
+                           & jnp.take_along_axis(v, last, axis=-1))
             else:
-                stats = rk.windowed_stats(x, v, start, end)
+                stats = rk.bucket_stats(bid, x, v, start, end)
                 key = {2: "mean", 3: "min", 4: "max"}[fkey]
                 outs.append(stats[key])
                 oks.append(head & (stats["count"] > 0))

@@ -102,11 +102,12 @@ def _binpack_worthwhile(l_layout, r_layout) -> bool:
 
 
 def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
-                       valid_cols):
+                       valid_cols, max_lookback: int = 0):
     """Join indices through the bin-packed segmented kernel: short
     series share lane rows (packing.bin_pack_series), one program for
     any skew shape.  ``valid_cols`` empty = skipNulls=False (only the
-    last-row channel is consumed)."""
+    last-row channel is consumed).  ``max_lookback`` rides the
+    sid-fenced windowed ladder (sortmerge._asof_merge_explicit)."""
     import jax.numpy as jnp
 
     from tempo_tpu.ops import sortmerge as sm
@@ -140,7 +141,8 @@ def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
 
     last_idx, per_col = sm.asof_indices_binpacked(
         jnp.asarray(lt), jnp.asarray(rt), jnp.asarray(rv),
-        jnp.asarray(lsid), jnp.asarray(rsid))
+        jnp.asarray(lsid), jnp.asarray(rsid),
+        max_lookback=int(max_lookback))
     return np.asarray(last_idx), np.asarray(per_col), bp
 
 
@@ -257,14 +259,15 @@ def asof_join(
     # the series bin-pack into shared lane rows and the segmented merge
     # kernel joins them independently (the packed-layout answer to the
     # reference's tsPartitionVal skew machinery, tsdf.py:164-190 —
-    # which remains available explicitly).  Bounded-feature paths
-    # (sequence tie-break, maxLookback, skew brackets, broadcast) keep
-    # the dense layout.
+    # which remains available explicitly).  The sequence tie-break,
+    # skew brackets, and broadcast paths keep the dense layout (the
+    # bin-pack layout sorts by ts only, so a seq-ordered merge
+    # precondition would not hold); maxLookback rides the sid-fenced
+    # windowed ladder since round 4.
     use_binpack = (
         not broadcast_path
         and tsPartitionVal is None
         and r_seq_j is None
-        and not maxLookback
         and n_series > 1
         and _binpack_worthwhile(l_layout, r_layout)
     )
@@ -272,6 +275,7 @@ def asof_join(
         last_row_idx, per_col_idx, bp = _binpacked_indices(
             right, l_layout, r_layout, r_sorted_take,
             right_value_cols if skipNulls else [],
+            max_lookback=int(maxLookback or 0),
         )
         keep_mask_packed = None
     else:

@@ -91,7 +91,6 @@ def _asof_indices_search_form(l_ts, r_ts, r_valids):
 # General path: merge by (ts, seq, side) with stable multi-key sort
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("n_cols", "max_lookback"))
 def asof_indices_merge(
     l_ts: jnp.ndarray,           # [K, Ll] int64 (TS_PAD padding)
     l_seq: Optional[jnp.ndarray],  # [K, Ll] float64 or None
@@ -107,7 +106,40 @@ def asof_indices_merge(
     NULLS FIRST, rec_ind) - tsdf.py:117-121.  Left rows carry seq=-inf
     when they have no sequence value (Spark nulls-first), rec=+1; right
     rows rec=-1.
+
+    On TPU the unbounded form runs as the VMEM Pallas merge kernel
+    with the sequence riding as extra order-preserving key planes
+    (ops/pallas_merge.py, round 4) — the XLA form below pays a
+    dynamic-gather per column, each costing more than a full lane sort
+    on this hardware (ops/sortmerge.py module docstring timings).
+    ``maxLookback`` keeps the XLA windowed-argmax ladder.
     """
+    from tempo_tpu.ops import pallas_merge as pm
+
+    if not max_lookback:
+        l_seq_k = pm.seq_kernel_form(l_seq)
+        r_seq_k = pm.seq_kernel_form(r_seq)
+        expressible = (l_seq is None or l_seq_k is not None) and \
+            (r_seq is None or r_seq_k is not None)
+        if expressible and pm.merge_indices_supported(
+                l_ts, r_ts, r_valids, l_seq_k, r_seq_k):
+            return pm.asof_merge_indices_pallas(l_ts, r_ts, r_valids,
+                                                l_seq_k, r_seq_k)
+    return _asof_indices_merge_xla(l_ts, l_seq, r_ts, r_seq, r_valids,
+                                   n_cols=n_cols,
+                                   max_lookback=max_lookback)
+
+
+@functools.partial(jax.jit, static_argnames=("n_cols", "max_lookback"))
+def _asof_indices_merge_xla(
+    l_ts: jnp.ndarray,
+    l_seq: Optional[jnp.ndarray],
+    r_ts: jnp.ndarray,
+    r_seq: Optional[jnp.ndarray],
+    r_valids: jnp.ndarray,
+    n_cols: int,
+    max_lookback: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     K, Ll = l_ts.shape
     Lr = r_ts.shape[1]
     Lc = Ll + Lr

@@ -36,15 +36,30 @@ This kernel runs the whole join on a [bk, Lc] block resident in VMEM:
 HBM traffic: one read of the input planes, one write of the output —
 independent of the number of network stages.
 
-Engages for float32 values, no sequence column, skipNulls=True (the
-reference's default join); the XLA forms remain for every other case
-(sequence tie-break, skipNulls=False, float64 golden runs, CPU).
-Reference semantics preserved: python/tempo/tsdf.py:111-162.
+Engages for float32 values on any combination of the reference's join
+flags (round 4; rounds 2-3 covered only the default configuration):
+
+* **sequence tie-break** (tsdf.py:117-121): the seq plane joins the
+  kernel's total order between the ts planes and the side key, as one
+  or two extra i32 key planes via an order-preserving bit map
+  (IEEE-float sign-fold, int64 hi/lo split — `_seq_key_planes`).  The
+  packed layout already sorts each side by (ts, seq)
+  (packing.py:228-245), so the bitonic-merge precondition holds.
+* **skipNulls=False** (tsdf.py:123-136 struct-wrap): the ffill ladder
+  switches from per-plane NaN fill to a *lockstep* fill keyed on the
+  last-right-row channel — every payload plane takes the same source
+  slot, so all columns come from the single last right row, nulls
+  included (`_ffill_stage_keyed`).
+
+The XLA forms remain for maxLookback, float64 golden runs, CPU, and
+VMEM-infeasible shapes.  Reference semantics: tsdf.py:111-162.
 """
 
 from __future__ import annotations
 
 import functools
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -149,6 +164,28 @@ def _unmerge_stage(payload, take, span: int, shape):
     )
 
 
+def _ffill_stage_keyed(planes, span: int, shape, sid=None):
+    """Lockstep fill: the LAST plane (the last-right-row index channel,
+    NaN at left/pad slots) keys the fill, and every plane moves with
+    it — so each slot always holds the fields of ONE source row.  This
+    realises skipNulls=False (all columns from the single last right
+    row, nulls included, tsdf.py:123-136): value planes are NaN-encoded
+    per right row (NaN = that row's value is null), and a filled slot
+    inherits the whole row, NaNs and all.  Pointer-doubling correctness
+    is the per-plane argument applied to the key plane; the other
+    planes ride its take mask, preserving the one-source invariant by
+    induction."""
+    ok = _lane(shape) >= span
+    if sid is not None:
+        ok = ok & (pltpu.roll(sid, shift=jnp.int32(span), axis=1) == sid)
+    take = jnp.isnan(planes[-1]) & ok
+    out = []
+    for p in planes:
+        prev = pltpu.roll(p, shift=jnp.int32(span), axis=1)
+        out.append(jnp.where(take, prev, p))
+    return out
+
+
 def _ffill_stage(planes, span: int, shape, sid=None):
     """planes[i] <- planes[i] if non-NaN else planes[i - span].  With
     ``sid`` (bin-packed rows: multiple series per lane row) the fill is
@@ -166,10 +203,14 @@ def _ffill_stage(planes, span: int, shape, sid=None):
     return out
 
 
-def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
+def _make_kernel(n_payload: int, Lc2: int, Llp: int, n_keys: int,
+                 segmented: bool, keyed_fill: bool):
     """Kernel closure: merge + ffill + unmerge on [bk, Lc2] blocks.
-    With ``segmented``, a leading series-id key plane both orders the
-    merge (so bin-packed series never interleave) and fences the fill.
+    ``n_keys`` counts the key planes (sid? + ts hi/lo + seq planes? +
+    side); with ``segmented``, the leading series-id key plane both
+    orders the merge (so bin-packed series never interleave) and fences
+    the fill.  ``keyed_fill`` switches the ladder to the lockstep
+    skipNulls=False form (`_ffill_stage_keyed`).
 
     Routing back to input lanes replays the merge's recorded swap masks
     in reverse (each stage is an involution over disjoint pairs), which
@@ -178,7 +219,6 @@ def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
     a destination-keyed route would need."""
 
     def kernel(*refs):
-        n_keys = 4 if segmented else 3
         key_refs = refs[:n_keys]
         payload_refs = refs[n_keys: n_keys + n_payload]
         out_refs = refs[n_keys + n_payload:]
@@ -194,9 +234,10 @@ def _make_kernel(n_payload: int, Lc2: int, Llp: int, segmented: bool):
             span //= 2
 
         sid = keys[0] if segmented else None
+        stage = _ffill_stage_keyed if keyed_fill else _ffill_stage
         span = 1
         while span < Lc2:
-            payload = _ffill_stage(payload, span, shape, sid=sid)
+            payload = stage(payload, span, shape, sid=sid)
             span *= 2
 
         for span, take in reversed(takes):
@@ -231,9 +272,11 @@ def _plan_merge(K: int, Lc2: int, n_payload: int, n_keys: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_payload", "Lc2", "Llp", "interpret")
+    jax.jit, static_argnames=("n_payload", "Lc2", "Llp", "segmented",
+                              "keyed_fill", "interpret")
 )
-def _merge_call(keys, payload, n_payload, Lc2, Llp, interpret=False):
+def _merge_call(keys, payload, n_payload, Lc2, Llp, segmented=False,
+                keyed_fill=False, interpret=False):
     K = keys[0].shape[0]
     n_keys = len(keys)
     plan = _plan_merge(K, Lc2, n_payload, n_keys)
@@ -255,7 +298,8 @@ def _merge_call(keys, payload, n_payload, Lc2, Llp, interpret=False):
         ospec = pl.BlockSpec((bk, Llp), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
         out = pl.pallas_call(
-            _make_kernel(n_payload, Lc2, Llp, segmented=n_keys == 4),
+            _make_kernel(n_payload, Lc2, Llp, n_keys=n_keys,
+                         segmented=segmented, keyed_fill=keyed_fill),
             grid=grid,
             in_specs=[spec] * (n_keys + n_payload),
             out_specs=[ospec] * n_payload,
@@ -282,13 +326,119 @@ def _split_ts(ts):
     return hi, lo
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _seq_key_planes(seq):
+    """Order-preserving i32 key planes for a sequence plane (the sort
+    key of the reference's tie-break, tsdf.py:117-121).  Floats ride
+    the IEEE sign-fold (monotone int of the bit pattern: non-negative
+    keeps its bits, negative maps to int_min - bits — exact for every
+    value including the caller's ±inf null/pad encodings; NaN is
+    excluded by the packing contract, which maps null seq to -inf
+    before any kernel).  64-bit keys split (hi, bias-corrected lo)
+    like the ts planes."""
+    if seq.dtype == jnp.int32:
+        return [seq]
+    if seq.dtype == jnp.int64:
+        return list(_split_ts(seq))
+    if seq.dtype == jnp.float32:
+        b = jax.lax.bitcast_convert_type(seq, jnp.int32)
+        return [jnp.where(b >= 0, b, jnp.int32(-(2**31)) - b)]
+    # float64 never reaches the kernel: a 64-bit bitcast-convert is
+    # unimplemented in the TPU backend's X64-rewrite pass (probed on
+    # v5e, 2026-07-30) — dispatchers re-encode concrete f64 planes via
+    # seq_kernel_form() first
+    raise TypeError(f"unsupported sequence dtype {seq.dtype}")
+
+
+def seq_kernel_form(seq):
+    """Concrete float64 sequence plane -> a kernel-expressible dtype,
+    or None when it must stay on the XLA path.
+
+    The TPU X64 rewriter cannot lower ``bitcast_convert(f64 -> s64)``
+    (probed), so f64 seq keys cannot ride the IEEE sign-fold on
+    device.  Instead, outside jit: cast to f32 when every value
+    round-trips exactly (±inf sentinels included); else, integral
+    values re-encode as int64 (shift/mask splitting IS supported — the
+    ts planes prove it) with ±inf mapped to the int64 extremes.  The
+    -inf -> int64-min collapse merges the null-seq key with the
+    synthesized left key — semantically invisible: they tie on seq and
+    the side key orders right-before-left, the same visible set as the
+    strict float order (tsdf.py:117-121 NULLS FIRST + rec_ind).
+
+    f32/int planes pass through; tracers (in-jit callers, e.g. the
+    dist shard_map kernels, which use the f32 compute dtype anyway)
+    and inexpressible f64 return None."""
+    if seq is None:
+        return seq
+    if isinstance(seq, jax.core.Tracer):
+        return None if seq.dtype == jnp.float64 else seq
+    if seq.dtype != jnp.float64:
+        return seq
+    a = np.asarray(seq)
+    f32 = a.astype(np.float32)
+    if np.array_equal(f32.astype(np.float64), a):
+        return jnp.asarray(f32)
+    finite = np.isfinite(a)
+    af = a[finite]
+    if np.array_equal(af, np.floor(af)) and (
+            af.size == 0 or np.abs(af).max() < 2.0**62):
+        i = np.where(finite, a, 0.0).astype(np.int64)
+        i = np.where(a == np.inf, np.iinfo(np.int64).max, i)
+        i = np.where(a == -np.inf, np.iinfo(np.int64).min, i)
+        return jnp.asarray(i)
+    return None
+
+
+def _n_seq_planes(l_seq, r_seq):
+    """Key-plane count the sequence pair will need, or None when the
+    (promoted) dtype has no order-preserving i32 mapping here (f64:
+    see seq_kernel_form — dispatchers re-encode before the gate)."""
+    if l_seq is None and r_seq is None:
+        return 0
+    dts = [s.dtype for s in (l_seq, r_seq) if s is not None]
+    pdt = dts[0] if len(dts) == 1 else jnp.promote_types(*dts)
+    if pdt in (jnp.int32, jnp.float32):
+        return 1
+    if pdt == jnp.int64:
+        return 2
+    return None
+
+
+def _seq_sides(l_seq, r_seq, K, Ll, Lr):
+    """(l_seq, r_seq) with the None side synthesized at the dtype
+    minimum and both cast to the promoted dtype — exactly the XLA
+    ``_merge_sides`` construction (sortmerge.py): the synthesized side
+    sits above the -inf null encoding and below any real value, giving
+    right-null < left < right-non-null on ts ties (Spark ASC NULLS
+    FIRST + rec_ind, tsdf.py:117-121)."""
+    sdt = (l_seq if l_seq is not None else r_seq).dtype
+    neg = (
+        jnp.finfo(sdt).min
+        if jnp.issubdtype(sdt, jnp.floating)
+        else jnp.iinfo(sdt).min
+    )
+    ls = l_seq if l_seq is not None else jnp.full((K, Ll), neg, sdt)
+    rs = r_seq if r_seq is not None else jnp.full((K, Lr), neg, sdt)
+    pdt = jnp.promote_types(ls.dtype, rs.dtype)
+    return ls.astype(pdt), rs.astype(pdt)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("skip_nulls", "interpret"))
 def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
                              l_sid=None, r_sid=None,
+                             l_seq=None, r_seq=None,
+                             skip_nulls: bool = True,
                              interpret: bool = False):
-    """skipNulls float path of ``asof_merge_values`` as one Pallas
-    kernel; same contract: ``(vals [C, K, Ll], found, last_row_idx)``.
-    REQUIRES both ts arrays ascending per row (packed-layout invariant).
+    """float path of ``asof_merge_values`` as one Pallas kernel; same
+    contract: ``(vals [C, K, Ll], found, last_row_idx)``.  REQUIRES
+    both ts arrays ascending per row (packed-layout invariant) — with
+    ``l_seq``/``r_seq``, ascending in (ts, seq), which the layout sort
+    guarantees (packing.py:228-245).
+
+    ``skip_nulls=False`` switches the ffill ladder to the lockstep
+    keyed form: every output column comes from the single last right
+    row, nulls included (tsdf.py:123-136) — the payload encoding is
+    identical (NaN = null), only the fill rule changes.
 
     ``l_sid``/``r_sid`` ([K, L] int32, non-decreasing per row) engage
     the *bin-packed* form: each lane row holds several series
@@ -328,6 +478,13 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
         keys.append(jnp.concatenate([sid_l, rev(sid_r)], axis=-1))
     keys.append(jnp.concatenate([hi_l, rev(hi_r)], axis=-1))
     keys.append(jnp.concatenate([lo_l, rev(lo_r)], axis=-1))
+    if l_seq is not None or r_seq is not None:
+        ls, rs = _seq_sides(l_seq, r_seq, K, Ll, Lr)
+        for pl_, pr_ in zip(_seq_key_planes(ls), _seq_key_planes(rs)):
+            keys.append(jnp.concatenate(
+                [padl(pl_, Llp - Ll, imax), rev(padl(pr_, Lrp - Lr, imax))],
+                axis=-1,
+            ))
     keys.append(jnp.concatenate([sec_l, rev(sec_r)], axis=-1))
 
     nanl = jnp.full((K, Llp), jnp.nan, jnp.float32)
@@ -348,7 +505,8 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
     )
 
     out = _merge_call(tuple(keys), tuple(payload), n_payload=C + 1,
-                      Lc2=Lc2, Llp=Llp, interpret=interpret)
+                      Lc2=Lc2, Llp=Llp, segmented=segmented,
+                      keyed_fill=not skip_nulls, interpret=interpret)
     vals = (jnp.stack([o[:, :Ll] for o in out[:C]]) if C
             else jnp.zeros((0, K, Ll), jnp.float32))
     found = ~jnp.isnan(vals)
@@ -358,23 +516,27 @@ def asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def asof_merge_indices_pallas(l_ts, r_ts, r_valids, interpret=False):
+def asof_merge_indices_pallas(l_ts, r_ts, r_valids, l_seq=None,
+                              r_seq=None, interpret=False):
     """Index-returning sibling of :func:`asof_merge_values_pallas` —
     the engine of the host frame path's ``asof_indices_merge`` (value
     gathering happens host-side so string columns ride the same join,
-    ops/asof.py).  Same kernel, position-encoded payloads: plane c is
-    ``where(valid_c, lane, NaN)``, so the ffill produces each column's
-    last-valid right row index directly; the value wrapper's own ridx
-    channel doubles as the unconditional last-row index.  Returns
-    ``(last_row_idx [K, Ll], per_col_idx [C, K, Ll])``, -1 for none.
-    Positions are exact in f32 up to 2^24 rows/series."""
+    ops/asof.py), including the sequence-tie-break form the host join
+    dispatches with (join.py -> asof.py).  Same kernel, position-
+    encoded payloads: plane c is ``where(valid_c, lane, NaN)``, so the
+    ffill produces each column's last-valid right row index directly;
+    the value wrapper's own ridx channel doubles as the unconditional
+    last-row index.  Returns ``(last_row_idx [K, Ll],
+    per_col_idx [C, K, Ll])``, -1 for none.  Positions are exact in
+    f32 up to 2^24 rows/series."""
     C = int(r_valids.shape[0])
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]
     pos = jnp.broadcast_to(jnp.arange(Lr, dtype=jnp.float32), (K, Lr))
     planes = jnp.where(r_valids, pos[None], jnp.nan)
     out, _, last_idx = asof_merge_values_pallas(
-        l_ts, r_ts, r_valids, planes, interpret=interpret
+        l_ts, r_ts, r_valids, planes, l_seq=l_seq, r_seq=r_seq,
+        interpret=interpret,
     )
     per_col = jnp.where(jnp.isnan(out), -1, out).astype(jnp.int32)
     return last_idx, per_col
@@ -540,25 +702,33 @@ def _pallas_enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def merge_indices_supported(l_ts, r_ts, r_valids) -> bool:
+def merge_indices_supported(l_ts, r_ts, r_valids, l_seq=None,
+                            r_seq=None) -> bool:
     """Gate for the index kernel: the value-kernel conditions with C
     position payloads (+ the wrapper's ridx channel)."""
     if not _pallas_enabled():
         return False
     if int(r_ts.shape[-1]) >= (1 << 24):
         return False
+    nsq = _n_seq_planes(l_seq, r_seq)
+    if nsq is None:
+        return False
     K, Ll = l_ts.shape
     _, Lc2, _ = _pad_plan(Ll, int(r_ts.shape[-1]))
     C = int(r_valids.shape[0])
-    return _plan_merge(K, Lc2, C + 1, 3) is not None
+    return _plan_merge(K, Lc2, C + 1, 3 + nsq) is not None
 
 
 def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
                          skip_nulls: bool,
                          segmented: bool = False) -> bool:
-    """Gate for the Pallas path: reference-default join shape
-    (skipNulls, no sequence tie-break), f32 values, TPU backend, and a
-    feasible VMEM plan.
+    """Gate for the Pallas path: f32 values, TPU backend, a seq dtype
+    with an i32 key mapping (or none), and a feasible VMEM plan.
+    skipNulls=False rides the keyed lockstep fill; the sequence
+    tie-break adds 1-2 key planes.  Bin-packed (segmented) rows do not
+    combine with a sequence column — the bin-pack layout sorts by ts
+    only (packing.py:bin_pack_series callers), so the merge
+    precondition would not hold.
 
     NaN semantics: the kernel NaN-encodes validity, so a slot that is
     marked valid but holds NaN is treated as null.  That is the
@@ -569,12 +739,14 @@ def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
     """
     if not _pallas_enabled():
         return False
-    if not skip_nulls or l_seq is not None or r_seq is not None:
-        return False
     if r_values.dtype != jnp.float32:
+        return False
+    nsq = _n_seq_planes(l_seq, r_seq)
+    if nsq is None or (segmented and nsq):
         return False
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]
     _, Lc2, _ = _pad_plan(Ll, Lr)
     C = int(r_values.shape[0])
-    return _plan_merge(K, Lc2, C + 1, 4 if segmented else 3) is not None
+    n_keys = 3 + nsq + (1 if segmented else 0)
+    return _plan_merge(K, Lc2, C + 1, n_keys) is not None

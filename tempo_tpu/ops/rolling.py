@@ -28,6 +28,19 @@ import jax.numpy as jnp
 
 from tempo_tpu.ops import window_utils as wu
 
+# Auto-pick threshold between the static-shift range-stats form (W
+# masked shifted passes, ops/sortmerge.py:range_stats_shifted + the
+# VMEM kernel) and the general prefix-scan + RMQ form
+# (:func:`windowed_stats`): frames whose row extent (behind + tie
+# rows ahead) stays under this bound take the shifted form.  The
+# crossover is measured on-chip by bench.py's 12 Hz config (the
+# ``rolling_crossover`` record: both kernels on identical ~130-row
+# windows); the shifted form won every density it can legally reach
+# through round 4, so the bound is set by compile-time growth (each
+# extra row is one more unrolled pass per aggregate) rather than
+# runtime.
+SHIFTED_MAX_ROWS = 512
+
 
 def _sparse_table(arr: jnp.ndarray, fill, reducer, nlev: int = 0) -> jnp.ndarray:
     """Log-doubling table [K, L, nlev]: level k reduces the trailing 2^k
@@ -153,6 +166,23 @@ def windowed_stats(
         "stddev": std,
         "zscore": jnp.where(valid, zscore, jnp.nan),
     }
+
+
+def bucket_stats(bid, x, valid, start, end):
+    """Tumbling-bucket aggregates broadcast to every row of the bucket
+    (the resample/groupedStats reduction, reference resample.py:38-117
+    / tsdf.py:723-759).  On TPU/f32 the whole reduction runs as ONE
+    VMEM segmented-scan kernel (ops/pallas_bucket.py — no
+    searchsorteds, no prefix-sum gathers, no RMQ tables); elsewhere the
+    ``windowed_stats`` form over the precomputed [start, end) bucket
+    bounds.  ``bid`` is the per-row int32 bucket id (non-decreasing;
+    pad rows share a clamped id and form their own bucket — callers
+    mask their outputs)."""
+    from tempo_tpu.ops import pallas_bucket as pb
+
+    if pb.bucket_stats_supported(x):
+        return pb.bucket_stats_pallas(bid, x, valid)
+    return windowed_stats(x, valid, start, end)
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments",))

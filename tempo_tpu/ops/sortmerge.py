@@ -132,8 +132,9 @@ def asof_merge_values(
     on the fused pipeline built that way, measured 2026-07-30, so it is
     off by default) takes effect per call, not per first-trace.
 
-    On TPU the reference-default shape of the join (skipNulls, no
-    sequence tie-break, f32 values) runs as ONE Pallas kernel — bitonic
+    On TPU every f32 shape of the join — including the sequence
+    tie-break (extra kernel key planes) and skipNulls=False (lockstep
+    keyed fill) since round 4 — runs as ONE Pallas kernel: bitonic
     *merge* network + ffill ladder + routing sort, all VMEM-resident
     (``ops/pallas_merge.py``) — measured 7.5x this module's lax.sort
     form at [1024, 8192]: the sort ladders pay an HBM round-trip per
@@ -141,9 +142,19 @@ def asof_merge_values(
     """
     from tempo_tpu.ops import pallas_merge as pm
 
-    if not max_lookback and pm.merge_join_supported(
-            l_ts, r_ts, r_values, l_seq, r_seq, skip_nulls):
-        return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids, r_values)
+    if not max_lookback:
+        # f64 seq planes re-encode (f32 / int64) before the kernel gate
+        # — the TPU X64 rewriter has no 64-bit bitcast (seq_kernel_form)
+        l_seq_k = pm.seq_kernel_form(l_seq)
+        r_seq_k = pm.seq_kernel_form(r_seq)
+        expressible = (l_seq is None or l_seq_k is not None) and \
+            (r_seq is None or r_seq_k is not None)
+        if expressible and pm.merge_join_supported(
+                l_ts, r_ts, r_values, l_seq_k, r_seq_k, skip_nulls):
+            return pm.asof_merge_values_pallas(
+                l_ts, r_ts, r_valids, r_values, l_seq=l_seq_k,
+                r_seq=r_seq_k, skip_nulls=skip_nulls,
+            )
     if not max_lookback and skip_nulls \
             and jnp.issubdtype(r_values.dtype, jnp.floating) \
             and _nan_encoding_enabled():
@@ -187,19 +198,15 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
                          l_sid=None, r_sid=None, max_lookback=0):
     """Default form: validity rides as explicit bool planes.  With
     ``l_sid``/``r_sid`` (bin-packed rows) the series id leads the sort
-    keys and the fill is fenced at series boundaries (skipNulls only).
-    ``max_lookback`` > 0 caps the fill at the trailing ``max_lookback``
-    + 1 merged rows (Scala's rowsBetween(-maxLookback, 0) on the
-    union stream, asofJoin.scala:64-88) via the windowed argmax ladder.
+    keys and the fill is fenced at series boundaries — for every fill
+    flavour: the unbounded scan turns segmented, and the
+    ``max_lookback`` windowed argmax ladder (Scala's
+    rowsBetween(-maxLookback, 0) on the union stream,
+    asofJoin.scala:64-88) rejects candidates before the series' own
+    segment head (contiguous series + positional argmax make the
+    post-hoc fence exact: a cross-segment candidate only wins when no
+    same-segment one exists, window_utils.windowed_last_valid).
     """
-    if l_sid is not None and not skip_nulls:
-        raise NotImplementedError(
-            "bin-packed rows support skipNulls=True only"
-        )
-    if max_lookback and l_sid is not None:
-        raise NotImplementedError(
-            "maxLookback on bin-packed rows is not supported"
-        )
     C = int(r_values.shape[0])
     K, Ll = l_ts.shape
     Lr = r_ts.shape[-1]
@@ -242,14 +249,35 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
         jnp.zeros((0, K, Lc), jnp.bool_)
     is_right_s = is_left_s == 0
 
+    if l_sid is not None:
+        sid_s = sorted_ops[0]
+        head = jnp.concatenate(
+            [jnp.ones((K, 1), jnp.bool_),
+             sid_s[:, 1:] != sid_s[:, :-1]], axis=-1
+        )
+    else:
+        head = None
+
     def fill(has, val):
-        """Unbounded ffill, or the windowed argmax ladder when the
-        merged-stream row cap is active."""
+        """Unbounded ffill (segmented over bin-packed series), or the
+        windowed argmax ladder when the merged-stream row cap is active
+        (fenced at the series' segment head for bin-packed rows)."""
         if max_lookback:
             from tempo_tpu.ops import window_utils as wu
 
+            min_pos = None
+            if head is not None:
+                lane = jnp.broadcast_to(
+                    jnp.arange(Lc, dtype=jnp.int32), (K, Lc)
+                )
+                min_pos = _ffill_scan(head, jnp.where(head, lane, 0))[1]
             val_f, has_f = wu.windowed_last_valid(
-                has, val, max_lookback + 1
+                has, val, max_lookback + 1, min_pos=min_pos
+            )
+            return has_f, val_f
+        if head is not None:
+            _, has_f, val_f = _ffill_scan_seg(
+                jnp.broadcast_to(head, has.shape), has, val
             )
             return has_f, val_f
         return _ffill_scan(has, val)
@@ -265,17 +293,7 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
             [jnp.where(vplanes_s, planes_s, 0.0),
              ridx_s[None].astype(vdt)], axis=0
         )
-        if l_sid is not None:
-            sid_s = sorted_ops[0]
-            head = jnp.concatenate(
-                [jnp.ones((K, 1), jnp.bool_),
-                 sid_s[:, 1:] != sid_s[:, :-1]], axis=-1
-            )
-            _, has_f, val_f = _ffill_scan_seg(
-                jnp.broadcast_to(head, has.shape), has, val
-            )
-        else:
-            has_f, val_f = fill(has, val)
+        has_f, val_f = fill(has, val)
         vals_sorted = val_f[:C]
         found_sorted = has_f[:C]
         idx_sorted = jnp.where(has_f[C], val_f[C].astype(jnp.int32), -1)
@@ -311,12 +329,14 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
 
 
 def asof_merge_values_binpacked(l_ts, r_ts, r_valids, r_values,
-                                l_sid, r_sid):
+                                l_sid, r_sid, skip_nulls: bool = True,
+                                max_lookback: int = 0):
     """AS-OF join over *bin-packed* rows: each [K, L] lane row holds
     several series back-to-back, identified by the non-decreasing
-    ``sid`` planes (packing.py:bin_pack_series).  skipNulls semantics
-    per column, right rows winning full ties — the same contract as
-    :func:`asof_merge_values`, with ``last_row_idx`` a within-lane-row
+    ``sid`` planes (packing.py:bin_pack_series).  Right rows win full
+    ties — the same contract as :func:`asof_merge_values` including
+    ``skip_nulls`` and the ``max_lookback`` merged-row cap (both fenced
+    at series boundaries), with ``last_row_idx`` a within-lane-row
     position.  The TPU answer to Zipf-skewed key distributions (the
     reference's tsPartitionVal machinery, tsdf.py:164-190): instead of
     padding every series to the longest (96% padding on NBBO-shaped
@@ -325,15 +345,20 @@ def asof_merge_values_binpacked(l_ts, r_ts, r_valids, r_values,
     """
     from tempo_tpu.ops import pallas_merge as pm
 
-    if pm.merge_join_supported(l_ts, r_ts, r_values, None, None, True,
-                               segmented=True):
+    if not max_lookback and pm.merge_join_supported(
+            l_ts, r_ts, r_values, None, None, skip_nulls,
+            segmented=True):
         return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids,
-                                           r_values, l_sid, r_sid)
+                                           r_values, l_sid, r_sid,
+                                           skip_nulls=skip_nulls)
     return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
-                                l_sid=l_sid, r_sid=r_sid)
+                                l_sid=l_sid, r_sid=r_sid,
+                                skip_nulls=skip_nulls,
+                                max_lookback=int(max_lookback))
 
 
-def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid):
+def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid,
+                           max_lookback: int = 0):
     """Index-returning bin-packed join: same layout contract as
     :func:`asof_merge_values_binpacked`, position-encoded payloads.
     Returns ``(last_row_idx, per_col_idx)`` as WITHIN-LANE-ROW
@@ -344,7 +369,8 @@ def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid):
     pos = jnp.broadcast_to(jnp.arange(Lr, dtype=vdt), (K, Lr))
     planes = jnp.broadcast_to(pos[None], (C, K, Lr))
     vals, found, last_idx = asof_merge_values_binpacked(
-        l_ts, r_ts, r_valids, planes, l_sid, r_sid
+        l_ts, r_ts, r_valids, planes, l_sid, r_sid,
+        max_lookback=max_lookback,
     )
     per_col = jnp.where(found, vals, -1).astype(jnp.int32)
     return last_idx, per_col

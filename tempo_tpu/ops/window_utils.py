@@ -122,7 +122,8 @@ def windowed_max_last(x: jnp.ndarray, window: int) -> jnp.ndarray:
     return jnp.maximum(lo, _shift_right(lo, window - half, neg))
 
 
-def windowed_last_valid(has: jnp.ndarray, val: jnp.ndarray, window: int):
+def windowed_last_valid(has: jnp.ndarray, val: jnp.ndarray, window: int,
+                        min_pos: jnp.ndarray = None):
     """(value at the last ``has``-True position within the trailing
     ``window`` elements inclusive, found flag) per position.
 
@@ -132,6 +133,15 @@ def windowed_last_valid(has: jnp.ndarray, val: jnp.ndarray, window: int):
     combine exactly) carrying the value as an argmax payload.  This is
     the engine of Scala's ``maxLookback`` rowsBetween(-W+1, 0) merged-
     stream cap (scala asofJoin.scala:64-88) in packed form.
+
+    ``min_pos`` (broadcastable int32, the per-position segment-head
+    lane) fences the window at segment boundaries for bin-packed rows:
+    the found flag additionally requires the winning position to sit
+    at-or-after it.  The fence is exact post-hoc because segments are
+    contiguous and the ladder takes the *largest* has-position — a
+    cross-segment candidate (strictly before the head, so a strictly
+    smaller position) can only win when no same-segment candidate
+    exists in the window.
     """
     if window <= 0:
         raise ValueError("window must be >= 1")
@@ -162,7 +172,8 @@ def windowed_last_valid(has: jnp.ndarray, val: jnp.ndarray, window: int):
         p, v = combine(p, v, _shift_right(p, window - half, -1),
                        _shift_right(v, window - half,
                                     jnp.zeros((), v.dtype)))
-    return v, p >= 0
+    floor = 0 if min_pos is None else jnp.maximum(min_pos, 0)
+    return v, p >= floor
 
 
 def searchsorted_batched(sorted_keys: jnp.ndarray, queries: jnp.ndarray, side: str = "left") -> jnp.ndarray:

@@ -313,6 +313,43 @@ def row_mask(layout: FlatLayout, padded_len: int) -> np.ndarray:
     return np.arange(padded_len)[None, :] < layout.lengths[:, None]
 
 
+def layout_rowbounds(layout: "FlatLayout", window_secs: float):
+    """Static (max rows back, max tie rows ahead) any
+    rangeBetween(-window_secs, 0) frame spans over this layout, or
+    None when a per-series seconds span + window would overflow the
+    int32 rebased keys the shifted/VMEM kernels compare (the pads
+    clamp to INT32_MAX and the truncation audit's pad-immunity needs
+    >= window of headroom above every real key).  Cached per (layout,
+    window) — chained frames sharing a layout reuse the bounds.
+    Shared by the host frame auto-pick (rolling.with_range_stats) and
+    the mesh path (dist._window_rowbounds)."""
+    cache = layout.__dict__.setdefault("_rowbound_cache", {})
+    key = float(window_secs)
+    if key not in cache:
+        secs = layout.ts_ns // NS_PER_S
+        w = np.int64(window_secs)
+        behind = 0
+        ahead = 0
+        span_i32 = True
+        for k in range(layout.n_series):
+            s = secs[layout.starts[k]: layout.starts[k + 1]]
+            if len(s) == 0:
+                continue
+            idx = np.arange(len(s))
+            behind = max(
+                behind,
+                int((idx - np.searchsorted(s, s - w, side="left")).max()),
+            )
+            ahead = max(
+                ahead,
+                int((np.searchsorted(s, s, side="right") - 1 - idx).max()),
+            )
+            if int(s[-1] - s[0]) + int(w) >= 2**31 - 2:
+                span_i32 = False
+        cache[key] = (behind, ahead) if span_i32 else None
+    return cache[key]
+
+
 SID_PAD = np.int32(2**31 - 1)
 
 

@@ -109,12 +109,25 @@ def pick_asof_strategy(
     """'broadcast' | 'merge' | 'searchsorted' — mirrors the reference's
     decision tree (tsdf.py:482-509 fast path; the union/sort algorithm
     otherwise, with the merge variant when a sequence tie-break or row
-    cap forces merged-stream coordinates)."""
+    cap forces merged-stream coordinates).
+
+    ``maxLookback`` wins over the broadcast fast path: the broadcast
+    kernel has no row cap, and Scala — the source of maxLookback
+    (asofJoin.scala:64-88) — has no broadcast path to mirror, so
+    honouring the cap is the only semantics-preserving choice
+    (ADVICE r3: the old order silently dropped the cap)."""
+    if max_lookback and max_lookback > 0:
+        if sql_join_opt:
+            logger.warning(
+                "asofJoin: sql_join_opt is ignored when maxLookback is "
+                "set — the broadcast fast path cannot bound lookback"
+            )
+        return "merge"
     if sql_join_opt and (
         host_bytes(left_df) < BROADCAST_BYTES_THRESHOLD
         or host_bytes(right_df) < BROADCAST_BYTES_THRESHOLD
     ):
         return "broadcast"
-    if has_sequence or (max_lookback and max_lookback > 0):
+    if has_sequence:
         return "merge"
     return "searchsorted"

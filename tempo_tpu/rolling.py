@@ -64,31 +64,71 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     # a window larger than any rebased span is equivalent to 'unbounded
     # preceding'; clamp so huge windows cannot overflow the int32 path
     w = min(int(rangeBackWindowSecs), int(np.iinfo(ts_long.dtype).max) // 2)
-    start, end = rk.range_window_bounds(
-        jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))
-    )
-
-    # static row bound for the min/max sparse tables: a 10s window over
-    # 1Hz data needs 4 levels, not log2(L); bucket to a power of two so
-    # distinct datasets reuse the compiled kernel.  Padded slots all
-    # share the clamped sentinel timestamp, so their windows span the
-    # whole pad run — mask them out of the bound or ragged series
-    # inflate it toward L
-    real = jnp.asarray(tsdf.packed_mask())
-    max_w = max(1, int(jax.device_get(jnp.max(jnp.where(real, end - start, 0)))))
-    max_w = 1 << (max_w - 1).bit_length()
 
     vals, valids = _packed_metric_stack(tsdf, cols)
     C, K, L = vals.shape
     flat = lambda a: jnp.asarray(a).reshape(C * K, L)
     tile = lambda a: jnp.broadcast_to(a[None], (C, K, L)).reshape(C * K, L)
-    stats = rk.windowed_stats(
-        flat(vals), flat(valids), tile(start), tile(end), max_window=max_w
-    )
+
+    # auto-pick (bench.py rolling_crossover is the measured evidence):
+    # row-boundable frames take the static-shift form — W masked
+    # shifted passes, VMEM-resident on TPU — the general prefix-scan +
+    # RMQ form covers dense data whose windows span too many rows (or
+    # spans past int32).  Same picker as the mesh path
+    # (dist.withRangeStats).
+    from tempo_tpu.ops import sortmerge as sm
+
+    rb = (packing.layout_rowbounds(layout, w)
+          if ts_long.dtype == np.int32 and sm.use_sort_kernels()
+          else None)
+    if rb is not None and rb[0] + rb[1] <= rk.SHIFTED_MAX_ROWS:
+        stats = dict(sm.range_stats_shifted(
+            tile(ts_long), flat(vals), flat(valids),
+            jnp.asarray(np.int32(w)),
+            max_behind=int(rb[0]), max_ahead=int(rb[1]),
+        ))
+        # the truncation audit rides the SAME stacked fetch as the
+        # stats below (the axon tunnel has a >1s per-transfer latency
+        # floor — one extra scalar round trip would double it)
+    else:
+        start, end = rk.range_window_bounds(
+            jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))
+        )
+        # static row bound for the min/max sparse tables: a 10s window
+        # over 1Hz data needs 4 levels, not log2(L); bucket to a power
+        # of two so distinct datasets reuse the compiled kernel.
+        # Padded slots all share the clamped sentinel timestamp, so
+        # their windows span the whole pad run — mask them out of the
+        # bound or ragged series inflate it toward L
+        real = jnp.asarray(tsdf.packed_mask())
+        max_w = max(1, int(jax.device_get(
+            jnp.max(jnp.where(real, end - start, 0)))))
+        max_w = 1 << (max_w - 1).bit_length()
+        stats = rk.windowed_stats(
+            flat(vals), flat(valids), tile(start), tile(end),
+            max_window=max_w
+        )
     # one stacked device->host transfer: the axon tunnel has a >1s
-    # per-transfer latency floor, so 7 separate fetches cost seconds
+    # per-transfer latency floor, so 7 separate fetches cost seconds.
+    # The shifted path's truncation-audit scalar piggybacks as one
+    # extra element on the same flattened buffer.
+    clip = stats.pop("clipped", None)
     names = sorted(stats)
-    stacked = np.asarray(jnp.stack([stats[k] for k in names]))
+    planes = jnp.stack([stats[k] for k in names]).reshape(-1)
+    if clip is not None:
+        planes = jnp.concatenate(
+            [planes, jnp.sum(clip).reshape(1).astype(planes.dtype)]
+        )
+    buf = np.asarray(planes)
+    if clip is not None:
+        clipped_total = float(buf[-1])
+        buf = buf[:-1]
+        if clipped_total:  # pragma: no cover - bound-derivation bug guard
+            raise AssertionError(
+                f"withRangeStats: {clipped_total} rows exceeded the "
+                f"derived row bounds {rb}; this is a tempo-tpu bug"
+            )
+    stacked = buf.reshape(len(names), C * K, L)
     stats = {k: stacked[i].reshape(C, K, L) for i, k in enumerate(names)}
 
     for ci, c in enumerate(cols):

@@ -29,6 +29,22 @@ import pandas as pd  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _bound_jax_live_state():
+    """Drop live compiled-executable state between test modules.
+
+    The round-4 suite compiles ~2x the programs of round 3 (seq /
+    skipNulls kernel variants, bucket kernels, interpret-mode ladders);
+    with everything held live in one process, jaxlib's CPU client
+    started segfaulting non-deterministically inside later *compiles*
+    (cache read, cache write, and plain compile paths — observed three
+    distinct crash sites at ~300 tests in).  Clearing the in-memory
+    executable caches per module bounds the live state; the on-disk
+    compilation cache keeps re-runs fast."""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def ts():
     """Shorthand timestamp parser used by golden fixtures."""

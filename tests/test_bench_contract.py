@@ -40,11 +40,16 @@ def test_bench_smoke_prints_one_json_line():
     assert set(cfgs) == {
         "1_quickstart_asof", "2_range_stats_10s", "3_resample_ema",
         "4_nbbo_skew_asof", "5_skew_1b_bracketed",
+        "2b_range_stats_dense_50hz",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
     bad = {k: v for k, v in cfgs.items() if not v or v <= 0}
     assert not bad, f"configs failed or empty: {bad}\n{out.stderr[-2000:]}"
+    # the dense-vs-shifted rolling crossover must be measured (round 4)
+    assert rec["rolling_crossover"], "rolling_crossover missing"
+    assert rec["rolling_crossover"]["winner_at_12hz"] in (
+        "shifted", "windowed")
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)

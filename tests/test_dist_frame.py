@@ -130,6 +130,28 @@ class TestDistributedOps:
                 rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=c,
             )
 
+    def test_calc_bars_fill(self, frames, axes, ta):
+        """calc_bars(fill=True) on the mesh (round 4 — the last
+        resample-family host-only intersection): dense zero-filled
+        bucket grid, vs the host upsample_fill oracle."""
+        l, _ = frames
+        host = _sorted(l.calc_bars("5 minutes", metricCols=["price"],
+                                   fill=True).df)
+        mesh = make_mesh(axes)
+        got = _sorted(
+            l.on_mesh(mesh, time_axis=ta)
+            .calc_bars("5 minutes", metricCols=["price"], fill=True)
+            .collect().df
+        )
+        assert len(got) == len(host)
+        assert (got["event_ts"].to_numpy()
+                == host["event_ts"].to_numpy()).all()
+        for c in ("open_price", "low_price", "high_price", "close_price"):
+            np.testing.assert_allclose(
+                got[c].to_numpy(float), host[c].to_numpy(float),
+                rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=c,
+            )
+
     def test_asof_join_resampled_right(self, frames, axes, ta):
         """Bucket-head views keep real-looking ts at masked lane rows;
         the join must treat those rows as NON-existent — they must not
@@ -150,6 +172,35 @@ class TestDistributedOps:
                     got[c].to_numpy(float), host[c].to_numpy(float),
                     rtol=1e-6, atol=1e-9, equal_nan=True,
                     err_msg=f"{c} {kw}",
+                )
+
+    def test_asof_join_resampled_left_max_lookback(self, frames, axes,
+                                                   ta):
+        """maxLookback with a resampled (bucket-head) LEFT frame on the
+        mesh (round 4 — previously NotImplementedError): masked left
+        lane rows sort-compact to the tail so they consume no
+        merged-stream window slots, and outputs route back through the
+        recorded source-lane plane.  Oracle: collect the resampled
+        left, join on the host."""
+        l, r = frames
+        mesh = make_mesh(axes)
+        dl = l.on_mesh(mesh, time_axis=ta).resample(
+            "5 minutes", "mean", metricCols=["price"])
+        dr = r.on_mesh(mesh, time_axis=ta)
+        from tempo_tpu import TSDF as _T
+
+        host_l = _T(l.resample("5 minutes", "mean",
+                               metricCols=["price"]).df,
+                    "event_ts", ["symbol"])
+        for ml in (1, 3):
+            host = _sorted(host_l.asofJoin(r, maxLookback=ml).df)
+            got = _sorted(dl.asofJoin(dr, maxLookback=ml).collect().df)
+            assert len(got) == len(host)
+            for c in ("right_bid", "right_ask"):
+                np.testing.assert_allclose(
+                    got[c].to_numpy(float), host[c].to_numpy(float),
+                    rtol=1e-6, atol=1e-9, equal_nan=True,
+                    err_msg=f"{c} ml={ml}",
                 )
 
     def test_asof_join_keep_nulls(self, frames, axes, ta):
@@ -256,6 +307,51 @@ class TestChaining:
         np.testing.assert_allclose(
             got["EMA_price"].to_numpy(float),
             host["EMA_price"].to_numpy(float), rtol=1e-9, atol=1e-12,
+        )
+
+    def test_chained_resample_with_sort_kernels(self, frames, monkeypatch):
+        """resample of a resample under the TPU sort-kernel dispatch
+        (forced on the CPU mesh): the bucket-head view has interior
+        masked rows, and the sort-based searchsorted silently corrupts
+        on unsorted keys — _bucket_heads must feed it the monotone
+        all-rows bucket key (round-4 fix)."""
+        monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+        l, _ = frames
+        host = _sorted(
+            TSDF(l.resample("1 minute", "mean", metricCols=["price"]).df,
+                 "event_ts", ["symbol"])
+            .resample("5 minutes", "mean", metricCols=["price"]).df
+        )
+        mesh = make_mesh({"series": 4})
+        got = _sorted(
+            l.on_mesh(mesh).resample("1 minute", "mean")
+            .resample("5 minutes", "mean").collect().df
+        )
+        assert len(got) == len(host)
+        np.testing.assert_allclose(
+            got["price"].to_numpy(float), host["price"].to_numpy(float),
+            rtol=1e-9, equal_nan=True,
+        )
+
+    def test_interpolate_after_resample_with_sort_kernels(
+            self, frames, monkeypatch):
+        """interpolate's gap-fill merge joins under the sort-kernel
+        dispatch: the resample view they read has interior masked rows,
+        which must ride validity planes (not TS_PAD keys that unsort
+        the merge input — round-4 fix)."""
+        monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+        l, _ = frames
+        host = _sorted(l.interpolate(
+            freq="30 seconds", func="mean", target_cols=["price"],
+            method="linear").df)
+        mesh = make_mesh({"series": 4})
+        got = _sorted(l.on_mesh(mesh).interpolate(
+            freq="30 seconds", func="mean", target_cols=["price"],
+            method="linear").collect().df)
+        assert len(got) == len(host)
+        np.testing.assert_allclose(
+            got["price"].to_numpy(float), host["price"].to_numpy(float),
+            rtol=1e-6, atol=1e-9, equal_nan=True,
         )
 
     def test_left_prefix_rename(self, frames):

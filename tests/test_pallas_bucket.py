@@ -1,0 +1,130 @@
+"""Bucket (resample) VMEM kernels: interpret-mode parity vs the XLA
+windowed/segment forms and numpy oracles.
+
+The compiled path is TPU-only (bench.py config 3 + the resample device
+dispatch); the ladder logic (segmented scan + tail broadcast, fused
+head/EMA) is identical in interpret mode.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.ops.pallas_bucket import (
+    bucket_stats_pallas, resample_ema_pallas,
+)
+
+STATS = ("mean", "count", "min", "max", "sum", "stddev", "zscore")
+
+
+def _case(rng, K, L, gap_hi=3, step=60, masked=False):
+    secs = np.cumsum(rng.integers(1, gap_hi, (K, L)), -1).astype(np.int64)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > (0.3 if masked else 0.0)
+    bid = (secs // step).astype(np.int32)
+    return secs, bid, x, valid
+
+
+@pytest.mark.parametrize("K,L,masked", [(4, 256, False), (3, 512, True),
+                                        (6, 128, True)])
+def test_bucket_stats_matches_windowed(K, L, masked):
+    """Oracle: windowed_stats with searchsorted bucket bounds — the
+    XLA form the kernel replaces (dist.py:_bucket_heads semantics)."""
+    rng = np.random.default_rng(K * 100 + L)
+    secs, bid, x, valid = _case(rng, K, L, masked=masked)
+    start = np.stack([np.searchsorted(bid[k], bid[k], "left")
+                      for k in range(K)]).astype(np.int32)
+    end = np.stack([np.searchsorted(bid[k], bid[k], "right")
+                    for k in range(K)]).astype(np.int32)
+    want = rk.windowed_stats(jnp.asarray(x), jnp.asarray(valid),
+                             jnp.asarray(start), jnp.asarray(end))
+    got = bucket_stats_pallas(jnp.asarray(bid), jnp.asarray(x),
+                              jnp.asarray(valid), interpret=True)
+    for k in STATS:
+        np.testing.assert_allclose(
+            np.asarray(got[k]), np.asarray(want[k]), rtol=2e-5,
+            atol=2e-5, equal_nan=True, err_msg=k,
+        )
+
+
+def test_bucket_stats_numpy_oracle():
+    """Independent oracle: per-bucket numpy reductions."""
+    rng = np.random.default_rng(0)
+    K, L = 3, 256
+    secs, bid, x, valid = _case(rng, K, L, masked=True)
+    got = bucket_stats_pallas(jnp.asarray(bid), jnp.asarray(x),
+                              jnp.asarray(valid), interpret=True)
+    for k in range(K):
+        for b in np.unique(bid[k]):
+            sel = bid[k] == b
+            win = x[k, sel & valid[k]].astype(np.float64)
+            rows = np.flatnonzero(sel)
+            cnt = np.asarray(got["count"])[k, rows]
+            np.testing.assert_allclose(cnt, len(win), err_msg="count")
+            if len(win):
+                np.testing.assert_allclose(
+                    np.asarray(got["mean"])[k, rows], win.mean(),
+                    rtol=2e-5, atol=2e-5,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got["min"])[k, rows], win.min(), rtol=1e-6
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got["max"])[k, rows], win.max(), rtol=1e-6
+                )
+            if len(win) > 1:
+                np.testing.assert_allclose(
+                    np.asarray(got["stddev"])[k, rows],
+                    win.std(ddof=1), rtol=2e-4, atol=2e-4,
+                )
+
+
+def test_resample_ema_matches_xla_body():
+    """Oracle: the exact XLA op sequence of bench config 3 (bucket
+    change head + packed-in-place floor resample + exact EMA)."""
+    from tempo_tpu.ops import rolling as rkops
+
+    rng = np.random.default_rng(3)
+    K, L, step, alpha = 5, 512, 60, 0.2
+    secs, _, x, valid = _case(rng, K, L, masked=True, step=step)
+
+    bucket = secs // step
+    head = np.concatenate(
+        [np.ones_like(bucket[:, :1], bool),
+         bucket[:, 1:] != bucket[:, :-1]], axis=-1,
+    ) & valid
+    want_res = np.where(head, x, np.nan)
+    want_ema = np.asarray(rkops.ema_exact(
+        jnp.asarray(x), jnp.asarray(head), alpha
+    ))
+
+    res, ema = resample_ema_pallas(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), step=step, alpha=alpha, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(res), want_res, equal_nan=True)
+    np.testing.assert_allclose(np.asarray(ema), want_ema, rtol=2e-5,
+                               atol=2e-6)
+
+
+def test_resample_ema_bucket_division_boundaries():
+    """The in-kernel f32 division must floor exactly at bucket
+    boundaries (multiples of step) up to the 2^24 gate."""
+    step = 60
+    vals = np.array([0, 59, 60, 61, 119, 120, 2**24 - 64,
+                     2**24 - 60], np.int64)
+    secs = np.sort(np.pad(vals, (0, 128 - len(vals)),
+                          constant_values=2**24 - 1))[None, :]
+    x = np.ones((1, 128), np.float32)
+    valid = np.ones((1, 128), bool)
+    res, _ = resample_ema_pallas(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), step=step, alpha=0.2, interpret=True,
+    )
+    bucket = secs // step
+    head = np.concatenate(
+        [np.ones_like(bucket[:, :1], bool),
+         bucket[:, 1:] != bucket[:, :-1]], axis=-1,
+    )
+    np.testing.assert_array_equal(~np.isnan(np.asarray(res)), head)

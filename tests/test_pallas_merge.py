@@ -294,10 +294,294 @@ def test_supported_gate():
     vals32 = jnp.zeros((2, 4, 128), jnp.float32)
     vals64 = jnp.zeros((2, 4, 128), jnp.float64)
     seq = jnp.zeros((4, 128), jnp.float32)
-    # CPU backend in tests: never engages compiled path
+    # CPU backend in tests: never engages compiled path (seq and
+    # skipNulls=False included since round 4 — same answer here)
     assert not merge_join_supported(l_ts, r_ts, vals32, None, None, True)
+    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq, True)
+    assert not merge_join_supported(l_ts, r_ts, vals32, None, None, False)
     # independent of backend: these shapes must always be rejected
     assert not merge_join_supported(l_ts, r_ts, vals64, None, None, True)
-    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq, True)
-    assert not merge_join_supported(l_ts, r_ts, vals32, seq, None, True)
-    assert not merge_join_supported(l_ts, r_ts, vals32, None, None, False)
+    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq, True,
+                                    segmented=True)
+
+
+def test_gate_on_forced_tpu_backend(monkeypatch):
+    """The gate's shape logic with the backend check forced open: the
+    round-4 extensions admit seq and skipNulls=False, and the plane
+    budget counts the extra seq key planes."""
+    import tempo_tpu.ops.pallas_merge as pm
+
+    monkeypatch.setattr(pm, "_pallas_enabled", lambda: True)
+    l_ts = jnp.zeros((4, 128), jnp.int64)
+    r_ts = jnp.zeros((4, 128), jnp.int64)
+    vals32 = jnp.zeros((2, 4, 128), jnp.float32)
+    seq32 = jnp.zeros((4, 128), jnp.float32)
+    seq64 = jnp.zeros((4, 128), jnp.float64)
+    seqi64 = jnp.zeros((4, 128), jnp.int64)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, None, True)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, seq32, True)
+    assert merge_join_supported(l_ts, r_ts, vals32, seqi64, seqi64, True)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, None, False)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, seqi64, False)
+    # f64 has no device key mapping (the TPU X64 rewriter cannot
+    # bitcast 64-bit) — dispatchers re-encode via seq_kernel_form first
+    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq64,
+                                    True)
+    # segmented excludes seq (bin-pack layout sorts by ts only)
+    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq32,
+                                    True, segmented=True)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, None, False,
+                                segmented=True)
+
+
+def test_seq_kernel_form():
+    """f64 sequence planes re-encode for the kernel: f32 when exact,
+    int64 for big integral values, None (XLA fallback) otherwise."""
+    from tempo_tpu.ops.pallas_merge import seq_kernel_form
+
+    small = jnp.asarray(np.array([[1.0, 2.5, -np.inf, np.inf]]))
+    out = seq_kernel_form(small)
+    assert out.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(out), np.float32([[1.0, 2.5, -np.inf, np.inf]])
+    )
+    bigint = jnp.asarray(np.array([[2.0**40, 2.0**40 + 1, -np.inf,
+                                    np.inf]]))
+    out = seq_kernel_form(bigint)
+    assert out.dtype == jnp.int64
+    got = np.asarray(out)
+    assert got[0, 0] == 2**40 and got[0, 1] == 2**40 + 1
+    assert got[0, 2] == np.iinfo(np.int64).min
+    assert got[0, 3] == np.iinfo(np.int64).max
+    # non-integral and f32-inexact: no device form
+    assert seq_kernel_form(
+        jnp.asarray(np.array([[0.1 + 2.0**40]]))) is None
+    # pass-throughs
+    f32 = jnp.zeros((1, 4), jnp.float32)
+    assert seq_kernel_form(f32) is f32
+    assert seq_kernel_form(None) is None
+
+
+def _seq_case(rng, K, Ll, Lr, C, sdt=np.float64, tie_heavy=True):
+    """Tie-heavy case with sequence planes: right nulls ride -inf
+    (join.py / dist.py NULLS FIRST encoding), pads +inf."""
+    l_ts, r_ts, r_valids, r_values = _rand_case(rng, K, Ll, Lr, C,
+                                                tie_heavy)
+    # per-row (ts, seq)-ascending right seq — the packed-layout
+    # invariant (layouts sort by (key, ts, seq), packing.py:228-245)
+    r_seq = np.full((K, Lr), np.inf, sdt)
+    for k in range(K):
+        n = int((r_ts[k] < TS_PAD).sum())
+        s = rng.integers(-3, 3, n).astype(np.float64)
+        s[rng.random(n) < 0.3] = -np.inf     # null seq -> NULLS FIRST
+        order = np.lexsort((s, r_ts[k, :n]))
+        r_seq[k, :n] = s[order].astype(sdt)
+    return l_ts, r_ts, r_valids, r_values, r_seq
+
+
+@pytest.mark.parametrize("sdt", [np.float64, np.float32])
+@pytest.mark.parametrize("K,Ll,Lr,C", [(4, 128, 128, 2), (3, 200, 136, 1)])
+def test_seq_tiebreak_matches_xla(K, Ll, Lr, C, sdt):
+    from tempo_tpu.ops.pallas_merge import seq_kernel_form
+
+    rng = np.random.default_rng(K * 31 + Lr + (0 if sdt == np.float64
+                                               else 7))
+    l_ts, r_ts, r_valids, r_values, r_seq = _seq_case(rng, K, Ll, Lr, C,
+                                                      sdt)
+    want_v, want_f, want_i = sm._asof_merge_explicit(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=jnp.asarray(r_seq),
+    )
+    # f64 planes ride the dispatchers' re-encoding (seq_kernel_form)
+    sq = seq_kernel_form(jnp.asarray(r_seq))
+    assert sq is not None
+    got_v, got_f, got_i = asof_merge_values_pallas(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=sq, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_f), np.asarray(want_f))
+    np.testing.assert_allclose(
+        np.asarray(got_v), np.asarray(want_v), equal_nan=True
+    )
+    real = l_ts < TS_PAD
+    np.testing.assert_array_equal(
+        np.asarray(got_i)[real], np.asarray(want_i)[real]
+    )
+
+
+def test_seq_tiebreak_semantics_direct():
+    """Spark order on a full ts tie: right-null-seq < left < right-non-
+    null-seq (tsdf.py:117-121) — the null-seq right row is visible to
+    the tied left row, the non-null one is not."""
+    T = 10**9
+    l_ts = np.pad(np.array([[2 * T]], np.int64), ((0, 0), (0, 127)),
+                  constant_values=TS_PAD)
+    r_ts = np.pad(np.array([[2 * T, 2 * T]], np.int64),
+                  ((0, 0), (0, 126)), constant_values=TS_PAD)
+    r_seq = np.full((1, 128), np.inf)
+    r_seq[0, :2] = [-np.inf, 5.0]            # null first, then seq=5
+    r_vals = np.zeros((1, 1, 128), np.float32)
+    r_vals[0, 0, :2] = [10.0, 20.0]
+    r_valid = np.zeros((1, 1, 128), bool)
+    r_valid[0, 0, :2] = True
+    vals, found, idx = asof_merge_values_pallas(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valid),
+        jnp.asarray(r_vals), r_seq=jnp.asarray(r_seq, jnp.float32),
+        interpret=True,
+    )
+    assert np.asarray(vals)[0, 0, 0] == 10.0   # null-seq row wins
+    assert np.asarray(idx)[0, 0] == 0
+
+
+def test_seq_tiebreak_int64_planes():
+    """The two-plane (hi, lo) seq path: integral seqs beyond f32
+    exactness re-encode as int64 (seq_kernel_form) and must order
+    correctly across the 2^31 lo-plane boundary."""
+    from tempo_tpu.ops.pallas_merge import seq_kernel_form
+
+    rng = np.random.default_rng(5)
+    K, Ll, Lr, C = 3, 128, 128, 2
+    l_ts, r_ts, r_valids, r_values = _rand_case(rng, K, Ll, Lr, C,
+                                                tie_heavy=True)
+    base = 2.0**33
+    r_seq = np.full((K, Lr), np.inf)
+    for k in range(K):
+        n = int((r_ts[k] < TS_PAD).sum())
+        s = base + rng.integers(-(2**32), 2**32, n).astype(np.float64)
+        s[rng.random(n) < 0.3] = -np.inf
+        order = np.lexsort((s, r_ts[k, :n]))
+        r_seq[k, :n] = s[order]
+    sq = seq_kernel_form(jnp.asarray(r_seq))
+    assert sq is not None and sq.dtype == jnp.int64
+    want = sm._asof_merge_explicit(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=jnp.asarray(r_seq),
+    )
+    got = asof_merge_values_pallas(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=sq, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(want[0]), equal_nan=True
+    )
+
+
+@pytest.mark.parametrize("K,Ll,Lr,C,ties",
+                         [(4, 128, 128, 2, False), (6, 256, 256, 2, True),
+                          (3, 200, 136, 1, False)])
+def test_skipnulls_false_matches_xla(K, Ll, Lr, C, ties):
+    rng = np.random.default_rng(K * 77 + Lr + C)
+    l_ts, r_ts, r_valids, r_values = _rand_case(rng, K, Ll, Lr, C, ties)
+    want_v, want_f, want_i = sm._asof_merge_explicit(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), skip_nulls=False,
+    )
+    got_v, got_f, got_i = asof_merge_values_pallas(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), skip_nulls=False, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got_f), np.asarray(want_f))
+    np.testing.assert_allclose(
+        np.asarray(got_v), np.asarray(want_v), equal_nan=True
+    )
+    real = l_ts < TS_PAD
+    np.testing.assert_array_equal(
+        np.asarray(got_i)[real], np.asarray(want_i)[real]
+    )
+
+
+def test_skipnulls_false_seq_combined():
+    """All round-4 kernel extensions at once: seq tie-break + lockstep
+    skipNulls=False fill."""
+    from tempo_tpu.ops.pallas_merge import seq_kernel_form
+
+    rng = np.random.default_rng(11)
+    l_ts, r_ts, r_valids, r_values, r_seq = _seq_case(rng, 5, 128, 128, 2)
+    want = sm._asof_merge_explicit(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=jnp.asarray(r_seq),
+        skip_nulls=False,
+    )
+    got = asof_merge_values_pallas(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), r_seq=seq_kernel_form(jnp.asarray(r_seq)),
+        skip_nulls=False, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got[1]), np.asarray(want[1]))
+    np.testing.assert_allclose(
+        np.asarray(got[0]), np.asarray(want[0]), equal_nan=True
+    )
+
+
+def test_binpacked_skipnulls_false_matches_per_series_oracle():
+    """Bin-packed layout + skipNulls=False through the segmented keyed
+    fill (kernel) and the segmented pair fill (XLA), both vs the dense
+    per-series oracle."""
+    case = _binpacked_case(seed=9)
+    (l_ts, r_ts, r_valids, r_values, llen, rlen, bp,
+     lt2, rt2, lsid, rsid, rv2, rm2) = case
+    C, S, _ = r_values.shape
+
+    want_v, want_f, _ = (np.asarray(a) for a in sm._asof_merge_explicit(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_values), skip_nulls=False,
+    ))
+    for engine in ("pallas", "xla"):
+        if engine == "pallas":
+            got = asof_merge_values_pallas(
+                jnp.asarray(lt2), jnp.asarray(rt2), jnp.asarray(rm2),
+                jnp.asarray(rv2), jnp.asarray(lsid), jnp.asarray(rsid),
+                skip_nulls=False, interpret=True,
+            )
+        else:
+            got = sm._asof_merge_explicit(
+                jnp.asarray(lt2), jnp.asarray(rt2), jnp.asarray(rm2),
+                jnp.asarray(rv2), l_sid=jnp.asarray(lsid),
+                r_sid=jnp.asarray(rsid), skip_nulls=False,
+            )
+        gv, gf = np.asarray(got[0]), np.asarray(got[1])
+        for s in range(S):
+            r0, o0 = bp.row[s], bp.l_off[s]
+            sl = slice(o0, o0 + llen[s])
+            np.testing.assert_array_equal(
+                gf[:, r0, sl], want_f[:, s, : llen[s]],
+                err_msg=f"{engine} s={s} found",
+            )
+            np.testing.assert_allclose(
+                gv[:, r0, sl], want_v[:, s, : llen[s]], equal_nan=True,
+                err_msg=f"{engine} s={s} vals",
+            )
+
+
+def test_binpacked_maxlookback_fenced():
+    """maxLookback over bin-packed rows counts each series' own merged
+    stream only (the sid fence): parity vs the dense per-series
+    windowed form for several caps."""
+    case = _binpacked_case(seed=21, S=17, Lmax=48)
+    (l_ts, r_ts, r_valids, r_values, llen, rlen, bp,
+     lt2, rt2, lsid, rsid, rv2, rm2) = case
+    C, S, _ = r_values.shape
+    for ml in (1, 3, 8):
+        want_v, want_f, _ = (np.asarray(a) for a in
+                             sm._asof_merge_explicit(
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+            jnp.asarray(r_values), max_lookback=ml,
+        ))
+        got = sm._asof_merge_explicit(
+            jnp.asarray(lt2), jnp.asarray(rt2), jnp.asarray(rm2),
+            jnp.asarray(rv2), l_sid=jnp.asarray(lsid),
+            r_sid=jnp.asarray(rsid), max_lookback=ml,
+        )
+        gv, gf = np.asarray(got[0]), np.asarray(got[1])
+        for s in range(S):
+            r0, o0 = bp.row[s], bp.l_off[s]
+            sl = slice(o0, o0 + llen[s])
+            np.testing.assert_array_equal(
+                gf[:, r0, sl], want_f[:, s, : llen[s]],
+                err_msg=f"ml={ml} s={s} found",
+            )
+            np.testing.assert_allclose(
+                gv[:, r0, sl], want_v[:, s, : llen[s]], equal_nan=True,
+                err_msg=f"ml={ml} s={s} vals",
+            )

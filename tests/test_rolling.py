@@ -213,6 +213,40 @@ def test_range_stats_multi_key_and_cols():
     np.testing.assert_allclose(res["stddev_x"], oracle["sd"], atol=1e-9)
 
 
+def test_range_stats_shifted_autopick_parity(monkeypatch):
+    """With sort kernels forced (the TPU dispatch, CPU-executed), the
+    host frame auto-picks the static-shift range-stats form
+    (rolling.py round 4) — results must match the windowed form's,
+    which test_range_stats_multi_key_and_cols pins to pandas."""
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+    rng = np.random.default_rng(11)
+    n = 300
+    df = pd.DataFrame({
+        "symbol": rng.choice(["A", "B", "C"], n),
+        "event_ts": pd.to_datetime("2024-01-01")
+        + pd.to_timedelta(np.sort(rng.integers(0, 3600, n)), unit="s"),
+        "x": rng.normal(size=n),
+    })
+    secs = 120
+    got = (
+        TSDF(df, partition_cols=["symbol"])
+        .withRangeStats(rangeBackWindowSecs=secs)
+        .df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+    )
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "0")
+    want = (
+        TSDF(df, partition_cols=["symbol"])
+        .withRangeStats(rangeBackWindowSecs=secs)
+        .df.sort_values(["symbol", "event_ts"]).reset_index(drop=True)
+    )
+    for c in ("mean_x", "count_x", "min_x", "max_x", "sum_x", "stddev_x",
+              "zscore_x"):
+        np.testing.assert_allclose(
+            got[c].to_numpy(float), want[c].to_numpy(float),
+            rtol=1e-9, atol=1e-9, equal_nan=True, err_msg=c,
+        )
+
+
 def test_ema_scala_inclusive_window_golden():
     """Exact Scala expected values (EMATests.scala:25-40): window=2,
     exp_factor=0.5, lag range 0..window INCLUSIVE, with a tied-timestamp
