@@ -308,8 +308,22 @@ class DistributedTSDF:
         sort_kernels = _use_sort_kernels()
         rowbounds = None
         if sort_kernels and strategy == "exact":
+            from tempo_tpu.ops import pallas_stats as _ps
+
             rb = self._window_rowbounds(w)
-            if rb is not None and rb[0] + rb[1] <= rk.SHIFTED_MAX_ROWS:
+            # per-device shard element count bounds the unrolled form's
+            # HBM footprint (ops/rolling.py:shifted_row_budget); on the
+            # exact strategy the kernel computes over series-local FULL
+            # rows (the a2a layout switch), so the shard is K/devices
+            # by the full L
+            shard_k = self.K_dev // (self.n_series_shards
+                                     * max(self.n_time, 1))
+            pallas_ok = (
+                packing.compute_dtype() == np.float32
+                and _ps.pallas_block_feasible(max(shard_k, 1), self.L)
+            )
+            if rb is not None and rb[0] + rb[1] <= rk.shifted_row_budget(
+                    max(shard_k, 1) * self.L, pallas_ok):
                 rowbounds = rb
         for c in cols:
             col = self.cols[c]

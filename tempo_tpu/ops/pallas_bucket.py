@@ -224,20 +224,21 @@ def bucket_stats_pallas(bid, x, valid, interpret: bool = False):
 # Fused floor-resample + EMA (bench config 3)
 # ----------------------------------------------------------------------
 
-def _resample_ema_kernel(params_ref, secs_ref, x_ref, valid_ref,
-                         res_ref, ema_ref):
-    step_inv = params_ref[0]
-    alpha = params_ref[1]
+def _resample_ema_kernel(step_ref, alpha_ref, secs_ref, x_ref,
+                         valid_ref, res_ref, ema_ref):
+    step = step_ref[0]
+    alpha = alpha_ref[0]
     secs = secs_ref[:]
     x = x_ref[:]
     valid = valid_ref[:]
     shape = secs.shape
 
-    # f32 true division is correctly rounded, so floor(secs / step) is
-    # exact for integer secs below 2^24 (the gate enforces the bound:
-    # a correctly-rounded quotient only lands on an integer when the
-    # true quotient does)
-    bucket = jnp.floor(secs.astype(jnp.float32) * step_inv)
+    # exact integer bucketing: i32 floor-divide lowers natively in
+    # Mosaic (probed on v5e).  The first kernel revision multiplied by
+    # a rounded f32 reciprocal, which misassigns rows one second below
+    # a bucket boundary from secs ≈ 10.2M up (code-review r4 finding,
+    # verified numerically) — reciprocal multiply is NOT division.
+    bucket = secs // step
     lane = _lane(shape)
     head = ((lane == 0) | (bucket != _roll_back(bucket, 1))) & valid
 
@@ -260,7 +261,7 @@ def _resample_ema_kernel(params_ref, secs_ref, x_ref, valid_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _resample_ema_call(secs, x, valid, step_inv, alpha, interpret=False):
+def _resample_ema_call(secs, x, valid, step, alpha, interpret=False):
     K, L = x.shape
     plan = pk._plan(K, L, arrays=24, bk_max=32, budget=90 * 2**20)
     if plan is None:
@@ -276,7 +277,7 @@ def _resample_ema_call(secs, x, valid, step_inv, alpha, interpret=False):
         out = pl.pallas_call(
             _resample_ema_kernel,
             grid=grid,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
             + [spec] * 3,
             out_specs=[spec] * 2,
             out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 2,
@@ -284,15 +285,14 @@ def _resample_ema_call(secs, x, valid, step_inv, alpha, interpret=False):
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
-        )(jnp.stack([step_inv.astype(jnp.float32),
-                     alpha.astype(jnp.float32)]), secs, x, valid)
+        )(jnp.asarray([step], jnp.int32),
+          jnp.asarray([alpha], jnp.float32), secs, x, valid)
     return out[0][:K], out[1][:K]
 
 
 def resample_ema_supported(secs, x) -> bool:
-    """Gate: f32 lane-aligned TPU blocks AND a seconds axis inside the
-    f32-exact integer range (2^24) so the in-kernel bucket division is
-    exact."""
+    """Gate: f32 lane-aligned TPU blocks with an int32-expressible
+    seconds axis (the in-kernel bucketing is exact i32 division)."""
     return (
         x.dtype == jnp.float32
         and x.ndim == 2
@@ -308,10 +308,10 @@ def resample_ema_pallas(secs, x, valid, step: float, alpha: float,
     """Fused floor-resample + exact EMA: ``res`` is x at each bucket's
     first valid head row (NaN elsewhere — the packed-in-place
     downsample view), ``ema`` the exact EMA over the head-masked
-    samples.  ``secs`` must be integral and < 2^24 (caller gate)."""
+    samples.  ``secs`` must be integral and fit int32."""
     res, ema = _resample_ema_call(
         secs.astype(jnp.int32), x, valid,
-        jnp.asarray(1.0 / float(step), jnp.float32),
+        jnp.asarray(int(step), jnp.int32),
         jnp.asarray(alpha, jnp.float32), interpret=interpret,
     )
     return res, ema

@@ -113,15 +113,35 @@ def _make_kernel(max_behind: int, max_ahead: int):
     return kernel
 
 
+# Largest unrolled window the kernel may take.  Probed on v5e: W=64
+# compiles and runs (43s, bk=16); W≈150 fits standalone at bk=8 but
+# overflows VMEM by 7M once the bench's fori-loop wraps it, and W≈266
+# exceeds by 20M even at the minimum block — Mosaic's live temporaries
+# grow superlinearly in W, so the bound sits at the largest probed
+# size with comfortable margin.  Beyond this the XLA shifted form
+# (which can spill) takes over, up to the frame layer's
+# SHIFTED_MAX_ROWS; past that, the prefix-scan+RMQ windowed form.
+_PALLAS_STATS_MAX_W = 64
+
+
+def _plan_arrays(max_behind: int, max_ahead: int) -> int:
+    """Live-plane budget for the block plan.  The base term covers
+    I/O double buffers + accumulators (calibrated at the r3 window,
+    W≈28, bk=32); the per-shift term covers the temporaries Mosaic's
+    scheduler keeps live across the unrolled shift passes — measured:
+    W=64 at bk=32 overflowed VMEM by 29M (157M used), so the window
+    length must shrink the block."""
+    return 32 + max_behind + max_ahead
+
+
 @functools.partial(
     jax.jit, static_argnames=("max_behind", "max_ahead", "interpret")
 )
 def _stats_call(secs, x, valid, window, max_behind, max_ahead,
                 interpret=False):
     K, L = x.shape
-    # 3 in + 8 out with double-buffered I/O + ~8 accumulator/temp planes
-    plan = pk._plan(K, L, arrays=32, bk_max=32,
-                    budget=90 * 2**20)
+    plan = pk._plan(K, L, arrays=_plan_arrays(max_behind, max_ahead),
+                    bk_max=32, budget=90 * 2**20)
     if plan is None:
         # callers consult range_stats_supported first; a whole-array
         # block here would be strictly larger than the one the planner
@@ -154,13 +174,31 @@ def _stats_call(secs, x, valid, window, max_behind, max_ahead,
     return tuple(o[:K] for o in out)
 
 
-def range_stats_supported(secs, x, valid) -> bool:
+def pallas_block_feasible(K: int, L: int) -> bool:
+    """Whether THIS kernel could take a [K, L] f32 shard at its window
+    ceiling — the shard-shape part of :func:`range_stats_supported`,
+    used by the auto-pick budget (ops/rolling.py:shifted_row_budget):
+    the VMEM form's exemption from the XLA form's HBM bound only
+    applies when the VMEM form is actually reachable."""
+    return (
+        int(L) % 128 == 0
+        and jax.default_backend() == "tpu"
+        and pk._plan(int(K), int(L),
+                     arrays=_plan_arrays(_PALLAS_STATS_MAX_W, 0),
+                     bk_max=32, budget=90 * 2**20) is not None
+    )
+
+
+def range_stats_supported(secs, x, valid, max_behind: int = 28,
+                          max_ahead: int = 0) -> bool:
     return (
         x.dtype == jnp.float32
         and x.ndim == 2
         and x.shape[1] % 128 == 0
+        and int(max_behind) + int(max_ahead) <= _PALLAS_STATS_MAX_W
         and jax.default_backend() == "tpu"
-        and pk._plan(int(x.shape[0]), int(x.shape[1]), arrays=32,
+        and pk._plan(int(x.shape[0]), int(x.shape[1]),
+                     arrays=_plan_arrays(int(max_behind), int(max_ahead)),
                      bk_max=32, budget=90 * 2**20) is not None
     )
 

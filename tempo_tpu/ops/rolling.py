@@ -28,18 +28,42 @@ import jax.numpy as jnp
 
 from tempo_tpu.ops import window_utils as wu
 
-# Auto-pick threshold between the static-shift range-stats form (W
-# masked shifted passes, ops/sortmerge.py:range_stats_shifted + the
-# VMEM kernel) and the general prefix-scan + RMQ form
-# (:func:`windowed_stats`): frames whose row extent (behind + tie
-# rows ahead) stays under this bound take the shifted form.  The
-# crossover is measured on-chip by bench.py's 12 Hz config (the
-# ``rolling_crossover`` record: both kernels on identical ~130-row
-# windows); the shifted form won every density it can legally reach
-# through round 4, so the bound is set by compile-time growth (each
-# extra row is one more unrolled pass per aggregate) rather than
-# runtime.
+# Auto-pick between the static-shift range-stats form (W masked
+# shifted passes, ops/sortmerge.py:range_stats_shifted + the VMEM
+# kernel) and the general prefix-scan + RMQ form
+# (:func:`windowed_stats`): frames whose row extent (behind + tie rows
+# ahead) fits :func:`shifted_row_budget` take the shifted form.  The
+# crossover is measured on-chip by bench.py's 10 Hz config (the
+# ``rolling_crossover`` record, both kernels on identical ~140-row
+# windows: shifted 174M rows/s vs windowed 8.0M — the windowed form is
+# gather-bound on this part, ~96 ms per RMQ take_along_axis, so the
+# shifted form wins every extent it can legally reach).  The bound is
+# therefore set by resources, not runtime: compile-time growth on
+# small shards (SHIFTED_MAX_ROWS) and HBM on large ones.
 SHIFTED_MAX_ROWS = 512
+
+
+def shifted_row_budget(n_elems: int, pallas_ok: bool = False) -> int:
+    """Largest row extent the shifted form may take for a shard of
+    ``n_elems`` values.  The XLA form materialises ~2.4 shifted operand
+    copies per unrolled pass (measured on v5e at [1024, 8192]: W=512
+    demanded 40.9G of the 15.75G HBM; W=139 fit), so the memory bound
+    scales inversely with the shard's element count; 12G of the 15.75G
+    is budgeted, with a 3x-per-pass margin over the measured 2.4.
+
+    ``pallas_ok`` (the caller verified the VMEM kernel can take this
+    shard shape/dtype — pallas_stats.pallas_block_feasible) floors the
+    budget at that kernel's window ceiling: extents IT accepts never
+    materialise shifted copies in HBM.  The floor must not apply
+    otherwise — a shard the Pallas gate rejects for shape reasons
+    falls to the XLA form, where the memory bound is real (code-review
+    r4 finding)."""
+    from tempo_tpu.ops.pallas_stats import _PALLAS_STATS_MAX_W
+
+    mem_rows = int(12e9 // max(n_elems * 4 * 3, 1))
+    if pallas_ok:
+        mem_rows = max(mem_rows, _PALLAS_STATS_MAX_W)
+    return min(SHIFTED_MAX_ROWS, mem_rows)
 
 
 def _sparse_table(arr: jnp.ndarray, fill, reducer, nlev: int = 0) -> jnp.ndarray:

@@ -528,8 +528,8 @@ def range_stats_shifted(
     equivalent); int64 keys keep the XLA form below."""
     from tempo_tpu.ops import pallas_stats as ps
 
-    if secs.dtype == jnp.int32 and ps.range_stats_supported(secs, x,
-                                                            valid):
+    if secs.dtype == jnp.int32 and ps.range_stats_supported(
+            secs, x, valid, max_behind, max_ahead):
         return ps.range_stats_pallas(secs, x, valid, window,
                                      max_behind, max_ahead)
     return _range_stats_shifted_xla(secs, x, valid, window,

@@ -81,7 +81,12 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     rb = (packing.layout_rowbounds(layout, w)
           if ts_long.dtype == np.int32 and sm.use_sort_kernels()
           else None)
-    if rb is not None and rb[0] + rb[1] <= rk.SHIFTED_MAX_ROWS:
+    from tempo_tpu.ops import pallas_stats as _ps
+
+    pallas_ok = (np.dtype(packing.compute_dtype()) == np.float32
+                 and _ps.pallas_block_feasible(C * K, L))
+    if rb is not None and rb[0] + rb[1] <= rk.shifted_row_budget(
+            C * K * L, pallas_ok):
         stats = dict(sm.range_stats_shifted(
             tile(ts_long), flat(vals), flat(valids),
             jnp.asarray(np.int32(w)),

@@ -40,7 +40,7 @@ def test_bench_smoke_prints_one_json_line():
     assert set(cfgs) == {
         "1_quickstart_asof", "2_range_stats_10s", "3_resample_ema",
         "4_nbbo_skew_asof", "5_skew_1b_bracketed",
-        "2b_range_stats_dense_50hz",
+        "2b_range_stats_dense_50hz", "6_seq_tiebreak_asof",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -48,7 +48,7 @@ def test_bench_smoke_prints_one_json_line():
     assert not bad, f"configs failed or empty: {bad}\n{out.stderr[-2000:]}"
     # the dense-vs-shifted rolling crossover must be measured (round 4)
     assert rec["rolling_crossover"], "rolling_crossover missing"
-    assert rec["rolling_crossover"]["winner_at_12hz"] in (
+    assert rec["rolling_crossover"]["winner_at_10hz"] in (
         "shifted", "windowed")
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can

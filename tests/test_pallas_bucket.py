@@ -109,13 +109,16 @@ def test_resample_ema_matches_xla_body():
 
 
 def test_resample_ema_bucket_division_boundaries():
-    """The in-kernel f32 division must floor exactly at bucket
-    boundaries (multiples of step) up to the 2^24 gate."""
+    """In-kernel bucketing is exact i32 division — including the range
+    where the first revision's f32-reciprocal multiply misassigned
+    rows one second below a bucket boundary (secs ≈ 10.2M+; the first
+    failing value was 10_186_199, code-review r4)."""
     step = 60
-    vals = np.array([0, 59, 60, 61, 119, 120, 2**24 - 64,
-                     2**24 - 60], np.int64)
+    vals = np.array([0, 59, 60, 61, 119, 120, 10_186_199, 10_186_200,
+                     2**24 - 64, 2**24 - 60, 2**30, 2**30 + 59,
+                     2**31 - 128], np.int64)
     secs = np.sort(np.pad(vals, (0, 128 - len(vals)),
-                          constant_values=2**24 - 1))[None, :]
+                          constant_values=2**31 - 100))[None, :]
     x = np.ones((1, 128), np.float32)
     valid = np.ones((1, 128), bool)
     res, _ = resample_ema_pallas(
