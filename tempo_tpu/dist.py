@@ -224,15 +224,14 @@ class DistributedTSDF:
                 host_cols[c] = c
 
         sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis))
-        ts_d = jax.device_put(ts_p, sharding)
-        mask_d = jax.device_put(mask_p, sharding)
+        put = _put_global(sharding)
+        ts_d = put(ts_p)
+        mask_d = put(mask_p)
         cols_d = {
-            c: DistCol(jax.device_put(col.values, sharding),
-                       jax.device_put(col.valid, sharding))
+            c: DistCol(put(col.values), put(col.valid))
             for c, col in cols.items()
         }
-        seq_d = (jax.device_put(seq_p, sharding)
-                 if seq_p is not None else None)
+        seq_d = put(seq_p) if seq_p is not None else None
         _PACK_EVENTS += 1
         return cls(mesh, series_axis, time_axis, ts_d, mask_d, cols_d,
                    layout, tsdf.ts_col, tsdf.partitionCols,
@@ -963,9 +962,9 @@ class DistributedTSDF:
             v, ok, mask
         )
         K = self.layout.n_series
-        ac_h = np.asarray(ac).astype(np.float64)[:K]
-        cnt_h = np.asarray(cnt)[:K]
-        len_h = np.asarray(lengths)[:K]
+        ac_h = _to_host(ac).astype(np.float64)[:K]
+        cnt_h = _to_host(cnt)[:K]
+        len_h = _to_host(lengths)[:K]
         # a series only yields a row when the numerator join is non-empty
         # (reference tsdf.py:248-253 inner joins drop pairless series)
         present = (len_h > lag) & (cnt_h > lag)
@@ -976,14 +975,48 @@ class DistributedTSDF:
         return out[present].reset_index(drop=True)
 
     def fourier_transform(self, timestep: float, valueCol: str):
-        """Fourier transform via the host frame path.  The reference's
-        own implementation ships every group's rows to Python workers
-        over Arrow (applyInPandas, tsdf.py:865-899) — a materialisation
-        boundary by design — so the distributed form collects once,
-        runs the device-FFT host path (spectral.py), and re-meshes."""
-        host = self.collect().fourier_transform(timestep, valueCol)
-        return host.on_mesh(self.mesh, series_axis=self.series_axis,
-                            time_axis=self.time_axis)
+        """Fourier transform, device-resident (round 4; the reference
+        ships every group's rows to Python workers over Arrow —
+        applyInPandas, tsdf.py:865-899 — and earlier rounds mirrored
+        that with a collect()).  Each series' exact n-point DFT runs as
+        one batched Bluestein program at the frame's lane width
+        (ops/fft.py:bluestein_dft; time-sharded meshes switch to
+        series-local rows around it), and ``freq`` is the fftfreq grid
+        of each series' true length.  Output column surface matches the
+        host path: partition/ts/[seq] + value + freq/ft_real/ft_imag
+        (spectral.py:104-112).
+
+        Bucket-head (resampled) views keep the host fallback — their
+        real rows are not front-packed, which the batched DFT
+        requires."""
+        matches = [c for c in self.cols if c.lower() == valueCol.lower()
+                   and self.cols[c].ts_chunk is None
+                   and self.cols[c].host_gather is None]
+        if self.resampled or not matches:
+            # bucket-head views (rows not front-packed) and columns
+            # without a plain device plane (host-resident ints/strings,
+            # join-produced gather/ts-chunk columns) keep the
+            # collect-based path — spectral.py resolves any frame
+            # column, including raising the reference's error for a
+            # truly absent one
+            host = self.collect().fourier_transform(timestep, valueCol)
+            return host.on_mesh(self.mesh, series_axis=self.series_axis,
+                                time_axis=self.time_axis)
+        vc = matches[0]
+        col = self.cols[vc]
+        freq, ftr, fti = _fourier_fn(self.mesh, self.series_axis,
+                                     self.time_axis, float(timestep))(
+            col.values, self.mask
+        )
+        new_cols = {
+            vc: col,
+            "freq": DistCol(freq, self.mask),
+            "ft_real": DistCol(ftr, self.mask),
+            "ft_imag": DistCol(fti, self.mask),
+        }
+        keep_host = {c: src for c, src in self.host_cols.items()
+                     if c == self.seq_col}
+        return self._with(cols=new_cols, host_cols=keep_host)
 
     def withLookbackFeatures(self, featureCols, lookbackWindowSize: int,
                              exactSize: bool = True,
@@ -1010,7 +1043,7 @@ class DistributedTSDF:
         names = list(self.cols)
         # single stacked fetch: float cols as one [C, K, L] f64 block
         if names:
-            stacked = np.asarray(
+            stacked = _to_host(
                 jnp.stack([self.cols[c].values.astype(jnp.float64)
                            for c in names]
                           + [self.cols[c].valid.astype(jnp.float64)
@@ -1018,12 +1051,12 @@ class DistributedTSDF:
             )
             val_block = stacked[: len(names)]
             ok_block = stacked[len(names):] > 0.5
-        ts_h = np.asarray(self.ts)
-        mask_h = np.asarray(self.mask)
+        ts_h = _to_host(self.ts)
+        mask_h = _to_host(self.mask)
         _FETCH_EVENTS += 1
 
         for msg, count in self.audits:
-            n = int(np.asarray(count))
+            n = int(_to_host(count))
             if n > 0:
                 logger.warning(msg, n) if "%d" in msg else logger.warning(msg)
         K = self.layout.n_series
@@ -1111,6 +1144,40 @@ class DistributedTSDF:
             f"cols={self.numeric_columns()}, host_cols={list(self.host_cols)}, "
             f"ts_col={self.ts_col!r}, partition_cols={self.partitionCols})"
         )
+
+
+def _put_global(sharding):
+    """Host->device placement that works across processes.  Ingest is
+    replicated-host (every process packed the same frame, the standard
+    multi-controller SPMD pattern), so each device's shard is a slice
+    of the local array — ``make_array_from_callback`` places exactly
+    those slices.  Multi-process ``device_put`` would work too but
+    value-checks the array across processes with an equality that
+    fails on NaN payloads (jax multihost_utils.assert_equal; NaN !=
+    NaN), which every packed value plane contains."""
+    if jax.process_count() > 1:
+        def put(arr):
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx]
+            )
+
+        return put
+    return lambda arr: jax.device_put(arr, sharding)
+
+
+def _to_host(arr) -> np.ndarray:
+    """Device->host fetch that also works across processes: a
+    multi-controller frame's arrays are not fully addressable (each
+    host owns its mesh slice), so ``np.asarray`` would raise —
+    ``process_allgather`` rebuilds the global value on every host
+    instead (DCN), which is exactly collect()'s dense contract.
+    Single-process arrays take the plain fetch."""
+    if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr,
+                                                            tiled=True))
+    return np.asarray(arr)
 
 
 def _pad_k(arr: np.ndarray, K_dev: int, fill) -> np.ndarray:
@@ -1764,6 +1831,58 @@ def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2_in, sp2_in, sp3_in, sp3_in),
                              out_specs=out_specs))
+
+
+@functools.lru_cache(maxsize=256)
+def _fourier_fn(mesh, series_axis, time_axis, timestep):
+    """Per-series exact-length DFT planes (freq, ft_real, ft_imag) on
+    front-packed [K, L] rows; one Bluestein program at the lane width
+    serves every length mix (ops/fft.py).  Time-sharded meshes switch
+    to series-local full rows around the transform."""
+    from tempo_tpu.ops import fft as fft_ops
+
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    sp2 = _spec(mesh, series_axis, time_axis)
+
+    def local(vals, mask):
+        L = vals.shape[-1]
+        n = jnp.sum(mask, axis=-1)                       # [K]
+        x = jnp.where(mask, vals, 0.0).astype(vals.dtype)
+        # the Bluestein bucket must be a power of two (its internal
+        # convolution length is 2*bucket); the frame's lane width is
+        # only 8-aligned — zero-pad up and slice back
+        B2 = 1 << max(int(L) - 1, 1).bit_length()
+        if B2 != L:
+            x = jnp.pad(x, ((0, 0), (0, B2 - L)))
+        re, im = fft_ops.bluestein_dft(x, jnp.maximum(n, 1), B2)
+        re, im = re[:, :L], im[:, :L]
+        j = jnp.arange(L)[None, :]
+        n_ = jnp.maximum(n[:, None], 1)
+        # np.fft.fftfreq order: [0 .. (n-1)//2, -(n//2) .. -1] / (n d)
+        jj = jnp.where(j <= (n_ - 1) // 2, j, j - n_)
+        freq = jj.astype(vals.dtype) / (
+            n_.astype(vals.dtype) * vals.dtype.type(timestep)
+        )
+        ok = j < n[:, None]
+        nan = vals.dtype.type(jnp.nan)
+        return (jnp.where(ok, freq, nan),
+                jnp.where(ok, re.astype(vals.dtype), nan),
+                jnp.where(ok, im.astype(vals.dtype), nan))
+
+    def kernel(vals, mask):
+        if n_t > 1:
+            a2a_in = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+                tiled=True)
+            a2a_out = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
+                tiled=True)
+            outs = local(a2a_in(vals), a2a_in(mask))
+            return tuple(a2a_out(o) for o in outs)
+        return local(vals, mask)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp2, sp2),
+                             out_specs=(sp2, sp2, sp2)))
 
 
 @functools.lru_cache(maxsize=256)

@@ -308,10 +308,19 @@ def resample_ema_pallas(secs, x, valid, step: float, alpha: float,
     """Fused floor-resample + exact EMA: ``res`` is x at each bucket's
     first valid head row (NaN elsewhere — the packed-in-place
     downsample view), ``ema`` the exact EMA over the head-masked
-    samples.  ``secs`` must be integral and fit int32."""
+    samples.  ``secs`` and ``step`` must be integral (the in-kernel
+    bucketing is exact i32 division; a fractional step would silently
+    truncate and a sub-1 step would divide by zero) and fit int32."""
+    step_i = int(step)
+    if step_i != step or step_i < 1:
+        raise ValueError(
+            f"resample_ema_pallas needs an integral step >= 1 in the "
+            f"seconds unit of `secs`, got {step!r}; rescale secs (e.g. "
+            f"to ms) for sub-second buckets"
+        )
     res, ema = _resample_ema_call(
         secs.astype(jnp.int32), x, valid,
-        jnp.asarray(int(step), jnp.int32),
+        jnp.asarray(step_i, jnp.int32),
         jnp.asarray(alpha, jnp.float32), interpret=interpret,
     )
     return res, ema

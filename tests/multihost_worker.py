@@ -86,4 +86,56 @@ for i in range(L):
     expect[:, i] = acc
 np.testing.assert_allclose(np.asarray(ema), expect, rtol=1e-6, atol=1e-9)
 
+# 4) FRAME-LEVEL multi-process: the public TSDF.on_mesh -> asofJoin ->
+# EMA -> withRangeStats -> collect() chain with every device array
+# genuinely spanning the two processes.  Host ingest is replicated
+# (every process holds the same pandas frame — the standard
+# multi-controller SPMD pattern); collect() rebuilds the global value
+# on every host via process_allgather (dist._to_host, round 4).
+import pandas as pd  # noqa: E402
+
+from tempo_tpu import TSDF  # noqa: E402
+
+rng2 = np.random.default_rng(7)          # same seed on every process
+n = 240
+keys = np.repeat(["p1", "p2", "p3", "p4"], n // 4)
+secs = np.concatenate(
+    [np.cumsum(rng2.integers(1, 3, size=n // 4)) for _ in range(4)]
+)
+df_l = pd.DataFrame({
+    "id": keys,
+    "event_ts": pd.to_datetime(secs * np.int64(1_000_000_000)),
+    "x": rng2.standard_normal(n),
+})
+df_r = pd.DataFrame({
+    "id": keys,
+    "event_ts": pd.to_datetime(
+        (secs - rng2.integers(0, 2, size=n)) * np.int64(1_000_000_000)
+    ),
+    "v": np.where(rng2.random(n) > 0.2, rng2.standard_normal(n), np.nan),
+})
+lt = TSDF(df_l, "event_ts", ["id"])
+rt = TSDF(df_r, "event_ts", ["id"])
+
+dl = lt.on_mesh(mesh)
+dr = rt.on_mesh(mesh)
+assert not dl.ts.is_fully_addressable     # frame really spans processes
+
+chain = lambda a, b: (
+    a.asofJoin(b)
+    .EMA("x", exact=True)
+    .withRangeStats(colsToSummarize=["x"], rangeBackWindowSecs=8)
+)
+got = chain(dl, dr).collect().df
+want = chain(lt, rt).df
+key = ["id", "event_ts"]
+got = got.sort_values(key).reset_index(drop=True)
+want = want.sort_values(key).reset_index(drop=True)
+assert len(got) == len(want), (len(got), len(want))
+for c in ("right_v", "EMA_x", "mean_x", "stddev_x"):
+    np.testing.assert_allclose(
+        got[c].to_numpy(np.float64), want[c].to_numpy(np.float64),
+        rtol=1e-6, atol=1e-9, equal_nan=True, err_msg=c,
+    )
+
 print(f"proc {pid}/{nproc} OK", flush=True)

@@ -759,12 +759,58 @@ def test_autocorr_matches_host(frames, axes, ta, lag):
     )
 
 
-def test_fourier_roundtrip_on_mesh(frames):
+@pytest.mark.parametrize("axes,ta", MESHES)
+def test_fourier_device_resident_on_mesh(frames, axes, ta):
+    """Round 4: fourier_transform runs on the mesh (batched Bluestein
+    DFT in shard_map) instead of collecting — parity vs the host path
+    on every mesh shape, including time-sharded."""
+    l, _ = frames
+    mesh = make_mesh(axes)
+    dres = l.on_mesh(mesh, time_axis=ta).fourier_transform(1.0, "price")
+    got = _sorted(dres.collect().df)
+    want = _sorted(l.fourier_transform(1.0, "price").df)
+    assert set(got.columns) == set(want.columns)
+    for c in ("ft_real", "ft_imag", "freq"):
+        np.testing.assert_allclose(
+            got[c].to_numpy(float), want[c].to_numpy(float),
+            rtol=1e-6, atol=1e-9, err_msg=c,
+        )
+
+
+def test_fourier_host_resident_column_falls_back(frames):
+    """Columns without a plain device plane (e.g. joined host-gather
+    columns) keep the collect-based path instead of raising
+    (code-review r4 finding); truly absent columns still raise."""
+    l, r = frames
+    mesh = make_mesh({"series": 4})
+    joined = l.on_mesh(mesh).asofJoin(r.on_mesh(mesh))
+    # right_note is absent; right_bid is a plain device col; the left
+    # 'note' column is host-resident
+    host_joined = l.asofJoin(r)
+    got = _sorted(joined.fourier_transform(1.0, "right_bid")
+                  .collect().df)
+    want = _sorted(host_joined.fourier_transform(1.0, "right_bid").df)
+    np.testing.assert_allclose(
+        got["ft_real"].to_numpy(float), want["ft_real"].to_numpy(float),
+        rtol=1e-6, atol=1e-9,
+    )
+    with pytest.raises(ValueError, match="not found"):
+        joined.fourier_transform(1.0, "no_such_col")
+
+
+def test_fourier_resampled_view_falls_back(frames):
+    """Bucket-head views keep the collect-based path (rows are not
+    front-packed); results still match the host chain."""
     l, _ = frames
     mesh = make_mesh({"series": 4})
-    got = _sorted(l.on_mesh(mesh).fourier_transform(1.0, "price")
-                  .collect().df)
-    want = _sorted(l.fourier_transform(1.0, "price").df)
+    got = _sorted(
+        l.on_mesh(mesh).resample("1 minute", "mean", metricCols=["price"])
+        .fourier_transform(1.0, "price").collect().df
+    )
+    want = _sorted(
+        TSDF(l.resample("1 minute", "mean", metricCols=["price"]).df,
+             "event_ts", ["symbol"]).fourier_transform(1.0, "price").df
+    )
     for c in ("ft_real", "ft_imag", "freq"):
         np.testing.assert_allclose(
             got[c].to_numpy(float), want[c].to_numpy(float),

@@ -108,6 +108,16 @@ def test_resample_ema_matches_xla_body():
                                atol=2e-6)
 
 
+def test_resample_ema_rejects_non_integral_step():
+    x = jnp.ones((1, 128), jnp.float32)
+    s = jnp.zeros((1, 128), jnp.int32)
+    v = jnp.ones((1, 128), bool)
+    with pytest.raises(ValueError, match="integral step"):
+        resample_ema_pallas(s, x, v, step=0.5, alpha=0.2, interpret=True)
+    with pytest.raises(ValueError, match="integral step"):
+        resample_ema_pallas(s, x, v, step=90.7, alpha=0.2, interpret=True)
+
+
 def test_resample_ema_bucket_division_boundaries():
     """In-kernel bucketing is exact i32 division — including the range
     where the first revision's f32-reciprocal multiply misassigned

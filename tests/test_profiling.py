@@ -30,6 +30,20 @@ class TestStrategyPick:
         assert profiling.pick_asof_strategy(d, d, False, True, 0) == "merge"
         assert profiling.pick_asof_strategy(d, d, False, False, 5) == "merge"
 
+    def test_max_lookback_beats_broadcast(self, caplog):
+        """ADVICE r3: the broadcast kernel has no row cap, so a
+        user-supplied maxLookback must force the merge path even when
+        sql_join_opt and the size threshold would pick broadcast —
+        silently dropping the cap returns unbounded-lookback rows."""
+        import logging
+
+        small = _df(10)
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.profiling"):
+            got = profiling.pick_asof_strategy(small, small, True, False, 3)
+        assert got == "merge"
+        assert any("cannot bound lookback" in r.message
+                   for r in caplog.records)
+
     def test_broadcast_threshold(self):
         # both sides over 30MiB -> no broadcast even when opted in
         big = pd.DataFrame({"v": np.zeros(5_000_000)})  # 40MB of float64
