@@ -1026,10 +1026,52 @@ class DistributedTSDF:
         a shuffle (collect_list, tsdf.py:637-671) — inherently a
         row-materialisation op — so the distributed form collects once
         and runs the device shifted-stack path; the dense device-side
-        form is ``tempo_tpu.rolling.lookback_tensor``."""
+        form is :meth:`lookback_tensor`."""
         return self.collect().withLookbackFeatures(
             featureCols, lookbackWindowSize, exactSize, featureColName
         )
+
+    def lookback_tensor(self, featureCols, lookbackWindowSize: int):
+        """Dense ``([K, L, w, F] values, [K, L, w, F] validity)``
+        lookback tensor as DEVICE arrays, series-sharded — the
+        TPU-native model-feeding form of ``withLookbackFeatures``
+        (round 4; host analog ``tempo_tpu.rolling.lookback_tensor``),
+        with no object-array materialisation and no host round trip.
+        Window axis is oldest-first (row t's slot j holds observation
+        t - w + j), zero-padded with the mask False where no
+        observation exists.  On a time-sharded mesh the rows switch to
+        a series-local layout first (the shifts cross shard
+        boundaries), so the result is sharded over all devices along
+        the series axis.
+
+        Plain numeric device columns only (join-index/ts-chunk planes
+        hold row positions, not values), and not on bucket-head
+        (resampled) views — their real rows are interspersed with
+        masked lanes, so a physical-slot window would not be the w
+        previous observations; collect() + ``withLookbackFeatures``
+        compacts first."""
+        if self.resampled:
+            raise ValueError(
+                "lookback_tensor on a resampled (bucket-head) view "
+                "would window over physical lane slots, not the "
+                "previous w buckets; collect() and use "
+                "withLookbackFeatures (which compacts rows first)"
+            )
+        cols = list(featureCols)
+        eligible = set(self.numeric_columns())
+        bad = [c for c in cols if c not in eligible]
+        if bad:
+            raise ValueError(
+                f"lookback_tensor needs plain numeric device columns; "
+                f"{bad} are missing or host/join-resident "
+                f"(available: {sorted(eligible)})"
+            )
+        vals = jnp.stack([self.cols[c].values for c in cols])
+        valids = jnp.stack([self.cols[c].valid for c in cols])
+        return _lookback_tensor_fn(
+            self.mesh, self.series_axis, self.time_axis,
+            int(lookbackWindowSize), len(cols)
+        )(vals, valids)
 
     # ------------------------------------------------------------------
     # Materialisation
@@ -1831,6 +1873,35 @@ def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2_in, sp2_in, sp3_in, sp3_in),
                              out_specs=out_specs))
+
+
+@functools.lru_cache(maxsize=256)
+def _lookback_tensor_fn(mesh, series_axis, time_axis, w, n_cols):
+    """[F, K, L] planes -> ([K, L, w, F] values, mask) shifted stacks
+    (rolling.lookback_tensor semantics: slot j = observation t-w+j,
+    zero/False where absent).  Time-sharded meshes regather
+    series-local rows first — the output stays series-local over all
+    devices, like the interpolate grid outputs."""
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    sp_in = _spec(mesh, series_axis, time_axis, 3)
+    if n_t > 1:
+        sp_out = P((series_axis, time_axis), None, None, None)
+    else:
+        sp_out = P(series_axis, None, None, None)
+
+    def kernel(vals, valids):
+        from tempo_tpu.rolling import lookback_stack
+
+        if n_t > 1:
+            a2a = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
+                tiled=True)
+            vals, valids = a2a(vals), a2a(valids)
+        return lookback_stack(vals.transpose(1, 2, 0),
+                              valids.transpose(1, 2, 0), w)
+
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp_in, sp_in),
+                             out_specs=(sp_out, sp_out)))
 
 
 @functools.lru_cache(maxsize=256)

@@ -315,6 +315,18 @@ def with_lookback_features(tsdf, featureCols: List[str], lookbackWindowSize: int
     return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
 
 
+def lookback_stack(x, m, w: int):
+    """[K, L, F] (values, mask) -> [K, L, w, F] shifted stacks: window
+    slot j holds observation t - w + j (oldest first), zero/False
+    where absent.  The single definition of the lookback-window
+    semantics — shared by the host path below and the shard_map kernel
+    (dist.py:_lookback_tensor_fn)."""
+    L = x.shape[1]
+    sh = lambda a, j: jnp.pad(a, ((0, 0), (j, 0), (0, 0)))[:, :L, :]
+    return (jnp.stack([sh(x, j) for j in range(w, 0, -1)], axis=2),
+            jnp.stack([sh(m, j) for j in range(w, 0, -1)], axis=2))
+
+
 def lookback_tensor(tsdf, featureCols: List[str], lookbackWindowSize: int):
     """TPU-native variant: the dense [K, L, w, F] lookback tensor as a
     jax array (zero-padded, with a validity mask), suitable for feeding
@@ -322,13 +334,4 @@ def lookback_tensor(tsdf, featureCols: List[str], lookbackWindowSize: int):
     vals, valids = _packed_metric_stack(tsdf, featureCols)   # [F, K, L]
     x = jnp.asarray(vals).transpose(1, 2, 0)                 # [K, L, F]
     m = jnp.asarray(valids).transpose(1, 2, 0)
-    w = int(lookbackWindowSize)
-    shifted = [
-        jnp.pad(x, ((0, 0), (j, 0), (0, 0)))[:, : x.shape[1], :]
-        for j in range(w, 0, -1)
-    ]
-    shifted_m = [
-        jnp.pad(m, ((0, 0), (j, 0), (0, 0)))[:, : m.shape[1], :]
-        for j in range(w, 0, -1)
-    ]
-    return jnp.stack(shifted, axis=2), jnp.stack(shifted_m, axis=2)
+    return lookback_stack(x, m, int(lookbackWindowSize))

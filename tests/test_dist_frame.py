@@ -798,6 +798,49 @@ def test_fourier_host_resident_column_falls_back(frames):
         joined.fourier_transform(1.0, "no_such_col")
 
 
+@pytest.mark.parametrize("axes,ta", MESHES)
+def test_lookback_tensor_on_mesh(frames, axes, ta):
+    """Device-resident [K, L, w, F] lookback tensor (round 4) vs the
+    host shifted-stack form, on every mesh shape."""
+    from tempo_tpu.rolling import lookback_tensor as host_lt
+
+    l, _ = frames
+    mesh = make_mesh(axes)
+    dl = l.on_mesh(mesh, time_axis=ta)
+    vals_d, mask_d = dl.lookback_tensor(["price"], 4)
+    want_v, want_m = host_lt(l, ["price"], 4)
+    K = l.layout.n_series
+    # the dist frame pads K to the mesh multiple and L to 8*n_time;
+    # compare the real [K, L_host] block (pad slots carry mask False)
+    Lh = np.asarray(want_m).shape[1]
+    got_v = np.asarray(vals_d)[:K, :Lh]
+    got_m = np.asarray(mask_d)[:K, :Lh]
+    np.testing.assert_array_equal(got_m, np.asarray(want_m))
+    np.testing.assert_allclose(
+        got_v[got_m], np.asarray(want_v)[np.asarray(want_m)],
+        rtol=1e-6, atol=1e-9,
+    )
+
+
+def test_lookback_tensor_guards(frames):
+    """Ineligible columns and bucket-head views raise instead of
+    silently feeding join-index planes / physical-slot windows
+    (code-review r4 findings)."""
+    l, r = frames
+    mesh = make_mesh({"series": 4})
+    dl = l.on_mesh(mesh)
+    with pytest.raises(ValueError, match="missing or host/join"):
+        dl.lookback_tensor(["note"], 3)          # host-resident
+    with pytest.raises(ValueError, match="missing or host/join"):
+        dl.lookback_tensor(["nope"], 3)          # absent
+    joined = dl.asofJoin(r.on_mesh(mesh))
+    with pytest.raises(ValueError, match="missing or host/join"):
+        joined.lookback_tensor(["right_event_ts"], 3)   # ts-chunk col
+    res = dl.resample("1 minute", "mean", metricCols=["price"])
+    with pytest.raises(ValueError, match="bucket-head"):
+        res.lookback_tensor(["price"], 3)
+
+
 def test_fourier_resampled_view_falls_back(frames):
     """Bucket-head views keep the collect-based path (rows are not
     front-packed); results still match the host chain."""
