@@ -3,18 +3,27 @@ surface (the packed-array equivalent of the reference's WindowSpec
 builders, scala TSDF.scala:127-159; mapping table in MIGRATION.md).
 
 Kernel-choice note: the scan-shaped ops (EMA, last/first-valid, prefix
-sums) run as Pallas VMEM ladders on TPU (see ``pallas_kernels``);
-variable-width *range* windows stay on XLA because their queries need
-per-element dynamic gathers, which Mosaic cannot lower (probed on v5e)
-— and XLA's cumsum+gather formulation is already near the HBM bound.
+sums) run as Pallas VMEM ladders on TPU (``pallas_kernels``), range
+windows with a boundable row extent run as the VMEM shifted kernel
+(``pallas_stats``, auto-picked through ``rolling.shifted_row_budget``),
+and tumbling-bucket reductions as the VMEM segmented-scan kernel
+(``pallas_bucket``).  Only UNBOUNDED-extent range windows stay on XLA:
+their queries need per-element dynamic gathers, which Mosaic cannot
+lower (probed on v5e).
 """
 
 from tempo_tpu.ops.rolling import (
     range_window_bounds,
     windowed_stats,
+    bucket_stats,
     segment_stats,
+    shifted_row_budget,
     ema_compat,
     ema_exact,
+)
+from tempo_tpu.ops.pallas_bucket import (
+    bucket_stats_pallas,
+    resample_ema_pallas,
 )
 from tempo_tpu.ops.window_utils import (
     last_valid_index,
@@ -33,7 +42,11 @@ from tempo_tpu.ops.pallas_kernels import (
 __all__ = [
     "range_window_bounds",
     "windowed_stats",
+    "bucket_stats",
     "segment_stats",
+    "shifted_row_budget",
+    "bucket_stats_pallas",
+    "resample_ema_pallas",
     "ema_compat",
     "ema_exact",
     "last_valid_index",

@@ -38,9 +38,13 @@ def _bound_jax_live_state():
     with everything held live in one process, jaxlib's CPU client
     started segfaulting non-deterministically inside later *compiles*
     (cache read, cache write, and plain compile paths — observed three
-    distinct crash sites at ~300 tests in).  Clearing the in-memory
-    executable caches per module bounds the live state; the on-disk
-    compilation cache keeps re-runs fast."""
+    distinct crash sites at ~300 tests in).  Root cause: every live
+    executable holds JIT code mappings, and the process exhausts the
+    kernel's per-process mmap budget (vm.max_map_count = 65530 here) —
+    LLVM then reports 'Cannot allocate memory' and the next allocation
+    faults.  Clearing the in-memory executable caches per module
+    bounds the mapping count; the on-disk compilation cache keeps
+    re-runs fast."""
     yield
     jax.clear_caches()
 

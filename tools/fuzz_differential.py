@@ -15,6 +15,7 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import jax
 import numpy as np
 import pandas as pd
 
@@ -193,6 +194,13 @@ def main():
             rng = np.random.default_rng(seed * 37 + hash(adv or "x") % 1000)
             for fn in TESTS:
                 check(fn.__name__, seed, adv, lambda: fn(np.random.default_rng(seed * 101 + 7), adv))
+        # every shape fuzzed is a fresh compile; holding thousands of
+        # executables live exhausts the process mmap budget
+        # (vm.max_map_count) — LLVM then fails allocation and jaxlib
+        # segfaults (observed ~30 seeds in).  Same mitigation as the
+        # test suite's per-module fixture.
+        if seed % 3 == 2:
+            jax.clear_caches()
 
     print(f"fuzz done: {len(fails)} failures")
     for name, seed, adv, tb in fails[:6]:
