@@ -20,21 +20,199 @@ first-class (driver spec "failure detection, checkpoint/resume"):
 Checkpoints are atomic (write to ``<dir>.tmp`` then rename) so a crash
 mid-save never corrupts the previous checkpoint, and versioned so
 future layout changes can refuse gracefully.
+
+Hardening (the failure-detection half of the driver spec, with
+:mod:`tempo_tpu.resilience`):
+
+* every npz array and parquet file carries a CRC-32 checksum in
+  ``manifest.json`` (``checksum_algo: "crc32"``); :func:`load` verifies
+  them and raises :class:`CheckpointError` naming the corrupt artifact;
+* missing / newer-format checkpoints raise :class:`CheckpointError`
+  naming the path and found/expected ``FORMAT_VERSION`` instead of raw
+  ``FileNotFoundError``/``KeyError``;
+* stale ``<dir>.tmp`` crash residue is detected and cleaned on load;
+* :func:`list_steps` / :func:`latest` / :func:`prune` manage the
+  ``step_NNNNN`` checkpoint families written by
+  :func:`tempo_tpu.resilience.run_resumable` (keep-last-K retention);
+* host-side reads/writes ride the transient-IO retry policy.
 """
 
 from __future__ import annotations
 
+import glob
 import json
+import logging
 import os
+import re
 import shutil
-from typing import Optional
+import zipfile
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pandas as pd
 
 import jax
 
-FORMAT_VERSION = 1
+from tempo_tpu import resilience
+from tempo_tpu.resilience import CheckpointError, FailureKind
+
+logger = logging.getLogger(__name__)
+
+FORMAT_VERSION = 2
+
+_IO_RETRY = resilience.retrying(resilience.DEFAULT_IO_POLICY,
+                                label="checkpoint-io")
+
+
+# ----------------------------------------------------------------------
+# Checksummed, retrying IO primitives
+# ----------------------------------------------------------------------
+
+def _array_crc(arr: np.ndarray) -> int:
+    """CRC-32 of an array's raw bytes (dtype-agnostic, no copy)."""
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(a.reshape(-1).view(np.uint8)) & 0xFFFFFFFF
+
+
+def _file_crc(path: str, chunk: int = 1 << 20) -> int:
+    c = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            c = zlib.crc32(b, c)
+    return c & 0xFFFFFFFF
+
+
+@_IO_RETRY
+def _read_parquet(path: str) -> pd.DataFrame:
+    return pd.read_parquet(path)
+
+
+@_IO_RETRY
+def _write_parquet(df: pd.DataFrame, path: str) -> None:
+    df.to_parquet(path)
+
+
+@_IO_RETRY
+def _savez(path: str, arrays: Dict[str, np.ndarray]) -> Dict[str, int]:
+    """Write an npz and return the per-array CRCs for the manifest."""
+    np.savez(path, **arrays)
+    return {k: _array_crc(v) for k, v in arrays.items()}
+
+
+@_IO_RETRY
+def _load_npz(path: str, checksums: Optional[Dict[str, int]] = None,
+              verify: bool = True) -> Dict[str, np.ndarray]:
+    """Eagerly read every array of an npz, naming the failing array on
+    container corruption and checking manifest CRCs when available."""
+    try:
+        z = np.load(path, allow_pickle=False)
+    except FileNotFoundError as e:
+        raise CheckpointError(
+            f"checkpoint file {path!r} is missing (incomplete save?)"
+        ) from e
+    except (zipfile.BadZipFile, OSError, ValueError, EOFError) as e:
+        if resilience.classify(e) is FailureKind.TRANSIENT_IO:
+            raise   # stays retryable under the IO policy
+        raise CheckpointError(
+            f"checkpoint file {path!r} is unreadable: {e}"
+        ) from e
+    out: Dict[str, np.ndarray] = {}
+    with z:
+        for name in z.files:
+            try:
+                arr = z[name]
+            except Exception as e:
+                if resilience.classify(e) is FailureKind.TRANSIENT_IO:
+                    raise
+                raise CheckpointError(
+                    f"checkpoint array {name!r} in {path!r} is "
+                    f"unreadable (corrupt container): {e}"
+                ) from e
+            if verify and checksums is not None and name in checksums:
+                got = _array_crc(arr)
+                want = int(checksums[name])
+                if got != want:
+                    raise CheckpointError(
+                        f"checksum mismatch for array {name!r} in "
+                        f"{path!r}: manifest crc32 {want}, computed {got}"
+                    )
+            out[name] = arr
+    return out
+
+
+def _write_manifest(d: str, man: dict) -> None:
+    """Finalize a manifest: stamp the format version and file-level
+    CRCs for every parquet artifact already written into ``d``."""
+    man.setdefault("format_version", FORMAT_VERSION)
+    man["checksum_algo"] = "crc32"
+    man["file_checksums"] = {
+        os.path.basename(p): _file_crc(p)
+        for p in sorted(glob.glob(os.path.join(d, "*.parquet")))
+    }
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump(man, f, indent=2)
+
+
+def _manifest(path: str) -> dict:
+    """Read + validate a manifest, raising :class:`CheckpointError`
+    (never raw FileNotFoundError/KeyError) on every failure mode."""
+    mp = os.path.join(path, "manifest.json")
+    if not os.path.exists(mp):
+        raise CheckpointError(
+            f"no checkpoint at {path!r}: manifest.json not found",
+            kind=FailureKind.PERMANENT,
+        )
+    try:
+        with open(mp) as f:
+            man = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointError(
+            f"checkpoint manifest {mp!r} is corrupt: {e}"
+        ) from e
+    fv = man.get("format_version") if isinstance(man, dict) else None
+    # bool is an int subclass but never a valid version
+    if not isinstance(fv, int) or isinstance(fv, bool) \
+            or "kind" not in man:
+        raise CheckpointError(
+            f"checkpoint manifest {mp!r} is missing required fields "
+            f"(integer format_version / kind) — truncated or foreign "
+            f"file?"
+        )
+    if fv > FORMAT_VERSION:
+        raise CheckpointError(
+            f"checkpoint at {path!r} has format_version {fv}, newer than "
+            f"this library understands (expected <= {FORMAT_VERSION}); "
+            f"upgrade tempo-tpu to load it",
+            kind=FailureKind.PERMANENT,
+        )
+    return man
+
+
+def _clean_stale_tmp(path: str) -> None:
+    """Remove ``<path>.tmp`` crash residue from a hard-killed save.
+
+    Only manifest-less residue is deleted: a tmp WITH a manifest means
+    the save finished writing and died before the rename swap — it is a
+    complete newest checkpoint (possibly the only one), so a read
+    operation must never destroy it; it is left in place with a
+    warning for the operator.  Loading concurrently with an in-flight
+    save is not supported (same as before this hardening)."""
+    tmp = path + ".tmp"
+    if not os.path.isdir(tmp) or jax.process_index() != 0:
+        return
+    if os.path.exists(os.path.join(tmp, "manifest.json")):
+        logger.warning(
+            "checkpoint %s: %s holds a fully-written checkpoint from a "
+            "save killed before its final rename — leaving it on disk "
+            "(rename it to recover that state)", path, tmp)
+        return
+    logger.warning(
+        "checkpoint %s: removing stale crash residue %s", path, tmp)
+    shutil.rmtree(tmp, ignore_errors=True)
 
 
 def save(frame, path: str, sharded: bool = False) -> None:
@@ -123,27 +301,122 @@ def save(frame, path: str, sharded: bool = False) -> None:
 
 
 def load(path: str, mesh=None, series_axis: str = "series",
-         time_axis: Optional[str] = None):
+         time_axis: Optional[str] = None, verify: bool = True):
     """Restore a checkpoint.  Distributed checkpoints need a ``mesh``
     (any device count — resume elsewhere is a re-placement); host
-    checkpoints ignore it."""
+    checkpoints ignore it.
+
+    ``verify=True`` (default) checks every artifact against the
+    manifest's CRC-32 checksums and raises :class:`CheckpointError`
+    naming the corrupt array/file; corruption is never silently
+    restored.  Stale ``<path>.tmp`` crash residue is cleaned."""
+    _clean_stale_tmp(path)
     if not os.path.exists(os.path.join(path, "manifest.json")) \
             and os.path.exists(os.path.join(path + ".bak", "manifest.json")):
         path = path + ".bak"   # crash mid-swap: previous checkpoint
-    with open(os.path.join(path, "manifest.json")) as f:
-        man = json.load(f)
-    if man["format_version"] > FORMAT_VERSION:
-        raise ValueError(
-            f"checkpoint format {man['format_version']} is newer than "
-            f"this library understands ({FORMAT_VERSION})"
-        )
+    man = _manifest(path)
+    if verify:
+        _verify_file_checksums(path, man)
     if man["kind"] == "host":
         return _load_host(path, man)
     if mesh is None:
         raise ValueError("distributed checkpoint needs a mesh to resume on")
     if man["kind"] == "dist_sharded":
-        return _load_dist_sharded(path, man, mesh, series_axis, time_axis)
-    return _load_dist(path, man, mesh, series_axis, time_axis)
+        return _load_dist_sharded(path, man, mesh, series_axis, time_axis,
+                                  verify=verify)
+    return _load_dist(path, man, mesh, series_axis, time_axis, verify=verify)
+
+
+def _verify_file_checksums(path: str, man: dict) -> None:
+    for fname, want in (man.get("file_checksums") or {}).items():
+        fp = os.path.join(path, fname)
+        if not os.path.exists(fp):
+            raise CheckpointError(
+                f"checkpoint file {fname!r} recorded in the manifest is "
+                f"missing from {path!r}"
+            )
+        got = _IO_RETRY(_file_crc)(fp)
+        if got != int(want):
+            raise CheckpointError(
+                f"checksum mismatch for file {fname!r} in {path!r}: "
+                f"manifest crc32 {want}, computed {got}"
+            )
+
+
+def _npz_checksums(man: dict, npz_name: str) -> Optional[Dict[str, int]]:
+    sums = man.get("array_checksums") or {}
+    return sums.get(npz_name)
+
+
+# ----------------------------------------------------------------------
+# Checkpoint families (run_resumable's step_NNNNN layout)
+# ----------------------------------------------------------------------
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def list_steps(parent: str) -> List[Tuple[int, str]]:
+    """``[(step, path)]`` of step checkpoints under ``parent``, newest
+    first.  ``*.tmp`` crash residue found along the way is cleaned (the
+    swap never happened, so it holds nothing recoverable)."""
+    if not os.path.isdir(parent):
+        return []
+    out: List[Tuple[int, str]] = []
+    for name in sorted(os.listdir(parent)):
+        full = os.path.join(parent, name)
+        if name.endswith(".tmp") and os.path.isdir(full):
+            _clean_stale_tmp(full[:-len(".tmp")])
+            continue
+        m = _STEP_RE.match(name)
+        if m and os.path.isdir(full):
+            out.append((int(m.group(1)), full))
+    out.sort(reverse=True)
+    return out
+
+
+def verify_checkpoint(path: str, verify_arrays: bool = True) -> dict:
+    """Validate a checkpoint end to end (manifest, file CRCs, every npz
+    array CRC) and return its manifest.  Raises
+    :class:`CheckpointError` on the first problem found."""
+    man = _manifest(path)
+    if not verify_arrays:
+        return man
+    _verify_file_checksums(path, man)
+    for npz_name in sorted(man.get("array_checksums") or {}):
+        _load_npz(os.path.join(path, npz_name),
+                  _npz_checksums(man, npz_name), verify=True)
+    if man["kind"] == "dist_sharded":
+        for bp in sorted(glob.glob(os.path.join(path, "blocks_p*.json"))):
+            doc = _read_blocks(bp)
+            pid = os.path.basename(bp)[len("blocks_p"):-len(".json")]
+            _load_npz(os.path.join(path, f"shard_p{pid}.npz"),
+                      doc.get("checksums"), verify=True)
+    return man
+
+
+def latest(parent: str, verify: bool = True) -> Optional[str]:
+    """Path of the newest *intact* step checkpoint under ``parent``
+    (``None`` when there is none).  Corrupt or truncated candidates are
+    skipped with a warning — resume falls back to the previous one."""
+    for _, path in list_steps(parent):
+        try:
+            verify_checkpoint(path, verify_arrays=verify)
+            return path
+        except CheckpointError as e:
+            logger.warning(
+                "checkpoint %s unusable (%s); trying an older one", path, e)
+    return None
+
+
+def prune(parent: str, keep_last: int = 2) -> None:
+    """Keep-last-K retention for a step-checkpoint family."""
+    if jax.process_index() != 0:
+        return
+    for _, path in list_steps(parent)[max(keep_last, 1):]:
+        logger.info("pruning old checkpoint %s (keep_last=%d)",
+                    path, keep_last)
+        shutil.rmtree(path, ignore_errors=True)
+        shutil.rmtree(path + ".bak", ignore_errors=True)
 
 
 # ----------------------------------------------------------------------
@@ -151,21 +424,19 @@ def load(path: str, mesh=None, series_axis: str = "series",
 # ----------------------------------------------------------------------
 
 def _save_host(tsdf, d: str) -> None:
-    tsdf.df.to_parquet(os.path.join(d, "host.parquet"))
-    with open(os.path.join(d, "manifest.json"), "w") as f:
-        json.dump({
-            "format_version": FORMAT_VERSION,
-            "kind": "host",
-            "ts_col": tsdf.ts_col,
-            "partition_cols": tsdf.partitionCols,
-            "sequence_col": tsdf.sequence_col or None,
-        }, f, indent=2)
+    _write_parquet(tsdf.df, os.path.join(d, "host.parquet"))
+    _write_manifest(d, {
+        "kind": "host",
+        "ts_col": tsdf.ts_col,
+        "partition_cols": tsdf.partitionCols,
+        "sequence_col": tsdf.sequence_col or None,
+    })
 
 
 def _load_host(d: str, man: dict):
     from tempo_tpu.frame import TSDF
 
-    df = pd.read_parquet(os.path.join(d, "host.parquet"))
+    df = _read_parquet(os.path.join(d, "host.parquet"))
     return TSDF(df, man["ts_col"], man["partition_cols"],
                 man.get("sequence_col"))
 
@@ -215,16 +486,16 @@ def _save_dist(frame, d: str) -> None:
             meta["host_gather_len"] = int(len(flat_vals))
             hg_idx += 1
         col_meta[str(i)] = meta
-    np.savez(os.path.join(d, "arrays.npz"),
-             **{k: v for k, v in arrays.items() if v.dtype != object})
+    crcs = _savez(os.path.join(d, "arrays.npz"),
+                  {k: v for k, v in arrays.items() if v.dtype != object})
     _write_host_side(frame, d,
                      {k: v for k, v in arrays.items()
                       if v.dtype == object})
-    with open(os.path.join(d, "manifest.json"), "w") as f:
-        man = _dist_manifest(frame)
-        man.update({"kind": "dist", "columns": col_meta,
-                    "n_cols": len(names)})
-        json.dump(man, f, indent=2)
+    man = _dist_manifest(frame)
+    man.update({"kind": "dist", "columns": col_meta,
+                "n_cols": len(names),
+                "array_checksums": {"arrays.npz": crcs}})
+    _write_manifest(d, man)
 
 
 def _write_host_side(frame, d: str, obj_arrays: dict) -> None:
@@ -232,13 +503,14 @@ def _write_host_side(frame, d: str, obj_arrays: dict) -> None:
     planes, the key frame, and the host-column source."""
     objs = {k: v for k, v in obj_arrays.items() if v.dtype == object}
     if objs:
-        pd.DataFrame({k: pd.Series(v) for k, v in objs.items()}) \
-            .to_parquet(os.path.join(d, "objects.parquet"))
-    frame.layout.key_frame.to_parquet(os.path.join(d, "keys.parquet"))
+        _write_parquet(
+            pd.DataFrame({k: pd.Series(v) for k, v in objs.items()}),
+            os.path.join(d, "objects.parquet"))
+    _write_parquet(frame.layout.key_frame, os.path.join(d, "keys.parquet"))
     if frame._source_df is not None and frame.host_cols:
-        frame._source_df[
-            sorted(set(frame.host_cols.values()))
-        ].to_parquet(os.path.join(d, "host.parquet"))
+        _write_parquet(
+            frame._source_df[sorted(set(frame.host_cols.values()))],
+            os.path.join(d, "host.parquet"))
 
 
 def _read_host_gather(meta: dict, z, objs):
@@ -311,19 +583,19 @@ def _save_dist_sharded(frame, d: str) -> None:
                               else arr.shape[-1])],
             })
             local[f"{name}_b{j}"] = np.asarray(sh.data)
-    np.savez(os.path.join(d, f"shard_p{pid}.npz"), **local)
+    shard_crcs = _savez(os.path.join(d, f"shard_p{pid}.npz"), local)
     with open(os.path.join(d, f"blocks_p{pid}.json"), "w") as f:
-        json.dump(blocks, f)
+        json.dump({"blocks": blocks, "checksums": shard_crcs}, f)
 
     if pid == 0:
-        np.savez(
-            os.path.join(d, "host_arrays.npz"),
+        host_arrays = dict(
             layout_ts_ns=frame.layout.ts_ns,
             layout_starts=frame.layout.starts,
             layout_key_ids=frame.layout.key_ids,
             layout_order=frame.layout.order,
             **{k: v for k, v in hg_arrays.items() if v.dtype != object},
         )
+        host_crcs = _savez(os.path.join(d, "host_arrays.npz"), host_arrays)
         _write_host_side(frame, d, hg_arrays)
         man = _dist_manifest(frame)
         man.update({
@@ -333,9 +605,9 @@ def _save_dist_sharded(frame, d: str) -> None:
             "n_processes": jax.process_count(),
             "shape": [int(s) for s in frame.ts.shape],
             "has_seq": frame.seq is not None,
+            "array_checksums": {"host_arrays.npz": host_crcs},
         })
-        with open(os.path.join(d, "manifest.json"), "w") as f:
-            json.dump(man, f, indent=2)
+        _write_manifest(d, man)
 
 
 def _assemble_plane(all_blocks, name: str, shape, lo: int,
@@ -359,22 +631,36 @@ def _assemble_plane(all_blocks, name: str, shape, lo: int,
     return out
 
 
-def _load_dist_sharded(d: str, man: dict, mesh, series_axis: str,
-                       time_axis: Optional[str]):
-    import glob as _glob
+def _read_blocks(bp: str) -> dict:
+    """Blocks sidecar in v2 form ({"blocks": ..., "checksums": ...});
+    v1 files were a bare list with no checksums."""
+    try:
+        with open(bp) as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointError(
+            f"checkpoint shard index {bp!r} is corrupt: {e}"
+        ) from e
+    if isinstance(doc, list):
+        return {"blocks": doc, "checksums": None}
+    return doc
 
+
+def _load_dist_sharded(d: str, man: dict, mesh, series_axis: str,
+                       time_axis: Optional[str], verify: bool = True):
     from jax.sharding import NamedSharding
 
     from tempo_tpu import packing
     from tempo_tpu.dist import DistCol, DistributedTSDF, _spec
     from tempo_tpu.parallel import multihost as mh
 
-    z = np.load(os.path.join(d, "host_arrays.npz"), allow_pickle=False)
+    z = _load_npz(os.path.join(d, "host_arrays.npz"),
+                  _npz_checksums(man, "host_arrays.npz"), verify=verify)
     obj_path = os.path.join(d, "objects.parquet")
-    objs = pd.read_parquet(obj_path) if os.path.exists(obj_path) else None
-    key_frame = pd.read_parquet(os.path.join(d, "keys.parquet"))
+    objs = _read_parquet(obj_path) if os.path.exists(obj_path) else None
+    key_frame = _read_parquet(os.path.join(d, "keys.parquet"))
     host_path = os.path.join(d, "host.parquet")
-    source_df = pd.read_parquet(host_path) if os.path.exists(host_path) \
+    source_df = _read_parquet(host_path) if os.path.exists(host_path) \
         else None
     layout = packing.FlatLayout(
         key_ids=z["layout_key_ids"], ts_ns=z["layout_ts_ns"],
@@ -384,12 +670,13 @@ def _load_dist_sharded(d: str, man: dict, mesh, series_axis: str,
 
     all_blocks = {}
     shard_files = {}
-    for bp in sorted(_glob.glob(os.path.join(d, "blocks_p*.json"))):
+    for bp in sorted(glob.glob(os.path.join(d, "blocks_p*.json"))):
         pid = int(os.path.basename(bp)[len("blocks_p"):-len(".json")])
-        with open(bp) as f:
-            all_blocks[pid] = json.load(f)
-        shard_files[pid] = np.load(
-            os.path.join(d, f"shard_p{pid}.npz"), allow_pickle=False
+        doc = _read_blocks(bp)
+        all_blocks[pid] = doc["blocks"]
+        shard_files[pid] = _load_npz(
+            os.path.join(d, f"shard_p{pid}.npz"),
+            doc.get("checksums"), verify=verify,
         )
     if len(all_blocks) != man["n_processes"]:
         raise ValueError(
@@ -466,18 +753,19 @@ def _plane_dtype(all_blocks, shard_files, name):
 
 
 def _load_dist(d: str, man: dict, mesh, series_axis: str,
-               time_axis: Optional[str]):
+               time_axis: Optional[str], verify: bool = True):
     from jax.sharding import NamedSharding
 
     from tempo_tpu import packing
     from tempo_tpu.dist import DistCol, DistributedTSDF, _pad_k, _spec
 
-    z = np.load(os.path.join(d, "arrays.npz"), allow_pickle=False)
+    z = _load_npz(os.path.join(d, "arrays.npz"),
+                  _npz_checksums(man, "arrays.npz"), verify=verify)
     obj_path = os.path.join(d, "objects.parquet")
-    objs = pd.read_parquet(obj_path) if os.path.exists(obj_path) else None
-    key_frame = pd.read_parquet(os.path.join(d, "keys.parquet"))
+    objs = _read_parquet(obj_path) if os.path.exists(obj_path) else None
+    key_frame = _read_parquet(os.path.join(d, "keys.parquet"))
     host_path = os.path.join(d, "host.parquet")
-    source_df = pd.read_parquet(host_path) if os.path.exists(host_path) \
+    source_df = _read_parquet(host_path) if os.path.exists(host_path) \
         else None
 
     layout = packing.FlatLayout(
@@ -524,7 +812,7 @@ def _load_dist(d: str, man: dict, mesh, series_axis: str,
     # normalise to the -inf encoding so restored frames join like fresh
     # ones (idempotent: current-format planes carry no NaN).
     seq_d = (put2(np.where(np.isnan(z["seq"]), -np.inf, z["seq"]), np.inf)
-             if "seq" in z.files else None)
+             if "seq" in z else None)
     return DistributedTSDF(
         mesh, series_axis, time_axis, ts_d, mask_d, cols, layout,
         man["ts_col"], man["partition_cols"], np.dtype(man["ts_dtype"]),

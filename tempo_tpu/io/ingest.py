@@ -41,7 +41,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from tempo_tpu import packing
+from tempo_tpu import packing, resilience
 
 logger = logging.getLogger(__name__)
 
@@ -50,6 +50,25 @@ def _dataset(path: str):
     import pyarrow.dataset as pads
 
     return pads.dataset(path, partitioning="hive")
+
+
+def _validate_dataset(ds, path: str, ts_col: str,
+                      partition_cols: List[str]) -> None:
+    """Fail fast, naming the offending column, instead of surfacing a
+    downstream shape/KeyError after two streaming passes."""
+    names = set(ds.schema.names)
+    missing = [c for c in [ts_col, *partition_cols] if c not in names]
+    if missing:
+        raise ValueError(
+            f"from_parquet: dataset at {path!r} has no column(s) "
+            f"{', '.join(repr(c) for c in missing)}; schema columns are "
+            f"{sorted(names)}"
+        )
+    if ds.count_rows() == 0:
+        raise ValueError(
+            f"from_parquet: dataset at {path!r} is empty (0 rows) — "
+            "nothing to pack"
+        )
 
 
 def _census(ds, ts_col: str, partition_cols: List[str], batch_rows: int):
@@ -110,9 +129,16 @@ def from_parquet(
     batch_rows: int = 1 << 18,
     budget_bytes: Optional[int] = None,
     halo_fraction: float = 0.5,
+    retry_policy: Optional["resilience.RetryPolicy"] = None,
 ):
     """Stream a Parquet dataset into a :class:`DistributedTSDF` with
-    bounded host memory (see module docstring)."""
+    bounded host memory (see module docstring).
+
+    Both streaming passes are read-only, so transient IO faults (flaky
+    network filesystems, connection resets) are retried at pass
+    granularity under ``retry_policy`` (default
+    :data:`tempo_tpu.resilience.DEFAULT_IO_POLICY`); budget violations
+    and schema errors are permanent and surface immediately."""
     from tempo_tpu.dist import DistCol, DistributedTSDF
     from tempo_tpu.parallel.mesh import make_mesh
 
@@ -121,8 +147,11 @@ def from_parquet(
     n_s = mesh.shape[series_axis]
     n_t = mesh.shape[time_axis] if time_axis else 1
 
-    ds = _dataset(path)
-    key_frame, lengths = _census(ds, ts_col, pcols, batch_rows)
+    retry = resilience.retrying(
+        retry_policy or resilience.DEFAULT_IO_POLICY, label="parquet-ingest")
+    ds = retry(_dataset)(path)
+    _validate_dataset(ds, path, ts_col, pcols)
+    key_frame, lengths = retry(_census)(ds, ts_col, pcols, batch_rows)
     K = len(lengths)
     k_mult = n_s * n_t
     K_dev = max(1, -(-K // k_mult)) * k_mult
@@ -184,33 +213,10 @@ def from_parquet(
         if pcols:
             vals = shard_keys[pcols[0]].unique().tolist()
             filt = pc.field(pcols[0]).isin(vals)
-        parts = []
-        held = 0
-        for batch in ds.to_batches(columns=read_cols, batch_size=batch_rows,
-                                   filter=filt):
-            if batch.num_rows == 0:
-                continue
-            dfb = batch.to_pandas()
-            if pcols and k1 > k0:
-                # exact membership for compound keys
-                marked = dfb.merge(
-                    shard_keys.assign(__in__=True), on=pcols, how="left"
-                )
-                dfb = dfb[marked["__in__"].fillna(False).to_numpy(bool)]
-            if len(dfb) == 0:
-                continue
-            held += int(dfb.memory_usage(deep=False).sum())
-            if budget_bytes is not None and held > budget_bytes:
-                raise MemoryError(
-                    f"series shard {si} exceeded the host ingest budget "
-                    f"({held} > {budget_bytes} bytes)"
-                )
-            parts.append(dfb)
-        shard_df = (
-            pd.concat(parts, ignore_index=True)
-            if parts else pd.DataFrame(columns=read_cols)
+        shard_df = retry(_stream_shard)(
+            ds, read_cols, batch_rows, filt, shard_keys, pcols,
+            budget_bytes, si,
         )
-        del parts
 
         # local layout for this shard's keys (ids relative to k0)
         if pcols and len(shard_df):
@@ -281,6 +287,40 @@ def from_parquet(
 
     dist_mod._PACK_EVENTS += 1
     return frame
+
+
+def _stream_shard(ds, read_cols: List[str], batch_rows: int, filt,
+                  shard_keys, pcols: List[str],
+                  budget_bytes: Optional[int], si: int) -> pd.DataFrame:
+    """Pass 2 unit of work: stream one series shard's row batches into
+    a host frame.  Pure read (local ``parts`` rebuilt on every call),
+    so the caller can retry it wholesale on transient IO faults."""
+    parts = []
+    held = 0
+    for batch in ds.to_batches(columns=read_cols, batch_size=batch_rows,
+                               filter=filt):
+        if batch.num_rows == 0:
+            continue
+        dfb = batch.to_pandas()
+        if pcols:
+            # exact membership for compound keys
+            marked = dfb.merge(
+                shard_keys.assign(__in__=True), on=pcols, how="left"
+            )
+            dfb = dfb[marked["__in__"].fillna(False).to_numpy(bool)]
+        if len(dfb) == 0:
+            continue
+        held += int(dfb.memory_usage(deep=False).sum())
+        if budget_bytes is not None and held > budget_bytes:
+            raise MemoryError(
+                f"series shard {si} exceeded the host ingest budget "
+                f"({held} > {budget_bytes} bytes)"
+            )
+        parts.append(dfb)
+    return (
+        pd.concat(parts, ignore_index=True)
+        if parts else pd.DataFrame(columns=read_cols)
+    )
 
 
 def _scatter_shard(sink: List, host_block: np.ndarray, dev_row, Lt: int):

@@ -31,10 +31,100 @@ from typing import List, Optional
 import numpy as np
 import pandas as pd
 
-from tempo_tpu import packing, profiling
+from tempo_tpu import packing, profiling, resilience
 from tempo_tpu.ops import asof as asof_ops
 
 logger = logging.getLogger(__name__)
+
+
+def _estimate_merged_lanes(l_codes: np.ndarray, r_codes: np.ndarray,
+                           n_series: int) -> int:
+    """Padded merged-lane count the AS-OF kernels would materialise for
+    the dense layout — the quantity whose measured ceiling (~205K lanes,
+    BASELINE.md r3) OOM-kills the XLA compiler.  Host-side and O(n):
+    runs before any packing so oversize joins can be rerouted."""
+    max_l = int(np.bincount(l_codes, minlength=max(n_series, 1)).max(initial=0))
+    max_r = int(np.bincount(r_codes, minlength=max(n_series, 1)).max(initial=0))
+    return packing.pad_length(max_l) + packing.pad_length(max_r)
+
+
+def _auto_bracket(l_codes, l_ts_ns, r_codes, r_ts_ns, r_seq_vals,
+                  n_series, est_lanes, limit, valid_masks):
+    """Exact host time-bracketing for oversize joins.
+
+    Splits every series into (key, time-bracket) joint series — the
+    same composition the explicit ``tsPartitionVal`` skew machinery
+    uses — but instead of replicating a trailing *fraction* of each
+    bracket (lossy beyond the lookback), it carries into each bracket
+    the per-column last-non-null right row and the last right row
+    overall from before the bracket's start.  Each joint series is then
+    self-contained, so the bracketed join is **bit-identical** to the
+    unbracketed one: the VERDICT "cannot execute at all" regime becomes
+    slow-but-correct.
+
+    ``valid_masks`` is ``[C, n_right]`` bool (per right column
+    non-null), empty first axis for ``skipNulls=False`` where only the
+    last-row channel is consumed.
+
+    Returns ``(l_brackets, r_take, r_bracket_all, n_brackets, width_ns)``
+    or ``None`` when the data cannot be split (zero time span)."""
+    lo = min(int(l_ts_ns.min()), int(r_ts_ns.min()))
+    hi = max(int(l_ts_ns.max()), int(r_ts_ns.max()))
+    span = hi - lo + 1
+    # enough brackets that a bracket's share of the dominant series sits
+    # well under the limit (assuming rough uniformity in time; heavy
+    # temporal skew degrades the bound, never correctness)
+    n_brackets = int(-(-2 * est_lanes // max(limit, 1)))
+    n_brackets = max(2, min(n_brackets, 1 << 16))
+    width_ns = max(1, -(-span // n_brackets))
+    if span <= 1:
+        return None
+
+    l_b = (l_ts_ns - lo) // width_ns
+    r_b = (r_ts_ns - lo) // width_ns
+
+    # right side in layout order (series-major, ts/seq-sorted) so
+    # "last row before a boundary" is a searchsorted + prefix scan
+    r_layout0 = packing.build_layout_from_codes(
+        r_codes, r_ts_ns, r_seq_vals, n_series)
+    rs_ts = r_layout0.ts_ns
+    starts = r_layout0.starts
+    n_r = len(r_codes)
+    idx = np.arange(n_r, dtype=np.int64)
+    last_valid = [
+        np.maximum.accumulate(np.where(valid_masks[c][r_layout0.order],
+                                       idx, -1))
+        for c in range(valid_masks.shape[0])
+    ] if n_r else []
+
+    pairs = np.unique(
+        np.stack([l_codes, l_b], axis=1), axis=0) if len(l_codes) else \
+        np.zeros((0, 2), np.int64)
+    carry_rows: List[int] = []
+    carry_brackets: List[int] = []
+    for k, b in pairs:
+        s0, s1 = int(starts[k]), int(starts[k + 1])
+        if s1 <= s0:
+            continue
+        boundary = lo + int(b) * width_ns
+        p = s0 + int(np.searchsorted(rs_ts[s0:s1], boundary, side="left"))
+        if p <= s0:
+            continue
+        carry = {p - 1}
+        for lv in last_valid:
+            j = int(lv[p - 1])
+            if j >= s0:
+                carry.add(j)
+        for j in carry:
+            carry_rows.append(j)
+            carry_brackets.append(int(b))
+
+    carried = np.asarray(carry_rows, dtype=np.int64)
+    r_take = np.concatenate(
+        [np.arange(n_r, dtype=np.int64), r_layout0.order[carried]])
+    r_bracket_all = np.concatenate(
+        [r_b, np.asarray(carry_brackets, dtype=np.int64)])
+    return l_b, r_take, r_bracket_all, n_brackets, width_ns
 
 
 def _prefixed(cols: List[str], prefix: Optional[str]) -> dict:
@@ -146,6 +236,22 @@ def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
     return np.asarray(last_idx), np.asarray(per_col), bp
 
 
+def _joint_bracket_codes(l_codes, r_codes_taken, l_brackets, r_brackets):
+    """Compose (key, time-bracket) joint series ids — shared by the
+    explicit ``tsPartitionVal`` skew path and the oversize auto-bracket
+    fallback so the encoding can never diverge between them.
+
+    Returns ``(l_codes_j, r_codes_j, n_series)``."""
+    all_codes = np.concatenate([l_codes, r_codes_taken])
+    all_brackets = np.concatenate([l_brackets, r_brackets])
+    joint = all_codes * np.int64(2 ** 31) + pd.factorize(all_brackets)[0]
+    joint_codes, _ = pd.factorize(joint)
+    n_series = int(joint_codes.max()) + 1
+    nl = len(l_brackets)
+    return (joint_codes[:nl].astype(np.int64),
+            joint_codes[nl:].astype(np.int64), n_series)
+
+
 def _time_brackets(ts_ns: np.ndarray, ts_partition_val: float):
     """Bracket id + remainder fraction, double-seconds math mirroring
     tsdf.py:176-180 (cast to double, truncate toward zero)."""
@@ -198,6 +304,16 @@ def asof_join(
     lmap = _prefixed(left_value_cols, left_prefix)
     rmap = _prefixed(right_value_cols, right_prefix)
 
+    _valid_cache: dict = {}
+
+    def _right_valid(c: str) -> np.ndarray:
+        """Right column non-null mask in original row order, computed
+        once per column (shared by the oversize-bracket carries and the
+        packed validity planes)."""
+        if c not in _valid_cache:
+            _valid_cache[c] = (~pd.isna(right.df[c])).to_numpy()
+        return _valid_cache[c]
+
     # --- joint key encoding over the union of both sides' keys ---------
     l_codes, r_codes, key_frame = packing.encode_keys_joint(left.df, right.df, pcols)
     l_ts_ns = packing.series_to_ns(left.df[left.ts_col])
@@ -233,13 +349,8 @@ def asof_join(
             [r_bracket, r_bracket[spill] + tsPartitionVal]
         )
         # re-encode keys as (key, bracket)
-        all_brackets = np.concatenate([l_bracket, r_bracket])
-        all_codes = np.concatenate([l_codes, r_codes[r_take]])
-        joint = all_codes * np.int64(2**31) + pd.factorize(all_brackets)[0]
-        joint_codes, _ = pd.factorize(joint)
-        n_series = int(joint_codes.max()) + 1
-        l_codes_j = joint_codes[: len(l_bracket)].astype(np.int64)
-        r_codes_j = joint_codes[len(l_bracket):].astype(np.int64)
+        l_codes_j, r_codes_j, n_series = _joint_bracket_codes(
+            l_codes, r_codes[r_take], l_bracket, r_bracket)
         r_ts_j = r_ts_ns[r_take]
         r_seq_j = r_seq_vals[r_take] if r_seq_vals is not None else None
     else:
@@ -247,6 +358,54 @@ def asof_join(
         l_codes_j, r_codes_j = l_codes, r_codes
         r_ts_j = r_ts_ns
         r_seq_j = r_seq_vals
+
+    # --- graceful degradation: oversize joins bracket instead of OOM --
+    # Past the merge-plan limit the XLA sort ladder OOM-kills the
+    # compiler (VERDICT missing #1) — reroute to (key, time-bracket)
+    # joint series with exact cross-bracket carries before any device
+    # program sees the full width.
+    auto_bracketed = False
+    if tsPartitionVal is None and not broadcast_path \
+            and len(left.df) and len(right.df):
+        limit = resilience.max_merged_lanes()
+        est = _estimate_merged_lanes(l_codes, r_codes, n_series)
+        if 0 < limit < est:
+            if maxLookback and int(maxLookback) > 0:
+                logger.warning(
+                    "asofJoin: estimated %d merged lanes exceeds the "
+                    "merge-plan limit %d, but maxLookback counts rows of "
+                    "the full merged stream and cannot ride the "
+                    "bracketing fallback — attempting the full-size "
+                    "merge (may exhaust compiler memory)", est, limit,
+                )
+            else:
+                carry_cols = right_value_cols if skipNulls else []
+                masks = np.stack([
+                    _right_valid(c) for c in carry_cols
+                ]) if carry_cols else np.zeros((0, len(right.df)), bool)
+                plan = _auto_bracket(
+                    l_codes, l_ts_ns, r_codes, r_ts_ns, r_seq_vals,
+                    n_series, est, limit, masks,
+                )
+                if plan is not None:
+                    l_b, r_take, r_bracket_all, n_brackets, width_ns = plan
+                    l_codes_j, r_codes_j, n_series = _joint_bracket_codes(
+                        l_codes, r_codes[r_take], l_b, r_bracket_all)
+                    r_ts_j = r_ts_ns[r_take]
+                    r_seq_j = (r_seq_vals[r_take]
+                               if r_seq_vals is not None else None)
+                    auto_bracketed = True
+                    logger.warning(
+                        "asofJoin: estimated %d merged lanes exceeds the "
+                        "merge-plan limit %d; degrading to the host "
+                        "time-bracketing path (%d brackets, width %.0fs, "
+                        "%d carried rows). Results are exact but "
+                        "execution is slower — deferred audit: oversize "
+                        "AS-OF join rerouted instead of compiler OOM.",
+                        est, limit, n_brackets,
+                        width_ns / packing.NS_PER_S,
+                        len(r_take) - len(right.df),
+                    )
 
     l_layout = packing.build_layout_from_codes(l_codes_j, l_ts_ns, None, n_series)
     r_layout = packing.build_layout_from_codes(r_codes_j, r_ts_j, r_seq_j, n_series)
@@ -292,7 +451,7 @@ def asof_join(
         # validity masks per right column (order: right_value_cols)
         r_valid_packed = []
         for c in right_value_cols:
-            valid = (~pd.isna(right.df[c])).to_numpy()[r_sorted_take]
+            valid = _right_valid(c)[r_sorted_take]
             r_valid_packed.append(
                 packing.pack_column(valid, r_layout, Lr, fill=False)
             )
@@ -391,7 +550,7 @@ def asof_join(
         # order — keep_mask_packed is indexed by (k_ids, pos)
         keep = keep_mask_packed[k_ids, pos]
         res = res[keep].reset_index(drop=True)
-    if tsPartitionVal is not None:
+    if tsPartitionVal is not None or auto_bracketed:
         # the joint (key, bracket) layout emits rows in bracket order;
         # restore the same (key, ts) order the non-skew path produces so
         # the two strategies are interchangeable row-for-row

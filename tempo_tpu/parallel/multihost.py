@@ -22,37 +22,118 @@ test suite.
 
 from __future__ import annotations
 
+import inspect
+import logging
+import threading
 from typing import Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tempo_tpu.resilience import FailureKind, classify
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedInitTimeout(TimeoutError):
+    """``distributed_init`` gave up waiting for the coordinator — the
+    diagnostic alternative to hanging the process forever."""
+
+    failure_kind = FailureKind.DEADLINE
+
+
+def _watchdog_call(fn, kwargs: dict, timeout_s: float):
+    """Run ``fn(**kwargs)`` in a daemon thread with a join timeout: a
+    hung initializer (unreachable coordinator on a jax without native
+    ``initialization_timeout``) surfaces as ``TimeoutError`` instead of
+    blocking the process.  The stuck thread cannot be killed and leaks,
+    but the caller gets a diagnostic and keeps control."""
+    result: dict = {}
+
+    def target():
+        try:
+            result["value"] = fn(**kwargs)
+        except BaseException as e:
+            result["exc"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name="tempo-distributed-init")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise TimeoutError(f"initializer still blocked after {timeout_s}s")
+    if "exc" in result:
+        raise result["exc"]
+    return result.get("value")
+
 
 def distributed_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    timeout_s: Optional[float] = 300.0,
 ) -> None:
     """Initialise JAX's multi-process runtime (idempotent, no-op when
     single-process).  The moral analog of standing up the Spark cluster
     (scala/.../utils/SparkSessionWrapper.scala:12-37 chooses local vs
-    cluster master); here the coordinator bootstraps over DCN."""
+    cluster master); here the coordinator bootstraps over DCN.
+
+    ``timeout_s`` bounds the wait for the coordinator (default 300s;
+    ``None``/0 restores the old block-forever behaviour).  On expiry a
+    :class:`DistributedInitTimeout` names the coordinator address and
+    process coordinates instead of hanging the job silently — the
+    failure-detection half of the resilience story for the one call
+    that previously could block forever.  The bound is plumbed through
+    jax's native ``initialization_timeout`` when this jax version has
+    it, and enforced by a watchdog thread otherwise."""
     if num_processes is None or num_processes <= 1:
         return
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None and is_init():
         return
+    init = jax.distributed.initialize
+    kwargs = dict(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
     try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
+        native_timeout = (
+            "initialization_timeout" in inspect.signature(init).parameters
         )
+    except (TypeError, ValueError):
+        native_timeout = False
+
+    def _diagnostic(cause: Optional[BaseException]):
+        raise DistributedInitTimeout(
+            f"distributed_init did not complete (timeout_s={timeout_s}): "
+            f"coordinator_address={coordinator_address!r}, "
+            f"num_processes={num_processes}, process_id={process_id}. "
+            "Check that the coordinator is reachable from this host and "
+            "that every process in the job was launched with the same "
+            "num_processes."
+        ) from cause
+
+    try:
+        if timeout_s and native_timeout:
+            kwargs["initialization_timeout"] = int(timeout_s)
+            init(**kwargs)
+        elif timeout_s:
+            _watchdog_call(init, kwargs, timeout_s)
+        else:
+            init(**kwargs)
+    except DistributedInitTimeout:
+        raise
+    except TimeoutError as e:
+        _diagnostic(e)
     except RuntimeError as e:
         # older jax has no is_initialized(); a double call raises here
-        if "once" not in str(e):
-            raise
+        if "once" in str(e):
+            return
+        if classify(e) is FailureKind.DEADLINE:
+            _diagnostic(e)
+        raise
 
 
 def process_mesh(axes: Optional[dict] = None) -> Mesh:

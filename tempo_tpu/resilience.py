@@ -1,0 +1,379 @@
+"""Failure detection, classification, retry/backoff, and resumable
+pipelines.
+
+The driver spec for this rebuild names "failure detection,
+checkpoint/resume" as first-class (quoted in checkpoint.py:10).  The
+save/load half lives in :mod:`tempo_tpu.checkpoint`; this module adds
+the other half — the part Spark gives the reference for free through
+task re-run recovery (SURVEY.md §5) and that a JAX-native stack must
+supply itself:
+
+* **Failure taxonomy** — :class:`FailureKind` plus :func:`classify`,
+  mapping an arbitrary exception to the recovery action it admits.  A
+  flaky NFS read (transient-io) is retryable; a checksum mismatch
+  (corrupted-artifact) is not — it needs an older checkpoint; an XLA
+  RESOURCE_EXHAUSTED (compile-oom) needs a smaller program, which the
+  join planner arranges (join.py oversize bracketing).
+* **Bounded retry** — :class:`RetryPolicy` (exponential backoff,
+  jitter, attempt cap, wall-clock deadline) and :func:`retrying`, the
+  wrapper the fallible host-side paths ride: Parquet ingest
+  (io/ingest.py), checkpoint IO (checkpoint.py), multi-host init
+  (parallel/multihost.py).
+* **Resumable pipelines** — :func:`run_resumable` chains device ops
+  with periodic checkpoints and, on restart, resumes from the newest
+  *intact* checkpoint (corrupt ones are detected by checksum and
+  skipped), recomputing only the steps after it.
+
+Fault-injection coverage for all three lives in
+:mod:`tempo_tpu.testing.faults` and the ``chaos``-marked test suite.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import errno
+import functools
+import logging
+import os
+import random
+import re
+import time
+import zipfile
+from typing import Callable, FrozenSet, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Failure taxonomy
+# ----------------------------------------------------------------------
+
+class FailureKind(enum.Enum):
+    """What an exception *means* for recovery, independent of which
+    library raised it."""
+
+    TRANSIENT_IO = "transient-io"            # retry with backoff
+    CORRUPTED_ARTIFACT = "corrupted-artifact"  # fall back to older data
+    COMPILE_OOM = "compile-oom"              # shrink the program
+    DEVICE_LOSS = "device-loss"              # re-init runtime / new mesh
+    DEADLINE = "deadline"                    # give up, surface diagnostics
+    PERMANENT = "permanent"                  # a bug or bad input: raise
+
+
+class CheckpointError(ValueError):
+    """A checkpoint could not be used: missing, corrupt (checksum or
+    container failure), or written by a newer format version.  Carries
+    the :class:`FailureKind` so retry wrappers know not to retry
+    corruption (an older checkpoint is the recovery, not a re-read)."""
+
+    def __init__(self, message: str,
+                 kind: FailureKind = FailureKind.CORRUPTED_ARTIFACT):
+        super().__init__(message)
+        self.failure_kind = kind
+
+
+class DeadlineExceeded(TimeoutError):
+    """A retry loop ran out of wall-clock budget (RetryPolicy.deadline_s)."""
+
+    failure_kind = FailureKind.DEADLINE
+
+
+# errnos that indicate a transient environment problem, not a bug
+_TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "EAGAIN", "EINTR", "EBUSY", "ETIMEDOUT", "ECONNRESET",
+        "ECONNABORTED", "ECONNREFUSED", "ENETRESET", "ENETUNREACH",
+        "EHOSTUNREACH", "EPIPE", "EIO", "ESTALE",
+    )
+    if hasattr(errno, name)
+)
+
+# message heuristics for exceptions that arrive as bare RuntimeError /
+# XlaRuntimeError strings (XLA does not export a typed hierarchy)
+_OOM_PAT = re.compile(
+    r"resource[ _]exhausted|out of memory|\boom\b|cannot allocate memory"
+    r"|allocation .* (failed|exceeds)|exceeds the limit in memory",
+    re.IGNORECASE,
+)
+_DEVICE_PAT = re.compile(
+    r"device (?:lost|halted|failure|unavailable)|DEVICE_LOST"
+    r"|data[ _]loss|chip (?:reboot|halt)|\bnccl\b|ici (?:link|failure)",
+    re.IGNORECASE,
+)
+_DEADLINE_PAT = re.compile(
+    r"deadline[ _]exceeded|timed[ _]?out|timeout", re.IGNORECASE
+)
+_TRANSIENT_PAT = re.compile(
+    r"\bunavailable\b|connection (?:reset|refused|aborted)"
+    r"|temporarily|try again|broken pipe",
+    re.IGNORECASE,
+)
+
+
+def classify(exc: BaseException) -> FailureKind:
+    """Map an exception to its :class:`FailureKind`.
+
+    Precedence: an explicit ``failure_kind`` attribute on the exception
+    wins (our own errors and injected faults self-describe); then typed
+    checks (OSError errno, TimeoutError, zip/EOF container failures);
+    then message heuristics for the string-typed XLA/runtime errors;
+    then ``PERMANENT`` — unknown failures must surface, not retry."""
+    kind = getattr(exc, "failure_kind", None)
+    if isinstance(kind, FailureKind):
+        return kind
+    # errno before the TimeoutError type check: Python surfaces
+    # OSError(ETIMEDOUT) AS TimeoutError, and a socket/NFS timeout is
+    # transient weather (retry), unlike a logical deadline (give up)
+    if isinstance(exc, OSError) and exc.errno in _TRANSIENT_ERRNOS:
+        return FailureKind.TRANSIENT_IO
+    if isinstance(exc, TimeoutError):
+        return FailureKind.DEADLINE
+    if isinstance(exc, (zipfile.BadZipFile, EOFError)):
+        return FailureKind.CORRUPTED_ARTIFACT
+    if isinstance(exc, MemoryError):
+        return FailureKind.COMPILE_OOM
+    if isinstance(exc, ConnectionError):
+        return FailureKind.TRANSIENT_IO
+    if isinstance(exc, OSError) and exc.errno == errno.ENOENT:
+        return FailureKind.PERMANENT
+    msg = str(exc)
+    if _OOM_PAT.search(msg):
+        return FailureKind.COMPILE_OOM
+    if _DEVICE_PAT.search(msg):
+        return FailureKind.DEVICE_LOSS
+    if _DEADLINE_PAT.search(msg):
+        return FailureKind.DEADLINE
+    if _TRANSIENT_PAT.search(msg):
+        return FailureKind.TRANSIENT_IO
+    return FailureKind.PERMANENT
+
+
+# ----------------------------------------------------------------------
+# Retry / backoff
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with jitter and a wall-clock deadline.
+
+    ``retry_on`` is the set of :class:`FailureKind` worth re-attempting;
+    everything else re-raises immediately (retrying a checksum mismatch
+    or a real bug only hides it).  ``deadline_s`` caps the *total* time
+    the retry loop may consume — the loop never starts a sleep that
+    would cross it."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.1
+    max_delay_s: float = 30.0
+    multiplier: float = 2.0
+    jitter: float = 0.5            # fraction of each delay randomized away
+    deadline_s: Optional[float] = None
+    retry_on: FrozenSet[FailureKind] = frozenset({FailureKind.TRANSIENT_IO})
+
+    def delay_s(self, prior_failures: int, rng: random.Random) -> float:
+        raw = min(self.max_delay_s,
+                  self.base_delay_s * self.multiplier ** prior_failures)
+        return raw * (1.0 - self.jitter * rng.random())
+
+
+#: Default policy for host-side file IO (checkpoint + Parquet ingest).
+DEFAULT_IO_POLICY = RetryPolicy(
+    max_attempts=4, base_delay_s=0.05, max_delay_s=2.0, deadline_s=60.0,
+)
+
+
+def retrying(
+    policy: Optional[RetryPolicy] = None,
+    label: Optional[str] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    rng: Optional[random.Random] = None,
+):
+    """Decorator/wrapper giving a callable bounded retry semantics.
+
+    Catches ``Exception`` only: simulated-kill faults
+    (:class:`tempo_tpu.testing.faults.SimulatedKill`) and real signals
+    derive from ``BaseException`` and always propagate.  Each retry is
+    logged at WARNING with the classified kind; exhaustion logs at
+    ERROR and re-raises the last failure (or raises
+    :class:`DeadlineExceeded` when the wall clock, not the attempt
+    count, ran out)."""
+    pol = policy or DEFAULT_IO_POLICY
+    _rng = rng or random.Random()
+
+    def deco(fn):
+        name = label or getattr(fn, "__qualname__", repr(fn))
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            start = clock()
+            failures = 0
+            while True:
+                try:
+                    return fn(*args, **kwargs)
+                except Exception as exc:
+                    kind = classify(exc)
+                    failures += 1
+                    if kind not in pol.retry_on:
+                        raise
+                    if failures >= pol.max_attempts:
+                        logger.error(
+                            "%s: giving up after %d attempt(s) (%s: %s)",
+                            name, failures, kind.value, exc,
+                        )
+                        raise
+                    delay = pol.delay_s(failures - 1, _rng)
+                    elapsed = clock() - start
+                    if pol.deadline_s is not None and \
+                            elapsed + delay > pol.deadline_s:
+                        logger.error(
+                            "%s: retry deadline %.1fs exhausted after %d "
+                            "attempt(s) (%s: %s)",
+                            name, pol.deadline_s, failures, kind.value, exc,
+                        )
+                        raise DeadlineExceeded(
+                            f"{name}: {elapsed:.1f}s elapsed of "
+                            f"{pol.deadline_s:.1f}s retry deadline "
+                            f"(last failure: {exc})"
+                        ) from exc
+                    logger.warning(
+                        "%s: attempt %d/%d failed (%s: %s); retrying in "
+                        "%.2fs", name, failures, pol.max_attempts,
+                        kind.value, exc, delay,
+                    )
+                    sleep(delay)
+
+        return wrapper
+
+    return deco
+
+
+def call_with_retry(fn, *args, policy: Optional[RetryPolicy] = None,
+                    label: Optional[str] = None, **kwargs):
+    """One-shot form of :func:`retrying` for call sites that don't want
+    a decorated helper."""
+    return retrying(policy, label=label)(fn)(*args, **kwargs)
+
+
+# ----------------------------------------------------------------------
+# Graceful degradation knobs (consumed by join.py)
+# ----------------------------------------------------------------------
+
+#: Merged-lane ceiling above which the AS-OF join degrades to the host
+#: time-bracketing path instead of handing XLA a program it cannot
+#: compile.  The measured failure: the lax.sort merge ladder OOM-killed
+#: the compiler at ~205K merged lanes (BASELINE.md r3, VERDICT.md
+#: missing #1); 192K leaves headroom below that cliff.
+DEFAULT_MAX_MERGED_LANES = 196_608
+
+
+def max_merged_lanes() -> int:
+    """Merged-lane limit for a single AS-OF merge program.  Override
+    with ``TEMPO_TPU_MAX_MERGED_LANES`` (ints only; smaller values force
+    the bracketing fallback earlier, 0/negative disables the guard)."""
+    env = os.environ.get("TEMPO_TPU_MAX_MERGED_LANES")
+    if env:
+        return int(env)
+    return DEFAULT_MAX_MERGED_LANES
+
+
+# ----------------------------------------------------------------------
+# Resumable pipelines
+# ----------------------------------------------------------------------
+
+def _apply_step(state, step):
+    """A step is a callable ``frame -> frame``, a method name, or a
+    ``(method_name, kwargs)`` tuple."""
+    if callable(step):
+        return step(state)
+    if isinstance(step, str):
+        return getattr(state, step)()
+    name = step[0]
+    kwargs = step[1] if len(step) > 1 else {}
+    return getattr(state, name)(**kwargs)
+
+
+def _step_label(step) -> str:
+    if callable(step):
+        return getattr(step, "__name__", repr(step))
+    if isinstance(step, str):
+        return step
+    return str(step[0])
+
+
+def run_resumable(
+    frame,
+    steps: Sequence,
+    ckpt_dir: str,
+    every: int = 1,
+    keep_last: int = 2,
+    sharded: bool = False,
+):
+    """Run a chain of device ops with periodic checkpoints and
+    crash-resume.
+
+    ``steps`` is a sequence of callables ``frame -> frame`` (or
+    ``(method_name, kwargs)`` tuples resolved against the frame).  After
+    every ``every``-th step — and always after the last — the
+    intermediate frame is checkpointed to ``ckpt_dir/step_NNNNN`` via
+    :func:`tempo_tpu.checkpoint.save` (atomic, checksummed), and older
+    checkpoints beyond ``keep_last`` are pruned.
+
+    On restart with the same ``ckpt_dir``, the newest *intact*
+    checkpoint is restored (corrupt or truncated ones are detected by
+    checksum, logged, and skipped in favour of the next-older one —
+    crash residue ``*.tmp`` directories are cleaned) and only the steps
+    after it re-run.  Steps must be deterministic for the resumed result
+    to be bit-identical to an uninterrupted run; all tempo-tpu device
+    ops are.
+
+    Checkpoint IO needs no extra wrapping here: every read/write
+    primitive inside :mod:`tempo_tpu.checkpoint` already retries
+    transient faults under :data:`DEFAULT_IO_POLICY` — one retry
+    altitude, not nested loops."""
+    from tempo_tpu import checkpoint
+
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    mesh = getattr(frame, "mesh", None)
+    series_axis = getattr(frame, "series_axis", "series")
+    time_axis = getattr(frame, "time_axis", None)
+
+    state, done = frame, 0
+    for step_no, path in checkpoint.list_steps(ckpt_dir):
+        if step_no > len(steps):
+            logger.warning(
+                "run_resumable: ignoring checkpoint %s beyond the %d-step "
+                "pipeline (stale ckpt_dir?)", path, len(steps),
+            )
+            continue
+        try:
+            state = checkpoint.load(path, mesh=mesh,
+                                    series_axis=series_axis,
+                                    time_axis=time_axis)
+            done = step_no
+            logger.info(
+                "run_resumable: resumed after step %d/%d from %s",
+                done, len(steps), path,
+            )
+            break
+        except CheckpointError as e:
+            logger.warning(
+                "run_resumable: checkpoint %s unusable (%s); falling back "
+                "to an older one", path, e,
+            )
+
+    for i in range(done, len(steps)):
+        state = _apply_step(state, steps[i])
+        if (i + 1) % every == 0 or i + 1 == len(steps):
+            path = os.path.join(ckpt_dir, f"step_{i + 1:05d}")
+            checkpoint.save(state, path, sharded=sharded)
+            logger.info(
+                "run_resumable: step %d/%d (%s) checkpointed to %s",
+                i + 1, len(steps), _step_label(steps[i]), path,
+            )
+            checkpoint.prune(ckpt_dir, keep_last=keep_last)
+    return state

@@ -1,0 +1,10 @@
+"""Test-support subpackage: fault injection (:mod:`tempo_tpu.testing.faults`).
+
+Shipped inside the library (not under tests/) so downstream users can
+chaos-test their own pipelines against the same harness the ``chaos``
+suite uses.
+"""
+
+from tempo_tpu.testing import faults  # noqa: F401
+
+__all__ = ["faults"]

@@ -39,8 +39,10 @@ def display_html(df) -> None:
         from IPython.display import display as ipydisplay  # type: ignore
 
         ipydisplay(HTML("<style>pre { white-space: pre !important; }</style>"))
-    except Exception:
-        pass
+    except Exception as e:
+        # cosmetic only — but never swallowed silently (bare-except ban,
+        # tools/check_no_bare_except.py)
+        logger.debug("notebook HTML styling unavailable: %s", e)
     if isinstance(df, pd.DataFrame):
         print(df.head(20).to_string(index=False))
     else:

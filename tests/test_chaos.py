@@ -1,0 +1,185 @@
+"""Chaos suite: fault-injection end-to-end for the resilience layer.
+
+The acceptance scenario from the resilience ISSUE: a 3-op
+``run_resumable`` chain killed mid-save and restarted resumes from the
+last intact checkpoint and produces *bit-identical* output to the
+fault-free run; injected transient IO faults are retried and logged; a
+corrupted checkpoint is detected, skipped, and resume falls back to the
+previous intact one."""
+
+import logging
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, checkpoint, resilience
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture
+def frame():
+    rng = np.random.default_rng(17)
+    n = 160
+    df = pd.DataFrame({
+        "sym": rng.choice(["a", "b", "c"], n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 400, n)) * 1_000_000_000),
+        "px": rng.standard_normal(n) + 10,
+        "qty": rng.integers(1, 50, n).astype(float),
+    })
+    return TSDF(df, "event_ts", ["sym"]).on_mesh(make_mesh({"series": 4}))
+
+
+STEPS = [
+    lambda f: f.EMA("px", exact=True),
+    lambda f: f.withRangeStats(colsToSummarize=["px"],
+                               rangeBackWindowSecs=60),
+    lambda f: f.EMA("qty", exact=True),
+]
+
+
+def _counted(ran):
+    """STEPS instrumented to record which step indices actually ran."""
+    def mk(i, step):
+        def wrapper(f):
+            ran.append(i + 1)
+            return step(f)
+        wrapper.__name__ = f"step{i + 1}"
+        return wrapper
+    return [mk(i, s) for i, s in enumerate(STEPS)]
+
+
+def _df(frame_out):
+    return frame_out.collect().df.sort_values(
+        ["sym", "event_ts"], kind="stable").reset_index(drop=True)
+
+
+def _assert_bit_identical(got, want):
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_kill_mid_save_then_restart_resumes_bit_identical(tmp_path, frame):
+    want = _df(resilience.run_resumable(
+        frame, STEPS, str(tmp_path / "clean"), every=1))
+
+    d = str(tmp_path / "killed")
+    ran1, ran2 = [], []
+    with faults.FaultInjector() as fi:
+        # np.savez is checkpoint.save's single arrays write per dense
+        # save: call 2 = mid-save of the step-2 checkpoint
+        fi.kill_on_call(np, "savez", call_no=2)
+        with pytest.raises(faults.SimulatedKill):
+            resilience.run_resumable(frame, _counted(ran1), d, every=1)
+    assert ran1 == [1, 2]                       # died saving step 2
+    assert checkpoint.latest(d).endswith("step_00001")
+
+    got = _df(resilience.run_resumable(frame, _counted(ran2), d, every=1))
+    assert ran2 == [2, 3]                       # step 1 restored, not re-run
+    _assert_bit_identical(got, want)
+
+
+def test_kill_leaving_partial_tmp_residue(tmp_path, frame):
+    """A kill that leaves truncated bytes in the tmp dir (no cleanup
+    ran): the residue is ignored + cleaned, the chain resumes."""
+    want = _df(resilience.run_resumable(
+        frame, STEPS, str(tmp_path / "clean"), every=1))
+    d = str(tmp_path / "killed")
+
+    def partial(path, **arrays):
+        with open(path if str(path).endswith(".npz") else str(path) + ".npz",
+                  "wb") as f:
+            f.write(b"PK\x03\x04 truncated mid-flush")
+
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(np, "savez", call_no=3, partial_write=partial)
+        with pytest.raises(faults.SimulatedKill):
+            resilience.run_resumable(frame, STEPS, d, every=1)
+    # fabricate the worst case: residue survived the dying process
+    faults.make_stale_tmp(os.path.join(d, "step_00003"))
+    got = _df(resilience.run_resumable(frame, STEPS, d, every=1))
+    assert not os.path.exists(os.path.join(d, "step_00003.tmp"))
+    _assert_bit_identical(got, want)
+
+
+@pytest.mark.parametrize("corruptor", [
+    lambda p: faults.corrupt_npz_array(p),
+    lambda p: faults.truncate_file(p, keep_fraction=0.5),
+], ids=["flip-byte", "truncate"])
+def test_corrupt_newest_checkpoint_falls_back_to_previous(
+        tmp_path, frame, caplog, corruptor):
+    want = _df(resilience.run_resumable(
+        frame, STEPS, str(tmp_path / "clean"), every=1))
+    d = str(tmp_path / "corrupt")
+    ran = []
+    resilience.run_resumable(frame, STEPS, d, every=1, keep_last=3)
+    corruptor(os.path.join(d, "step_00003", "arrays.npz"))
+
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu"):
+        got = _df(resilience.run_resumable(
+            frame, _counted(ran), d, every=1, keep_last=3))
+    assert ran == [3]      # fell back to the intact step-2 checkpoint
+    assert any("unusable" in r.message for r in caplog.records)
+    _assert_bit_identical(got, want)
+
+
+def test_corruption_detected_not_silently_restored(tmp_path, frame):
+    """Corrupting EVERY checkpoint forces a full recompute — never a
+    silent restore of bad data."""
+    want = _df(resilience.run_resumable(
+        frame, STEPS, str(tmp_path / "clean"), every=1))
+    d = str(tmp_path / "all_bad")
+    ran = []
+    resilience.run_resumable(frame, STEPS, d, every=1, keep_last=3)
+    for step in ("step_00001", "step_00002", "step_00003"):
+        faults.corrupt_npz_array(os.path.join(d, step, "arrays.npz"))
+    got = _df(resilience.run_resumable(frame, _counted(ran), d, every=1))
+    assert ran == [1, 2, 3]
+    _assert_bit_identical(got, want)
+
+
+def test_transient_read_faults_retried_and_logged(tmp_path, frame, caplog):
+    """2 failures then success on the parquet read path: the load
+    succeeds through the retry policy and each retry is logged."""
+    lt = TSDF(frame._source_df, "event_ts", ["sym"])
+    p = str(tmp_path / "host_ckpt")
+    checkpoint.save(lt, p)
+    with faults.FaultInjector() as fi:
+        fi.flaky(pd, "read_parquet", failures=2)
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.resilience"):
+            back = checkpoint.load(p)
+    pd.testing.assert_frame_equal(back.df, lt.df)
+    retries = [r for r in caplog.records if "retrying in" in r.message]
+    assert len(retries) == 2
+    assert [r.action for r in fi.records] == ["raise", "raise", "pass"]
+
+
+def test_transient_save_faults_retried(tmp_path, frame, caplog):
+    d = str(tmp_path / "flaky_save")
+    with faults.FaultInjector() as fi:
+        fi.flaky(np, "savez", failures=2)
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.resilience"):
+            out = resilience.run_resumable(frame, STEPS[:1], d, every=1)
+    assert checkpoint.latest(d) is not None
+    assert len([r for r in caplog.records if "retrying in" in r.message]) == 2
+    assert "EMA_px" in out.collect().df.columns
+
+
+def test_every_n_checkpoints_between_chained_ops(tmp_path, frame):
+    d = str(tmp_path / "every2")
+    resilience.run_resumable(frame, STEPS, d, every=2, keep_last=5)
+    steps = [s for s, _ in checkpoint.list_steps(d)]
+    # step 2 (every=2) and step 3 (always checkpoint the final state)
+    assert sorted(steps) == [2, 3]
+
+
+def test_keep_last_retention_prunes_oldest(tmp_path, frame):
+    d = str(tmp_path / "retention")
+    resilience.run_resumable(frame, STEPS, d, every=1, keep_last=2)
+    steps = [s for s, _ in checkpoint.list_steps(d)]
+    assert sorted(steps) == [2, 3]
+    assert checkpoint.latest(d).endswith("step_00003")

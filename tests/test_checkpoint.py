@@ -131,6 +131,179 @@ def test_future_format_version_refused(tmp_path, frames):
         checkpoint.load(p)
 
 
+def test_version_mismatch_names_path_and_versions(tmp_path, frames):
+    """CheckpointError (not raw KeyError) naming the path and the
+    found/expected FORMAT_VERSION."""
+    import json
+    import os
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_ver2")
+    checkpoint.save(lt, p)
+    man = json.load(open(os.path.join(p, "manifest.json")))
+    man["format_version"] = 99
+    json.dump(man, open(os.path.join(p, "manifest.json"), "w"))
+    with pytest.raises(checkpoint.CheckpointError) as ei:
+        checkpoint.load(p)
+    msg = str(ei.value)
+    assert "ckpt_ver2" in msg
+    assert "99" in msg
+    assert str(checkpoint.FORMAT_VERSION) in msg
+
+
+def test_load_nonexistent_names_path(tmp_path):
+    """CheckpointError naming the path, not a raw FileNotFoundError."""
+    missing = str(tmp_path / "never_saved")
+    with pytest.raises(checkpoint.CheckpointError, match="never_saved"):
+        checkpoint.load(missing)
+
+
+def test_manifest_missing_fields_is_checkpoint_error(tmp_path):
+    import json
+    import os
+
+    p = str(tmp_path / "foreign")
+    os.makedirs(p)
+    json.dump({"whatever": 1}, open(os.path.join(p, "manifest.json"), "w"))
+    with pytest.raises(checkpoint.CheckpointError, match="format_version"):
+        checkpoint.load(p)
+
+
+def test_manifest_malformed_version_is_checkpoint_error(tmp_path):
+    """A string format_version (foreign/corrupt manifest) must raise
+    CheckpointError — not a TypeError that escapes latest()'s and
+    run_resumable's corrupt-checkpoint fallback."""
+    import json
+    import os
+
+    p = str(tmp_path / "step_00001")
+    os.makedirs(p)
+    json.dump({"format_version": "2", "kind": "host"},
+              open(os.path.join(p, "manifest.json"), "w"))
+    with pytest.raises(checkpoint.CheckpointError, match="format_version"):
+        checkpoint.load(p)
+    # latest() must SKIP the malformed candidate, not crash on it
+    assert checkpoint.latest(str(tmp_path)) is None
+
+
+def test_flipped_byte_reports_checksum_and_names_array(tmp_path, frames):
+    """Satellite: flip one byte in arrays.npz — load must report the
+    mismatch and name the bad array, never restore silently."""
+    import os
+
+    from tempo_tpu.testing import faults
+
+    lt, _ = frames
+    mesh = make_mesh({"series": 4})
+    p = str(tmp_path / "ckpt_flip")
+    checkpoint.save(lt.on_mesh(mesh), p)
+    bad = faults.corrupt_npz_array(os.path.join(p, "arrays.npz"))
+    with pytest.raises(checkpoint.CheckpointError) as ei:
+        checkpoint.load(p, mesh=mesh)
+    msg = str(ei.value)
+    assert bad in msg
+    assert "checksum mismatch" in msg or "unreadable" in msg
+
+
+def test_corrupt_host_parquet_detected_by_file_crc(tmp_path, frames):
+    import os
+
+    from tempo_tpu.testing import faults
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_pq")
+    checkpoint.save(lt, p)
+    fp = os.path.join(p, "host.parquet")
+    faults.flip_byte(fp, os.path.getsize(fp) // 2)
+    with pytest.raises(checkpoint.CheckpointError, match="host.parquet"):
+        checkpoint.load(p)
+
+
+def test_stale_tmp_residue_ignored_and_cleaned(tmp_path, frames):
+    """Satellite: a stale <dir>.tmp from a killed save must not shadow
+    or break the intact checkpoint, and gets cleaned on load."""
+    import os
+
+    from tempo_tpu.testing import faults
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_stale")
+    checkpoint.save(lt, p)
+    tmp = faults.make_stale_tmp(p)
+    back = checkpoint.load(p)
+    pd.testing.assert_frame_equal(back.df, lt.df)
+    assert not os.path.exists(tmp)
+
+
+def test_sharded_shard_corruption_detected(tmp_path, frames):
+    import os
+
+    from tempo_tpu.testing import faults
+
+    lt, _ = frames
+    mesh = make_mesh({"series": 4})
+    p = str(tmp_path / "ckpt_shard_bad")
+    checkpoint.save(lt.on_mesh(mesh), p, sharded=True)
+    bad = faults.corrupt_npz_array(os.path.join(p, "shard_p0.npz"))
+    with pytest.raises(checkpoint.CheckpointError) as ei:
+        checkpoint.load(p, mesh=mesh)
+    assert bad in str(ei.value)
+
+
+def test_complete_tmp_from_postwrite_kill_is_preserved(tmp_path, frames):
+    """A <dir>.tmp WITH a manifest is a fully-written checkpoint whose
+    save died before the final rename — a read must never delete it
+    (it may be the only copy of the newest state)."""
+    import os
+    import shutil
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_main")
+    checkpoint.save(lt, p)
+    donor = str(tmp_path / "ckpt_donor")
+    checkpoint.save(lt, donor)
+    shutil.copytree(donor, p + ".tmp")   # complete tmp, manifest included
+    back = checkpoint.load(p)
+    pd.testing.assert_frame_equal(back.df, lt.df)
+    assert os.path.exists(os.path.join(p + ".tmp", "manifest.json"))
+
+
+def test_v1_checkpoint_without_checksums_still_loads(tmp_path, frames):
+    """Format bump to v2 (checksums) must not orphan v1 checkpoints:
+    absent checksum fields mean 'nothing to verify', not corruption."""
+    import json
+    import os
+
+    lt, _ = frames
+    p = str(tmp_path / "ckpt_v1")
+    checkpoint.save(lt, p)
+    man = json.load(open(os.path.join(p, "manifest.json")))
+    man["format_version"] = 1
+    for key in ("file_checksums", "array_checksums", "checksum_algo"):
+        man.pop(key, None)
+    json.dump(man, open(os.path.join(p, "manifest.json"), "w"))
+    back = checkpoint.load(p)
+    pd.testing.assert_frame_equal(back.df, lt.df)
+
+
+def test_latest_skips_corrupt_and_prune_keeps_k(tmp_path, frames):
+    import os
+
+    from tempo_tpu.testing import faults
+
+    lt, _ = frames
+    parent = str(tmp_path / "fam")
+    os.makedirs(parent)
+    for i in (1, 2, 3):
+        checkpoint.save(lt, os.path.join(parent, f"step_{i:05d}"))
+    assert checkpoint.latest(parent).endswith("step_00003")
+    fp = os.path.join(parent, "step_00003", "host.parquet")
+    faults.flip_byte(fp, os.path.getsize(fp) // 2)
+    assert checkpoint.latest(parent).endswith("step_00002")
+    checkpoint.prune(parent, keep_last=1)
+    assert [s for s, _ in checkpoint.list_steps(parent)] == [3]
+
+
 def test_dist_load_requires_mesh(tmp_path, frames):
     lt, _ = frames
     mesh = make_mesh({"series": 4})
@@ -221,7 +394,10 @@ def test_sharded_save_covers_every_slot(tmp_path, frames):
     import json
     import os
     with open(os.path.join(p, "blocks_p0.json")) as f:
-        blocks = json.load(f)
+        doc = json.load(f)
+    blocks = doc["blocks"]
+    # v2 sidecar carries a per-block checksum for every saved plane
+    assert set(doc["checksums"]) == {b["key"] for b in blocks}
     K, L = d.ts.shape
     cover = np.zeros((K, L), np.int32)
     for b in blocks:

@@ -1,0 +1,147 @@
+"""Graceful degradation of oversize AS-OF joins (join.py + resilience.py).
+
+VERDICT missing #1: past the merge plan the XLA sort ladder OOM-killed
+the compiler at ~205K merged lanes — a regime that could not execute at
+all.  The resilience layer pre-estimates the merged-lane count and
+reroutes oversize joins through the host time-bracketing path with
+exact cross-bracket carries; these tests pin (a) that the reroute
+engages above the configured limit with a warning, and (b) that its
+output is bit-identical to the unbracketed join in every supported
+flag combination.  The limit is exercised at a test-sized value via
+``TEMPO_TPU_MAX_MERGED_LANES``; the default's relationship to the
+measured threshold is pinned in test_resilience.py."""
+
+import logging
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, join
+
+
+def _frames(seed=5, n=700, m=800, span=40_000, nan_frac=0.35):
+    rng = np.random.default_rng(seed)
+    lt = TSDF(pd.DataFrame({
+        "sym": rng.choice(["a", "b"], n),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, span, n)) * 1_000_000_000),
+        "px": rng.standard_normal(n),
+    }), "event_ts", ["sym"])
+    rt = TSDF(pd.DataFrame({
+        "sym": rng.choice(["a", "b"], m),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, span, m)) * 1_000_000_000),
+        "bid": np.where(rng.random(m) > nan_frac,
+                        rng.standard_normal(m), np.nan),
+        "ask": np.where(rng.random(m) > 0.6,
+                        rng.standard_normal(m), np.nan),
+    }), "event_ts", ["sym"])
+    return lt, rt
+
+
+def _degraded(monkeypatch, limit=256):
+    monkeypatch.setenv("TEMPO_TPU_MAX_MERGED_LANES", str(limit))
+
+
+def _full(monkeypatch):
+    monkeypatch.delenv("TEMPO_TPU_MAX_MERGED_LANES", raising=False)
+
+
+def test_oversize_join_brackets_with_warning_and_is_bit_identical(
+        monkeypatch, caplog):
+    lt, rt = _frames()
+    _full(monkeypatch)
+    want = lt.asofJoin(rt).df
+    _degraded(monkeypatch)
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.join"):
+        got = lt.asofJoin(rt).df
+    assert any("bracket" in r.message for r in caplog.records)
+    assert any("deferred audit" in r.message for r in caplog.records)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_oversize_skipnulls_false_bit_identical(monkeypatch):
+    lt, rt = _frames(seed=6)
+    _full(monkeypatch)
+    want = lt.asofJoin(rt, skipNulls=False).df
+    _degraded(monkeypatch)
+    got = lt.asofJoin(rt, skipNulls=False).df
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_oversize_sequence_tiebreak_bit_identical(monkeypatch):
+    rng = np.random.default_rng(11)
+    lt, _ = _frames(seed=7)
+    m = 800
+    rt = TSDF(pd.DataFrame({
+        "sym": rng.choice(["a", "b"], m),
+        "event_ts": pd.to_datetime(
+            np.sort(rng.integers(0, 9_000, m)) * 1_000_000_000),
+        "seqno": np.where(rng.random(m) > 0.2,
+                          rng.integers(0, 50, m).astype(float), np.nan),
+        "bid": np.where(rng.random(m) > 0.3,
+                        rng.standard_normal(m), np.nan),
+    }), "event_ts", ["sym"], sequence_col="seqno")
+    _full(monkeypatch)
+    want = lt.asofJoin(rt).df
+    _degraded(monkeypatch, limit=128)
+    got = lt.asofJoin(rt).df
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_sparse_right_side_carries_across_many_brackets(monkeypatch):
+    """The regime the fraction-spill skew path gets wrong: a right
+    match many brackets back must still be found via the carries."""
+    lt = TSDF(pd.DataFrame({
+        "sym": ["a"] * 500,
+        "event_ts": pd.to_datetime(
+            (np.arange(500) + 20_000) * 1_000_000_000),
+        "px": np.arange(500, dtype=float),
+    }), "event_ts", ["sym"])
+    rt = TSDF(pd.DataFrame({
+        "sym": ["a", "a"],
+        "event_ts": pd.to_datetime(np.array([1, 2]) * 1_000_000_000),
+        "bid": [7.5, np.nan],      # last non-null bid is 2 brackets back
+    }), "event_ts", ["sym"])
+    _full(monkeypatch)
+    want = lt.asofJoin(rt).df
+    _degraded(monkeypatch, limit=64)
+    got = lt.asofJoin(rt).df
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+    assert (got["right_bid"] == 7.5).all()
+
+
+def test_max_lookback_does_not_bracket_but_warns(monkeypatch, caplog):
+    lt, rt = _frames(seed=8, n=400, m=400)
+    _full(monkeypatch)
+    want = lt.asofJoin(rt, maxLookback=50).df
+    _degraded(monkeypatch, limit=128)
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.join"):
+        got = lt.asofJoin(rt, maxLookback=50).df
+    assert any("maxLookback" in r.message and "bracket" in r.message
+               for r in caplog.records)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_under_limit_join_untouched(monkeypatch, caplog):
+    lt, rt = _frames(seed=9, n=100, m=100)
+    _full(monkeypatch)
+    want = lt.asofJoin(rt).df
+    monkeypatch.setenv("TEMPO_TPU_MAX_MERGED_LANES", "100000")
+    with caplog.at_level(logging.WARNING, logger="tempo_tpu.join"):
+        got = lt.asofJoin(rt).df
+    assert not any("bracket" in r.message for r in caplog.records)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_estimate_matches_padded_layout_width():
+    lt, rt = _frames(seed=10, n=300, m=300)
+    from tempo_tpu import packing
+
+    l_codes, r_codes, kf = packing.encode_keys_joint(
+        lt.df, rt.df, ["sym"])
+    est = join._estimate_merged_lanes(l_codes, r_codes, len(kf))
+    max_l = int(np.bincount(l_codes).max())
+    max_r = int(np.bincount(r_codes).max())
+    assert est == packing.pad_length(max_l) + packing.pad_length(max_r)

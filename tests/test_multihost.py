@@ -107,6 +107,81 @@ def test_two_process_distributed_ingest_end_to_end():
         assert f"proc {i}/2 OK" in out
 
 
+class TestDistributedInitTimeout:
+    """Timeout plumbing with a monkeypatched initializer: the call must
+    bound its wait (natively or via watchdog) and surface a diagnostic
+    instead of hanging the process."""
+
+    @pytest.fixture(autouse=True)
+    def _not_initialized(self, monkeypatch):
+        monkeypatch.setattr(jax.distributed, "is_initialized",
+                            lambda: False, raising=False)
+
+    def test_timeout_plumbed_into_native_kwarg(self, monkeypatch):
+        seen = {}
+
+        def fake_init(coordinator_address=None, num_processes=None,
+                      process_id=None, initialization_timeout=None):
+            seen.update(locals())
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        mh.distributed_init("10.0.0.1:1234", num_processes=2, process_id=0,
+                            timeout_s=7)
+        assert seen["initialization_timeout"] == 7
+        assert seen["coordinator_address"] == "10.0.0.1:1234"
+
+    def test_watchdog_times_out_hung_initializer(self, monkeypatch):
+        import time as _time
+
+        def hung_init(coordinator_address=None, num_processes=None,
+                      process_id=None):     # no initialization_timeout
+            _time.sleep(30)
+
+        monkeypatch.setattr(jax.distributed, "initialize", hung_init)
+        with pytest.raises(mh.DistributedInitTimeout) as ei:
+            mh.distributed_init("10.0.0.9:555", num_processes=2,
+                                process_id=1, timeout_s=0.2)
+        msg = str(ei.value)
+        assert "10.0.0.9:555" in msg
+        assert "num_processes=2" in msg
+        assert "process_id=1" in msg
+
+    def test_deadline_shaped_runtime_error_becomes_diagnostic(
+            self, monkeypatch):
+        def failing_init(coordinator_address=None, num_processes=None,
+                         process_id=None, initialization_timeout=None):
+            raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+        monkeypatch.setattr(jax.distributed, "initialize", failing_init)
+        with pytest.raises(mh.DistributedInitTimeout, match="coordinator"):
+            mh.distributed_init("h:1", num_processes=2, process_id=0,
+                                timeout_s=5)
+
+    def test_double_init_still_tolerated(self, monkeypatch):
+        def once_init(coordinator_address=None, num_processes=None,
+                      process_id=None, initialization_timeout=None):
+            raise RuntimeError("distributed.initialize may only be "
+                               "called once")
+
+        monkeypatch.setattr(jax.distributed, "initialize", once_init)
+        mh.distributed_init("h:1", num_processes=2, process_id=0)  # no raise
+
+    def test_other_runtime_errors_propagate(self, monkeypatch):
+        def bad_init(coordinator_address=None, num_processes=None,
+                     process_id=None, initialization_timeout=None):
+            raise RuntimeError("invalid coordinator address")
+
+        monkeypatch.setattr(jax.distributed, "initialize", bad_init)
+        with pytest.raises(RuntimeError, match="invalid coordinator"):
+            mh.distributed_init("h:1", num_processes=2, process_id=0)
+
+    def test_classified_as_deadline(self):
+        from tempo_tpu.resilience import FailureKind, classify
+
+        assert classify(mh.DistributedInitTimeout("x")) is \
+            FailureKind.DEADLINE
+
+
 class TestRoutingRulePure:
     """The process_index-dependent routing branches, driven with
     synthetic device->process grids (no multi-process runtime needed —

@@ -109,6 +109,84 @@ def test_no_partition_cols(dataset, tmp_path):
                                rtol=1e-12)
 
 
+def test_missing_ts_col_fails_fast(dataset):
+    _, path = dataset
+    with pytest.raises(ValueError, match="'not_a_ts_col'"):
+        ingest.from_parquet(path, "not_a_ts_col", ["symbol"],
+                            mesh=make_mesh({"series": 4}))
+
+
+def test_missing_partition_col_fails_fast(dataset):
+    _, path = dataset
+    with pytest.raises(ValueError, match="'venue_missing'"):
+        ingest.from_parquet(path, "event_ts", ["symbol", "venue_missing"],
+                            mesh=make_mesh({"series": 4}))
+
+
+def test_empty_dataset_fails_fast(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    d = tmp_path / "empty"
+    d.mkdir()
+    pq.write_table(
+        pa.table({
+            "symbol": pa.array([], pa.string()),
+            "event_ts": pa.array([], pa.timestamp("ns")),
+            "x": pa.array([], pa.float64()),
+        }),
+        d / "part-0.parquet",
+    )
+    with pytest.raises(ValueError, match="empty"):
+        ingest.from_parquet(str(d), "event_ts", ["symbol"],
+                            mesh=make_mesh({"series": 4}))
+
+
+def test_transient_census_fault_retried(dataset, caplog):
+    """A flaky pass-1 read (transient IO) is retried under the ingest
+    retry policy and the frame still comes out bit-identical."""
+    import logging
+
+    from tempo_tpu.testing import faults
+
+    df, path = dataset
+    mesh = make_mesh({"series": 8})
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_census", failures=1)
+        with caplog.at_level(logging.WARNING, logger="tempo_tpu.resilience"):
+            frame = ingest.from_parquet(path, "event_ts", ["symbol"],
+                                        mesh=mesh, batch_rows=8192)
+    assert [r.action for r in fi.records] == ["raise", "pass"]
+    assert any("retrying in" in r.message for r in caplog.records)
+    got = _sorted(frame.collect().df)
+    want = _sorted(df.drop(columns=["tag"]))
+    np.testing.assert_allclose(got["x"].to_numpy(float),
+                               want["x"].to_numpy(float), rtol=1e-12)
+
+
+def test_budget_violation_not_retried(dataset):
+    """MemoryError is classified compile-oom, not transient — the
+    retry wrapper must surface it immediately (one attempt, no
+    backoff loop around a structurally-over-budget shard)."""
+    _, path = dataset
+    mesh = make_mesh({"series": 8})
+    calls = {"n": 0}
+    orig = ingest._stream_shard
+
+    def always_over_budget(*a, **k):
+        calls["n"] += 1
+        raise MemoryError("series shard 0 exceeded the host ingest budget")
+
+    ingest._stream_shard = always_over_budget
+    try:
+        with pytest.raises(MemoryError, match="budget"):
+            ingest.from_parquet(path, "event_ts", ["symbol"], mesh=mesh,
+                                batch_rows=4096)
+    finally:
+        ingest._stream_shard = orig
+    assert calls["n"] == 1
+
+
 def test_fewer_keys_than_shards(tmp_path):
     """Padding shards past the real key range must emit all-pad blocks,
     not stream the whole dataset with garbage key ids (regression)."""

@@ -1,0 +1,51 @@
+"""Tooling gates wired into the test run.
+
+tools/check_no_bare_except.py bans bare ``except:`` and silent
+``except Exception: pass`` in tempo_tpu/ — patterns that would make
+failures invisible to the resilience layer's classify/retry machinery."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+CHECKER = REPO / "tools" / "check_no_bare_except.py"
+
+
+def test_package_has_no_bare_except():
+    proc = subprocess.run(
+        [sys.executable, str(CHECKER), str(REPO / "tempo_tpu")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, \
+        f"bare-except violations:\n{proc.stdout}{proc.stderr}"
+
+
+def test_checker_flags_violations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n"
+        "    x = 1\n"
+        "except:\n"                      # bare
+        "    raise\n"
+        "try:\n"
+        "    y = 2\n"
+        "except Exception:\n"            # silent swallow
+        "    pass\n"
+        "try:\n"
+        "    z = 3\n"
+        "except (ValueError, Exception):\n"   # broad inside tuple, silent
+        "    ...\n"
+        "try:\n"
+        "    w = 4\n"
+        "except Exception as e:\n"       # broad but handled: allowed
+        "    print(e)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(CHECKER), str(bad)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert proc.stdout.count(str(bad)) == 3
+    assert "bare 'except:'" in proc.stdout
+    assert "silently swallows" in proc.stdout
