@@ -306,24 +306,32 @@ class DistributedTSDF:
         # host layout once per window size
         sort_kernels = _use_sort_kernels()
         rowbounds = None
+        engine = "shifted"
         if sort_kernels and strategy == "exact":
             from tempo_tpu.ops import pallas_stats as _ps
+            from tempo_tpu.ops import pallas_window as _pw
 
             rb = self._window_rowbounds(w)
             # per-device shard element count bounds the unrolled form's
             # HBM footprint (ops/rolling.py:shifted_row_budget); on the
             # exact strategy the kernel computes over series-local FULL
             # rows (the a2a layout switch), so the shard is K/devices
-            # by the full L
+            # by the full L.  Same three-way pick as the host frame
+            # (ops/rolling.pick_range_engine): shifted / streaming VMEM
+            # sweep / prefix+RMQ fallback.
             shard_k = self.K_dev // (self.n_series_shards
                                      * max(self.n_time, 1))
-            pallas_ok = (
-                packing.compute_dtype() == np.float32
-                and _ps.pallas_block_feasible(max(shard_k, 1), self.L)
-            )
-            if rb is not None and rb[0] + rb[1] <= rk.shifted_row_budget(
-                    max(shard_k, 1) * self.L, pallas_ok):
-                rowbounds = rb
+            f32 = packing.compute_dtype() == np.float32
+            pallas_ok = f32 and _ps.pallas_block_feasible(
+                max(shard_k, 1), self.L)
+            stream_ok = f32 and _pw.stream_block_feasible(
+                max(shard_k, 1), self.L)
+            if rb is not None:
+                engine = rk.pick_range_engine(
+                    max(shard_k, 1) * self.L, rb[0], rb[1],
+                    pallas_ok, stream_ok)
+                if engine != "windowed":
+                    rowbounds = rb
         for c in cols:
             col = self.cols[c]
             if self.n_time > 1 and strategy == "halo":
@@ -339,11 +347,12 @@ class DistributedTSDF:
             elif self.n_time > 1:
                 stats, rb_clipped = _range_stats_a2a(
                     self.mesh, self.series_axis, self.time_axis, w,
-                    rowbounds, sort_kernels,
+                    rowbounds, sort_kernels, engine,
                 )(self.ts, col.values, col.valid)
             else:
                 stats, rb_clipped = _range_stats_local(
-                    self.mesh, self.series_axis, w, rowbounds, sort_kernels,
+                    self.mesh, self.series_axis, w, rowbounds,
+                    sort_kernels, engine,
                 )(self.ts, col.values, col.valid)
             if strategy == "exact" and rowbounds is not None:
                 # deferred truncation audit of the shifted-window form:
@@ -1273,11 +1282,13 @@ def _range_stats_halo(mesh, series_axis, time_axis, window_secs, halo):
     return fn
 
 
-def _range_stats_block(ts, x, valid, w, rowbounds):
+def _range_stats_block(ts, x, valid, w, rowbounds, engine="shifted"):
     """Shard-local range stats: shifted gather-free form when static row
-    bounds are known (TPU), else bounds + prefix/RMQ form.  Returns
-    (stats dict, clipped row count) — clipped is the shifted kernel's
-    truncation audit (zero by construction for the exact form)."""
+    bounds are known (TPU), the streaming VMEM sweep for wider bounded
+    frames (``engine="stream"``), else bounds + prefix/RMQ form.
+    Returns (stats dict, clipped row count) — clipped is the window
+    kernels' truncation audit (zero by construction for the exact
+    form)."""
     from tempo_tpu.ops import sortmerge as sm
 
     secs = ts // packing.NS_PER_S
@@ -1291,10 +1302,16 @@ def _range_stats_block(ts, x, valid, w, rowbounds):
         # pad-immunity condition)
         rb = jnp.minimum(secs - secs[:, :1], 2**31 - 1).astype(jnp.int32)
         w32 = jnp.asarray(w).astype(jnp.int32)
-        stats = sm.range_stats_shifted(
-            rb, x, valid, w32,
-            max_behind=int(behind), max_ahead=int(ahead),
-        )
+        if engine == "stream":
+            stats = rk.range_stats_streaming(
+                rb, x, valid, w32,
+                max_behind=int(behind), max_ahead=int(ahead),
+            )
+        else:
+            stats = sm.range_stats_shifted(
+                rb, x, valid, w32,
+                max_behind=int(behind), max_ahead=int(ahead),
+            )
         clipped = jnp.sum(stats.pop("clipped")).astype(jnp.int64)
         return stats, clipped
     start, end = rk.range_window_bounds(secs, jnp.asarray(w))
@@ -1303,12 +1320,13 @@ def _range_stats_block(ts, x, valid, w, rowbounds):
 
 @functools.lru_cache(maxsize=256)
 def _range_stats_local(mesh, series_axis, window_secs, rowbounds=None,
-                       sort_kernels=False):
+                       sort_kernels=False, engine="shifted"):
     sp = _spec(mesh, series_axis, None)
     w = window_secs
 
     def kernel(ts, x, valid):
-        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds)
+        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds,
+                                            engine)
         return stats, jax.lax.psum(clipped, series_axis)
 
     stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
@@ -1319,7 +1337,8 @@ def _range_stats_local(mesh, series_axis, window_secs, rowbounds=None,
 
 @functools.lru_cache(maxsize=256)
 def _range_stats_a2a(mesh, series_axis, time_axis, window_secs,
-                     rowbounds=None, sort_kernels=False):
+                     rowbounds=None, sort_kernels=False,
+                     engine="shifted"):
     """Exact range stats on a time-sharded mesh via the series-local
     layout switch (all_to_all in, compute full rows, all_to_all out)."""
     sp = _spec(mesh, series_axis, time_axis)
@@ -1331,7 +1350,8 @@ def _range_stats_a2a(mesh, series_axis, time_axis, window_secs,
         rev = lambda a: jax.lax.all_to_all(
             a, time_axis, split_axis=1, concat_axis=0, tiled=True)
         ts, x, valid = fwd(ts), fwd(x), fwd(valid)
-        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds)
+        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds,
+                                            engine)
         # after the a2a each (series, time) device owns disjoint full
         # rows, so a psum over both axes counts every series once
         clipped = jax.lax.psum(clipped, (series_axis, time_axis))

@@ -37,6 +37,17 @@ _SUMMARIZABLE_KINDS = ("i", "u", "f")
 DEFAULT_SEQ_COLNAME = "sequence_num"  # parity: scala TSDF.scala:529
 
 
+def _strict_sql(strict: Optional[bool]) -> bool:
+    """Resolve the strict-SQL escape hatch: an explicit argument wins,
+    else the TEMPO_TPU_STRICT_SQL env default (off)."""
+    if strict is not None:
+        return bool(strict)
+    import os
+
+    val = os.environ.get("TEMPO_TPU_STRICT_SQL", "").strip().lower()
+    return val not in ("", "0", "false", "no", "off")
+
+
 def _split_alias(raw: str):
     """Split ``expr as alias`` at the LAST top-level ``as``/``AS``
     (outside single/double quotes and backticks) for the selectExpr
@@ -279,20 +290,34 @@ class TSDF:
             "seq_col_stub(optional) must be present"
         )
 
-    def selectExpr(self, *exprs) -> "TSDF":
+    def selectExpr(self, *exprs, strict: Optional[bool] = None) -> "TSDF":
         """Spark-style SQL projections (parity: TSDF.scala:226-229) via
         the vectorized expression engine (``tempo_tpu.sql``): arithmetic,
         CASE WHEN, CAST, IN/BETWEEN/LIKE, and the common function
         library, with ``expr AS alias`` naming.  Expressions the SQL
         grammar rejects fall back to pandas ``eval`` syntax (backward
-        compat with the pre-SQL implementation, e.g. ``price ** 2``)."""
+        compat with the pre-SQL implementation, e.g. ``price ** 2``) —
+        the switch is LOGGED (the two engines differ on NULL semantics
+        and function surface), and ``strict=True`` (or
+        ``TEMPO_TPU_STRICT_SQL=1``) re-raises the ``SqlError`` instead
+        of silently changing evaluation semantics."""
         from tempo_tpu import sql
 
+        strict = _strict_sql(strict)
         out = {}
         for raw in exprs:
             try:
                 out.update(sql.select_exprs(self.df, [raw]))
-            except sql.SqlError:
+                logger.debug("selectExpr(%r): evaluated by the SQL "
+                             "engine", raw)
+            except sql.SqlError as e:
+                if strict:
+                    raise
+                logger.warning(
+                    "selectExpr(%r): SQL engine rejected the expression "
+                    "(%s); falling back to pandas eval semantics — pass "
+                    "strict=True (or set TEMPO_TPU_STRICT_SQL=1) to "
+                    "re-raise instead", raw, e)
                 split = _split_alias(raw)
                 if split is not None:
                     src, alias = split
@@ -302,10 +327,13 @@ class TSDF:
                     out[raw.strip()] = self.df[raw.strip()]
         return self._with_df(pd.DataFrame(out))
 
-    def filter(self, condition) -> "TSDF":
+    def filter(self, condition, strict: Optional[bool] = None) -> "TSDF":
         """Row filter (parity: TSDF.scala:232-238).  String predicates
         parse as SQL (three-valued logic: NULL rows drop, like Spark),
-        falling back to pandas ``query`` syntax for backward compat."""
+        falling back to pandas ``query`` syntax for backward compat —
+        logged, because the engines disagree on NULL handling, and
+        suppressed entirely by ``strict=True`` /
+        ``TEMPO_TPU_STRICT_SQL=1`` (the ``SqlError`` re-raises)."""
         if callable(condition):
             mask = condition(self.df)
         elif isinstance(condition, str):
@@ -313,7 +341,16 @@ class TSDF:
 
             try:
                 mask = sql.filter_mask(self.df, condition)
-            except sql.SqlError:
+                logger.debug("filter(%r): evaluated by the SQL engine",
+                             condition)
+            except sql.SqlError as e:
+                if _strict_sql(strict):
+                    raise
+                logger.warning(
+                    "filter(%r): SQL engine rejected the predicate "
+                    "(%s); falling back to pandas query semantics — "
+                    "pass strict=True (or set TEMPO_TPU_STRICT_SQL=1) "
+                    "to re-raise instead", condition, e)
                 return self._with_df(self.df.query(condition))
         else:
             mask = condition
@@ -532,6 +569,15 @@ class TSDF:
         from tempo_tpu import resample as rs
 
         return rs.calc_bars(self, freq, func, metricCols, fill)
+
+    def resampleEMA(self, freq: str, colName: str,
+                    exp_factor: float = 0.2) -> "TSDF":
+        """Fused floor-resample + exact EMA in one device pass — the
+        single-read form of ``resample(freq, 'floor')`` followed by
+        ``EMA(..., exact=True)`` (tempo_tpu/resample.py:resample_ema)."""
+        from tempo_tpu import resample as rs
+
+        return rs.resample_ema(self, freq, colName, exp_factor)
 
     def interpolate(
         self,

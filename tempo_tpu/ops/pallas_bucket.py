@@ -176,7 +176,7 @@ def _bucket_stats_call(bid, x, valid, interpret=False):
     grid, bk, K_pad = plan
     bid = pk._pad_rows(bid, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with pk.x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
         out = pl.pallas_call(
@@ -185,7 +185,7 @@ def _bucket_stats_call(bid, x, valid, interpret=False):
             in_specs=[spec] * 3,
             out_specs=[spec] * 7,
             out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 7,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
@@ -211,8 +211,9 @@ def bucket_stats_pallas(bid, x, valid, interpret: bool = False):
     searchsorteds and gathers.  ``bid`` is an int32 bucket id,
     non-decreasing per row (pad rows carry a distinct id so they form
     their own bucket; their outputs are masked by callers)."""
-    outs = _bucket_stats_call(bid.astype(jnp.int32), x, valid,
-                              interpret=interpret)
+    with pk.interpret_scope(interpret):
+        outs = _bucket_stats_call(bid.astype(jnp.int32), x, valid,
+                                  interpret=interpret)
     mean, cnt, mn, mx, total, std, z = outs
     return {
         "mean": mean, "count": cnt, "min": mn, "max": mx, "sum": total,
@@ -224,12 +225,15 @@ def bucket_stats_pallas(bid, x, valid, interpret: bool = False):
 # Fused floor-resample + EMA (bench config 3)
 # ----------------------------------------------------------------------
 
-def _resample_ema_kernel(step_ref, alpha_ref, secs_ref, x_ref,
-                         valid_ref, res_ref, ema_ref):
+def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
+                         x_ref, valid_ref, res_ref, ema_ref):
     step = step_ref[0]
     alpha = alpha_ref[0]
     secs = secs_ref[:]
-    x = x_ref[:]
+    # the scale scalar folds the caller's elementwise pre-pass into
+    # this kernel's single read of x (the pre-pass re-streamed the
+    # column through HBM: 8B/row of pure overhead at bench scale)
+    x = x_ref[:] * scale_ref[0]
     valid = valid_ref[:]
     shape = secs.shape
 
@@ -261,7 +265,8 @@ def _resample_ema_kernel(step_ref, alpha_ref, secs_ref, x_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _resample_ema_call(secs, x, valid, step, alpha, interpret=False):
+def _resample_ema_call(secs, x, valid, step, alpha, scale,
+                       interpret=False):
     K, L = x.shape
     plan = pk._plan(K, L, arrays=24, bk_max=32, budget=90 * 2**20)
     if plan is None:
@@ -271,22 +276,23 @@ def _resample_ema_call(secs, x, valid, step, alpha, interpret=False):
     grid, bk, K_pad = plan
     secs = pk._pad_rows(secs, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with pk.x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
         out = pl.pallas_call(
             _resample_ema_kernel,
             grid=grid,
-            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3
             + [spec] * 3,
             out_specs=[spec] * 2,
             out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 2,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
         )(jnp.asarray([step], jnp.int32),
-          jnp.asarray([alpha], jnp.float32), secs, x, valid)
+          jnp.asarray([alpha], jnp.float32),
+          jnp.asarray(scale, jnp.float32).reshape(1), secs, x, valid)
     return out[0][:K], out[1][:K]
 
 
@@ -304,13 +310,16 @@ def resample_ema_supported(secs, x) -> bool:
 
 
 def resample_ema_pallas(secs, x, valid, step: float, alpha: float,
-                        interpret: bool = False):
+                        scale=None, interpret: bool = False):
     """Fused floor-resample + exact EMA: ``res`` is x at each bucket's
     first valid head row (NaN elsewhere — the packed-in-place
     downsample view), ``ema`` the exact EMA over the head-masked
     samples.  ``secs`` and ``step`` must be integral (the in-kernel
     bucketing is exact i32 division; a fractional step would silently
-    truncate and a sub-1 step would divide by zero) and fit int32."""
+    truncate and a sub-1 step would divide by zero) and fit int32.
+    ``scale`` (scalar) multiplies x inside the kernel -- callers
+    fold the elementwise pre-pass they would otherwise re-stream
+    the column for."""
     step_i = int(step)
     if step_i != step or step_i < 1:
         raise ValueError(
@@ -318,9 +327,12 @@ def resample_ema_pallas(secs, x, valid, step: float, alpha: float,
             f"seconds unit of `secs`, got {step!r}; rescale secs (e.g. "
             f"to ms) for sub-second buckets"
         )
-    res, ema = _resample_ema_call(
-        secs.astype(jnp.int32), x, valid,
-        jnp.asarray(step_i, jnp.int32),
-        jnp.asarray(alpha, jnp.float32), interpret=interpret,
-    )
+    with pk.interpret_scope(interpret):
+        res, ema = _resample_ema_call(
+            secs.astype(jnp.int32), x, valid,
+            jnp.asarray(step_i, jnp.int32),
+            jnp.asarray(alpha, jnp.float32),
+            jnp.float32(1.0) if scale is None else scale,
+            interpret=interpret,
+        )
     return res, ema

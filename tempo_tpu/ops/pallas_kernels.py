@@ -48,6 +48,40 @@ _BK = 32  # series rows per grid step; carries + roll temps + I/O double
           # buffers for a [32, 8192] f32 block stay under the 16M VMEM cap
 
 
+def x64_off():
+    """Context manager forcing 32-bit tracing around a pallas_call
+    (index maps must trace as i32: under the library's global x64 mode
+    they come out i64, which Mosaic's func.return rejects).  Newer jax
+    exposes this as ``jax.enable_x64``; older builds (this image's
+    0.4.37) only have the experimental context manager — same object,
+    different home."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import enable_x64 as _enable_x64
+
+    return _enable_x64(False)
+
+
+def interpret_scope(interpret: bool):
+    """Scope for CALLING an interpret-capable kernel wrapper: interpret
+    mode inlines the pallas machinery into the caller's jaxpr and
+    lowers it in the caller's config scope, so the whole call must run
+    32-bit or the grid-loop constants come out i64 against the
+    kernel's i32 jaxpr (verifier mismatch under the library's global
+    x64).  Compiled mode needs no extra scope."""
+    import contextlib
+
+    return x64_off() if interpret else contextlib.nullcontext()
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` across jax versions (older builds spell
+    it ``TPUCompilerParams``)."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
 def _ladder_levels(L: int):
     spans = []
     s = 1
@@ -143,7 +177,7 @@ def _cumsum3_call(x, valid, interpret=False):
     # three carries + three outputs live at once: a larger array budget
     grid, bk, K_pad = _plan(K, L, arrays=16, bk_max=16) or ((1,), K, K)
     x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         out = pl.pallas_call(
             _cumsum3_kernel,
@@ -162,7 +196,8 @@ def cumsum3(x, valid, interpret: bool = False):
     x = jnp.asarray(x)
     valid = jnp.asarray(valid)
     if interpret or _supported(x, arrays=16, bk_max=16):
-        return _cumsum3_call(x, valid, interpret=interpret)
+        with interpret_scope(interpret):
+            return _cumsum3_call(x, valid, interpret=interpret)
     from tempo_tpu.ops import window_utils as wu
 
     xz = jnp.where(valid, x, 0.0)
@@ -222,7 +257,7 @@ def _ema_call(x, valid, alpha, interpret=False):
     x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
     # index maps must trace as i32: under the library's global x64 mode
     # they come out i64, which Mosaic's func.return rejects
-    with jax.enable_x64(False):
+    with x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         out = pl.pallas_call(
             _ema_kernel,
@@ -244,7 +279,7 @@ def _last_valid_call(x, valid, interpret=False):
     K, L = x.shape
     grid, bk, K_pad = _plan(K, L) or ((1,), K, K)
     x, valid = _pad_rows(x, K_pad), _pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         out = pl.pallas_call(
             _last_valid_kernel,
@@ -265,7 +300,7 @@ def _index_scan_call(valid, kernel, interpret=False):
     K, L = valid.shape
     grid, bk, K_pad = _plan(K, L, arrays=8) or ((1,), K, K)
     valid = _pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0), memory_space=pltpu.VMEM)
         out = pl.pallas_call(
             kernel,
@@ -293,8 +328,9 @@ def last_valid_index_scan(valid, interpret: bool = False):
     the first.  Pallas on TPU, XLA cummax elsewhere."""
     valid = jnp.asarray(valid)
     if interpret or _index_supported(valid):
-        return _index_scan_call(valid, _last_valid_index_kernel,
-                                interpret=interpret)
+        with interpret_scope(interpret):
+            return _index_scan_call(valid, _last_valid_index_kernel,
+                                    interpret=interpret)
     from tempo_tpu.ops import window_utils as wu
 
     return wu.last_valid_index_xla(valid)
@@ -304,8 +340,9 @@ def first_valid_index_scan(valid, interpret: bool = False):
     """Index of the first True at-or-after each lane; L where none."""
     valid = jnp.asarray(valid)
     if interpret or _index_supported(valid):
-        return _index_scan_call(valid, _first_valid_index_kernel,
-                                interpret=interpret)
+        with interpret_scope(interpret):
+            return _index_scan_call(valid, _first_valid_index_kernel,
+                                    interpret=interpret)
     from tempo_tpu.ops import window_utils as wu
 
     return wu.first_valid_index_xla(valid)
@@ -316,7 +353,8 @@ def ema_scan(x, valid, alpha: float, interpret: bool = False):
     x = jnp.asarray(x)
     valid = jnp.asarray(valid)
     if interpret or _supported(x):
-        return _ema_call(x, valid, float(alpha), interpret=interpret)
+        with interpret_scope(interpret):
+            return _ema_call(x, valid, float(alpha), interpret=interpret)
     from tempo_tpu.ops import rolling as rk
 
     return rk.ema_exact(x, valid, alpha)
@@ -327,7 +365,8 @@ def last_valid_scan(x, valid, interpret: bool = False):
     x = jnp.asarray(x)
     valid = jnp.asarray(valid)
     if interpret or _supported(x):
-        return _last_valid_call(x, valid, interpret=interpret)
+        with interpret_scope(interpret):
+            return _last_valid_call(x, valid, interpret=interpret)
     # XLA fallback: the same scan via associative_scan
     def combine(c1, c2):
         h1, v1 = c1

@@ -292,7 +292,7 @@ def _merge_call(keys, payload, n_payload, Lc2, Llp, segmented=False,
         )
     grid, bk, K_pad = plan
     args = [pk._pad_rows(a, K_pad) for a in (*keys, *payload)]
-    with jax.enable_x64(False):
+    with pk.x64_off():
         spec = pl.BlockSpec((bk, Lc2), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
         ospec = pl.BlockSpec((bk, Llp), lambda i: (i, 0),
@@ -309,7 +309,7 @@ def _merge_call(keys, payload, n_payload, Lc2, Llp, segmented=False,
             # 16M default scoped-vmem cap at [8, 16384] blocks; v5e has
             # 128M physical VMEM per core — raise the cap instead of
             # shrinking blocks below Mosaic's 8-sublane minimum
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
@@ -592,7 +592,7 @@ def _rank_call(keys, isk, n_keys, Lc2, Lqp, interpret=False):
         raise ValueError("merge_rank kernel infeasible for this shape")
     grid, bk, K_pad = plan
     args = [pk._pad_rows(a, K_pad) for a in (*keys, isk)]
-    with jax.enable_x64(False):
+    with pk.x64_off():
         spec = pl.BlockSpec((bk, Lc2), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
         ospec = pl.BlockSpec((bk, Lqp), lambda i: (i, 0),
@@ -603,7 +603,7 @@ def _rank_call(keys, isk, n_keys, Lc2, Lqp, interpret=False):
             in_specs=[spec] * (n_keys + 1),
             out_specs=ospec,
             out_shape=jax.ShapeDtypeStruct((K_pad, Lqp), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,

@@ -1,4 +1,10 @@
-"""Pallas VMEM kernel for the shifted-window range stats.
+"""Pallas VMEM kernel for the shifted-window range stats (legacy).
+
+Since the streaming window engine landed (ops/pallas_window.py — same
+semantics, leaner per-pass math, runtime window widths), the shifted
+dispatcher prefers that module's unrolled form; this kernel stays as
+the TEMPO_TPU_WINDOW_ENGINE=legacy fallback and the parity baseline
+its tests pin.
 
 ``ops/sortmerge.py:range_stats_shifted`` computes Spark's
 rangeBetween(-window, 0) aggregates as W static shifted masked
@@ -153,7 +159,7 @@ def _stats_call(secs, x, valid, window, max_behind, max_ahead,
     grid, bk, K_pad = plan
     secs = pk._pad_rows(secs, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
-    with jax.enable_x64(False):
+    with pk.x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
         out = pl.pallas_call(
@@ -166,7 +172,7 @@ def _stats_call(secs, x, valid, window, max_behind, max_ahead,
             # measured 18.9M at [8, 8192] blocks: over the 16M default
             # scoped cap; v5e has 128M physical VMEM (same treatment as
             # the merge kernel)
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
             ),
             interpret=interpret,
@@ -208,12 +214,13 @@ def range_stats_pallas(secs, x, valid, window, max_behind: int,
     """Drop-in VMEM form of ``range_stats_shifted``; same output dict.
     ``secs`` must fit int32 after the caller's per-series rebase (the
     wrapper in sortmerge casts and falls back when it cannot)."""
-    outs = _stats_call(
-        secs.astype(jnp.int32), x, valid,
-        jnp.asarray(window).astype(jnp.int32),
-        max_behind=int(max_behind), max_ahead=int(max_ahead),
-        interpret=interpret,
-    )
+    with pk.interpret_scope(interpret):
+        outs = _stats_call(
+            secs.astype(jnp.int32), x, valid,
+            jnp.asarray(window).astype(jnp.int32),
+            max_behind=int(max_behind), max_ahead=int(max_ahead),
+            interpret=interpret,
+        )
     mean, cnt, mn, mx, total, std, z, clip = outs
     return {
         "mean": mean, "count": cnt, "min": mn, "max": mx, "sum": total,

@@ -28,19 +28,86 @@ import jax.numpy as jnp
 
 from tempo_tpu.ops import window_utils as wu
 
-# Auto-pick between the static-shift range-stats form (W masked
-# shifted passes, ops/sortmerge.py:range_stats_shifted + the VMEM
-# kernel) and the general prefix-scan + RMQ form
-# (:func:`windowed_stats`): frames whose row extent (behind + tie rows
-# ahead) fits :func:`shifted_row_budget` take the shifted form.  The
-# crossover is measured on-chip by bench.py's 10 Hz config (the
-# ``rolling_crossover`` record, both kernels on identical ~140-row
-# windows: shifted 174M rows/s vs windowed 8.0M — the windowed form is
-# gather-bound on this part, ~96 ms per RMQ take_along_axis, so the
-# shifted form wins every extent it can legally reach).  The bound is
-# therefore set by resources, not runtime: compile-time growth on
-# small shards (SHIFTED_MAX_ROWS) and HBM on large ones.
+# Three-way auto-pick between the range-stats engines (the measured
+# evidence is bench.py's ``rolling_crossover`` record):
+#
+# 1. **shifted** — W static masked shifted passes
+#    (ops/sortmerge.py:range_stats_shifted; VMEM-resident via the
+#    unrolled ops/pallas_window.py kernel on TPU).  Wins every extent
+#    it can legally reach (shifted 175M rows/s vs windowed 8.0M on
+#    identical ~140-row windows, BENCH_r05) but is bounded by
+#    resources: compile-time growth on small shards (SHIFTED_MAX_ROWS)
+#    and HBM shifted-copy materialisation on large ones
+#    (:func:`shifted_row_budget`).
+# 2. **stream** — the streaming VMEM sweep
+#    (ops/pallas_window.py:range_stats_stream): same O(W) work but the
+#    width is a runtime scalar, O(1) live planes, one HBM read — it
+#    serves every extent the unrolled forms cannot, up to
+#    TEMPO_TPU_STREAM_MAX_ROWS.
+# 3. **windowed** — the general prefix-scan + RMQ form
+#    (:func:`windowed_stats`).  Gather-bound on TPU (~96 ms per RMQ
+#    take_along_axis at [1024, 8192]) — the last resort there, the
+#    default off-TPU.
+#
+# TEMPO_TPU_WINDOW_ENGINE forces a choice (auto | shifted | stream |
+# windowed | legacy — legacy keeps the pre-streaming pallas_stats
+# kernel on the shifted path).
 SHIFTED_MAX_ROWS = 512
+
+
+def window_engine_override() -> str:
+    import os
+
+    return os.environ.get("TEMPO_TPU_WINDOW_ENGINE", "auto").lower()
+
+
+def pick_range_engine(n_elems: int, max_behind: int, max_ahead: int,
+                      pallas_small_ok: bool = False,
+                      stream_ok: bool = False) -> str:
+    """'shifted' | 'stream' | 'windowed' for a frame whose row extent
+    is (max_behind, max_ahead) on a shard of ``n_elems`` values.
+    ``pallas_small_ok``/``stream_ok``: the caller verified the
+    respective VMEM kernels can take this shard shape/dtype."""
+    forced = window_engine_override()
+    if forced in ("shifted", "stream", "windowed"):
+        return forced
+    W = int(max_behind) + int(max_ahead)
+    if W <= shifted_row_budget(n_elems, pallas_small_ok):
+        return "shifted"
+    from tempo_tpu.ops import pallas_window as pw
+
+    if stream_ok and W <= pw._stream_max_rows():
+        return "stream"
+    return "windowed"
+
+
+def range_stats_streaming(secs, x, valid, window, max_behind, max_ahead,
+                          scale=None):
+    """Streaming-engine entry: the VMEM sweep on TPU/f32/int32 keys,
+    the exact windowed (prefix-scan + RMQ) form elsewhere.  Returns the
+    ``range_stats_shifted`` output dict including ``clipped`` (always
+    zero on the fallback — the windowed form has no truncation)."""
+    from tempo_tpu.ops import pallas_window as pw
+
+    secs = jnp.asarray(secs)
+    x = jnp.asarray(x)
+    valid = jnp.asarray(valid)
+    if (secs.dtype == jnp.int32 and pw.stream_supported(x)
+            and window_engine_override() != "windowed"):
+        return pw.range_stats_stream(secs, x, valid, window,
+                                     max_behind, max_ahead, scale=scale)
+    if scale is not None:
+        x = x * jnp.asarray(scale, x.dtype)
+    start, end = range_window_bounds(secs, jnp.asarray(window, secs.dtype))
+    try:
+        max_w = 1 << (int(max_behind) + int(max_ahead) + 1).bit_length()
+    except TypeError:
+        # traced bounds (the streaming kernel takes them as runtime
+        # scalars): build every sparse-table level instead
+        max_w = 0
+    stats = dict(windowed_stats(x, valid, start, end, max_window=max_w))
+    stats["clipped"] = jnp.zeros((x.shape[0], 1), x.dtype)
+    return stats
 
 
 def shifted_row_budget(n_elems: int, pallas_ok: bool = False) -> int:
@@ -59,10 +126,11 @@ def shifted_row_budget(n_elems: int, pallas_ok: bool = False) -> int:
     falls to the XLA form, where the memory bound is real (code-review
     r4 finding)."""
     from tempo_tpu.ops.pallas_stats import _PALLAS_STATS_MAX_W
+    from tempo_tpu.ops.pallas_window import UNROLL_MAX_W
 
     mem_rows = int(12e9 // max(n_elems * 4 * 3, 1))
     if pallas_ok:
-        mem_rows = max(mem_rows, _PALLAS_STATS_MAX_W)
+        mem_rows = max(mem_rows, _PALLAS_STATS_MAX_W, UNROLL_MAX_W)
     return min(SHIFTED_MAX_ROWS, mem_rows)
 
 

@@ -520,18 +520,36 @@ def range_stats_shifted(
     window: jnp.ndarray,     # scalar window size in key units
     max_behind: int,         # static bound: rows any window reaches back
     max_ahead: int = 0,      # static bound: longest tie run ahead
+    scale=None,              # optional scalar folded onto x in-kernel
 ) -> Dict[str, jnp.ndarray]:
     """Dispatcher: on TPU with int32 keys and f32 values the whole
-    shifted-pass structure runs VMEM-resident as one Pallas kernel
-    (ops/pallas_stats.py) — an int32 ``secs`` dtype is the caller's
-    assertion that per-series key spans fit (rebase_seconds or
-    equivalent); int64 keys keep the XLA form below."""
+    shifted-pass structure runs VMEM-resident as one Pallas kernel —
+    the streamlined ops/pallas_window.py unrolled form by default
+    (fewer rotate/mask ops per pass; TEMPO_TPU_WINDOW_ENGINE=legacy
+    keeps the original ops/pallas_stats.py kernel) — an int32 ``secs``
+    dtype is the caller's assertion that per-series key spans fit
+    (rebase_seconds or equivalent); int64 keys keep the XLA form
+    below.  ``scale``, when given, multiplies ``x`` inside the kernel
+    (consumers fold the elementwise pre-pass they would otherwise
+    re-stream the column for)."""
     from tempo_tpu.ops import pallas_stats as ps
+    from tempo_tpu.ops import pallas_window as pw
+    from tempo_tpu.ops.rolling import window_engine_override
 
-    if secs.dtype == jnp.int32 and ps.range_stats_supported(
-            secs, x, valid, max_behind, max_ahead):
-        return ps.range_stats_pallas(secs, x, valid, window,
-                                     max_behind, max_ahead)
+    if secs.dtype == jnp.int32:
+        if window_engine_override() != "legacy" and pw.unrolled_supported(
+                x, max_behind, max_ahead):
+            return pw.range_stats_unrolled(
+                secs, x, valid, window, max_behind, max_ahead,
+                scale=scale)
+        if ps.range_stats_supported(secs, x, valid, max_behind,
+                                    max_ahead):
+            if scale is not None:
+                x = x * jnp.asarray(scale, x.dtype)
+            return ps.range_stats_pallas(secs, x, valid, window,
+                                         max_behind, max_ahead)
+    if scale is not None:
+        x = x * jnp.asarray(scale, x.dtype)
     return _range_stats_shifted_xla(secs, x, valid, window,
                                     max_behind=max_behind,
                                     max_ahead=max_ahead)

@@ -93,6 +93,47 @@ def compiled_cost(fn, *args, **kwargs) -> Dict[str, Optional[float]]:
     return out
 
 
+def window_roofline(
+    n_rows: int,
+    read_bytes_per_row: float,
+    write_bytes_per_row: float,
+    restream_bytes_per_row: float = 0.0,
+    t_iter: Optional[float] = None,
+    stream_bytes_per_sec: Optional[float] = None,
+) -> Dict[str, float]:
+    """Roofline accounting for a windowed/streaming config: bytes-moved
+    vs bytes-minimal, and their fractions of a *measured* stream rate.
+
+    * ``bytes_minimal`` — the compulsory traffic of an ideal
+      implementation: every input column read ONCE, every output plane
+      written ONCE.  ``minimal_frac`` answers "how close is this config
+      to the fastest any implementation could possibly be".
+    * ``bytes_moved`` — what the current implementation actually
+      streams, including re-streamed intermediates (e.g. a cast or
+      scale pass that writes a converted copy the kernel then re-reads:
+      ``restream_bytes_per_row``).  ``achieved_frac`` answers "what
+      fraction of the machine's stream capability is this config
+      driving" — the utilization number the hbm-stream bound compares.
+    * ``stream_efficiency`` = minimal/moved — 1.0 means no byte is
+      moved twice; below 1.0 quantifies exactly the re-streaming that
+      kernel fusion (scale/jitter scalars riding SMEM,
+      ops/pallas_window.py / ops/pallas_bucket.py) removes.
+    """
+    bytes_min = float(n_rows) * (read_bytes_per_row + write_bytes_per_row)
+    bytes_moved = bytes_min + float(n_rows) * restream_bytes_per_row
+    out: Dict[str, float] = {
+        "bytes_minimal_per_row": read_bytes_per_row + write_bytes_per_row,
+        "bytes_moved_per_row": bytes_moved / max(n_rows, 1),
+        "stream_efficiency": round(bytes_min / max(bytes_moved, 1.0), 3),
+    }
+    if t_iter and stream_bytes_per_sec:
+        out["achieved_frac"] = round(
+            bytes_moved / t_iter / stream_bytes_per_sec, 3)
+        out["minimal_frac"] = round(
+            bytes_min / t_iter / stream_bytes_per_sec, 3)
+    return out
+
+
 def host_bytes(df: pd.DataFrame) -> int:
     """Driver-side in-memory size of a frame — the packed-columnar analog
     of the reference's ``explain cost`` sizeInBytes scrape."""

@@ -152,6 +152,78 @@ def upsample_fill(res: pd.DataFrame, pcols: List[str], ts_col: str,
     return merged
 
 
+def resample_ema(tsdf, freq: str, colName: str, exp_factor: float = 0.2):
+    """Fused floor-resample + exact EMA in ONE device pass.
+
+    The chained form — ``resample(freq, 'floor')`` then ``ema(...,
+    exact=True)`` — streams the column through HBM twice (one pass per
+    op) plus a host round trip for the intermediate frame.  Here the
+    bucket-head pick and the EMA scan run as a single VMEM kernel on
+    TPU (ops/pallas_bucket.py:resample_ema_pallas) or one fused XLA
+    program elsewhere: the column is read once.
+
+    Semantics: per (series, epoch-aligned ``freq`` bucket), the value
+    of the bucket's first row *when that row is non-null* (a bucket
+    whose first row is null yields a null sample and the EMA carries —
+    the ``ema_exact`` null contract); the EMA is the exact
+    infinite-horizon scan over those samples (the scan-based upgrade
+    of the reference's truncated-lag EMA, tsdf.py:617-618 TODO).
+    Returns a TSDF with one row per bucket: partition cols, the bucket
+    start as the new ts, ``colName`` (the floor sample) and
+    ``EMA_<colName>``.
+    """
+    from tempo_tpu.ops import pallas_bucket as pb
+    from tempo_tpu.ops import pallas_kernels as pkk
+
+    freq_sec = freq_to_seconds(freq)
+    layout = tsdf.layout
+
+    v, m = tsdf.packed_numeric(colName)            # [K, L] + mask
+    secs = tsdf.packed_ts() // packing.NS_PER_S    # absolute int64 s
+    vj = jnp.asarray(v)
+    mj = jnp.asarray(m)
+    # bucket boundaries are epoch-aligned, so the kernel needs the
+    # ABSOLUTE seconds (a per-series rebase would move them): int32
+    # only until 2038 — fall back to XLA beyond that.  Pads carry the
+    # TS_PAD sentinel and are invalid either way (head requires a
+    # valid row), so only REAL rows bound the cast
+    real = tsdf.packed_mask()
+    secs_max = int(np.where(real, secs, 0).max(initial=0))
+    use_pallas = (secs_max + freq_sec < 2**31
+                  and pb.resample_ema_supported(
+                      jnp.asarray(secs).astype(jnp.int32), vj))
+    if use_pallas:
+        res, ema = pb.resample_ema_pallas(
+            jnp.asarray(secs).astype(jnp.int32), vj, mj,
+            step=freq_sec, alpha=float(exp_factor))
+    else:
+        bucket = jnp.asarray(secs) // freq_sec
+        head = jnp.concatenate(
+            [jnp.ones_like(bucket[:, :1], dtype=bool),
+             bucket[:, 1:] != bucket[:, :-1]], axis=-1,
+        ) & mj
+        res = jnp.where(head, vj, jnp.nan)
+        ema = pkk.ema_scan(vj, head, float(exp_factor))
+
+    # one stacked fetch, then assemble one output row per (series,
+    # bucket) run from the host segment machinery
+    planes = np.asarray(jnp.stack([res.astype(jnp.float32),
+                                   ema.astype(jnp.float32)]))
+    res_flat = packing.unpack_column(planes[0], layout)
+    ema_flat = packing.unpack_column(planes[1], layout)
+
+    bucket_ns = _bucket_ns(layout.ts_ns, freq_sec)
+    seg_ids, first_row, seg_bucket = _segments(layout, bucket_ns)
+    sorted_df = tsdf.df.iloc[layout.order].reset_index(drop=True)
+    out = {}
+    for c in tsdf.partitionCols:
+        out[c] = sorted_df[c].to_numpy()[first_row]
+    out[tsdf.ts_col] = packing.ns_to_original(seg_bucket, tsdf.ts_dtype())
+    out[colName] = res_flat[first_row].astype(np.float64)
+    out["EMA_" + colName] = ema_flat[first_row].astype(np.float64)
+    return TSDF(pd.DataFrame(out), tsdf.ts_col, tsdf.partitionCols)
+
+
 def resample(tsdf, freq: str, func=None, metricCols=None, prefix=None,
              fill=None):
     """TSDF.resample (tsdf.py:764-776): validates the func, aggregates,

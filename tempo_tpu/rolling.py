@@ -70,23 +70,27 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     flat = lambda a: jnp.asarray(a).reshape(C * K, L)
     tile = lambda a: jnp.broadcast_to(a[None], (C, K, L)).reshape(C * K, L)
 
-    # auto-pick (bench.py rolling_crossover is the measured evidence):
-    # row-boundable frames take the static-shift form — W masked
-    # shifted passes, VMEM-resident on TPU — the general prefix-scan +
-    # RMQ form covers dense data whose windows span too many rows (or
-    # spans past int32).  Same picker as the mesh path
-    # (dist.withRangeStats).
+    # three-way auto-pick (bench.py rolling_crossover is the measured
+    # evidence): row-boundable frames take the static-shift form — W
+    # masked shifted passes, VMEM-resident on TPU; wider frames the
+    # streaming VMEM sweep (runtime-width, ops/pallas_window.py); the
+    # general prefix-scan + RMQ form covers whatever remains (spans
+    # past int32, no TPU, extents past TEMPO_TPU_STREAM_MAX_ROWS).
+    # Same picker as the mesh path (dist.withRangeStats).
     from tempo_tpu.ops import sortmerge as sm
 
     rb = (packing.layout_rowbounds(layout, w)
           if ts_long.dtype == np.int32 and sm.use_sort_kernels()
           else None)
     from tempo_tpu.ops import pallas_stats as _ps
+    from tempo_tpu.ops import pallas_window as _pw
 
-    pallas_ok = (np.dtype(packing.compute_dtype()) == np.float32
-                 and _ps.pallas_block_feasible(C * K, L))
-    if rb is not None and rb[0] + rb[1] <= rk.shifted_row_budget(
-            C * K * L, pallas_ok):
+    f32 = np.dtype(packing.compute_dtype()) == np.float32
+    pallas_ok = f32 and _ps.pallas_block_feasible(C * K, L)
+    stream_ok = f32 and _pw.stream_block_feasible(C * K, L)
+    engine = ("windowed" if rb is None else rk.pick_range_engine(
+        C * K * L, rb[0], rb[1], pallas_ok, stream_ok))
+    if engine == "shifted":
         stats = dict(sm.range_stats_shifted(
             tile(ts_long), flat(vals), flat(valids),
             jnp.asarray(np.int32(w)),
@@ -95,6 +99,12 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
         # the truncation audit rides the SAME stacked fetch as the
         # stats below (the axon tunnel has a >1s per-transfer latency
         # floor — one extra scalar round trip would double it)
+    elif engine == "stream":
+        stats = dict(rk.range_stats_streaming(
+            tile(ts_long), flat(vals), flat(valids),
+            jnp.asarray(np.int32(w)),
+            max_behind=int(rb[0]), max_ahead=int(rb[1]),
+        ))
     else:
         start, end = rk.range_window_bounds(
             jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))

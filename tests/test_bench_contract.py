@@ -46,10 +46,21 @@ def test_bench_smoke_prints_one_json_line():
     # failure, which is exactly the silent loss this test guards
     bad = {k: v for k, v in cfgs.items() if not v or v <= 0}
     assert not bad, f"configs failed or empty: {bad}\n{out.stderr[-2000:]}"
-    # the dense-vs-shifted rolling crossover must be measured (round 4)
+    # the three-way rolling crossover must be measured (rounds 4 + 6)
     assert rec["rolling_crossover"], "rolling_crossover missing"
     assert rec["rolling_crossover"]["winner_at_10hz"] in (
-        "shifted", "windowed")
+        "shifted", "windowed", "streaming")
+    assert rec["rolling_crossover"]["winner_at_50hz"] in (
+        "windowed", "streaming")
+    for k in ("streaming_rows_per_sec_at_10hz",
+              "streaming_rows_per_sec_at_50hz"):
+        assert rec["rolling_crossover"].get(k, 0) > 0, k
+    # the op-surface sweep (round 6): every op must report a number
+    sweep = rec.get("opsweep") or {}
+    for op in ("interpolate", "fourier", "grouped_stats", "vwap",
+               "describe", "autocorr_lag1"):
+        assert sweep.get(op, {}).get("rows_per_sec", 0) > 0, \
+            f"opsweep config {op} missing/empty: {sweep.get(op)}"
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)

@@ -1,0 +1,198 @@
+"""Streaming sliding-window engine: interpret-mode property tests.
+
+Pins the ops/pallas_window.py kernels (streaming fori-loop form AND the
+statically-unrolled twin) against
+
+* the XLA shifted form (itself oracle-tested in test_rolling /
+  test_pallas_stats), across window sizes spanning every auto-pick
+  crossover: tiny (shifted regime), at the unroll ceiling, and far
+  beyond it (streaming-only regime);
+* a brute-force per-row numpy float64 oracle, including range windows
+  whose bounds land BETWEEN timestamps, ragged series tails (i32-max
+  clamped pads), NaN-masked rows, and tie runs;
+* each other (the two forms must agree exactly — same math, different
+  loop structure).
+
+Also covers the three-way auto-pick (ops/rolling.pick_range_engine)
+and the streaming dispatcher's CPU fallback.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tempo_tpu.ops import pallas_window as pw
+from tempo_tpu.ops import rolling as rk
+from tempo_tpu.ops import sortmerge as sm
+
+KEYS = ("mean", "count", "min", "max", "sum", "stddev", "zscore",
+        "clipped")
+
+
+def _case(seed, K=4, L=256, span=600, pads=True, invalids=True):
+    rng = np.random.default_rng(seed)
+    secs = np.sort(rng.integers(0, span, (K, L)), axis=-1).astype(np.int64)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = (rng.random((K, L)) > 0.25) if invalids else np.ones((K, L), bool)
+    if invalids and K > 1:
+        valid[1] = False                      # a fully-null series
+    if pads:
+        cut = rng.integers(L // 2, L, K)
+        for k in range(K):
+            secs[k, cut[k]:] = 2**31 - 1
+            valid[k, cut[k]:] = False
+    return secs.astype(np.int32), x, valid
+
+
+def _assert_close(got, want, err=""):
+    for k in KEYS:
+        np.testing.assert_allclose(
+            np.asarray(got[k], dtype=np.float64),
+            np.asarray(want[k], dtype=np.float64),
+            rtol=2e-5, atol=2e-5, equal_nan=True, err_msg=f"{err}:{k}",
+        )
+
+
+# window sizes spanning the shifted (<= shifted_row_budget), unrolled
+# (<= UNROLL_MAX_W) and streaming-only (beyond) regimes; `span` tunes
+# the resulting row extents
+@pytest.mark.parametrize("seed,span,W,behind,ahead", [
+    (0, 600, 25, 24, 12),        # shifted regime, ties + pads
+    (1, 40, 25, 64, 32),         # heavy ties, at the unroll ceiling
+    (2, 600, 120, 100, 8),       # past the unroll ceiling
+    (3, 200, 180, 250, 16),      # streaming-only: W ~ L
+])
+def test_stream_matches_xla_shifted(seed, span, W, behind, ahead):
+    secs, x, valid = _case(seed, span=span)
+    args = (jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+            jnp.asarray(np.int32(W)))
+    want = sm._range_stats_shifted_xla(
+        *args, max_behind=behind, max_ahead=ahead)
+    got = pw.range_stats_stream(
+        *args, max_behind=behind, max_ahead=ahead, interpret=True)
+    _assert_close(got, want, f"stream W={W}")
+    if behind + ahead <= pw.UNROLL_MAX_W:
+        got_u = pw.range_stats_unrolled(
+            *args, max_behind=behind, max_ahead=ahead, interpret=True)
+        _assert_close(got_u, want, f"unrolled W={W}")
+        # the two forms are the same math: exact agreement
+        for k in KEYS:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(got_u[k]), err_msg=k)
+
+
+def test_numpy_oracle_window_between_timestamps():
+    """Keys stride 5, window 7: every frame boundary lands strictly
+    between timestamps; brute-force f64 oracle per row."""
+    K, L = 3, 128
+    rng = np.random.default_rng(9)
+    secs = (np.arange(L, dtype=np.int64) * 5)[None].repeat(K, 0)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.2
+    W = 7
+    got = pw.range_stats_stream(
+        jnp.asarray(secs.astype(np.int32)), jnp.asarray(x),
+        jnp.asarray(valid), jnp.asarray(np.int32(W)),
+        max_behind=4, max_ahead=2, interpret=True)
+    x64 = x.astype(np.float64)
+    for k in range(K):
+        for i in range(L):
+            lo, hi = secs[k, i] - W, secs[k, i]
+            inw = (secs[k] >= lo) & (secs[k] <= hi) & valid[k]
+            win = x64[k, inw]
+            assert float(got["count"][k, i]) == len(win), (k, i)
+            if len(win):
+                np.testing.assert_allclose(
+                    float(got["min"][k, i]), win.min(), rtol=1e-5)
+                np.testing.assert_allclose(
+                    float(got["max"][k, i]), win.max(), rtol=1e-5)
+                np.testing.assert_allclose(
+                    float(got["mean"][k, i]), win.mean(),
+                    rtol=1e-4, atol=1e-5)
+            else:
+                assert np.isnan(float(got["mean"][k, i]))
+
+
+def test_rows_mode_matches_bruteforce():
+    K, L = 3, 128
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.25
+    rb, ra = 6, 3
+    got = pw.rows_stats_stream(jnp.asarray(x), jnp.asarray(valid),
+                               rb, ra, interpret=True)
+    x64 = x.astype(np.float64)
+    for k in range(K):
+        for i in range(L):
+            s, e = max(0, i - rb), min(L, i + ra + 1)
+            win = x64[k, s:e][valid[k, s:e]]
+            assert float(got["count"][k, i]) == len(win), (k, i)
+            if len(win) > 1:
+                np.testing.assert_allclose(
+                    float(got["stddev"][k, i]), win.std(ddof=1),
+                    rtol=1e-4, atol=1e-5)
+    assert float(np.asarray(got["clipped"]).sum()) == 0
+
+
+def test_scale_folds_into_kernel():
+    secs, x, valid = _case(5)
+    args = (jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+            jnp.asarray(np.int32(30)))
+    want = sm._range_stats_shifted_xla(
+        args[0], jnp.asarray(x * np.float32(2.5)), args[2], args[3],
+        max_behind=20, max_ahead=8)
+    for fn in (pw.range_stats_stream, pw.range_stats_unrolled):
+        got = fn(*args, max_behind=20, max_ahead=8, scale=2.5,
+                 interpret=True)
+        _assert_close(got, want, fn.__name__)
+
+
+def test_clipped_audit_parity_when_truncating():
+    secs, x, valid = _case(6)
+    args = (jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+            jnp.asarray(np.int32(50)))
+    want = sm._range_stats_shifted_xla(*args, max_behind=3, max_ahead=0)
+    assert float(np.asarray(want["clipped"]).sum()) > 0
+    for fn in (pw.range_stats_stream, pw.range_stats_unrolled):
+        got = fn(*args, max_behind=3, max_ahead=0, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(got["clipped"]), np.asarray(want["clipped"]),
+            err_msg=fn.__name__)
+
+
+def test_pick_range_engine_three_way(monkeypatch):
+    monkeypatch.delenv("TEMPO_TPU_WINDOW_ENGINE", raising=False)
+    n = 1024 * 8192
+    # small extent -> shifted; past the budget with a feasible stream
+    # block -> stream; past the stream ceiling (or no stream) -> windowed
+    assert rk.pick_range_engine(n, 10, 2, True, True) == "shifted"
+    assert rk.pick_range_engine(n, 500, 8, True, True) == "stream"
+    assert rk.pick_range_engine(n, 500, 8, True, False) == "windowed"
+    big = pw._stream_max_rows() + 1
+    assert rk.pick_range_engine(n, big, 0, True, True) == "windowed"
+    monkeypatch.setenv("TEMPO_TPU_WINDOW_ENGINE", "stream")
+    assert rk.pick_range_engine(n, 10, 2, True, True) == "stream"
+    monkeypatch.setenv("TEMPO_TPU_WINDOW_ENGINE", "legacy")
+    # legacy only redirects the shifted path's kernel choice
+    assert rk.pick_range_engine(n, 10, 2, True, True) == "shifted"
+
+
+def test_streaming_dispatcher_cpu_fallback():
+    """Off-TPU the dispatcher must produce the same numbers through the
+    windowed form, including a zero clipped plane."""
+    secs, x, valid = _case(7, pads=False)
+    W, behind, ahead = 40, 40, 16
+    want = sm._range_stats_shifted_xla(
+        jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+        jnp.asarray(np.int32(W)), max_behind=behind, max_ahead=ahead)
+    got = rk.range_stats_streaming(
+        jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+        jnp.asarray(np.int32(W)), behind, ahead)
+    for k in KEYS:
+        if k == "clipped":
+            assert float(np.asarray(got[k]).sum()) == 0
+            continue
+        np.testing.assert_allclose(
+            np.asarray(got[k], np.float64),
+            np.asarray(want[k], np.float64),
+            rtol=2e-4, atol=2e-4, equal_nan=True, err_msg=k)

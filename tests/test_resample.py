@@ -122,3 +122,73 @@ def test_resample_ceil_and_scala_leads():
     # scala-side aliases (resample.scala:17-20) map onto the same engine
     res2 = _tsdf().resample(freq="min", func="closest_lead", prefix="floor").df
     assert res2.iloc[0]["floor_trade_pr"] == 349.21
+
+
+def test_fused_resample_ema_matches_chained():
+    """TSDF.resampleEMA (one device pass, tempo_tpu/resample.py:
+    resample_ema) must equal the two-pass chain it fuses:
+    resample(freq, 'floor') then EMA(exact) over the resampled rows —
+    including null bucket heads (the EMA carries) and multi-series
+    frames."""
+    import numpy as np
+    import pandas as pd
+
+    from tempo_tpu import resample as rs
+    from tempo_tpu import rolling as fr
+    from tempo_tpu.frame import TSDF
+
+    rng = np.random.default_rng(3)
+    n = 600
+    df = pd.DataFrame({
+        "id": np.repeat(["a", "b", "c"], n // 3),
+        "event_ts": pd.to_datetime(
+            np.concatenate([np.cumsum(rng.integers(1, 20, n // 3))] * 3),
+            unit="s"),
+        "x": rng.standard_normal(n),
+    })
+    df.loc[rng.random(n) < 0.15, "x"] = np.nan
+    t = TSDF(df, "event_ts", ["id"])
+
+    fused = t.resampleEMA("1 minute", "x", exp_factor=0.2)
+    chained = fr.ema(rs.resample(t, "1 minute", "floor"), "x", exact=True)
+
+    a = fused.df.sort_values(["id", "event_ts"]).reset_index(drop=True)
+    b = chained.df.sort_values(["id", "event_ts"]).reset_index(drop=True)
+    assert len(a) == len(b)
+    np.testing.assert_allclose(a["x"].to_numpy(), b["x"].to_numpy(),
+                               rtol=1e-5, atol=1e-6, equal_nan=True)
+    np.testing.assert_allclose(a["EMA_x"].to_numpy(),
+                               b["EMA_x"].to_numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_resample_ema_kernel_interpret_parity():
+    """The pallas kernel path (interpret mode) must match the XLA
+    fallback the frame API uses off-TPU, scale fold included."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tempo_tpu.ops import pallas_bucket as pb
+    from tempo_tpu.ops import pallas_kernels as pk
+
+    rng = np.random.default_rng(5)
+    K, L = 4, 256
+    secs = np.cumsum(rng.integers(1, 4, (K, L)), axis=-1).astype(np.int32)
+    x = rng.standard_normal((K, L)).astype(np.float32)
+    valid = rng.random((K, L)) > 0.2
+    res, ema = pb.resample_ema_pallas(
+        jnp.asarray(secs), jnp.asarray(x), jnp.asarray(valid),
+        step=60, alpha=0.2, scale=jnp.float32(1.5), interpret=True)
+
+    xs = x * np.float32(1.5)
+    bucket = secs // 60
+    head = np.concatenate(
+        [np.ones_like(bucket[:, :1], bool),
+         bucket[:, 1:] != bucket[:, :-1]], axis=-1) & valid
+    want_res = np.where(head, xs, np.nan)
+    want_ema = np.asarray(pk.ema_scan(jnp.asarray(xs),
+                                      jnp.asarray(head), 0.2))
+    np.testing.assert_allclose(np.asarray(res), want_res, rtol=1e-6,
+                               equal_nan=True)
+    np.testing.assert_allclose(np.asarray(ema), want_ema, rtol=1e-5,
+                               atol=1e-6)

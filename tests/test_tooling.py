@@ -2,7 +2,12 @@
 
 tools/check_no_bare_except.py bans bare ``except:`` and silent
 ``except Exception: pass`` in tempo_tpu/ — patterns that would make
-failures invisible to the resilience layer's classify/retry machinery."""
+failures invisible to the resilience layer's classify/retry machinery.
+
+tools/check_no_dynamic_gather.py bans gather/scatter-shaped calls in
+the Pallas kernel modules (ops/pallas_*.py) — the primitive class
+behind the dense-regime rolling regression (BENCH_r05 2b at 8M rows/s,
+below one CPU core) that the streaming window engine removed."""
 
 import subprocess
 import sys
@@ -49,3 +54,65 @@ def test_checker_flags_violations(tmp_path):
     assert proc.stdout.count(str(bad)) == 3
     assert "bare 'except:'" in proc.stdout
     assert "silently swallows" in proc.stdout
+
+
+GATHER_CHECKER = REPO / "tools" / "check_no_dynamic_gather.py"
+
+
+def test_pallas_modules_have_no_dynamic_gathers():
+    proc = subprocess.run(
+        [sys.executable, str(GATHER_CHECKER)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, \
+        f"dynamic-gather violations:\n{proc.stdout}{proc.stderr}"
+
+
+def test_gather_checker_flags_violations(tmp_path):
+    bad = tmp_path / "pallas_bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def kernel(x, idx):\n"
+        "    a = jnp.take_along_axis(x, idx, axis=1)\n"       # banned
+        "    b = jnp.take(x, idx)\n"                          # banned
+        "    c = jnp.searchsorted(x[0], idx[0])  # gather-ok: host side\n"
+        "    return a, b, c\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(GATHER_CHECKER), str(bad)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert proc.stdout.count(str(bad)) == 2, proc.stdout
+    assert "take_along_axis" in proc.stdout
+    # the gather-ok marker whitelists the searchsorted line
+    assert "searchsorted" not in proc.stdout
+
+
+def test_dryrun_stderr_filter_drops_only_benign_lines(capfd):
+    """__graft_entry__._filter_benign_stderr: the XLA:CPU AOT
+    feature-mismatch spew disappears from fd 2, real warnings and a
+    one-line dropped-count summary remain (VERDICT weak #6)."""
+    import os
+
+    import __graft_entry__ as ge
+
+    with ge._filter_benign_stderr():
+        os.write(2, b"E0731 cpu_aot_loader.cc:210] Loading XLA:CPU AOT "
+                    b"result. Target machine feature +prefer-no-gather\n")
+        os.write(2, b"W0731 a genuinely new warning\n")
+    err = capfd.readouterr().err
+    assert "cpu_aot_loader" not in err
+    assert "genuinely new warning" in err
+    assert "filtered 1 known-benign" in err
+
+
+def test_dryrun_stderr_filter_disable_knob(capfd, monkeypatch):
+    import os
+
+    import __graft_entry__ as ge
+
+    monkeypatch.setenv("TEMPO_TPU_NO_STDERR_FILTER", "1")
+    with ge._filter_benign_stderr():
+        os.write(2, b"cpu_aot_loader passthrough when disabled\n")
+    assert "passthrough when disabled" in capfd.readouterr().err
