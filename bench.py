@@ -1117,6 +1117,185 @@ def _nbbo_subprocess():
         return None
 
 
+def _chunked_case(Kc, Ls, seed=7):
+    """Two-sided sorted join data at the oversize merged-lane shapes."""
+    rng = np.random.default_rng(seed)
+    l_ts = np.cumsum(rng.integers(1, 3, size=(Kc, Ls)).astype(np.int64),
+                     axis=-1) * np.int64(1_000_000)
+    r_ts = np.cumsum(rng.integers(1, 3, size=(Kc, Ls)).astype(np.int64),
+                     axis=-1) * np.int64(1_000_000)
+    r_values = rng.standard_normal(
+        (N_RIGHT_COLS, Kc, Ls)).astype(np.float32)
+    r_valids = rng.random((N_RIGHT_COLS, Kc, Ls)) > 0.1
+    return l_ts, r_ts, r_valids, r_values
+
+
+def _chunked_oracle_audit(l_ts, r_ts, r_valids, r_values, vals, idx,
+                          label, sub=SUB_K):
+    """Exact (bit-level: fills select, never compute) numpy searchsorted
+    oracle on a strided series subsample."""
+    Kc = l_ts.shape[0]
+    Lr = r_ts.shape[1]
+    stride = max(Kc // sub, 1)
+    for k in range(0, Kc, stride):
+        pos = np.searchsorted(r_ts[k], l_ts[k], side="right") - 1
+        want_last = pos.astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(idx)[k], want_last, err_msg=f"{label} k={k} idx")
+        for c in range(r_values.shape[0]):
+            lv = np.maximum.accumulate(
+                np.where(r_valids[c, k], np.arange(Lr), -1))
+            j = np.where(pos >= 0, lv[np.maximum(pos, 0)], -1)
+            want = np.where(j >= 0, r_values[c, k][np.maximum(j, 0)],
+                            np.float32(np.nan))
+            np.testing.assert_array_equal(
+                np.asarray(vals)[c, k], want.astype(np.float32),
+                err_msg=f"{label} k={k} c={c}")
+
+
+def bench_chunked():
+    """Configs 8/9: the lane-chunked streaming merge at the two shapes
+    the single-program regime could never run — the round-3 compiler
+    OOM shape (K=128, ~205K merged lanes) and a 1M-row single series
+    (one ordinary hot symbol-day).  The host chunk plan is built once
+    (it is packing work, paid once per frame like all packing); the
+    timed loop drives the streaming pallas program on the prebuilt
+    planes with a carry-dependent payload scale so no iteration can be
+    elided.  Value audit: numpy searchsorted oracle, exact equality
+    (fills select, never compute)."""
+    from tempo_tpu import resilience
+    from tempo_tpu.ops import pallas_merge as pm
+
+    smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+    shapes = {
+        "8_chunked_205k_k128": (128, 102_400),
+        "9_chunked_1m_single": (1, 1_000_000),
+    }
+    if smoke:
+        shapes = {"8_chunked_205k_k128": (8, 1024),
+                  "9_chunked_1m_single": (1, 4096)}
+    interpret = jax.default_backend() != "tpu"
+    chunk_lanes = 512 if smoke else None
+    out = {}
+    for label, (Kc, Ls) in shapes.items():
+        l_ts, r_ts, r_valids, r_values = _chunked_case(Kc, Ls)
+        est = 2 * Ls
+        single_ok = pm.merge_join_supported(
+            jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_values),
+            None, None, True)
+        # correctness first: full wrapper once + oracle audit
+        vals, found, idx = pm.asof_merge_values_chunked(
+            l_ts, r_ts, r_valids, r_values, chunk_lanes=chunk_lanes,
+            interpret=interpret)
+        _chunked_oracle_audit(l_ts, r_ts, r_valids, r_values, vals, idx,
+                              label)
+        del vals, found, idx
+
+        keys, planes, plan, meta = pm.build_chunked_planes(
+            l_ts, r_ts, r_valids, r_values, chunk_lanes=chunk_lanes)
+        n_keys = meta["n_keys"]
+
+        def body(scale, *args, _meta=meta, _plan=plan):
+            ks = args[:_meta["n_keys"]]
+            ps = tuple(p * scale for p in args[_meta["n_keys"]:])
+            outs = pm._chunked_call(
+                ks, ps, n_payload=_meta["n_payload"],
+                n_out=_meta["n_out"], Cm=_plan.merged_lanes,
+                segmented=False, keyed_fill=False,
+                chunk_rows=_plan.chunk_rows, interpret=interpret)
+            return {f"o{i}": o for i, o in enumerate(outs)}
+
+        args = [jax.device_put(jnp.asarray(a)) for a in (*keys, *planes)]
+        with pk.interpret_scope(interpret):
+            rate, bw, t_iter = _loop_rate(body, args, Kc * Ls, label)
+
+        W = plan.n_chunks * plan.merged_lanes
+        read_b = (n_keys + meta["n_payload"]) * Kc * W * 4
+        write_b = meta["n_out"] * Kc * W // 2 * 4
+        # minimal = logical inputs once + outputs once
+        min_b = Kc * Ls * (8 + 8 + N_RIGHT_COLS * 5) \
+            + meta["n_out"] * Kc * Ls * 4
+        out[label] = {
+            "rows_per_sec": rate, "implied_bw": bw, "t_iter": t_iter,
+            "merged_lanes": est,
+            "engine": "chunked",
+            "single_plan_supported": bool(single_ok),
+            "past_sort_ladder_ceiling": est > resilience.max_merged_lanes(),
+            "chunk_lanes": plan.merged_lanes,
+            "n_chunks": plan.n_chunks,
+            "layout_occupancy": round(2 * Ls / W, 3),
+            "roofline": {
+                "bytes_moved_per_iter": read_b + write_b,
+                "bytes_minimal_per_iter": min_b,
+                "stream_efficiency": round(min_b / (read_b + write_b), 3),
+                "achieved_frac_of_spec": round(
+                    (read_b + write_b) / t_iter / V5E_HBM_BYTES_PER_SEC,
+                    3),
+            },
+            "value_audit": "exact vs numpy searchsorted oracle",
+        }
+        del keys, planes, args
+    return out
+
+
+def bench_frame_e2e():
+    """Config 7: the user-facing frame chain
+    ``TSDF.on_mesh().asofJoin().withRangeStats().EMA().collect()`` on a
+    1-device mesh — proving the public API lands near the raw fused
+    kernel number (VERDICT r5 "Next round" #5).  Wall-clock includes
+    everything a user pays after the one-time pack: device chain, the
+    host key alignment, and the collect-side frame assembly."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+    from tempo_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(11)
+    Kf, Lf = (K, L)
+    secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                     axis=-1)
+    syms = np.repeat(np.arange(Kf), Lf)
+    df_l = pd.DataFrame({
+        "sym": syms, "event_ts": secs.ravel(),
+        "x": rng.standard_normal(Kf * Lf),
+    })
+    r_secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                       axis=-1)
+    df_r = pd.DataFrame({
+        "sym": syms, "event_ts": r_secs.ravel(),
+        "v0": rng.standard_normal(Kf * Lf),
+        "v1": rng.standard_normal(Kf * Lf),
+    })
+    lt = TSDF(df_l, "event_ts", ["sym"])
+    rt = TSDF(df_r, "event_ts", ["sym"])
+    mesh = make_mesh({"series": 1})
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+
+    def chain():
+        res = (dl.asofJoin(dr)
+               .withRangeStats(colsToSummarize=["x"],
+                               rangeBackWindowSecs=WINDOW_SECS)
+               .EMA("x", exact=True)
+               .collect().df)
+        return res
+
+    print("[frame_e2e] warmup/compile...", file=sys.stderr, flush=True)
+    warm = chain()
+    assert len(warm) == Kf * Lf
+    del warm
+    print("[frame_e2e] timing...", file=sys.stderr, flush=True)
+    ts = []
+    for _ in range(max(ITERS, 2)):
+        t0 = time.perf_counter()
+        res = chain()
+        ts.append(time.perf_counter() - t0)
+        del res
+    t_iter = float(np.median(ts))
+    return {"rows_per_sec": Kf * Lf / t_iter, "t_iter": t_iter,
+            "rows": Kf * Lf}
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -1207,6 +1386,18 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-chunked" in sys.argv:
+        res = _attempt("chunked", bench_chunked)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
+    if "--only-frame-e2e" in sys.argv:
+        res = _attempt("frame_e2e", bench_frame_e2e)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
 
     data = make_data()
     # host-only denominator first: immune to device-worker state
@@ -1250,6 +1441,10 @@ def main():
     stream_st = _config_subprocess("--only-stream-stats", "stream_stats")
     opsweep = _config_subprocess("--only-opsweep", "opsweep",
                                  timeout=2400)
+    chunked = _config_subprocess("--only-chunked", "chunked",
+                                 timeout=2400)
+    frame_e2e = _config_subprocess("--only-frame-e2e", "frame_e2e",
+                                   timeout=2400)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
     # three engines ran on identical data; at 50 Hz the unrolled forms
     # cannot legally run, so the record is streaming vs windowed —
@@ -1321,7 +1516,23 @@ def main():
                       if dense else None)),
             "6_seq_tiebreak_asof": (round(seq["rows_per_sec"])
                                     if seq else None),
+            "7_frame_e2e_pipeline": (round(frame_e2e["rows_per_sec"])
+                                     if frame_e2e else None),
+            "8_chunked_205k_k128": (
+                round(chunked["8_chunked_205k_k128"]["rows_per_sec"])
+                if chunked and "8_chunked_205k_k128" in chunked
+                else None),
+            "9_chunked_1m_single": (
+                round(chunked["9_chunked_1m_single"]["rows_per_sec"])
+                if chunked and "9_chunked_1m_single" in chunked
+                else None),
         },
+        # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
+        # within ~1.2x is the claim being measured
+        "frame_e2e_vs_fused": (
+            round(fused_rows_sec / frame_e2e["rows_per_sec"], 2)
+            if frame_e2e else None),
+        "chunked": chunked,
         "opsweep": opsweep,
         "nbbo_slot_occupancy": (round(nbbo[2], 3) if nbbo else None),
         "rolling_crossover": crossover,

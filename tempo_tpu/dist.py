@@ -524,6 +524,27 @@ class DistributedTSDF:
         vstack = align3(vstack, perm, ok, False)
 
         sort_kernels = _use_sort_kernels()
+        # per-shard engine note (round 6): the a2a layout switch hands
+        # each device FULL series rows, so the shard-local merge width
+        # is the full merged width — past the single-program ceiling
+        # (resilience.max_merged_lanes) the sortmerge dispatch inside
+        # the shard kernels routes to the XLA bitonic network
+        # (ops/pallas_merge.py:asof_merge_values_bitonic, O(log Lc)
+        # stages — the lax.sort ladder's unrolled network OOM-killed
+        # the compiler at ~205K lanes), governed by the same
+        # TEMPO_TPU_JOIN_ENGINE knob as the host join.  The host-built
+        # lane-chunked layout cannot cross shard_map, so chunked stays
+        # a host-path engine.
+        from tempo_tpu import resilience as _resilience
+
+        _merged_full = int(self.L) + int(right.L)
+        _limit = _resilience.max_merged_lanes()
+        if 0 < _limit < _merged_full:
+            logger.info(
+                "asofJoin(mesh): merged width %d exceeds the "
+                "single-program limit %d — shard-local joins use the "
+                "XLA bitonic oversize engine", _merged_full, _limit,
+            )
         # sequence-number tie-break (tsdf.py:117-121): the reference
         # sorts the merged stream by (combined_ts, RIGHT's sequence col
         # ASC NULLS FIRST, rec_ind).  Left rows carry NULL in the

@@ -192,14 +192,23 @@ def _binpack_worthwhile(l_layout, r_layout) -> bool:
 
 
 def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
-                       valid_cols, max_lookback: int = 0):
+                       valid_cols, max_lookback: int = 0,
+                       r_seq_sorted=None, engine: str = "single",
+                       interpret: bool = False):
     """Join indices through the bin-packed segmented kernel: short
     series share lane rows (packing.bin_pack_series), one program for
     any skew shape.  ``valid_cols`` empty = skipNulls=False (only the
     last-row channel is consumed).  ``max_lookback`` rides the
-    sid-fenced windowed ladder (sortmerge._asof_merge_explicit)."""
+    sid-fenced windowed ladder (sortmerge._asof_merge_explicit) or the
+    chunked streaming kernel.  ``r_seq_sorted`` (layout-ordered right
+    sequence values) engages the tie-break — the layouts were sorted
+    (ts, seq) per series so the segmented merge precondition holds
+    (round-6 lift of the seq x bin-pack exclusion).  ``engine``:
+    'chunked' runs the lane-chunked streaming VMEM kernel (oversize
+    lane-row widths past the single-plan merge)."""
     import jax.numpy as jnp
 
+    from tempo_tpu.ops import pallas_merge as pm
     from tempo_tpu.ops import sortmerge as sm
 
     Wl = packing.pad_length(
@@ -228,11 +237,19 @@ def _binpacked_indices(right, l_layout, r_layout, r_sorted_take,
             dest_r, K2, Wr, False)
         for c in valid_cols
     ]) if valid_cols else np.zeros((0, K2, Wr), bool)
+    rsq = (packing.binpack_scatter(r_seq_sorted, dest_r, K2, Wr, np.inf)
+           if r_seq_sorted is not None else None)
 
-    last_idx, per_col = sm.asof_indices_binpacked(
-        jnp.asarray(lt), jnp.asarray(rt), jnp.asarray(rv),
-        jnp.asarray(lsid), jnp.asarray(rsid),
-        max_lookback=int(max_lookback))
+    if engine == "chunked":
+        last_idx, per_col = pm.asof_merge_indices_chunked(
+            lt, rt, rv, lsid, rsid, r_seq=rsq,
+            max_lookback=int(max_lookback), interpret=interpret)
+    else:
+        last_idx, per_col = sm.asof_indices_binpacked(
+            jnp.asarray(lt), jnp.asarray(rt), jnp.asarray(rv),
+            jnp.asarray(lsid), jnp.asarray(rsid),
+            max_lookback=int(max_lookback),
+            r_seq=jnp.asarray(rsq) if rsq is not None else None)
     return np.asarray(last_idx), np.asarray(per_col), bp
 
 
@@ -359,25 +376,49 @@ def asof_join(
         r_ts_j = r_ts_ns
         r_seq_j = r_seq_vals
 
-    # --- graceful degradation: oversize joins bracket instead of OOM --
-    # Past the merge-plan limit the XLA sort ladder OOM-kills the
-    # compiler (VERDICT missing #1) — reroute to (key, time-bracket)
-    # joint series with exact cross-bracket carries before any device
-    # program sees the full width.
+    # --- oversize engine pick: single-plan -> chunked -> brackets -----
+    # Past the merge-plan limit one device program cannot run: the XLA
+    # sort ladder OOM-kills the compiler at ~205K merged lanes (VERDICT
+    # missing #1).  Since round 6 the default oversize engine is the
+    # lane-chunked streaming VMEM merge (ops/pallas_merge.py) — on-chip
+    # at any length under 2^24 merged rows, every flag combination
+    # including maxLookback.  Host time-bracketing remains the last
+    # resort (non-TPU backends, >= 2^24 rows), selectable explicitly
+    # with TEMPO_TPU_JOIN_ENGINE=bracket.
     auto_bracketed = False
+    join_engine = "single"
     if tsPartitionVal is None and not broadcast_path \
             and len(left.df) and len(right.df):
+        from tempo_tpu.ops import pallas_merge as pm
+
         limit = resilience.max_merged_lanes()
         est = _estimate_merged_lanes(l_codes, r_codes, n_series)
-        if 0 < limit < est:
+        # the availability probe scans the seq column (seq_kernel_form)
+        # — only pay it when the engine decision actually needs it
+        # (oversize, or an explicit TEMPO_TPU_JOIN_ENGINE override)
+        if 0 < limit < est or profiling.join_engine_override():
+            chunked_ok = pm.chunked_join_available(
+                est, len(right_value_cols), r_seq_vals,
+                skip_nulls=skipNulls, max_lookback=int(maxLookback or 0))
+            join_engine = profiling.pick_join_engine(est, limit,
+                                                    chunked_ok)
+        if join_engine == "chunked" and 0 < limit < est:
+            logger.info(
+                "asofJoin: estimated %d merged lanes exceeds the "
+                "single-program limit %d; using the lane-chunked "
+                "streaming merge engine", est, limit,
+            )
+        if join_engine == "bracket":
             if maxLookback and int(maxLookback) > 0:
                 logger.warning(
-                    "asofJoin: estimated %d merged lanes exceeds the "
-                    "merge-plan limit %d, but maxLookback counts rows of "
-                    "the full merged stream and cannot ride the "
-                    "bracketing fallback — attempting the full-size "
-                    "merge (may exhaust compiler memory)", est, limit,
+                    "asofJoin: bracket engine selected (estimated %d "
+                    "merged lanes, limit %d), but maxLookback counts "
+                    "rows of the full merged stream and cannot ride "
+                    "the bracketing fallback — attempting the "
+                    "full-size merge (may exhaust compiler memory)",
+                    est, limit,
                 )
+                join_engine = "single"
             else:
                 carry_cols = right_value_cols if skipNulls else []
                 masks = np.stack([
@@ -396,13 +437,16 @@ def asof_join(
                                if r_seq_vals is not None else None)
                     auto_bracketed = True
                     logger.warning(
-                        "asofJoin: estimated %d merged lanes exceeds the "
-                        "merge-plan limit %d; degrading to the host "
+                        "asofJoin: estimated %d merged lanes vs the "
+                        "merge-plan limit %d; %s the host "
                         "time-bracketing path (%d brackets, width %.0fs, "
                         "%d carried rows). Results are exact but "
                         "execution is slower — deferred audit: oversize "
                         "AS-OF join rerouted instead of compiler OOM.",
-                        est, limit, n_brackets,
+                        est, limit,
+                        ("degrading to" if est > limit
+                         else "TEMPO_TPU_JOIN_ENGINE forced"),
+                        n_brackets,
                         width_ns / packing.NS_PER_S,
                         len(r_take) - len(right.df),
                     )
@@ -418,15 +462,19 @@ def asof_join(
     # the series bin-pack into shared lane rows and the segmented merge
     # kernel joins them independently (the packed-layout answer to the
     # reference's tsPartitionVal skew machinery, tsdf.py:164-190 —
-    # which remains available explicitly).  The sequence tie-break,
-    # skew brackets, and broadcast paths keep the dense layout (the
-    # bin-pack layout sorts by ts only, so a seq-ordered merge
-    # precondition would not hold); maxLookback rides the sid-fenced
-    # windowed ladder since round 4.
+    # which remains available explicitly).  Skew brackets and the
+    # broadcast path keep the dense layout; a sequence tie-break rides
+    # the bin-packed layout too since round 6 (the layouts sort
+    # (ts, seq) per series when a seq plane is present, so the
+    # segmented merge precondition holds); maxLookback rides the
+    # sid-fenced windowed ladder (round 4) or the chunked streaming
+    # kernel (round 6).
+    import jax as _jax
+
+    interp_chunked = _jax.default_backend() != "tpu"
     use_binpack = (
         not broadcast_path
         and tsPartitionVal is None
-        and r_seq_j is None
         and n_series > 1
         and _binpack_worthwhile(l_layout, r_layout)
     )
@@ -435,6 +483,9 @@ def asof_join(
             right, l_layout, r_layout, r_sorted_take,
             right_value_cols if skipNulls else [],
             max_lookback=int(maxLookback or 0),
+            r_seq_sorted=(r_seq_j[r_layout.order]
+                          if r_seq_j is not None else None),
+            engine=join_engine, interpret=interp_chunked,
         )
         keep_mask_packed = None
     else:
@@ -460,6 +511,13 @@ def asof_join(
 
     # --- kernel dispatch ----------------------------------------------
     use_merge = strategy == "merge"
+    r_seq_packed = (
+        packing.pack_column(
+            r_seq_j[r_layout.order], r_layout, Lr, fill=np.inf
+        )
+        if r_seq_j is not None and not use_binpack and not broadcast_path
+        else None
+    )
     if use_binpack:
         pass
     elif broadcast_path:
@@ -467,14 +525,18 @@ def asof_join(
         last_row_idx = np.asarray(idx)
         per_col_idx = None  # broadcast path is row-level, nulls included
         keep_mask_packed = np.asarray(matched)
-    elif use_merge:
-        r_seq_packed = (
-            packing.pack_column(
-                r_seq_j[r_layout.order], r_layout, Lr, fill=np.inf
-            )
-            if r_seq_j is not None
-            else None
+    elif join_engine == "chunked":
+        from tempo_tpu.ops import pallas_merge as pm
+
+        last_row_idx, per_col_idx = pm.asof_merge_indices_chunked(
+            l_ts_p, r_ts_p, r_valids, r_seq=r_seq_packed,
+            max_lookback=int(maxLookback or 0),
+            interpret=interp_chunked,
         )
+        last_row_idx = np.asarray(last_row_idx)
+        per_col_idx = np.asarray(per_col_idx)
+        keep_mask_packed = None
+    elif use_merge:
         last_row_idx, per_col_idx = asof_ops.asof_indices_merge(
             l_ts_p, None, r_ts_p, r_seq_packed, r_valids,
             n_cols=len(right_value_cols), max_lookback=int(maxLookback),

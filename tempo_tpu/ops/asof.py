@@ -112,7 +112,11 @@ def asof_indices_merge(
     (ops/pallas_merge.py, round 4) — the XLA form below pays a
     dynamic-gather per column, each costing more than a full lane sort
     on this hardware (ops/sortmerge.py module docstring timings).
-    ``maxLookback`` keeps the XLA windowed-argmax ladder.
+    ``maxLookback`` keeps the XLA windowed-argmax ladder here; the
+    host join reroutes oversize and maxLookback-capped joins to the
+    lane-chunked streaming kernel instead
+    (pallas_merge.asof_merge_indices_chunked, dispatched by join.py
+    via profiling.pick_join_engine).
     """
     from tempo_tpu.ops import pallas_merge as pm
 

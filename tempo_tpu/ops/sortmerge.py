@@ -149,9 +149,20 @@ def asof_merge_values(
         r_seq_k = pm.seq_kernel_form(r_seq)
         expressible = (l_seq is None or l_seq_k is not None) and \
             (r_seq is None or r_seq_k is not None)
-        if expressible and pm.merge_join_supported(
+        if expressible and not _forced_bitonic() \
+                and pm.merge_join_supported(
                 l_ts, r_ts, r_values, l_seq_k, r_seq_k, skip_nulls):
             return pm.asof_merge_values_pallas(
+                l_ts, r_ts, r_valids, r_values, l_seq=l_seq_k,
+                r_seq=r_seq_k, skip_nulls=skip_nulls,
+            )
+        if expressible and _oversize_bitonic(l_ts, r_ts, r_values,
+                                             l_seq_k, r_seq_k):
+            # past the lax.sort compiler ceiling (and the VMEM plan):
+            # the XLA bitonic network joins at O(log Lc) full-array
+            # stages instead of O(log^2), tracer-safe — the per-shard
+            # oversize engine of the mesh paths (dist.py, parallel/halo)
+            return pm.asof_merge_values_bitonic(
                 l_ts, r_ts, r_valids, r_values, l_seq=l_seq_k,
                 r_seq=r_seq_k, skip_nulls=skip_nulls,
             )
@@ -163,6 +174,34 @@ def asof_merge_values(
     return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
                                 l_seq, r_seq, skip_nulls=skip_nulls,
                                 max_lookback=int(max_lookback))
+
+
+def _forced_bitonic() -> bool:
+    from tempo_tpu import profiling
+
+    return profiling.join_engine_override() == "bitonic"
+
+
+def _oversize_bitonic(l_ts, r_ts, r_values, l_seq, r_seq) -> bool:
+    """Whether the merged width sits in the regime where the lax.sort
+    ladders OOM-kill the XLA compiler (~205K merged lanes, BASELINE.md
+    r3) and the f32 bitonic network should run instead.  Forced on/off
+    by TEMPO_TPU_JOIN_ENGINE=bitonic / single|bracket (the forced form
+    also suppresses the single-plan Pallas branch at the call sites —
+    the knob must measure the engine it names)."""
+    from tempo_tpu import profiling, resilience
+    from tempo_tpu.ops import pallas_merge as pm
+
+    if not pm.merge_join_bitonic_supported(l_ts, r_ts, r_values,
+                                           l_seq, r_seq):
+        return False
+    forced = profiling.join_engine_override()
+    if forced == "bitonic":
+        return True
+    if forced in ("single", "bracket"):
+        return False
+    limit = resilience.max_merged_lanes()
+    return 0 < limit < int(l_ts.shape[-1]) + int(r_ts.shape[-1])
 
 
 def _merge_sides(l_ts, r_ts, l_seq, r_seq):
@@ -330,35 +369,51 @@ def _asof_merge_explicit(l_ts, r_ts, r_valids, r_values, l_seq=None,
 
 def asof_merge_values_binpacked(l_ts, r_ts, r_valids, r_values,
                                 l_sid, r_sid, skip_nulls: bool = True,
-                                max_lookback: int = 0):
+                                max_lookback: int = 0,
+                                l_seq=None, r_seq=None):
     """AS-OF join over *bin-packed* rows: each [K, L] lane row holds
     several series back-to-back, identified by the non-decreasing
     ``sid`` planes (packing.py:bin_pack_series).  Right rows win full
     ties — the same contract as :func:`asof_merge_values` including
-    ``skip_nulls`` and the ``max_lookback`` merged-row cap (both fenced
-    at series boundaries), with ``last_row_idx`` a within-lane-row
-    position.  The TPU answer to Zipf-skewed key distributions (the
-    reference's tsPartitionVal machinery, tsdf.py:164-190): instead of
-    padding every series to the longest (96% padding on NBBO-shaped
-    data, round-2 verdict), short series share lane rows at ~full
-    occupancy and one compiled program serves every skew shape.
+    ``skip_nulls``, the ``max_lookback`` merged-row cap (both fenced
+    at series boundaries) and, since round 6, the sequence tie-break
+    (REQUIRES the packed runs sorted by (ts, seq) per series — what
+    join.py's layouts guarantee when a seq plane is packed), with
+    ``last_row_idx`` a within-lane-row position.  The TPU answer to
+    Zipf-skewed key distributions (the reference's tsPartitionVal
+    machinery, tsdf.py:164-190): instead of padding every series to
+    the longest (96% padding on NBBO-shaped data, round-2 verdict),
+    short series share lane rows at ~full occupancy and one compiled
+    program serves every skew shape.
     """
     from tempo_tpu.ops import pallas_merge as pm
 
-    if not max_lookback and pm.merge_join_supported(
-            l_ts, r_ts, r_values, None, None, skip_nulls,
+    l_seq_k = pm.seq_kernel_form(l_seq)
+    r_seq_k = pm.seq_kernel_form(r_seq)
+    expressible = (l_seq is None or l_seq_k is not None) and \
+        (r_seq is None or r_seq_k is not None)
+    if not max_lookback and expressible and not _forced_bitonic() \
+            and pm.merge_join_supported(
+            l_ts, r_ts, r_values, l_seq_k, r_seq_k, skip_nulls,
             segmented=True):
         return pm.asof_merge_values_pallas(l_ts, r_ts, r_valids,
                                            r_values, l_sid, r_sid,
+                                           l_seq=l_seq_k, r_seq=r_seq_k,
                                            skip_nulls=skip_nulls)
+    if not max_lookback and expressible and _oversize_bitonic(
+            l_ts, r_ts, r_values, l_seq_k, r_seq_k):
+        return pm.asof_merge_values_bitonic(
+            l_ts, r_ts, r_valids, r_values, l_sid, r_sid,
+            l_seq=l_seq_k, r_seq=r_seq_k, skip_nulls=skip_nulls)
     return _asof_merge_explicit(l_ts, r_ts, r_valids, r_values,
+                                l_seq=l_seq, r_seq=r_seq,
                                 l_sid=l_sid, r_sid=r_sid,
                                 skip_nulls=skip_nulls,
                                 max_lookback=int(max_lookback))
 
 
 def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid,
-                           max_lookback: int = 0):
+                           max_lookback: int = 0, r_seq=None):
     """Index-returning bin-packed join: same layout contract as
     :func:`asof_merge_values_binpacked`, position-encoded payloads.
     Returns ``(last_row_idx, per_col_idx)`` as WITHIN-LANE-ROW
@@ -370,7 +425,7 @@ def asof_indices_binpacked(l_ts, r_ts, r_valids, l_sid, r_sid,
     planes = jnp.broadcast_to(pos[None], (C, K, Lr))
     vals, found, last_idx = asof_merge_values_binpacked(
         l_ts, r_ts, r_valids, planes, l_sid, r_sid,
-        max_lookback=max_lookback,
+        max_lookback=max_lookback, r_seq=r_seq,
     )
     per_col = jnp.where(found, vals, -1).astype(jnp.int32)
     return last_idx, per_col
@@ -401,8 +456,12 @@ def asof_merge_indices(l_ts, r_ts, r_valids):
     ``l_ts`` ascending per row (the packed-layout invariant)."""
     from tempo_tpu.ops import pallas_merge as pm
 
-    if pm.merge_indices_supported(l_ts, r_ts, r_valids):
+    if not _forced_bitonic() and pm.merge_indices_supported(
+            l_ts, r_ts, r_valids):
         return pm.asof_merge_indices_pallas(l_ts, r_ts, r_valids)
+    if _oversize_bitonic(l_ts, r_ts,
+                         jnp.zeros((0,), jnp.float32), None, None):
+        return pm.asof_merge_indices_bitonic(l_ts, r_ts, r_valids)
     return _asof_merge_indices_xla(l_ts, r_ts, r_valids)
 
 

@@ -504,6 +504,172 @@ def binpack_sid(
     return out
 
 
+# ----------------------------------------------------------------------
+# Lane-chunked AS-OF layout (the streaming merge kernel's host planner)
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AsofChunkPlan:
+    """Merge-path split of packed AS-OF sides into VMEM-sized chunks.
+
+    The streaming merge kernel (ops/pallas_merge.py chunked form) grids
+    over the merged-lane axis: chunk ``c`` of a lane row holds merged
+    rows [c*S, (c+1)*S) of that row's (ts [, seq], side) total order —
+    the exact split points are per-row data, so the host computes them
+    once (numpy searchsorted over the already-sorted packed sides, the
+    same cost class as the packing itself) and scatters both sides into
+    a ``[K, n_chunks * Cm]`` chunk-major layout, ``Cm = 2 * S`` lanes
+    per chunk: ``[left rows (<= S, ascending) | reversed right rows
+    (<= S)]`` — a bitonic sequence per chunk, like the single-plan
+    layout per full row.  Greedy packing guarantees every chunk before
+    a non-empty one is full, so a real slot's global merged position is
+    ``c * S + lane`` (what the maxLookback horizon counts).
+
+    ``l_dest``/``r_dest`` are lane destinations inside [K, n_chunks*Cm]
+    (-1 at padding); ``l_out`` the destination inside the kernel's
+    [K, n_chunks*S] output; ``r_pos`` each right row's global merged
+    position (the psrc planes of the maxLookback form);
+    ``chunk_pad_sid`` the per-(row, chunk) series id given to pad
+    lanes so the segmented fill flows into the chunk tail and the
+    cross-chunk carry can be read at the last lane (SID_PAD when the
+    chunk is empty)."""
+
+    n_chunks: int
+    chunk_rows: int                 # S = real merged rows per full chunk
+    merged_lanes: int               # Cm = 2 * S (power of two)
+    l_dest: np.ndarray              # [K, Ll] int64, -1 pads
+    r_dest: np.ndarray              # [K, Lr] int64, -1 pads
+    l_out: np.ndarray               # [K, Ll] int64, -1 pads
+    r_pos: np.ndarray               # [K, Lr] int64, -1 pads
+    chunk_pad_sid: Optional[np.ndarray]   # [K, n_chunks] int32 or None
+
+
+def _seq_merge_sides_np(l_seq, r_seq, K, Ll, Lr):
+    """Numpy mirror of the kernels' ``_seq_sides`` synthesis: the None
+    side rides the promoted dtype's minimum (above the -inf null-seq
+    encoding, below any real value — Spark ASC NULLS FIRST + rec_ind)."""
+    sdt = (l_seq if l_seq is not None else r_seq).dtype
+    neg = (np.finfo(sdt).min if np.issubdtype(sdt, np.floating)
+           else np.iinfo(sdt).min)
+    ls = l_seq if l_seq is not None else np.full((K, Ll), neg, sdt)
+    rs = r_seq if r_seq is not None else np.full((K, Lr), neg, sdt)
+    pdt = np.promote_types(ls.dtype, rs.dtype)
+    return ls.astype(pdt), rs.astype(pdt)
+
+
+def asof_chunk_plan(
+    l_ts: np.ndarray,               # [K, Ll] int64 ns, TS_PAD padded
+    r_ts: np.ndarray,               # [K, Lr] int64 ns
+    merged_lanes: int,              # Cm (power of two); S = Cm // 2
+    l_sid: Optional[np.ndarray] = None,
+    r_sid: Optional[np.ndarray] = None,
+    l_seq: Optional[np.ndarray] = None,
+    r_seq: Optional[np.ndarray] = None,
+) -> AsofChunkPlan:
+    """Split packed AS-OF sides along each row's merged stream.
+
+    REQUIRES the packed-layout invariant (real rows lead, ascending in
+    (sid?, ts, seq); TS_PAD tails).  The merged order replicated here —
+    lexicographic (sid?, ts, seq, side) with right rows before left on
+    full ties, stable within a side — must match the kernels' key-plane
+    order exactly or chunk boundaries would disagree with the fill."""
+    K, Ll = l_ts.shape
+    Lr = r_ts.shape[1]
+    Cm = int(merged_lanes)
+    if Cm < 2 or Cm & (Cm - 1):
+        raise ValueError(f"merged_lanes must be a power of two, got {Cm}")
+    S = Cm // 2
+    segmented = l_sid is not None
+    if l_seq is not None or r_seq is not None:
+        l_seq, r_seq = _seq_merge_sides_np(
+            np.asarray(l_seq) if l_seq is not None else None,
+            np.asarray(r_seq) if r_seq is not None else None, K, Ll, Lr)
+
+    l_real = np.asarray(l_ts) < TS_REAL_MAX
+    r_real = np.asarray(r_ts) < TS_REAL_MAX
+    l_counts = l_real.sum(axis=1)
+    r_counts = r_real.sum(axis=1)
+    n_chunks = max(int(-(-int((l_counts + r_counts).max(initial=0)) // S)),
+                   1)
+
+    l_dest = np.full((K, Ll), -1, np.int64)
+    r_dest = np.full((K, Lr), -1, np.int64)
+    l_out = np.full((K, Ll), -1, np.int64)
+    r_pos = np.full((K, Lr), -1, np.int64)
+    pad_sid = (np.full((K, n_chunks), -1, np.int64) if segmented else None)
+
+    for k in range(K):
+        nl, nr = int(l_counts[k]), int(r_counts[k])
+        n = nl + nr
+        if n == 0:
+            continue
+        ts = np.concatenate([l_ts[k, :nl], r_ts[k, :nr]])
+        side = np.concatenate([np.ones(nl, np.int8), np.zeros(nr, np.int8)])
+        lex = [side]
+        if l_seq is not None:
+            lex.append(np.concatenate([l_seq[k, :nl], r_seq[k, :nr]]))
+        lex.append(ts)
+        if segmented:
+            lex.append(np.concatenate([l_sid[k, :nl], r_sid[k, :nr]]))
+        order = np.lexsort(tuple(lex))
+        mpos = np.empty(n, np.int64)
+        mpos[order] = np.arange(n, dtype=np.int64)
+        l_mpos, r_mpos = mpos[:nl], mpos[nl:]
+
+        lc = l_mpos // S
+        rc = r_mpos // S
+        # within-chunk per-side rank: both sides' mpos are ascending
+        # (each side was sorted and the merge is stable), so the first
+        # same-side row of a chunk is one searchsorted away
+        l_rank = np.arange(nl) - np.searchsorted(l_mpos, lc * S)
+        r_rank = np.arange(nr) - np.searchsorted(r_mpos, rc * S)
+        l_dest[k, :nl] = lc * Cm + l_rank
+        # the right part sits reversed at the chunk tail (the bitonic
+        # [ascending | descending] precondition): ascending rank j
+        # lands at offset S + (S - 1 - j)
+        r_dest[k, :nr] = rc * Cm + (2 * S - 1 - r_rank)
+        l_out[k, :nl] = lc * S + l_rank
+        r_pos[k, :nr] = r_mpos
+        if segmented:
+            sid_sorted = np.concatenate(
+                [l_sid[k, :nl], r_sid[k, :nr]])[order]
+            np.maximum.at(pad_sid[k], np.arange(n, dtype=np.int64) // S,
+                          sid_sorted.astype(np.int64))
+
+    if segmented:
+        pad_sid = np.where(pad_sid < 0, np.int64(SID_PAD),
+                           pad_sid).astype(np.int32)
+    return AsofChunkPlan(
+        n_chunks=n_chunks, chunk_rows=S, merged_lanes=Cm,
+        l_dest=l_dest, r_dest=r_dest, l_out=l_out, r_pos=r_pos,
+        chunk_pad_sid=pad_sid,
+    )
+
+
+def chunk_scatter(src: np.ndarray, dest: np.ndarray, width: int, fill,
+                  dtype=None) -> np.ndarray:
+    """Scatter per-row source lanes into the [K, width] chunked layout
+    (``dest`` from :func:`asof_chunk_plan`, -1 entries dropped)."""
+    K = src.shape[0]
+    out = np.full((K, width), fill, dtype=dtype or src.dtype)
+    rows = np.broadcast_to(np.arange(K)[:, None], dest.shape)
+    m = dest >= 0
+    out[rows[m], dest[m]] = src[m]
+    return out
+
+
+def chunk_gather(plane: np.ndarray, dest: np.ndarray, fill,
+                 dtype=None) -> np.ndarray:
+    """Inverse of :func:`chunk_scatter` for kernel outputs: read each
+    real lane's chunked destination back into the packed [K, L] form."""
+    K = dest.shape[0]
+    out = np.full(dest.shape, fill, dtype=dtype or plane.dtype)
+    rows = np.broadcast_to(np.arange(K)[:, None], dest.shape)
+    m = dest >= 0
+    out[m] = plane[rows[m], dest[m]]
+    return out
+
+
 def unpack_ragged(
     packed: np.ndarray, lengths: np.ndarray
 ) -> Tuple[np.ndarray, np.ndarray]:

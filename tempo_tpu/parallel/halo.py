@@ -333,7 +333,15 @@ def _build_asof(mesh: Mesh, halo: int, time_axis: str, series_axis: str,
 
         if sort_kernels:
             # gather-free shard-local join (the value gather below is
-            # the single most expensive op on TPU — sortmerge.py)
+            # the single most expensive op on TPU — sortmerge.py).
+            # Engine cascade per shard (round 6): single-plan VMEM
+            # merge when the halo-extended width fits its plan, the
+            # XLA bitonic network past the single-program ceiling —
+            # so a time-sharded join whose SHARD width exceeds ~205K
+            # merged lanes no longer OOMs the compiler; the time
+            # sharding itself is the distributed form of lane
+            # chunking (shard = chunk, the cross-shard carry below =
+            # the chunked kernel's carried ffill state).
             from tempo_tpu.ops import sortmerge as sm
 
             vals, found, last_idx = sm.asof_merge_values(

@@ -134,6 +134,108 @@ def window_roofline(
     return out
 
 
+def join_engine_override() -> Optional[str]:
+    """``TEMPO_TPU_JOIN_ENGINE``: force one AS-OF merge engine —
+    ``single`` (the one-shot VMEM plan; expert, may exceed the
+    compiler ceiling), ``chunked`` (the lane-chunked streaming VMEM
+    kernel), ``bracket`` (legacy host time-bracketing), or ``bitonic``
+    (the XLA log-stage network, the tracer-context oversize engine).
+    Unset/unknown = auto."""
+    import os
+
+    env = os.environ.get("TEMPO_TPU_JOIN_ENGINE", "").strip().lower()
+    if env == "vmem":
+        env = "single"
+    return env if env in ("single", "chunked", "bracket", "bitonic") \
+        else None
+
+
+def pick_join_engine(est_lanes: int, limit: int,
+                     chunked_ok: bool) -> str:
+    """'single' | 'chunked' | 'bracket' — the three-way oversize
+    decision of the host AS-OF join (join.py):
+
+    * ``single``: the estimated merged-lane width fits one device
+      program (the single-shot VMEM merge plan, or the XLA ladders
+      under the measured ~205K-lane compiler ceiling,
+      resilience.max_merged_lanes);
+    * ``chunked``: past the ceiling, the lane-chunked streaming VMEM
+      kernel (ops/pallas_merge.py) joins on-chip at any length — the
+      default oversize engine since round 6;
+    * ``bracket``: host time-bracketing with exact carries — the last
+      resort when the streaming engine cannot run (non-TPU backend,
+      >= 2^24 merged rows).
+
+    ``TEMPO_TPU_JOIN_ENGINE`` forces a specific engine (the
+    ``bitonic`` value is a device-dispatch knob — the host path treats
+    it as ``single`` and the sortmerge layer routes to the XLA bitonic
+    network)."""
+    forced = join_engine_override()
+    if forced == "bitonic":
+        return "single"
+    if forced is not None:
+        return forced
+    if limit <= 0 or est_lanes <= limit:
+        return "single"
+    return "chunked" if chunked_ok else "bracket"
+
+
+_COLLECTIVE_OPS = ("collective-permute", "all-to-all", "all-gather",
+                   "all-reduce")
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8,
+                "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1}
+
+
+def comm_bytes_from_compiled(compiled) -> Dict[str, int]:
+    """Per-kind ICI/DCN communication bytes of a compiled program, read
+    from its optimized HLO: every collective instruction's result shape
+    (per-shard, SPMD) summed by op kind.  The measured side of the
+    dryrun's ``comm_bytes=model:measured`` audit — XLA's
+    ``cost_analysis`` does not break out collective traffic, the HLO
+    does."""
+    import re
+
+    text = compiled.as_text()
+    out: Dict[str, int] = {}
+    # e.g.  %all-to-all.1 = f32[4,16]{1,0} all-to-all(...)
+    #       ROOT %cp = (f32[2,4]{...}, u32[]) collective-permute(...)
+    # Async decompositions count at the '-done' op (its result IS the
+    # received data; the '-start' result is a bundle whose tuple would
+    # double-count the operand).
+    shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = re.match(r"^(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$", stripped)
+        if not m:
+            continue
+        rhs = m.group(1)
+        kind = op = None
+        for k in _COLLECTIVE_OPS:
+            for suffix in ("", "-done"):
+                if re.search(rf"\b{k}{suffix}\(", rhs):
+                    kind, op = k, k + suffix
+                    break
+            if kind:
+                break
+        if kind is None:
+            continue
+        # result type is everything before the op name: one shape, or a
+        # tuple of shapes
+        type_part = rhs.split(op + "(")[0]
+        nbytes = 0
+        for dt, dims in shape_re.findall(type_part):
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES[dt]
+        out[kind] = out.get(kind, 0) + nbytes
+    return out
+
+
 def host_bytes(df: pd.DataFrame) -> int:
     """Driver-side in-memory size of a frame — the packed-columnar analog
     of the reference's ``explain cost`` sizeInBytes scrape."""
@@ -156,7 +258,12 @@ def pick_asof_strategy(
     kernel has no row cap, and Scala — the source of maxLookback
     (asofJoin.scala:64-88) — has no broadcast path to mirror, so
     honouring the cap is the only semantics-preserving choice
-    (ADVICE r3: the old order silently dropped the cap)."""
+    (ADVICE r3: the old order silently dropped the cap).
+
+    This picks the *algorithm*; the orthogonal oversize *engine*
+    decision (single-plan VMEM / lane-chunked streaming / host
+    brackets) is :func:`pick_join_engine`, consulted by join.py once
+    the merged-lane estimate is known."""
     if max_lookback and max_lookback > 0:
         if sql_join_opt:
             logger.warning(

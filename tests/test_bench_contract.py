@@ -41,6 +41,8 @@ def test_bench_smoke_prints_one_json_line():
         "1_quickstart_asof", "2_range_stats_10s", "3_resample_ema",
         "4_nbbo_skew_asof", "5_skew_1b_bracketed",
         "2b_range_stats_dense_50hz", "6_seq_tiebreak_asof",
+        "7_frame_e2e_pipeline", "8_chunked_205k_k128",
+        "9_chunked_1m_single",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards

@@ -327,9 +327,10 @@ def test_gate_on_forced_tpu_backend(monkeypatch):
     # bitcast 64-bit) — dispatchers re-encode via seq_kernel_form first
     assert not merge_join_supported(l_ts, r_ts, vals32, None, seq64,
                                     True)
-    # segmented excludes seq (bin-pack layout sorts by ts only)
-    assert not merge_join_supported(l_ts, r_ts, vals32, None, seq32,
-                                    True, segmented=True)
+    # round 6: segmented combines with seq (bin-pack layouts sort
+    # (ts, seq) per series when a seq plane is packed — join.py)
+    assert merge_join_supported(l_ts, r_ts, vals32, None, seq32,
+                                True, segmented=True)
     assert merge_join_supported(l_ts, r_ts, vals32, None, None, False,
                                 segmented=True)
 
