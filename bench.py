@@ -1472,8 +1472,16 @@ def main():
             "windowed_rows_per_sec_at_50hz": round(at50["windowed"]),
             "streaming_rows_per_sec_at_50hz": round(at50["streaming"]),
             "shifted_max_behind": (shifted_med or {}).get("max_behind"),
-            "winner_at_10hz": max(at10, key=at10.get),
-            "winner_at_50hz": max(at50, key=at50.get),
+            # a crashed/absent child contributes 0 rows/s — it is
+            # unmeasured, not a crossover loser; never crown a winner
+            # from zeros (the record retunes SHIFTED_MAX_ROWS /
+            # TEMPO_TPU_STREAM_MAX_ROWS, so a fake winner misleads)
+            "winner_at_10hz": max(
+                (k for k, v in at10.items() if v),
+                key=at10.get, default=None),
+            "winner_at_50hz": max(
+                (k for k, v in at50.items() if v),
+                key=at50.get, default=None),
         }
 
     t_iters = {

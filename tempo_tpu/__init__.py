@@ -13,10 +13,12 @@ Public surface mirrors the reference: ``TSDF`` plus ``display``
 
 import os as _os
 
+from tempo_tpu import config as _config
+
 # capture the platform the user asked for BEFORE importing jax: device
 # plugins may rewrite JAX_PLATFORMS during jax import, which would
 # silently retarget e.g. an explicitly requested CPU run
-_requested_platform = _os.environ.get("JAX_PLATFORMS")
+_requested_platform = _config.env_external("JAX_PLATFORMS")
 
 import jax
 
@@ -38,7 +40,7 @@ if _requested_platform and jax.config.jax_platforms != _requested_platform:
 # XLA time; caching makes every process after the first start warm.
 # Opt out with TEMPO_TPU_CACHE_DIR="" or pre-set jax_compilation_cache_dir.
 if jax.config.jax_compilation_cache_dir is None:
-    _cache_dir = _os.environ.get(
+    _cache_dir = _config.get(
         "TEMPO_TPU_CACHE_DIR",
         _os.path.join(_os.path.expanduser("~"), ".cache", "tempo_tpu", "jax"),
     )

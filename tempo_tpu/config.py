@@ -1,0 +1,152 @@
+"""Central registry of every ``TEMPO_TPU_*`` environment knob.
+
+The knobs grew one module at a time (each engine added its own
+override) and by round 6 two of them (``TEMPO_TPU_WAREHOUSE``,
+``TEMPO_TPU_BINPACK``) had silently drifted out of BUILDING.md's knob
+table.  This module is the single source of truth: every knob the
+package reads is declared here with its type, default, owning module
+and one-line contract, and *all* ``os.environ`` access inside
+``tempo_tpu/`` goes through the accessors below.  The static analyzer
+(``tools/analysis`` — the ``env-knobs`` rule) enforces both halves:
+
+* ``os.environ`` / ``os.getenv`` anywhere in ``tempo_tpu/`` outside
+  this file is a lint violation;
+* the registry, the ``TEMPO_TPU_*`` string literals in the code, and
+  BUILDING.md's knob table must agree exactly (no undeclared reads, no
+  dead documentation).
+
+Keep this module import-light (stdlib ``os`` only): it is imported by
+``tempo_tpu/__init__`` *before* jax, while the process environment is
+still being inspected.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, NamedTuple, Optional
+
+
+class Knob(NamedTuple):
+    """One declared environment knob.
+
+    ``type`` is documentation-grade ("bool", "int", "enum(...)",
+    "path", "dtype"): the owning modules keep their historical parsing
+    (tri-state bools, backend-dependent defaults), so the registry
+    records intent rather than re-implementing coercion.  ``default``
+    is the rendered default shown to humans; ``None`` means
+    "unset = automatic choice"."""
+
+    name: str
+    type: str
+    default: Optional[str]
+    owner: str
+    doc: str
+
+
+def _knobs(*knobs: Knob) -> Dict[str, Knob]:
+    return {k.name: k for k in knobs}
+
+
+#: Every TEMPO_TPU_* knob the codebase reads, in BUILDING.md table
+#: order.  Adding an ``os.environ`` read without declaring it here
+#: fails ``python tools/analyze.py`` (env-knobs rule).
+KNOBS: Dict[str, Knob] = _knobs(
+    Knob("TEMPO_TPU_NATIVE", "bool", "1", "tempo_tpu/native",
+         "0 forces the pure-numpy ingest path over the self-built C++ "
+         "packer"),
+    Knob("TEMPO_TPU_NATIVE_THREADS", "int", "cpu_count", "tempo_tpu/native",
+         "thread-pool bound for the native packer"),
+    Knob("TEMPO_TPU_COMPUTE_DTYPE", "dtype", None, "tempo_tpu/packing",
+         "float64|float32 override of the per-backend metric-math "
+         "dtype policy"),
+    Knob("TEMPO_TPU_CACHE_DIR", "path", "~/.cache/tempo_tpu/jax",
+         "tempo_tpu/__init__",
+         "persistent XLA compilation cache location; empty disables"),
+    Knob("TEMPO_TPU_SORT_KERNELS", "bool", None, "tempo_tpu/ops/sortmerge",
+         "force/forbid the sort-and-scan kernel forms (default: on for "
+         "TPU, off elsewhere)"),
+    Knob("TEMPO_TPU_PALLAS_ASOF", "bool", "1", "tempo_tpu/ops/pallas_merge",
+         "0 kills the VMEM merge-join kernels"),
+    Knob("TEMPO_TPU_NAN_ASOF", "bool", "0", "tempo_tpu/ops/sortmerge",
+         "opt into the NaN-encoded XLA AS-OF variant"),
+    Knob("TEMPO_TPU_WINDOW_ENGINE", "enum(auto|shifted|stream|windowed|legacy)",
+         "auto", "tempo_tpu/ops/rolling",
+         "force one of the rolling range-stats engines"),
+    Knob("TEMPO_TPU_STREAM_MAX_ROWS", "int", "16384",
+         "tempo_tpu/ops/pallas_window",
+         "row-extent ceiling of the streaming window engine"),
+    Knob("TEMPO_TPU_STRICT_SQL", "bool", "0", "tempo_tpu/frame",
+         "make selectExpr/filter re-raise instead of falling back to "
+         "pandas eval/query"),
+    Knob("TEMPO_TPU_JOIN_ENGINE", "enum(single|chunked|bracket|bitonic)",
+         None, "tempo_tpu/profiling",
+         "force one AS-OF merge engine; unset = auto"),
+    Knob("TEMPO_TPU_JOIN_CHUNK_LANES", "int", None,
+         "tempo_tpu/ops/pallas_merge",
+         "merged-lane chunk width of the streaming join engine "
+         "(power of two >= 256); unset = largest feasible"),
+    Knob("TEMPO_TPU_MAX_MERGED_LANES", "int", "196608",
+         "tempo_tpu/resilience",
+         "single-program merged-lane ceiling (under the measured ~205K "
+         "XLA-sort compiler OOM)"),
+    Knob("TEMPO_TPU_BINPACK", "bool", None, "tempo_tpu/join",
+         "force/forbid the bin-packed (segmented) join layout; unset = "
+         "engage below 0.35 slot occupancy"),
+    Knob("TEMPO_TPU_WAREHOUSE", "path", "tempo_tpu_warehouse",
+         "tempo_tpu/io/writer",
+         "base directory of the partitioned Parquet/Delta warehouse"),
+    Knob("TEMPO_TPU_NO_STDERR_FILTER", "bool", "0", "__graft_entry__",
+         "1 disables the benign XLA:CPU AOT stderr filter of the "
+         "multichip dryrun"),
+)
+
+#: Non-TEMPO_TPU environment variables the package legitimately reads
+#: (foreign contracts: jax's platform selection, Databricks runtime
+#: detection).  ``env_external`` refuses anything not listed, so new
+#: foreign reads are declared here or fail loudly.
+EXTERNAL_VARS = (
+    "JAX_PLATFORMS",
+    "DATABRICKS_RUNTIME_VERSION",
+)
+
+
+def get(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw string value of a *declared* knob (``KeyError`` on an
+    undeclared name — declare it in :data:`KNOBS` first).  ``None``
+    when unset and no ``default`` given; owning modules keep their
+    historical parsing on top of this."""
+    if name not in KNOBS:
+        raise KeyError(
+            f"undeclared knob {name!r}: add it to tempo_tpu.config.KNOBS "
+            f"(and BUILDING.md's knob table) before reading it")
+    return os.environ.get(name, default)
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    """Common falsy-string parse: unset/''/'0'/'false'/'no'/'off' →
+    False-ish side of ``default``; anything else → True.  Knobs with
+    tri-state semantics (forced on / forced off / auto) read
+    :func:`get` and decide themselves."""
+    val = get(name)
+    if val is None or val.strip().lower() in ("", "0", "false", "no", "off"):
+        return False if val is not None else default
+    return True
+
+
+def get_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    """Integer knob; unset or empty → ``default``."""
+    val = get(name)
+    if val is None or not val.strip():
+        return default
+    return int(val)
+
+
+def env_external(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Sanctioned read of a non-``TEMPO_TPU`` environment variable
+    (:data:`EXTERNAL_VARS`); the env-knobs lint bans direct
+    ``os.environ`` use everywhere else in the package."""
+    if name not in EXTERNAL_VARS:
+        raise KeyError(
+            f"{name!r} is not a declared external env var: add it to "
+            f"tempo_tpu.config.EXTERNAL_VARS")
+    return os.environ.get(name, default)

@@ -42,10 +42,9 @@ def _strict_sql(strict: Optional[bool]) -> bool:
     else the TEMPO_TPU_STRICT_SQL env default (off)."""
     if strict is not None:
         return bool(strict)
-    import os
+    from tempo_tpu import config
 
-    val = os.environ.get("TEMPO_TPU_STRICT_SQL", "").strip().lower()
-    return val not in ("", "0", "false", "no", "off")
+    return config.get_bool("TEMPO_TPU_STRICT_SQL")
 
 
 def _split_alias(raw: str):

@@ -28,7 +28,9 @@ DEFAULT_WAREHOUSE = "tempo_tpu_warehouse"
 
 
 def _table_path(tab_name: str, base_dir: Optional[str]) -> str:
-    base = base_dir or os.environ.get(WAREHOUSE_ENV, DEFAULT_WAREHOUSE)
+    from tempo_tpu import config
+
+    base = base_dir or config.get(WAREHOUSE_ENV, DEFAULT_WAREHOUSE)
     return os.path.join(base, tab_name)
 
 

@@ -171,7 +171,7 @@ def _binpack_worthwhile(l_layout, r_layout) -> bool:
     """Engage the bin-packed layout when one-series-per-row padding
     would waste most of the slot grid (Zipf-skewed key distributions).
     TEMPO_TPU_BINPACK=1/0 forces/forbids."""
-    import os
+    from tempo_tpu import config
 
     K = l_layout.n_series
     Ll = int(l_layout.lengths.max(initial=0))
@@ -182,7 +182,7 @@ def _binpack_worthwhile(l_layout, r_layout) -> bool:
     # stay far below 2^31)
     if max(Ll, Lr) >= (1 << 24) - 128:
         return False
-    env = os.environ.get("TEMPO_TPU_BINPACK")
+    env = config.get("TEMPO_TPU_BINPACK")
     if env is not None:
         return env not in ("0", "false", "no")
     slots = K * (Ll + Lr)

@@ -19,6 +19,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from tempo_tpu import config
+
 logger = logging.getLogger(__name__)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -28,7 +30,7 @@ _SO = os.path.join(_HERE, "_packer.so")
 _lib = None
 _tried = False
 
-N_THREADS = int(os.environ.get("TEMPO_TPU_NATIVE_THREADS", os.cpu_count() or 1))
+N_THREADS = config.get_int("TEMPO_TPU_NATIVE_THREADS", os.cpu_count() or 1)
 
 
 def _build() -> bool:
@@ -61,7 +63,7 @@ def _load():
     if _tried:
         return _lib
     _tried = True
-    if os.environ.get("TEMPO_TPU_NATIVE", "1") == "0":
+    if config.get("TEMPO_TPU_NATIVE", "1") == "0":
         return None
     try:
         # binary-only installs (no .cpp) load whatever .so is shipped;

@@ -122,11 +122,13 @@ def _bucket_stats_kernel(bid_ref, x_ref, valid_ref,
 
     head_f, tail_f = _head_tail(bid, shape)
 
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
     validf = valid.astype(jnp.float32)
-    xz = jnp.where(valid, x, 0.0)
+    xz = jnp.where(valid, x, f0)
     nv = jnp.sum(validf, axis=1, keepdims=True)
-    center = jnp.sum(xz, axis=1, keepdims=True) / jnp.maximum(nv, 1.0)
-    xc = jnp.where(valid, x - center, 0.0)
+    center = jnp.sum(xz, axis=1, keepdims=True) / jnp.maximum(nv, f1)
+    xc = jnp.where(valid, x - center, f0)
 
     pinf = jnp.float32(jnp.inf)
     planes = [
@@ -136,21 +138,21 @@ def _bucket_stats_kernel(bid_ref, x_ref, valid_ref,
         jnp.where(valid, x, pinf),               # min
         jnp.where(valid, x, -pinf),              # max
     ]
-    add = (jnp.add, 0.0)
+    add = (jnp.add, f0)
     ops = [add, add, add, (jnp.minimum, pinf), (jnp.maximum, -pinf)]
     planes = _seg_scan(planes, ops, head_f, shape)
     cnt, s1, s2, mn, mx = _tail_broadcast(planes, tail_f, shape)
 
     nan = jnp.float32(jnp.nan)
-    mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1.0) + center, nan)
+    mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, f1) + center, nan)
     total = s1 + cnt * center
     var = jnp.where(
         cnt > 1,
-        (s2 - s1 * s1 / jnp.maximum(cnt, 1.0))
-        / jnp.maximum(cnt - 1.0, 1.0),
+        (s2 - s1 * s1 / jnp.maximum(cnt, f1))
+        / jnp.maximum(cnt - f1, f1),
         nan,
     )
-    std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, 0.0)), nan)
+    std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, f0)), nan)
 
     mean_ref[:] = mean
     cnt_ref[:] = cnt
@@ -250,14 +252,16 @@ def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
     res_ref[:] = jnp.where(head, x, nan)
 
     # exact EMA ladder over head-masked samples (pallas_kernels._ema)
-    d = jnp.where(head, 1.0 - alpha, 1.0)
-    v = jnp.where(head, alpha * x, 0.0)
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
+    d = jnp.where(head, f1 - alpha, f1)
+    v = jnp.where(head, alpha * x, f0)
     L = shape[1]
     span = 1
     while span < L:
         ok = lane >= span
-        d_prev = jnp.where(ok, _roll_back(d, span), 1.0)
-        v_prev = jnp.where(ok, _roll_back(v, span), 0.0)
+        d_prev = jnp.where(ok, _roll_back(d, span), f1)
+        v_prev = jnp.where(ok, _roll_back(v, span), f0)
         v = v + d * v_prev
         d = d * d_prev
         span *= 2

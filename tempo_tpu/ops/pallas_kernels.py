@@ -104,23 +104,26 @@ def _shift_with_identity(arr, span: int, identity):
 def _ema_kernel(alpha_ref, x_ref, valid_ref, out_ref):
     a = alpha_ref[0]
     valid = valid_ref[:]
+    f0 = jnp.float32(0.0)
+    f1 = jnp.float32(1.0)
     # linear recurrence y_i = d_i * y_{i-1} + v_i
-    d = jnp.where(valid, 1.0 - a, 1.0)
-    v = jnp.where(valid, a * x_ref[:], 0.0)
+    d = jnp.where(valid, f1 - a, f1)
+    v = jnp.where(valid, a * x_ref[:], f0)
     for span in _ladder_levels(d.shape[1]):
-        d_prev = _shift_with_identity(d, span, 1.0)
-        v_prev = _shift_with_identity(v, span, 0.0)
+        d_prev = _shift_with_identity(d, span, f1)
+        v_prev = _shift_with_identity(v, span, f0)
         v = v + d * v_prev
         d = d * d_prev
     out_ref[:] = v
 
 
 def _last_valid_kernel(x_ref, valid_ref, out_ref, outv_ref):
+    f0 = jnp.float32(0.0)
     has = valid_ref[:].astype(jnp.float32)
-    val = jnp.where(valid_ref[:], x_ref[:], 0.0)
+    val = jnp.where(valid_ref[:], x_ref[:], f0)
     for span in _ladder_levels(has.shape[1]):
-        has_prev = _shift_with_identity(has, span, 0.0)
-        val_prev = _shift_with_identity(val, span, 0.0)
+        has_prev = _shift_with_identity(has, span, f0)
+        val_prev = _shift_with_identity(val, span, f0)
         val = jnp.where(has > 0, val, val_prev)
         has = jnp.maximum(has, has_prev)
     out_ref[:] = val
@@ -158,14 +161,15 @@ def _cumsum3_kernel(x_ref, valid_ref, s1_ref, s2_ref, c_ref):
     """Inclusive prefix sums of (masked x, masked x^2, valid count) in
     one VMEM pass — the three scans behind windowed range stats."""
     valid = valid_ref[:]
-    xz = jnp.where(valid, x_ref[:], 0.0)
+    f0 = jnp.float32(0.0)
+    xz = jnp.where(valid, x_ref[:], f0)
     s1 = xz
     s2 = xz * xz
     c = valid.astype(jnp.float32)
     for span in _ladder_levels(s1.shape[1]):
-        s1 = s1 + _shift_with_identity(s1, span, 0.0)
-        s2 = s2 + _shift_with_identity(s2, span, 0.0)
-        c = c + _shift_with_identity(c, span, 0.0)
+        s1 = s1 + _shift_with_identity(s1, span, f0)
+        s2 = s2 + _shift_with_identity(s2, span, f0)
+        c = c + _shift_with_identity(c, span, f0)
     s1_ref[:] = s1
     s2_ref[:] = s2
     c_ref[:] = c

@@ -851,9 +851,9 @@ def merge_rank_supported(sorted_keys, sorted_queries) -> bool:
 
 def _pallas_enabled() -> bool:
     """Shared kill-switch + backend gate for every Pallas join path."""
-    import os
+    from tempo_tpu import config
 
-    env = os.environ.get("TEMPO_TPU_PALLAS_ASOF")
+    env = config.get("TEMPO_TPU_PALLAS_ASOF")
     if env is not None and env in ("0", "false", "no"):
         return False
     return jax.default_backend() == "tpu"
@@ -919,10 +919,9 @@ def join_chunk_lanes_override():
     """``TEMPO_TPU_JOIN_CHUNK_LANES`` — explicit merged-lane chunk width
     (power of two >= 256) for the streaming engine; unset = the largest
     width the VMEM plan admits."""
-    import os
+    from tempo_tpu import config
 
-    env = os.environ.get("TEMPO_TPU_JOIN_CHUNK_LANES")
-    return int(env) if env else None
+    return config.get_int("TEMPO_TPU_JOIN_CHUNK_LANES")
 
 
 def _chunk_plane_counts(C: int, nsq: int, segmented: bool, keyed: bool,
@@ -1100,7 +1099,7 @@ def _chunked_call(keys, payload, n_payload, n_out, Cm, segmented,
         scratch = [pltpu.VMEM((n_payload, bk, 128), jnp.float32)]
         if segmented:
             scratch.append(pltpu.VMEM((bk, 128), jnp.int32))
-        out = pl.pallas_call(
+        out = pl.pallas_call(  # lint-ok: vmem-budget: Cm is sized by _plan_chunk_lanes in every caller (asof_merge_*_chunked)
             _make_chunked_kernel(n_payload, n_out, Cm, n_keys,
                                  segmented, keyed_fill, chunk_rows,
                                  windowed),

@@ -60,11 +60,13 @@ def _make_kernel(max_behind: int, max_ahead: int):
         shape = secs.shape
 
         # bool planes cannot ride pltpu.roll: shift an f32 image
+        f0 = jnp.float32(0.0)
+        f1 = jnp.float32(1.0)
         validf = valid.astype(jnp.float32)
-        xz = jnp.where(valid, x, 0.0)
+        xz = jnp.where(valid, x, f0)
         nv = jnp.sum(validf, axis=1, keepdims=True)
-        center = jnp.sum(xz, axis=1, keepdims=True) / jnp.maximum(nv, 1.0)
-        xc = jnp.where(valid, x - center, 0.0)
+        center = jnp.sum(xz, axis=1, keepdims=True) / jnp.maximum(nv, f1)
+        xc = jnp.where(valid, x - center, f0)
 
         lo = secs - w
         pinf = jnp.float32(jnp.inf)
@@ -76,26 +78,26 @@ def _make_kernel(max_behind: int, max_ahead: int):
         for j in range(-max_ahead, max_behind + 1):
             sj = _shift(secs, j, _I32_BIG, shape)
             inw = (sj >= lo) & (sj <= secs) & (
-                _shift(validf, j, 0.0, shape) > 0.0
+                _shift(validf, j, f0, shape) > f0
             )
-            xj = _shift(xc, j, 0.0, shape)
-            xr = _shift(x, j, 0.0, shape)
+            xj = _shift(xc, j, f0, shape)
+            xr = _shift(x, j, f0, shape)
             cnt = cnt + inw.astype(jnp.float32)
-            s1 = s1 + jnp.where(inw, xj, 0.0)
-            s2 = s2 + jnp.where(inw, xj * xj, 0.0)
+            s1 = s1 + jnp.where(inw, xj, f0)
+            s2 = s2 + jnp.where(inw, xj * xj, f0)
             mn = jnp.minimum(mn, jnp.where(inw, xr, pinf))
             mx = jnp.maximum(mx, jnp.where(inw, xr, -pinf))
 
         nan = jnp.float32(jnp.nan)
-        mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, 1.0) + center, nan)
+        mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, f1) + center, nan)
         total = s1 + cnt * center
         var = jnp.where(
             cnt > 1,
-            (s2 - s1 * s1 / jnp.maximum(cnt, 1.0))
-            / jnp.maximum(cnt - 1.0, 1.0),
+            (s2 - s1 * s1 / jnp.maximum(cnt, f1))
+            / jnp.maximum(cnt - f1, f1),
             nan,
         )
-        std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, 0.0)), nan)
+        std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, f0)), nan)
 
         # truncation audit: mirrors range_stats_shifted exactly
         L = shape[1]
@@ -104,7 +106,7 @@ def _make_kernel(max_behind: int, max_ahead: int):
             sj = _shift(secs, j, _I32_BIG, shape)
             clipped = clipped | (
                 (sj >= lo) & (sj <= secs)
-                & (valid | (_shift(validf, j, 0.0, shape) > 0.0))
+                & (valid | (_shift(validf, j, f0, shape) > f0))
             )
 
         mean_ref[:] = mean

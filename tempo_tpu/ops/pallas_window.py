@@ -54,7 +54,6 @@ brute-force numpy oracle.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -92,7 +91,9 @@ def _stream_max_rows() -> int:
     floor (~1.05 s/iteration at that shape, BENCH_r05) puts the
     crossover above 20k rows.  Re-measure with bench.py
     --only-stream-stats and override here."""
-    return int(os.environ.get("TEMPO_TPU_STREAM_MAX_ROWS", "16384"))
+    from tempo_tpu import config
+
+    return config.get_int("TEMPO_TPU_STREAM_MAX_ROWS", 16384)
 
 
 def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,

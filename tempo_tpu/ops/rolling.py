@@ -56,9 +56,9 @@ SHIFTED_MAX_ROWS = 512
 
 
 def window_engine_override() -> str:
-    import os
+    from tempo_tpu import config
 
-    return os.environ.get("TEMPO_TPU_WINDOW_ENGINE", "auto").lower()
+    return (config.get("TEMPO_TPU_WINDOW_ENGINE") or "auto").lower()
 
 
 def pick_range_engine(n_elems: int, max_behind: int, max_ahead: int,

@@ -508,9 +508,9 @@ def _asof_merge_indices_xla(l_ts, r_ts, r_valids):
 
 
 def _nan_encoding_enabled() -> bool:
-    import os
+    from tempo_tpu import config
 
-    return os.environ.get("TEMPO_TPU_NAN_ASOF", "0") not in ("0", "false",
+    return (config.get("TEMPO_TPU_NAN_ASOF") or "0") not in ("0", "false",
                                                              "no")
 
 
@@ -716,9 +716,9 @@ def use_sort_kernels() -> bool:
     """Whether the sort-and-scan forms should replace search-and-gather
     on the current backend (TPU: yes — see module docstring timings;
     override with TEMPO_TPU_SORT_KERNELS=0/1)."""
-    import os
+    from tempo_tpu import config
 
-    env = os.environ.get("TEMPO_TPU_SORT_KERNELS")
+    env = config.get("TEMPO_TPU_SORT_KERNELS")
     if env is not None:
         return env not in ("0", "false", "no")
     return jax.default_backend() == "tpu"

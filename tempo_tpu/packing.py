@@ -51,9 +51,9 @@ def compute_dtype() -> np.dtype:
     boundary.  CPU (the golden-parity test platform) keeps full float64.
     Override with TEMPO_TPU_COMPUTE_DTYPE=float64|float32.
     """
-    import os
+    from tempo_tpu import config
 
-    env = os.environ.get("TEMPO_TPU_COMPUTE_DTYPE")
+    env = config.get("TEMPO_TPU_COMPUTE_DTYPE")
     if env:
         return np.dtype(env)
     import jax

@@ -141,9 +141,9 @@ def join_engine_override() -> Optional[str]:
     kernel), ``bracket`` (legacy host time-bracketing), or ``bitonic``
     (the XLA log-stage network, the tracer-context oversize engine).
     Unset/unknown = auto."""
-    import os
+    from tempo_tpu import config
 
-    env = os.environ.get("TEMPO_TPU_JOIN_ENGINE", "").strip().lower()
+    env = (config.get("TEMPO_TPU_JOIN_ENGINE") or "").strip().lower()
     if env == "vmem":
         env = "single"
     return env if env in ("single", "chunked", "bracket", "bitonic") \

@@ -273,9 +273,11 @@ def max_merged_lanes() -> int:
     """Merged-lane limit for a single AS-OF merge program.  Override
     with ``TEMPO_TPU_MAX_MERGED_LANES`` (ints only; smaller values force
     the bracketing fallback earlier, 0/negative disables the guard)."""
-    env = os.environ.get("TEMPO_TPU_MAX_MERGED_LANES")
-    if env:
-        return int(env)
+    from tempo_tpu import config
+
+    env = config.get_int("TEMPO_TPU_MAX_MERGED_LANES")
+    if env is not None:
+        return env
     return DEFAULT_MAX_MERGED_LANES
 
 

@@ -635,8 +635,12 @@ class _Parser:
             def node(env, l=left, pat=pat):
                 v, p = l(env), pat(env)
                 if isinstance(v, pd.Series):
-                    return v.astype(str).str.contains(str(p), regex=True,
-                                                      na=pd.NA).astype("boolean")
+                    # na=pd.NA into a bool-dtype contains raises on this
+                    # image's pandas ("boolean value of NA is ambiguous");
+                    # compute on stringified values, restore NA by mask
+                    # (the LIKE branch's idiom)
+                    return (v.astype(str).str.contains(str(p), regex=True)
+                            .astype("boolean").mask(v.isna()))
                 return bool(re.search(str(p), str(v)))
             return _maybe_negate(node, negate)
         if negate:

@@ -9,15 +9,16 @@ IPython is absent.
 from __future__ import annotations
 
 import logging
-import os
 
 import pandas as pd
+
+from tempo_tpu import config
 
 logger = logging.getLogger(__name__)
 
 PLATFORM = (
     "DATABRICKS"
-    if "DATABRICKS_RUNTIME_VERSION" in os.environ
+    if config.env_external("DATABRICKS_RUNTIME_VERSION") is not None
     else "NON_DATABRICKS"
 )
 

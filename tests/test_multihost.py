@@ -102,6 +102,18 @@ def test_two_process_distributed_ingest_end_to_end():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    # image-level cause: this jaxlib's CPU collective runtime rejects
+    # true multi-process programs (XlaRuntimeError: "Multiprocess
+    # computations aren't implemented on the CPU backend") — the
+    # two-process path needs real TPU/GPU hosts or a jaxlib with CPU
+    # cross-process collectives.  The single-process mesh tests above
+    # still pin the routing math.  Scanned across ALL workers before
+    # any per-worker assert: the marker-free worker may just be the
+    # one that died waiting on its marker-bearing peer.
+    if any("Multiprocess computations aren't implemented" in out
+           for out in outs):
+        pytest.skip("jaxlib CPU backend in this image cannot run "
+                    "multi-process collectives")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
         assert f"proc {i}/2 OK" in out

@@ -1,0 +1,16 @@
+"""Kernel-safety static analyzer for the tempo-tpu tree.
+
+``python tools/analyze.py`` runs the whole battery; see
+``tools/analysis/core.py`` for the framework contract and
+``tools/analysis/rules/`` for the bug classes.
+"""
+
+from tools.analysis.core import (  # noqa: F401
+    ModuleSource,
+    PARSE_ERROR_CODE,
+    Rule,
+    Violation,
+    iter_py_files,
+    load_sources,
+    run,
+)

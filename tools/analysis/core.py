@@ -1,0 +1,186 @@
+"""Core of the kernel-safety static analyzer.
+
+One engine replaces the per-bug-class scripts that accreted in
+``tools/`` (``check_no_bare_except.py``, ``check_no_dynamic_gather.py``
+— both now thin shims over this framework): every rule shares one
+parse per file, one suppression syntax, and one reporting/exit-code
+contract, so adding the next bug-class check is a ~50-line rule module
+instead of another standalone script.
+
+Contract
+--------
+
+* :class:`ModuleSource` — a lazily parsed source file (text, split
+  lines, ``ast`` tree) shared by every rule; a file that does not
+  parse yields a single ``parse-error`` violation instead of crashing
+  the run.
+* :class:`Rule` — subclasses define ``name`` (the kebab-case id used
+  in suppressions and the CLI), ``code`` (a distinct power-of-two exit
+  bit), ``applies(path)`` (the file filter), and ``check(mod)``;
+  whole-tree consistency rules additionally implement
+  ``check_project(root, files)``, which runs once per invocation.
+* Suppression — ``# lint-ok: <rule>: <reason>`` on the flagged line
+  silences that rule there; the reason is mandatory (a bare marker
+  does not suppress).  Rules may also declare ``legacy_markers``
+  (e.g. ``# gather-ok:``) kept for pre-framework annotations.
+* Exit codes — :func:`run` returns the bitwise OR of the ``code`` of
+  every rule that fired, so a CI log's exit status alone names the
+  failing rule families (``parse-error`` contributes
+  :data:`PARSE_ERROR_CODE`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+#: Exit bit for files that fail to parse (or read) at all.  Kept below
+#: 128: ORed statuses at or above 128 collide with the shell's
+#: 128+signal convention (130 = SIGINT, 137 = SIGKILL), which would
+#: defeat the "exit status alone names the failing families" contract.
+PARSE_ERROR_CODE = 64
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", ".hypothesis"}
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: Path
+    line: int
+    rule: str
+    message: str
+
+    def render(self, with_rule: bool = True) -> str:
+        tag = f"[{self.rule}] " if with_rule else ""
+        return f"{self.path}:{self.line}: {tag}{self.message}"
+
+
+class ModuleSource:
+    """One parsed source file, shared across rules."""
+
+    def __init__(self, path: Path, text: Optional[str] = None):
+        self.path = Path(path)
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.text = self.path.read_text() if text is None else text
+        except (OSError, UnicodeDecodeError) as e:
+            # unreadable files report like syntax errors instead of
+            # crashing the run (the exit status must stay rule-shaped)
+            self.text = ""
+            self.lines = []
+            self.parse_error = SyntaxError(f"unreadable: {e}")
+            return
+        self.lines = self.text.splitlines()
+        try:
+            self.tree = ast.parse(self.text, filename=str(self.path))
+        except SyntaxError as e:
+            self.parse_error = e
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, rule: "Rule") -> bool:
+        """``# lint-ok: <rule>: <reason>`` (reason mandatory) on the
+        flagged line, or one of the rule's grandfathered markers."""
+        text = self.line(lineno)
+        if re.search(rf"#\s*lint-ok:\s*{re.escape(rule.name)}\s*:\s*\S",
+                     text):
+            return True
+        return any(marker in text for marker in rule.legacy_markers)
+
+
+class Rule:
+    """Base class: one decidable bug class."""
+
+    #: kebab-case id — the suppression token and CLI name.
+    name: str = ""
+    #: distinct power-of-two exit bit.
+    code: int = 0
+    #: one-line description shown by ``analyze.py --list-rules``.
+    doc: str = ""
+    #: pre-framework same-line markers that still suppress this rule.
+    legacy_markers: Tuple[str, ...] = ()
+
+    def applies(self, path: Path) -> bool:
+        return path.suffix == ".py"
+
+    def check(self, mod: ModuleSource) -> List[Violation]:
+        return []
+
+    def check_project(self, root: Path,
+                      files: Sequence[ModuleSource]) -> List[Violation]:
+        """Whole-tree consistency pass; runs once per invocation."""
+        return []
+
+    # -- helpers for subclasses ----------------------------------------
+
+    def violation(self, mod: ModuleSource, lineno: int,
+                  message: str) -> Optional[Violation]:
+        """A violation at ``lineno``, honouring suppressions."""
+        if mod.suppressed(lineno, self):
+            return None
+        return Violation(mod.path, lineno, self.name, message)
+
+
+def iter_py_files(paths: Iterable[Path]) -> List[Path]:
+    """Expand files/directories into a sorted, de-duplicated .py file
+    list, never descending into bytecode/VCS dirs."""
+    out = []
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            found = sorted(
+                f for f in p.rglob("*.py")
+                if not (_SKIP_DIRS & set(f.parts))
+            )
+        else:
+            found = [p]
+        for f in found:
+            key = f.resolve()
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+    return out
+
+
+def load_sources(paths: Iterable[Path]) -> List[ModuleSource]:
+    return [ModuleSource(p) for p in iter_py_files(paths)]
+
+
+def run(rules: Sequence[Rule], files: Sequence[ModuleSource],
+        root: Optional[Path] = None) -> Tuple[List[Violation], int]:
+    """Run every rule over every applicable file (plus each rule's
+    project pass).  Returns (violations, exit code) where the exit
+    code ORs the bits of the rules that fired."""
+    violations: List[Violation] = []
+    exit_code = 0
+    for mod in files:
+        if mod.parse_error is not None:
+            e = mod.parse_error
+            violations.append(Violation(
+                mod.path, e.lineno or 0, "parse-error",
+                f"unparseable: {e.msg}"))
+            exit_code |= PARSE_ERROR_CODE
+            continue
+        for rule in rules:
+            if not rule.applies(mod.path):
+                continue
+            found = rule.check(mod)
+            violations.extend(found)
+            if found:
+                exit_code |= rule.code
+    if root is not None:
+        for rule in rules:
+            found = rule.check_project(Path(root), files)
+            violations.extend(found)
+            if found:
+                exit_code |= rule.code
+    violations.sort(key=lambda v: (str(v.path), v.line))
+    return violations, exit_code

@@ -1,0 +1,201 @@
+"""Shared AST dataflow helpers: constant folding, import/alias
+resolution, and scope utilities.
+
+The rules need three things the raw AST does not give directly:
+
+* *constant folding* over straight-line assignments — BlockSpec shapes
+  are written as ``(bk, L)`` with ``bk``/``L`` bound a few lines up;
+  :func:`fold` resolves such names through the local then module
+  assignment environment, evaluating the arithmetic the kernels
+  actually use (``*``, ``//``, ``<<``, ``**``, unary ``-``) and
+  returning :data:`UNKNOWN` the moment anything runtime-dependent
+  (function args, ``.shape`` reads) enters;
+* *origin resolution* — ``from jax.numpy import take_along_axis as g``
+  and ``h = jnp.take`` both alias a banned gather;
+  :func:`build_aliases` maps every local name to its dotted origin so
+  call checks see through the rename;
+* *scope walks* — :func:`enclosing_function_map` ties every node to
+  its innermost function so rules can build per-function environments.
+
+Everything here is intentionally flow-insensitive (last assignment
+wins): the kernel dispatch wrappers this analyzes are straight-line,
+and a wrong ``UNKNOWN`` only widens a check, never silences it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List, Optional, Union
+
+
+class _Unknown:
+    """Sentinel: not statically resolvable."""
+
+    def __repr__(self):
+        return "UNKNOWN"
+
+    def __bool__(self):
+        return False
+
+
+UNKNOWN = _Unknown()
+
+Env = Dict[str, ast.expr]
+
+
+def assignment_env(body: List[ast.stmt]) -> Env:
+    """name -> last straight-line assigned expression, from the given
+    statement list only (no descent into nested functions: their
+    bindings are a different scope)."""
+    env: Env = {}
+
+    def visit(stmts):
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        env[tgt.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    env[stmt.target.id] = stmt.value
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.Try)):
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(stmt, field, None)
+                    if not sub:
+                        continue
+                    if field == "handlers":
+                        for h in sub:
+                            visit(h.body)
+                    else:
+                        visit(sub)
+
+    visit(body)
+    return env
+
+
+def fold(node: Optional[ast.expr], env: Env,
+         fallback: Optional[Env] = None, _depth: int = 0) -> Any:
+    """Evaluate ``node`` to a python value, or :data:`UNKNOWN`.
+
+    Handles int/float/str/bool constants, name lookups through ``env``
+    then ``fallback`` (module scope), tuples/lists (element-wise —
+    a partially known tuple folds to a tuple containing UNKNOWN
+    elements), the int arithmetic the kernel planners use, and
+    ``len()`` of resolvable sequences."""
+    if node is None or _depth > 32:
+        return UNKNOWN
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        for scope in (env, fallback or {}):
+            if node.id in scope:
+                return fold(scope[node.id], env, fallback, _depth + 1)
+        return UNKNOWN
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(fold(e, env, fallback, _depth + 1) for e in node.elts)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = fold(node.operand, env, fallback, _depth + 1)
+        return -v if isinstance(v, (int, float)) else UNKNOWN
+    if isinstance(node, ast.BinOp):
+        lhs = fold(node.left, env, fallback, _depth + 1)
+        rhs = fold(node.right, env, fallback, _depth + 1)
+        if isinstance(lhs, (int, float)) and isinstance(rhs, (int, float)):
+            try:
+                if isinstance(node.op, ast.Add):
+                    return lhs + rhs
+                if isinstance(node.op, ast.Sub):
+                    return lhs - rhs
+                if isinstance(node.op, ast.Mult):
+                    return lhs * rhs
+                if isinstance(node.op, ast.FloorDiv):
+                    return lhs // rhs
+                if isinstance(node.op, ast.Mod):
+                    return lhs % rhs
+                if isinstance(node.op, ast.Pow):
+                    return lhs ** rhs
+                if isinstance(node.op, ast.LShift):
+                    return lhs << rhs
+                if isinstance(node.op, ast.RShift):
+                    return lhs >> rhs
+            except (ZeroDivisionError, ValueError, OverflowError):
+                return UNKNOWN
+        return UNKNOWN
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "len" and len(node.args) == 1):
+        seq = fold(node.args[0], env, fallback, _depth + 1)
+        return len(seq) if isinstance(seq, tuple) else UNKNOWN
+    return UNKNOWN
+
+
+def dotted_name(node: ast.expr,
+                aliases: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """``jnp.take`` -> 'jax.numpy.take' (through ``aliases``), plain
+    names through the alias map, else None for non-name expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    head = node.id
+    if aliases and head in aliases:
+        head = aliases[head]
+    parts.append(head)
+    return ".".join(reversed(parts))
+
+
+def build_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> dotted origin, covering ``import x.y as z``,
+    ``from x import y as z``, and first-order assignment aliases
+    (``g = jnp.take``)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    # assignment aliases resolve through the import map built above
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, (ast.Attribute, ast.Name)):
+            origin = dotted_name(node.value, aliases)
+            if origin:
+                aliases[node.targets[0].id] = origin
+    return aliases
+
+
+def terminal_name(node: ast.expr) -> str:
+    """Rightmost identifier of a call target: ``pl.pallas_call`` ->
+    'pallas_call', ``take`` -> 'take', anything else -> ''."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+
+def enclosing_function_map(tree: ast.Module) -> Dict[ast.AST, FuncNode]:
+    """node -> innermost enclosing FunctionDef (nodes at module level
+    are absent)."""
+    out: Dict[ast.AST, FuncNode] = {}
+
+    def visit(node: ast.AST, current: Optional[FuncNode]):
+        for child in ast.iter_child_nodes(node):
+            if current is not None:
+                out[child] = current
+            nxt = child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else current
+            visit(child, nxt)
+
+    visit(tree, None)
+    return out

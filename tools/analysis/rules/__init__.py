@@ -1,0 +1,29 @@
+"""Rule suite of the kernel-safety analyzer.
+
+Each module holds one decidable-bug-class family; ``ALL_RULES`` is the
+engine's default battery, in exit-bit order."""
+
+from tools.analysis.rules.vmem import VmemBudgetRule
+from tools.analysis.rules.weak_dtype import WeakDtypeRule
+from tools.analysis.rules.gather import DynamicGatherRule, GridCarryRule
+from tools.analysis.rules.env_knobs import EnvKnobRule
+from tools.analysis.rules.excepts import BareExceptRule
+
+ALL_RULES = (
+    VmemBudgetRule(),
+    WeakDtypeRule(),
+    DynamicGatherRule(),
+    GridCarryRule(),
+    EnvKnobRule(),
+    BareExceptRule(),
+)
+
+__all__ = [
+    "ALL_RULES",
+    "VmemBudgetRule",
+    "WeakDtypeRule",
+    "DynamicGatherRule",
+    "GridCarryRule",
+    "EnvKnobRule",
+    "BareExceptRule",
+]

@@ -1,0 +1,151 @@
+"""env-knobs: every ``TEMPO_TPU_*`` knob is declared once and
+documented once.
+
+The bug class: silent engine fallbacks are governed by env knobs that
+accreted per-module, and by PR 3 two of them (``TEMPO_TPU_WAREHOUSE``,
+``TEMPO_TPU_BINPACK``) were read in code but absent from BUILDING.md —
+an operator reading the docs could not know the fallbacks existed.
+``tempo_tpu/config.py`` is now the registry (name, type, default,
+owning module, one-line contract); this rule keeps the three copies of
+the truth — registry, code, docs — from drifting again:
+
+* module pass — ``os.environ`` / ``os.getenv`` anywhere under
+  ``tempo_tpu/`` outside ``config.py`` is flagged: knob reads go
+  through ``config.get``/``get_bool``/``get_int``; foreign vars
+  (``JAX_PLATFORMS``...) through ``config.env_external``;
+* project pass — every ``TEMPO_TPU_*`` token mentioned anywhere in
+  package sources (string literals, comments, docstrings — mentions of
+  ghosts are exactly the drift) and in ``__graft_entry__.py`` must be
+  declared in the registry; every registry knob must appear in
+  BUILDING.md's knob documentation; every ``TEMPO_TPU_*`` token in
+  BUILDING.md must be a declared knob (else it documents a dead knob).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.analysis.core import ModuleSource, Rule, Violation
+from tools.analysis import dataflow as df
+
+_KNOB_RE = re.compile(r"TEMPO_TPU_[A-Z0-9_]+")
+
+#: basenames whose knob mentions are definitional, not drift.
+_REGISTRY_FILE = "config.py"
+
+
+def _in_package(path: Path) -> bool:
+    return "tempo_tpu" in path.parts
+
+
+class EnvKnobRule(Rule):
+    name = "env-knobs"
+    code = 16
+    doc = ("os.environ access outside tempo_tpu/config.py banned; "
+           "registry / code / BUILDING.md knob tables must agree")
+
+    # -- module pass ---------------------------------------------------
+
+    def applies(self, path: Path) -> bool:
+        # __graft_entry__.py imports tempo_tpu before jax, so it can
+        # (and must) read its knob through config too
+        return (path.suffix == ".py"
+                and (_in_package(path) or path.name == "__graft_entry__.py")
+                and path.name != _REGISTRY_FILE)
+
+    def check(self, mod: ModuleSource) -> List[Violation]:
+        aliases = df.build_aliases(mod.tree)
+        out: List[Optional[Violation]] = []
+        for node in ast.walk(mod.tree):
+            origin = None
+            if isinstance(node, ast.Attribute):
+                origin = df.dotted_name(node, aliases)
+            elif isinstance(node, ast.Name):
+                origin = aliases.get(node.id) if node.id in aliases else None
+            if origin in ("os.environ", "os.getenv", "os.putenv",
+                          "os.unsetenv"):
+                out.append(self.violation(
+                    mod, node.lineno,
+                    f"direct '{origin}' access outside the knob registry "
+                    f"— read TEMPO_TPU_* knobs via tempo_tpu.config.get/"
+                    f"get_bool/get_int and foreign vars via "
+                    f"config.env_external (declare new names in "
+                    f"config.KNOBS / config.EXTERNAL_VARS)"))
+        return [v for v in out if v is not None]
+
+    # -- project pass --------------------------------------------------
+
+    def check_project(self, root: Path,
+                      files: Sequence[ModuleSource]) -> List[Violation]:
+        registry = self._load_registry(files, root)
+        if registry is None:
+            return []  # no registry in this tree (fixture runs)
+        reg_mod, knobs = registry
+        out: List[Optional[Violation]] = []
+
+        # every knob token mentioned in package code is declared
+        for mod in files:
+            if not (_in_package(mod.path)
+                    or mod.path.name == "__graft_entry__.py"):
+                continue
+            if mod.path.name == _REGISTRY_FILE:
+                continue
+            for i, line in enumerate(mod.lines, start=1):
+                for token in _KNOB_RE.findall(line):
+                    if token not in knobs:
+                        out.append(self.violation(
+                            mod, i,
+                            f"'{token}' is not declared in "
+                            f"tempo_tpu.config.KNOBS — declare it (and "
+                            f"document it in BUILDING.md) or delete the "
+                            f"ghost reference"))
+
+        # registry <-> BUILDING.md
+        building = root / "BUILDING.md"
+        if building.exists():
+            doc_text = building.read_text()
+            doc_lines = doc_text.splitlines()
+            documented = set(_KNOB_RE.findall(doc_text))
+            for name, lineno in knobs.items():
+                if name not in documented:
+                    out.append(self.violation(
+                        reg_mod, lineno,
+                        f"knob '{name}' is declared but undocumented — "
+                        f"add it to BUILDING.md's knob table"))
+            for i, line in enumerate(doc_lines, start=1):
+                for token in _KNOB_RE.findall(line):
+                    if token not in knobs:
+                        out.append(Violation(
+                            building, i, self.name,
+                            f"BUILDING.md documents '{token}' but no such "
+                            f"knob is declared in tempo_tpu.config.KNOBS "
+                            f"— dead documentation or an undeclared "
+                            f"read"))
+        return [v for v in out if v is not None]
+
+    def _load_registry(self, files: Sequence[ModuleSource], root: Path):
+        """(registry ModuleSource, {knob name -> decl line}) from
+        tempo_tpu/config.py, parsed statically (Knob(...) calls)."""
+        reg = None
+        for mod in files:
+            if _in_package(mod.path) and mod.path.name == _REGISTRY_FILE:
+                reg = mod
+                break
+        if reg is None:
+            cand = root / "tempo_tpu" / _REGISTRY_FILE
+            if cand.exists():
+                reg = ModuleSource(cand)
+        if reg is None or reg.tree is None:
+            return None
+        knobs = {}
+        for node in ast.walk(reg.tree):
+            if isinstance(node, ast.Call) \
+                    and df.terminal_name(node.func) == "Knob" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                knobs[node.args[0].value] = node.lineno
+        return (reg, knobs) if knobs else None

@@ -1,0 +1,77 @@
+"""bare-except: no silent failure-swallowing.
+
+Framework port of ``tools/check_no_bare_except.py`` (now a shim), same
+two anti-patterns — both defeat the resilience layer's failure
+*detection* (an exception that vanishes can be neither classified nor
+retried nor surfaced — ``tempo_tpu/resilience.py``):
+
+* bare ``except:`` — catches everything including SystemExit /
+  KeyboardInterrupt / SimulatedKill; always wrong;
+* ``except Exception:`` (or ``BaseException``) whose body is only
+  ``pass``/``...`` — a broad catch is fine, silently discarding the
+  exception is not: log it or narrow the type.
+
+Scope grew with the migration: ``tools/`` and ``tests/helpers.py``
+are swept alongside ``tempo_tpu/`` (the analyzer's default path set).
+Suppress with ``# lint-ok: bare-except: <reason>`` on the ``except``
+line.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from tools.analysis.core import ModuleSource, Rule, Violation
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass / bare ellipsis — the exception is discarded."""
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in handler.body
+    )
+
+
+def _catches_broad(node: ast.expr) -> bool:
+    """The handler type names Exception or BaseException (possibly
+    inside a tuple)."""
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    for e in elts:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None)
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+class BareExceptRule(Rule):
+    name = "bare-except"
+    code = 32
+    doc = ("no bare 'except:' and no silent 'except Exception: pass' "
+           "anywhere in the swept tree")
+
+    def applies(self, path: Path) -> bool:
+        return path.suffix == ".py"
+
+    def check(self, mod: ModuleSource) -> List[Violation]:
+        out: List[Optional[Violation]] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(self.violation(
+                    mod, node.lineno,
+                    "bare 'except:' catches BaseException (incl. "
+                    "KeyboardInterrupt/SimulatedKill) — name the "
+                    "exception types"))
+            elif _catches_broad(node.type) and _is_silent(node):
+                out.append(self.violation(
+                    mod, node.lineno,
+                    "'except Exception: pass' silently swallows failures "
+                    "— log the exception or narrow the type"))
+        return [v for v in out if v is not None]

@@ -1,0 +1,163 @@
+"""weak-dtype: no bare Python float constants inside kernel bodies or
+SMEM scalar operands.
+
+The bug class (PR 3): a bare ``0.0``/``1.0`` in a kernel traces as a
+*weak* type and follows the caller's config — under the library's
+global x64 mode it re-traces as f64, which Mosaic's lowering rejects
+(22 interpret-mode kernel tests broke at HEAD on this image).  The fix
+shape is mechanical and local — ``jnp.float32(0.0)`` — so the rule
+demands it everywhere a float literal can flow into traced kernel
+math:
+
+* inside any kernel body (a function named ``kernel``/``*_kernel`` or
+  passed as the first argument to ``pl.pallas_call``), every float
+  literal must sit under an explicit dtype constructor
+  (``jnp.float32(...)``-style) or a call carrying a ``dtype``
+  argument.  Int literals stay legal: loop bounds, rotate amounts and
+  iota comparisons are python-level control, and integer weak-type
+  promotion against i32 operands is value-preserving.
+* at a ``pl.pallas_call(...)(operands)`` invocation, an operand built
+  with ``jnp.asarray``/``jnp.array``/``jnp.full`` and *no* dtype is
+  flagged: that is exactly the SMEM-scalar shape that re-traced f64
+  (``jnp.asarray([alpha])`` vs ``jnp.asarray([alpha], jnp.float32)``).
+
+Suppress a deliberate weak constant with
+``# lint-ok: weak-dtype: <why the promotion is safe>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional, Set
+
+from tools.analysis.core import ModuleSource, Rule, Violation
+from tools.analysis import dataflow as df
+
+#: Calls that make the element dtype explicit for every literal below
+#: them.
+_DTYPE_CONSTRUCTORS = {
+    "float32", "float64", "float16", "bfloat16",
+    "int32", "int64", "int16", "int8",
+    "uint32", "uint64", "uint16", "uint8",
+    "bool_", "astype", "ShapeDtypeStruct",
+}
+
+#: Array constructors whose *positional* second argument is a dtype.
+_POSITIONAL_DTYPE_CTORS = {"asarray", "array", "full"}
+
+
+def _has_dtype_kw(call: ast.Call) -> bool:
+    return any(kw.arg == "dtype" for kw in call.keywords)
+
+
+class WeakDtypeRule(Rule):
+    name = "weak-dtype"
+    code = 2
+    doc = ("bare Python float constants in kernel bodies / SMEM scalar "
+           "operands must carry an explicit dtype")
+
+    def applies(self, path: Path) -> bool:
+        return path.suffix == ".py"
+
+    def check(self, mod: ModuleSource) -> List[Violation]:
+        tree = mod.tree
+        out: List[Violation] = []
+        kernels = self._kernel_defs(tree)
+        for fn in kernels:
+            out.extend(self._check_kernel(mod, fn))
+        if "pallas_call" in mod.text:
+            out.extend(self._check_operands(mod, tree))
+        return [v for v in out if v is not None]
+
+    # -- kernel discovery ----------------------------------------------
+
+    def _kernel_defs(self, tree: ast.Module) -> List[ast.FunctionDef]:
+        by_name = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, node)
+        kernels: Set[ast.FunctionDef] = set()
+        for name, fn in by_name.items():
+            if name == "kernel" or name.endswith("_kernel"):
+                kernels.add(fn)
+        # functions handed to pallas_call by name
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and df.terminal_name(node.func) == "pallas_call" \
+                    and node.args and isinstance(node.args[0], ast.Name):
+                fn = by_name.get(node.args[0].id)
+                if fn is not None:
+                    kernels.add(fn)
+        # a kernel nested in another kernel (factory named *_kernel with
+        # an inner ``kernel``) is already covered by the outer walk
+        nested = {
+            inner
+            for outer in kernels
+            for inner in ast.walk(outer)
+            if isinstance(inner, ast.FunctionDef) and inner is not outer
+        }
+        return sorted(kernels - nested, key=lambda f: f.lineno)
+
+    # -- rule bodies ---------------------------------------------------
+
+    def _check_kernel(self, mod: ModuleSource,
+                      fn: ast.FunctionDef) -> List[Optional[Violation]]:
+        out = []
+
+        def visit(node: ast.AST, dtyped: bool):
+            for child in ast.iter_child_nodes(node):
+                child_dtyped = dtyped
+                if isinstance(child, ast.Call):
+                    name = df.terminal_name(child.func)
+                    if name in _DTYPE_CONSTRUCTORS or _has_dtype_kw(child):
+                        child_dtyped = True
+                    elif name in _POSITIONAL_DTYPE_CTORS \
+                            and len(child.args) >= 2:
+                        child_dtyped = True
+                if isinstance(child, ast.Constant) \
+                        and isinstance(child.value, float) and not dtyped:
+                    out.append(self.violation(
+                        mod, child.lineno,
+                        f"bare float constant {child.value!r} in kernel "
+                        f"'{fn.name}' traces as a weak type and re-traces "
+                        f"f64 under the library's global x64 mode (the "
+                        f"22-test interpret regression class) — wrap it: "
+                        f"jnp.float32({child.value!r})"))
+                visit(child, child_dtyped)
+
+        for stmt in fn.body:
+            visit(stmt, False)
+        return out
+
+    def _check_operands(self, mod: ModuleSource,
+                        tree: ast.Module) -> List[Optional[Violation]]:
+        out = []
+        for node in ast.walk(tree):
+            # pl.pallas_call(...)(operand, ...) — outer Call whose func
+            # is itself the pallas_call Call
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Call)
+                    and df.terminal_name(node.func.func) == "pallas_call"):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    continue
+                call = arg
+                # unwrap trailing .reshape(...)/.ravel() chains
+                while isinstance(call, ast.Call) \
+                        and isinstance(call.func, ast.Attribute) \
+                        and call.func.attr in ("reshape", "ravel"):
+                    call = call.func.value
+                if not isinstance(call, ast.Call):
+                    continue
+                name = df.terminal_name(call.func)
+                if name in _POSITIONAL_DTYPE_CTORS \
+                        and len(call.args) < 2 and not _has_dtype_kw(call):
+                    out.append(self.violation(
+                        mod, call.lineno,
+                        f"'{name}' operand of a pallas_call carries no "
+                        f"explicit dtype — a weak scalar here re-traces "
+                        f"f64 under global x64; pass one "
+                        f"(e.g. jnp.{name}(x, jnp.float32))"))
+        return out

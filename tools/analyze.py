@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Kernel-safety static analyzer — the whole rule battery in one run.
+
+Generalizes the two single-rule scripts that used to live here
+(``check_no_bare_except.py``, ``check_no_dynamic_gather.py`` — both
+now shims over this engine) into one AST/dataflow framework
+(``tools/analysis/``) with a rule per decidable bug class:
+
+==============  ====  =====================================================
+rule            exit  catches
+==============  ====  =====================================================
+vmem-budget        1  pallas_call sites that can exceed the ~16 MiB scoped
+                      VMEM budget without a chunking/feasibility plan (the
+                      ~205K-merged-lane compiler-OOM class)
+weak-dtype         2  bare Python float constants in kernel bodies / SMEM
+                      scalar operands (the weak-f64 22-test regression)
+dynamic-gather     4  gather/scatter-shaped calls in Pallas kernel modules,
+                      incl. aliased imports, getattr indirection, .at[...]
+grid-carry         8  sequential-grid scratch carries overwritten before
+                      being read within a step
+env-knobs         16  os.environ outside tempo_tpu/config.py; registry vs
+                      code vs BUILDING.md knob-table drift
+bare-except       32  bare 'except:' / silent 'except Exception: pass'
+parse-error       64  files that do not parse (or cannot be read)
+==============  ====  =====================================================
+
+The process exit code is the bitwise OR of the fired rules — a CI log's
+status alone names the failing families; 0 means clean.  Suppress one
+finding with ``# lint-ok: <rule>: <reason>`` on the flagged line.
+
+Usage::
+
+    python tools/analyze.py                  # default sweep, all rules
+    python tools/analyze.py --rule vmem-budget [paths...]
+    python tools/analyze.py --list-rules
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.analysis import core  # noqa: E402
+from tools.analysis.rules import ALL_RULES  # noqa: E402
+
+
+def default_paths() -> list:
+    """The enforced sweep: the package, the tools themselves, the
+    shared test helpers, and the dryrun entry point."""
+    return [
+        _REPO / "tempo_tpu",
+        _REPO / "tools",
+        _REPO / "tests" / "helpers.py",
+        _REPO / "__graft_entry__.py",
+    ]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="tempo-tpu kernel-safety static analyzer")
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to sweep (default: tempo_tpu/, "
+                         "tools/, tests/helpers.py, __graft_entry__.py)")
+    ap.add_argument("--rule", action="append", dest="rules", default=None,
+                    metavar="NAME", help="run only the named rule(s)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", type=Path, default=_REPO,
+                    help="project root for whole-tree consistency passes "
+                         "(BUILDING.md / knob registry)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name:16s} exit {rule.code:3d}  {rule.doc}")
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        known = {r.name: r for r in ALL_RULES}
+        unknown = [n for n in args.rules if n not in known]
+        if unknown:
+            ap.error(f"unknown rule(s): {', '.join(unknown)} "
+                     f"(see --list-rules)")
+        rules = [known[n] for n in args.rules]
+
+    if args.paths:
+        # an explicitly named path that is missing must not silently
+        # shrink the sweep to nothing (exit 0 while checking nothing)
+        missing = [p for p in args.paths if not Path(p).exists()]
+        if missing:
+            ap.error("no such path(s): "
+                     + ", ".join(str(p) for p in missing))
+        paths = [Path(p) for p in args.paths]
+    else:
+        paths = [p for p in default_paths() if p.exists()]
+    files = core.load_sources(paths)
+    violations, exit_code = core.run(rules, files, root=args.root)
+
+    for v in violations:
+        print(v.render())
+    if violations:
+        by_rule = {}
+        for v in violations:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"{len(violations)} violation(s) ({summary}); "
+              f"exit code {exit_code}", file=sys.stderr)
+    return exit_code
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

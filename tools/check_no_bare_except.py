@@ -1,90 +1,51 @@
 #!/usr/bin/env python
-"""Ban silent failure-swallowing in tempo_tpu/.
+"""Ban silent failure-swallowing — shim over the analysis framework.
 
-Flags two anti-patterns that defeat the resilience layer's failure
-*detection* (an exception that vanishes can be neither classified nor
-retried nor surfaced — tempo_tpu/resilience.py):
-
-* bare ``except:`` — catches everything including SystemExit /
-  KeyboardInterrupt / SimulatedKill; always wrong;
-* ``except Exception:`` (or ``BaseException``) whose body is only
-  ``pass``/``...`` — a broad catch is fine, silently discarding the
-  exception is not: log it or narrow the type.
-
-Wired into the test run via tests/test_tooling.py; also runnable
-standalone: ``python tools/check_no_bare_except.py [paths...]``
-(default: the tempo_tpu/ package next to this script).  Exit code 1
-when violations exist.
+The actual rule lives in ``tools/analysis/rules/excepts.py``
+(``bare-except``, part of ``python tools/analyze.py``); this wrapper
+keeps the historical CLI: ``python tools/check_no_bare_except.py
+[paths...]`` (default: ``tempo_tpu/`` plus — since the framework
+migration — ``tools/`` and ``tests/helpers.py``), printing
+``path:line: message`` per violation and exiting 1 when any exist.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import List, Tuple
 
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.analysis import core  # noqa: E402
+from tools.analysis.rules import BareExceptRule  # noqa: E402
+
 Violation = Tuple[Path, int, str]
 
-
-def _is_silent(handler: ast.ExceptHandler) -> bool:
-    """Body is only pass / bare ellipsis — the exception is discarded."""
-    return all(
-        isinstance(stmt, ast.Pass)
-        or (isinstance(stmt, ast.Expr)
-            and isinstance(stmt.value, ast.Constant)
-            and stmt.value.value is Ellipsis)
-        for stmt in handler.body
-    )
-
-
-def _catches_broad(node: ast.expr) -> bool:
-    """The handler type names Exception or BaseException (possibly
-    inside a tuple)."""
-    elts = node.elts if isinstance(node, ast.Tuple) else [node]
-    for e in elts:
-        name = e.id if isinstance(e, ast.Name) else (
-            e.attr if isinstance(e, ast.Attribute) else None)
-        if name in ("Exception", "BaseException"):
-            return True
-    return False
+_RULE = BareExceptRule()
 
 
 def check_file(path: Path) -> List[Violation]:
-    violations: List[Violation] = []
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as e:
+    mod = core.ModuleSource(path)
+    if mod.parse_error is not None:
+        e = mod.parse_error
         return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if node.type is None:
-            violations.append((
-                path, node.lineno,
-                "bare 'except:' catches BaseException (incl. "
-                "KeyboardInterrupt/SimulatedKill) — name the exception "
-                "types",
-            ))
-        elif _catches_broad(node.type) and _is_silent(node):
-            violations.append((
-                path, node.lineno,
-                "'except Exception: pass' silently swallows failures — "
-                "log the exception or narrow the type",
-            ))
-    return violations
+    return [(v.path, v.line, v.message) for v in _RULE.check(mod)]
+
+
+def default_paths() -> List[Path]:
+    return [_REPO / "tempo_tpu", _REPO / "tools",
+            _REPO / "tests" / "helpers.py"]
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    roots = [Path(a) for a in args] or [
-        Path(__file__).resolve().parent.parent / "tempo_tpu"
-    ]
+    roots = [Path(a) for a in args] or default_paths()
     violations: List[Violation] = []
-    for root in roots:
-        files = [root] if root.is_file() else sorted(root.rglob("*.py"))
-        for f in files:
-            violations.extend(check_file(f))
+    for f in core.iter_py_files(roots):
+        violations.extend(check_file(f))
     for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}")
     if violations:

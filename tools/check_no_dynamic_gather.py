@@ -1,97 +1,53 @@
 #!/usr/bin/env python
-"""Ban dynamic-gather ops in the Pallas kernel modules.
+"""Ban dynamic-gather ops in the Pallas kernel modules — shim over the
+analysis framework.
 
-The regression this guards against: the prefix-scan + RMQ rolling path
-was gather-bound for two rounds (~96 ms per ``take_along_axis`` level
-at [1024, 8192], BENCH_r05 ``2b_range_stats_dense_50hz`` at 8.0M
-rows/s — below one CPU core) because per-lane dynamic gathers are the
-one data-movement primitive this hardware cannot do at speed, and
-Mosaic cannot lower them inside kernels at all (it falls back to
-scalar loops or rejects the op).  Every kernel in ``ops/pallas_*.py``
-is built from the primitives that ARE fast — ``pltpu.roll``, sorts,
-``broadcasted_iota`` masks — and this check keeps it that way: any
-call to a gather/scatter-shaped jnp/lax op anywhere in those modules
-fails the suite.
-
-Flagged call names (as attribute or bare calls):
-``take_along_axis``, ``take``, ``gather``, ``dynamic_slice``,
-``dynamic_update_slice``, ``dynamic_index_in_dim``, ``searchsorted``,
-``scatter``, ``scatter_add``, ``at[...]``-style ``.get``/``.set`` are
-not detectable syntactically and are left to review.
-
-A genuinely-needed exception (e.g. host-side plumbing in the same
-file) is whitelisted by putting the marker comment
-``# gather-ok: <reason>`` on the SAME line as the call.
-
-Wired into the test run via tests/test_tooling.py; also runnable
-standalone: ``python tools/check_no_dynamic_gather.py [paths...]``
-(default: tempo_tpu/ops/pallas_*.py next to this script).  Exit code 1
-when violations exist.
+The actual rule lives in ``tools/analysis/rules/gather.py``
+(``dynamic-gather``, part of ``python tools/analyze.py``) and now also
+catches what this script's first revision punted on: aliased imports,
+``getattr`` indirection, and the ``.at[...].get/.set`` forms.  This
+wrapper keeps the historical CLI: ``python
+tools/check_no_dynamic_gather.py [paths...]`` (default:
+``tempo_tpu/ops/pallas_*.py`` plus — since the framework migration —
+``tools/`` and ``tests/helpers.py``), exit code 1 when violations
+exist.  The legacy same-line ``# gather-ok: <reason>`` marker still
+suppresses, as does ``# lint-ok: dynamic-gather: <reason>``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 from typing import List, Tuple
 
+_REPO = Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from tools.analysis import core  # noqa: E402
+from tools.analysis.rules import DynamicGatherRule  # noqa: E402
+from tools.analysis.rules.gather import BANNED  # noqa: E402,F401
+
 Violation = Tuple[Path, int, str]
 
-BANNED = {
-    "take_along_axis",
-    "take",
-    "gather",
-    "dynamic_slice",
-    "dynamic_update_slice",
-    "dynamic_index_in_dim",
-    "searchsorted",
-    "scatter",
-    "scatter_add",
-}
+_RULE = DynamicGatherRule()
 
-MARKER = "# gather-ok:"
-
-
-def _call_name(node: ast.Call) -> str:
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
+MARKER = "# gather-ok:"  # legacy suppression, still honoured
 
 
 def check_file(path: Path) -> List[Violation]:
-    violations: List[Violation] = []
-    try:
-        text = path.read_text()
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
+    mod = core.ModuleSource(path)
+    if mod.parse_error is not None:
+        e = mod.parse_error
         return [(path, e.lineno or 0, f"unparseable: {e.msg}")]
-    lines = text.splitlines()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _call_name(node)
-        if name not in BANNED:
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if MARKER in line:
-            continue
-        violations.append((
-            path, node.lineno,
-            f"dynamic-gather-shaped call '{name}' in a Pallas kernel "
-            f"module (the pattern behind the dense-regime regression; "
-            f"use roll/sort/iota primitives, or annotate the line with "
-            f"'{MARKER} <reason>' if it provably never runs on-chip)",
-        ))
-    return violations
+    return [(v.path, v.line, v.message) for v in _RULE.check(mod)]
 
 
 def default_paths() -> List[Path]:
-    ops = Path(__file__).resolve().parent.parent / "tempo_tpu" / "ops"
-    return sorted(ops.glob("pallas_*.py"))
+    ops = _REPO / "tempo_tpu" / "ops"
+    return (sorted(ops.glob("pallas_*.py"))
+            + core.iter_py_files([_REPO / "tools"])
+            + [_REPO / "tests" / "helpers.py"])
 
 
 def main(argv: List[str]) -> int:

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The enforced gate, runnable as one command: the kernel-safety static
+# analyzer (tools/analyze.py — exit code ORs the fired rule bits, see
+# BUILDING.md "Static analysis") followed by the tier-1 test suite
+# exactly as ROADMAP.md specifies it.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== static analysis (tools/analyze.py) =="
+python tools/analyze.py || exit $?
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc
