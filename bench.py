@@ -1296,6 +1296,99 @@ def bench_frame_e2e():
             "rows": Kf * Lf}
 
 
+def bench_plan_chain():
+    """Config 10: the lazy-planned frame chain vs the eager chain on
+    the config-7 shape.  With ``TEMPO_TPU_PLAN=1`` the optimizer
+    rewrites ``asofJoin -> withRangeStats -> EMA`` onto the fused
+    single-program path (tempo_tpu/plan/fused.py) and repeated
+    invocations hit the executable cache — the record captures both
+    rates, the cache counters (the second run must be a hit with zero
+    new compiles), and the first-call wall time (plan build +
+    compile)."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF, profiling
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.plan import cache as plan_cache
+
+    rng = np.random.default_rng(11)
+    Kf, Lf = (K, L)
+    secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                     axis=-1)
+    syms = np.repeat(np.arange(Kf), Lf)
+    df_l = pd.DataFrame({
+        "sym": syms, "event_ts": secs.ravel(),
+        "x": rng.standard_normal(Kf * Lf),
+    })
+    r_secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                       axis=-1)
+    df_r = pd.DataFrame({
+        "sym": syms, "event_ts": r_secs.ravel(),
+        "v0": rng.standard_normal(Kf * Lf),
+        "v1": rng.standard_normal(Kf * Lf),
+    })
+    lt = TSDF(df_l, "event_ts", ["sym"])
+    rt = TSDF(df_r, "event_ts", ["sym"])
+    mesh = make_mesh({"series": 1})
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+
+    def chain():
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW_SECS)
+                .EMA("x", exact=True)
+                .collect().df)
+
+    def timed(label):
+        print(f"[plan_chain] {label} warmup/compile...", file=sys.stderr,
+              flush=True)
+        t0 = time.perf_counter()
+        warm = chain()
+        first_call = time.perf_counter() - t0
+        assert len(warm) == Kf * Lf
+        del warm
+        ts = []
+        for _ in range(max(ITERS, 2)):
+            t0 = time.perf_counter()
+            res = chain()
+            ts.append(time.perf_counter() - t0)
+            del res
+        return float(np.median(ts)), first_call
+
+    # eager first (planning off), then the planned path on the SAME
+    # packed frames — results must agree bit-for-bit
+    os.environ.pop("TEMPO_TPU_PLAN", None)
+    eager_ref = chain()
+    t_eager, _ = timed("eager")
+    os.environ["TEMPO_TPU_PLAN"] = "1"
+    try:
+        plan_cache.CACHE.clear()
+        planned_ref = chain()
+        pd.testing.assert_frame_equal(eager_ref, planned_ref,
+                                      check_exact=True)
+        del eager_ref, planned_ref
+        plan_cache.CACHE.clear()
+        t_planned, first_call = timed("planned")
+        stats = profiling.plan_cache_stats()
+    finally:
+        os.environ.pop("TEMPO_TPU_PLAN", None)
+    assert stats["hits"] >= 2 and stats["builds"] == 1, stats
+    return {
+        "rows": Kf * Lf,
+        "planned_rows_per_sec": Kf * Lf / t_planned,
+        "eager_rows_per_sec": Kf * Lf / t_eager,
+        "planned_vs_eager": round(t_eager / t_planned, 3),
+        "t_iter_planned": t_planned,
+        "t_iter_eager": t_eager,
+        "first_call_s": round(first_call, 3),
+        "plan_cache": {k: stats[k] for k in
+                       ("hits", "misses", "builds", "evictions")},
+        "value_audit": "planned == eager bitwise (assert_frame_equal "
+                       "check_exact)",
+    }
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -1398,6 +1491,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-plan-chain" in sys.argv:
+        res = _attempt("plan_chain", bench_plan_chain)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
 
     data = make_data()
     # host-only denominator first: immune to device-worker state
@@ -1445,6 +1544,8 @@ def main():
                                  timeout=2400)
     frame_e2e = _config_subprocess("--only-frame-e2e", "frame_e2e",
                                    timeout=2400)
+    plan_chain = _config_subprocess("--only-plan-chain", "plan_chain",
+                                    timeout=2400)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
     # three engines ran on identical data; at 50 Hz the unrolled forms
     # cannot legally run, so the record is streaming vs windowed —
@@ -1534,12 +1635,22 @@ def main():
                 round(chunked["9_chunked_1m_single"]["rows_per_sec"])
                 if chunked and "9_chunked_1m_single" in chunked
                 else None),
+            "10_planned_chain": (
+                round(plan_chain["planned_rows_per_sec"])
+                if plan_chain else None),
         },
         # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
         # within ~1.2x is the claim being measured
         "frame_e2e_vs_fused": (
             round(fused_rows_sec / frame_e2e["rows_per_sec"], 2)
             if frame_e2e else None),
+        # the lazy-planned chain vs the raw fused kernel (round-7
+        # acceptance: within ~1.1x) and vs the eager chain; the cache
+        # counters prove the steady-state runs were compile-free
+        "planned_vs_fused": (
+            round(fused_rows_sec / plan_chain["planned_rows_per_sec"], 2)
+            if plan_chain else None),
+        "plan_chain": plan_chain,
         "chunked": chunked,
         "opsweep": opsweep,
         "nbbo_slot_occupancy": (round(nbbo[2], 3) if nbbo else None),

@@ -98,6 +98,14 @@ KNOBS: Dict[str, Knob] = _knobs(
     Knob("TEMPO_TPU_NO_STDERR_FILTER", "bool", "0", "__graft_entry__",
          "1 disables the benign XLA:CPU AOT stderr filter of the "
          "multichip dryrun"),
+    Knob("TEMPO_TPU_PLAN", "bool", "0", "tempo_tpu/plan",
+         "1 turns on the lazy query planner: recorded op chains are "
+         "optimized (kernel fusion, engine hoisting, column pruning) "
+         "and executed at collect(); eager is the default"),
+    Knob("TEMPO_TPU_PLAN_CACHE_SIZE", "int", "64", "tempo_tpu/plan/cache",
+         "LRU bound of the planner's compiled-executable cache "
+         "(entries keyed by plan signature + shapes + mesh; 0 disables "
+         "caching)"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

@@ -167,13 +167,8 @@ class DistributedTSDF:
         n_t = mesh.shape[time_axis] if time_axis else 1
 
         layout = tsdf.layout
-        K = layout.n_series
-        # series dim: multiple of every mesh axis so layout-switching
-        # collectives (all_to_all resample path) stay legal
-        k_mult = n_s * n_t
-        K_dev = max(1, -(-K // k_mult)) * k_mult
-        L = packing.pad_length(int(layout.lengths.max(initial=0)),
-                               multiple=8 * n_t)
+        K_dev, L, n_s, n_t = _mesh_packed_geometry(
+            layout, mesh, series_axis, time_axis)
 
         dt = packing.compute_dtype()
         ts_p = packing.pack_column(layout.ts_ns, layout, L, fill=packing.TS_PAD)
@@ -238,6 +233,26 @@ class DistributedTSDF:
                    tsdf.ts_dtype(), tsdf.df, host_cols, halo_fraction,
                    seq=seq_d, seq_col=tsdf.sequence_col or "")
 
+    def _plan_record(self, op: str, others=(), params=None, objs=None):
+        """Record a deferred plan node over this (already packed) mesh
+        frame instead of executing (``TEMPO_TPU_PLAN=1``); the lazy
+        wrapper's ``collect()`` optimizes + executes through the plan
+        executable cache (tempo_tpu/plan/)."""
+        from tempo_tpu.plan import lazy as plan_lazy
+
+        return plan_lazy.record(self, op, others, params, objs)
+
+    def explain(self, cost: bool = False) -> str:
+        """Render this frame's query plan (bare mesh source when
+        eager; the lazy wrappers show recorded chains + optimizer
+        rewrites)."""
+        from tempo_tpu.plan import ir, render
+
+        text = render.explain_text(ir.Node("dist_source", payload=self),
+                                   cost=cost)
+        print(text)
+        return text
+
     def _with(self, **kw) -> "DistributedTSDF":
         base = dict(
             mesh=self.mesh, series_axis=self.series_axis,
@@ -276,6 +291,32 @@ class DistributedTSDF:
         shard = L // self.n_time
         return max(1, min(shard, int(shard * self.halo_fraction)))
 
+    def _range_engine_choice(self, window_secs: float):
+        """``(engine, rowbounds, sort_kernels)`` — the three-way
+        range-stats engine decision for this frame's shard shape, shared
+        by the eager :meth:`withRangeStats`, the plan optimizer's
+        plan-time hoist (via :func:`plan_range_engine_choice`), and the
+        fused-chain executor (plan/fused.py).  On TPU, row-boundable
+        windows run gather-free as shifted masked accumulations
+        (ops/sortmerge.py); bounds come from the host layout once per
+        window size."""
+        sort_kernels = _use_sort_kernels()
+        if not sort_kernels:
+            return "shifted", None, sort_kernels
+        rb = self._window_rowbounds(window_secs)
+        # per-device shard element count bounds the unrolled form's
+        # HBM footprint (ops/rolling.py:shifted_row_budget); on the
+        # exact strategy the kernel computes over series-local FULL
+        # rows (the a2a layout switch), so the shard is K/devices
+        # by the full L.  Same three-way pick as the host frame
+        # (ops/rolling.pick_range_engine): shifted / streaming VMEM
+        # sweep / prefix+RMQ fallback.
+        shard_k = self.K_dev // (self.n_series_shards
+                                 * max(self.n_time, 1))
+        engine, rowbounds = _pick_range_engine_for_shard(shard_k, self.L,
+                                                         rb)
+        return engine, rowbounds, sort_kernels
+
     # ------------------------------------------------------------------
     # withRangeStats (tsdf.py:673-721)
     # ------------------------------------------------------------------
@@ -297,41 +338,23 @@ class DistributedTSDF:
         """
         if strategy not in ("exact", "halo"):
             raise ValueError("strategy must be 'exact' or 'halo'")
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("range_stats", params=dict(
+                colsToSummarize=tuple(colsToSummarize)
+                if colsToSummarize else None,
+                rangeBackWindowSecs=rangeBackWindowSecs,
+                strategy=strategy))
         cols = colsToSummarize or self.numeric_columns()
         w = float(rangeBackWindowSecs)
         new_cols = dict(self.cols)
         audits = list(self.audits)
-        # on TPU, row-boundable windows run gather-free as shifted
-        # masked accumulations (ops/sortmerge.py); bounds come from the
-        # host layout once per window size
-        sort_kernels = _use_sort_kernels()
-        rowbounds = None
-        engine = "shifted"
-        if sort_kernels and strategy == "exact":
-            from tempo_tpu.ops import pallas_stats as _ps
-            from tempo_tpu.ops import pallas_window as _pw
-
-            rb = self._window_rowbounds(w)
-            # per-device shard element count bounds the unrolled form's
-            # HBM footprint (ops/rolling.py:shifted_row_budget); on the
-            # exact strategy the kernel computes over series-local FULL
-            # rows (the a2a layout switch), so the shard is K/devices
-            # by the full L.  Same three-way pick as the host frame
-            # (ops/rolling.pick_range_engine): shifted / streaming VMEM
-            # sweep / prefix+RMQ fallback.
-            shard_k = self.K_dev // (self.n_series_shards
-                                     * max(self.n_time, 1))
-            f32 = packing.compute_dtype() == np.float32
-            pallas_ok = f32 and _ps.pallas_block_feasible(
-                max(shard_k, 1), self.L)
-            stream_ok = f32 and _pw.stream_block_feasible(
-                max(shard_k, 1), self.L)
-            if rb is not None:
-                engine = rk.pick_range_engine(
-                    max(shard_k, 1) * self.L, rb[0], rb[1],
-                    pallas_ok, stream_ok)
-                if engine != "windowed":
-                    rowbounds = rb
+        if strategy == "exact":
+            engine, rowbounds, sort_kernels = self._range_engine_choice(w)
+        else:
+            engine, rowbounds, sort_kernels = \
+                "shifted", None, _use_sort_kernels()
         for c in cols:
             col = self.cols[c]
             if self.n_time > 1 and strategy == "halo":
@@ -365,8 +388,7 @@ class DistributedTSDF:
                     f"this is a tempo-tpu bug — please report it",
                     rb_clipped,
                 ))
-            for stat in ("mean", "count", "min", "max", "sum", "stddev",
-                         "zscore"):
+            for stat in packing.RANGE_STATS:
                 new_cols[f"{stat}_{c}"] = DistCol(
                     stats[stat], self.mask, int64=(stat == "count"),
                 )
@@ -387,6 +409,12 @@ class DistributedTSDF:
         scan composes across time shards (associative carry stitch); the
         truncated-lag approximation does not, so time-sharded meshes
         require ``exact=True``."""
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("ema", params=dict(
+                colName=colName, window=window, exp_factor=exp_factor,
+                exact=exact, inclusive_window=inclusive_window))
         col = self.cols[colName]
         if self.n_time > 1:
             if not exact:
@@ -443,6 +471,15 @@ class DistributedTSDF:
         brackets and broadcast-range fast path (tsdf.py:463-509), both
         of which this join replaces — the packed layout is skew-free by
         construction and the merge join is already shuffle-free."""
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("asof_join", (right,), dict(
+                left_prefix=left_prefix, right_prefix=right_prefix,
+                tsPartitionVal=tsPartitionVal, fraction=fraction,
+                skipNulls=skipNulls, sql_join_opt=sql_join_opt,
+                suppress_null_warning=suppress_null_warning,
+                maxLookback=maxLookback))
         if tsPartitionVal is not None:
             logger.info(
                 "asofJoin: tsPartitionVal ignored on the mesh — the "
@@ -674,6 +711,12 @@ class DistributedTSDF:
         switched to a series-local layout with one all_to_all each way
         (the reshard analog of the reference's groupBy shuffle).
         """
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("resample", params=dict(
+                freq=freq, func=func,
+                metricCols=tuple(metricCols) if metricCols else None))
         validateFuncExists(func)
         step = freq_to_seconds(freq) * packing.NS_PER_S
         cols = metricCols or self.numeric_columns()
@@ -695,7 +738,7 @@ class DistributedTSDF:
                           resampled=True, seq=None, seq_col="",
                           resample_freq=freq)
 
-    def calc_bars(self, freq: str, func=None, metricCols=None,
+    def calc_bars(self, freq: str, func=None, metricCols=None,  # plan-ok: eager-only
                   fill=None) -> "DistributedTSDF":
         """OHLC bars (tsdf.py:813-826) device-resident.  The reference
         runs four resamples and joins them on key+ts; here the four
@@ -710,28 +753,33 @@ class DistributedTSDF:
         realised as the device interpolate's zero fill over the merged
         bucket-head view (round 4; the four grids are identical, so
         fill-then-merge and merge-then-fill commute)."""
-        mc = metricCols or self.numeric_columns()
-        new_cols: Dict[str, DistCol] = {}
-        base = None
-        for prefix, f in (("open", "floor"), ("low", "min"),
-                          ("high", "max"), ("close", "ceil")):
-            r = self.resample(freq, f, metricCols=mc)
-            base = r
-            for c in mc:
-                new_cols[f"{prefix}_{c}"] = r.cols[c]
-        # host column order parity: prefixed metrics sorted by name
-        # (resample.py:calc_bars sorts the non-partition columns)
-        new_cols = {c: new_cols[c] for c in sorted(new_cols)}
-        bars = base._with(cols=new_cols)
-        if fill:
-            bars = bars.interpolate(method="zero")
-        return bars
+        from tempo_tpu import plan
+
+        with plan.suspended():
+            # eager-only op whose body chains recorded methods
+            # (resample/interpolate): those must not re-enter planning
+            mc = metricCols or self.numeric_columns()
+            new_cols: Dict[str, DistCol] = {}
+            base = None
+            for prefix, f in (("open", "floor"), ("low", "min"),
+                              ("high", "max"), ("close", "ceil")):
+                r = self.resample(freq, f, metricCols=mc)
+                base = r
+                for c in mc:
+                    new_cols[f"{prefix}_{c}"] = r.cols[c]
+            # host column order parity: prefixed metrics sorted by name
+            # (resample.py:calc_bars sorts the non-partition columns)
+            new_cols = {c: new_cols[c] for c in sorted(new_cols)}
+            bars = base._with(cols=new_cols)
+            if fill:
+                bars = bars.interpolate(method="zero")
+            return bars
 
     # ------------------------------------------------------------------
     # withGroupedStats (tsdf.py:723-759) / vwap (TSDF.scala:378-401)
     # ------------------------------------------------------------------
 
-    def withGroupedStats(self, metricCols=None,
+    def withGroupedStats(self, metricCols=None,  # plan-ok: eager-only
                          freq: str = None) -> "DistributedTSDF":
         """Distributed tumbling-window grouped statistics: six
         aggregates per metric column per epoch-aligned bucket, emitted
@@ -756,7 +804,7 @@ class DistributedTSDF:
                           resampled=True, seq=None, seq_col="",
                           resample_freq=freq)
 
-    def vwap(self, frequency: str = "m", volume_col: str = "volume",
+    def vwap(self, frequency: str = "m", volume_col: str = "volume",  # plan-ok: eager-only
              price_col: str = "price") -> "DistributedTSDF":
         """Distributed VWAP (Scala spec): per (series, truncated-ts)
         bucket — dllr_value = sum(price*volume), total volume,
@@ -813,6 +861,13 @@ class DistributedTSDF:
         adds the reference's ``is_ts_interpolated`` /
         ``is_interpolated_<col>`` flag columns (interpol.py:330-364).
         """
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("interpolate", params=dict(
+                freq=freq, func=func, method=method,
+                target_cols=tuple(target_cols) if target_cols else None,
+                show_interpolated=show_interpolated))
         if method not in ("zero", "null", "ffill", "bfill", "linear"):
             raise ValueError(
                 f"Please select from one of the following fill options: "
@@ -1019,6 +1074,11 @@ class DistributedTSDF:
         Bucket-head (resampled) views keep the host fallback — their
         real rows are not front-packed, which the batched DFT
         requires."""
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("fourier", params=dict(
+                timestep=timestep, valueCol=valueCol))
         matches = [c for c in self.cols if c.lower() == valueCol.lower()
                    and self.cols[c].ts_chunk is None
                    and self.cols[c].host_gather is None]
@@ -1029,9 +1089,18 @@ class DistributedTSDF:
             # collect-based path — spectral.py resolves any frame
             # column, including raising the reference's error for a
             # truly absent one
-            host = self.collect().fourier_transform(timestep, valueCol)
-            return host.on_mesh(self.mesh, series_axis=self.series_axis,
-                                time_axis=self.time_axis)
+            logger.warning(
+                "fourier_transform(%r): materialization barrier — the "
+                "mesh chain silently collects to host here (%s) and "
+                "re-packs afterwards; under TEMPO_TPU_PLAN=1 explain() "
+                "marks this barrier in the plan", valueCol,
+                "bucket-head (resampled) view" if self.resampled
+                else "no plain device plane for the column")
+            with plan.suspended():
+                host = self.collect().fourier_transform(timestep, valueCol)
+                return host.on_mesh(self.mesh,
+                                    series_axis=self.series_axis,
+                                    time_axis=self.time_axis)
         vc = matches[0]
         col = self.cols[vc]
         freq, ftr, fti = _fourier_fn(self.mesh, self.series_axis,
@@ -1057,9 +1126,23 @@ class DistributedTSDF:
         row-materialisation op — so the distributed form collects once
         and runs the device shifted-stack path; the dense device-side
         form is :meth:`lookback_tensor`."""
-        return self.collect().withLookbackFeatures(
-            featureCols, lookbackWindowSize, exactSize, featureColName
-        )
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("lookback_features", params=dict(
+                featureCols=tuple(featureCols),
+                lookbackWindowSize=lookbackWindowSize,
+                exactSize=exactSize, featureColName=featureColName))
+        logger.warning(
+            "withLookbackFeatures: materialization barrier — the mesh "
+            "chain silently collects to host here (collect_list "
+            "semantics materialise rows); use lookback_tensor for the "
+            "device-resident dense form, or TEMPO_TPU_PLAN=1 explain() "
+            "to see the barrier in the plan")
+        with plan.suspended():
+            return self.collect().withLookbackFeatures(
+                featureCols, lookbackWindowSize, exactSize, featureColName
+            )
 
     def lookback_tensor(self, featureCols, lookbackWindowSize: int):
         """Dense ``([K, L, w, F] values, [K, L, w, F] validity)``
@@ -1107,7 +1190,7 @@ class DistributedTSDF:
     # Materialisation
     # ------------------------------------------------------------------
 
-    def collect(self):
+    def collect(self):  # plan-ok: eager-only
         """ONE stacked device->host transfer -> host TSDF."""
         global _FETCH_EVENTS
         from tempo_tpu.frame import TSDF
@@ -1216,6 +1299,68 @@ class DistributedTSDF:
             f"cols={self.numeric_columns()}, host_cols={list(self.host_cols)}, "
             f"ts_col={self.ts_col!r}, partition_cols={self.partitionCols})"
         )
+
+
+def _mesh_packed_geometry(layout, mesh, series_axis: str,
+                          time_axis: Optional[str]):
+    """``(K_dev, L, n_series_shards, n_time)`` — the packed geometry
+    :meth:`DistributedTSDF.from_tsdf` will realise for this layout on
+    this mesh.  The series dim is a multiple of every mesh axis so
+    layout-switching collectives (the all_to_all resample path) stay
+    legal.  Shared with the plan optimizer's engine hoist, which must
+    reason about shard shapes BEFORE the frame is packed."""
+    n_s = mesh.shape[series_axis]
+    n_t = mesh.shape[time_axis] if time_axis else 1
+    k_mult = n_s * n_t
+    K_dev = max(1, -(-layout.n_series // k_mult)) * k_mult
+    L = packing.pad_length(int(layout.lengths.max(initial=0)),
+                           multiple=8 * n_t)
+    return K_dev, L, n_s, n_t
+
+
+def _pick_range_engine_for_shard(shard_k: int, L: int, rb):
+    """The shifted/stream/windowed pick for one shard shape + static
+    row bounds (None = unboundable -> the data-independent windowed
+    form).  One function so the realized-frame pick
+    (:meth:`DistributedTSDF._range_engine_choice`) and the pre-packing
+    plan-time pick (:func:`plan_range_engine_choice`) can never
+    diverge — a hoisted hint that disagreed with the run-time pick
+    would silently change which kernel (and which float rounding) a
+    planned chain runs."""
+    from tempo_tpu.ops import pallas_stats as _ps
+    from tempo_tpu.ops import pallas_window as _pw
+
+    f32 = packing.compute_dtype() == np.float32
+    pallas_ok = f32 and _ps.pallas_block_feasible(max(shard_k, 1), L)
+    stream_ok = f32 and _pw.stream_block_feasible(max(shard_k, 1), L)
+    engine = "shifted"
+    rowbounds = None
+    if rb is not None:
+        engine = rk.pick_range_engine(max(shard_k, 1) * L, rb[0], rb[1],
+                                      pallas_ok, stream_ok)
+        if engine != "windowed":
+            rowbounds = rb
+    return engine, rowbounds
+
+
+def plan_range_engine_choice(layout, mesh, series_axis: str,
+                             time_axis: Optional[str],
+                             window_secs: float):
+    """``(engine, rowbounds, sort_kernels)`` a frame packed from
+    ``layout`` onto ``mesh`` will choose in
+    :meth:`DistributedTSDF._range_engine_choice` — computed WITHOUT
+    packing, for the plan optimizer's plan-time hoist."""
+    sort_kernels = _use_sort_kernels()
+    if not sort_kernels:
+        return "shifted", None, sort_kernels
+    K_dev, L, n_s, n_t = _mesh_packed_geometry(layout, mesh,
+                                               series_axis, time_axis)
+    rb = (packing.layout_rowbounds(layout, window_secs)
+          if layout.n_rows > 0 and int(layout.starts[-1]) == layout.n_rows
+          else None)
+    shard_k = K_dev // (n_s * max(n_t, 1))
+    engine, rowbounds = _pick_range_engine_for_shard(shard_k, L, rb)
+    return engine, rowbounds, sort_kernels
 
 
 def _put_global(sharding):

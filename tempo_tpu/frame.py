@@ -144,6 +144,34 @@ class TSDF:
             self.__validated_column(df, col)
         return colnames
 
+    # ------------------------------------------------------------------
+    # Lazy query planning (tempo_tpu/plan/; TEMPO_TPU_PLAN=1)
+    # ------------------------------------------------------------------
+
+    def _plan_record(self, op: str, others=(), params=None, objs=None):
+        """Record a deferred plan node over this frame instead of
+        executing (planning on, ``TEMPO_TPU_PLAN=1``).  Returns the
+        lazy wrapper the planned chain continues on; ``collect``/
+        ``.df``-style terminals optimize + execute it through the
+        executable cache."""
+        from tempo_tpu.plan import lazy as plan_lazy
+
+        return plan_lazy.record(self, op, others, params, objs)
+
+    def explain(self, cost: bool = False) -> str:
+        """Render this frame's query plan.  On an eager frame there is
+        nothing deferred — the plan is a bare source; under
+        ``TEMPO_TPU_PLAN=1`` the lazy wrappers' ``explain`` shows the
+        recorded logical plan, the optimizer's rewrites, per-node
+        engine choices and barriers (the analog of the reference's
+        ``explain cost``, tsdf.py display path)."""
+        from tempo_tpu.plan import ir, render
+
+        text = render.explain_text(ir.Node("source", payload=self),
+                                   cost=cost)
+        print(text)
+        return text
+
     def _check_partition_cols_match(self, other: "TSDF") -> None:
         for lc, rc in zip(self.partitionCols, other.partitionCols):
             if lc != rc:
@@ -278,6 +306,10 @@ class TSDF:
         """Parity: tsdf.py:319-343 - structural columns must be retained."""
         if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
             cols = tuple(cols[0])
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record("select", params=dict(cols=tuple(cols)))
         if "*" in cols:
             cols = tuple(self.df.columns)
         seq_stub = [self.sequence_col] if self.sequence_col else []
@@ -289,7 +321,7 @@ class TSDF:
             "seq_col_stub(optional) must be present"
         )
 
-    def selectExpr(self, *exprs, strict: Optional[bool] = None) -> "TSDF":
+    def selectExpr(self, *exprs, strict: Optional[bool] = None) -> "TSDF":  # plan-ok: eager-only
         """Spark-style SQL projections (parity: TSDF.scala:226-229) via
         the vectorized expression engine (``tempo_tpu.sql``): arithmetic,
         CASE WHEN, CAST, IN/BETWEEN/LIKE, and the common function
@@ -326,7 +358,7 @@ class TSDF:
                     out[raw.strip()] = self.df[raw.strip()]
         return self._with_df(pd.DataFrame(out))
 
-    def filter(self, condition, strict: Optional[bool] = None) -> "TSDF":
+    def filter(self, condition, strict: Optional[bool] = None) -> "TSDF":  # plan-ok: eager-only
         """Row filter (parity: TSDF.scala:232-238).  String predicates
         parse as SQL (three-valued logic: NULL rows drop, like Spark),
         falling back to pandas ``query`` syntax for backward compat —
@@ -357,10 +389,10 @@ class TSDF:
 
     where = filter
 
-    def limit(self, n: int) -> "TSDF":
+    def limit(self, n: int) -> "TSDF":  # plan-ok: eager-only
         return self._with_df(self.df.head(n))
 
-    def union(self, other: "TSDF") -> "TSDF":
+    def union(self, other: "TSDF") -> "TSDF":  # plan-ok: eager-only
         return self._with_df(
             pd.concat([self.df, other.df[self.df.columns]], ignore_index=True)
         )
@@ -368,21 +400,27 @@ class TSDF:
     unionAll = union
 
     def withColumn(self, colName: str, values) -> "TSDF":
+        from tempo_tpu import plan
+
+        if plan.recording():
+            return self._plan_record(
+                "with_column", params=dict(colName=colName, values=values),
+                objs=dict(values=values))
         df = self.df.copy()
         df[colName] = values(df) if callable(values) else values
         return self._with_df(df)
 
-    def withColumnRenamed(self, existing: str, new: str) -> "TSDF":
+    def withColumnRenamed(self, existing: str, new: str) -> "TSDF":  # plan-ok: eager-only
         df = self.df.rename(columns={existing: new})
         ts_col = new if existing == self.ts_col else self.ts_col
         pcols = [new if c == existing else c for c in self.partitionCols]
         seq = new if existing == self.sequence_col else (self.sequence_col or None)
         return TSDF(df, ts_col, pcols, seq)
 
-    def drop(self, *cols) -> "TSDF":
+    def drop(self, *cols) -> "TSDF":  # plan-ok: eager-only
         return self._with_df(self.df.drop(columns=list(cols)))
 
-    def withPartitionCols(self, partitionCols) -> "TSDF":
+    def withPartitionCols(self, partitionCols) -> "TSDF":  # plan-ok: eager-only
         """Parity: tsdf.py:583-590 (note: drops sequence_col, as reference does)."""
         return TSDF(self.df, self.ts_col, partitionCols)
 
@@ -476,6 +514,15 @@ class TSDF:
         model, SURVEY.md §2.3); pass a 2-D mesh + ``time_axis`` for
         sequence parallelism with halo exchange.  On a single device
         this is the device-residency fast path for chained pipelines."""
+        from tempo_tpu import plan
+
+        if plan.recording():
+            from tempo_tpu.plan import ir as plan_ir
+
+            return self._plan_record("on_mesh", params=dict(
+                time_axis=time_axis, series_axis=series_axis,
+                halo_fraction=halo_fraction,
+                mesh=plan_ir._mesh_state(mesh)), objs=dict(mesh=mesh))
         from tempo_tpu.dist import DistributedTSDF
 
         return DistributedTSDF.from_tsdf(
@@ -501,8 +548,15 @@ class TSDF:
     ) -> "TSDF":
         """AS-OF join (parity: tsdf.py:463-560; maxLookback from scala
         asofJoin.scala:64-88)."""
-        from tempo_tpu import join
+        from tempo_tpu import join, plan
 
+        if plan.recording():
+            return self._plan_record("asof_join", (right_tsdf,), dict(
+                left_prefix=left_prefix, right_prefix=right_prefix,
+                tsPartitionVal=tsPartitionVal, fraction=fraction,
+                skipNulls=skipNulls, sql_join_opt=sql_join_opt,
+                suppress_null_warning=suppress_null_warning,
+                maxLookback=maxLookback))
         return join.asof_join(
             self,
             right_tsdf,
@@ -516,7 +570,7 @@ class TSDF:
             maxLookback=maxLookback,
         )
 
-    def fourier_transform(self, timestep: float, valueCol: str) -> "TSDF":
+    def fourier_transform(self, timestep: float, valueCol: str) -> "TSDF":  # plan-ok: eager-only
         """Frequency-domain representation per series (parity:
         tsdf.py:828-902, scipy-via-applyInPandas replaced by batched
         on-device FFT)."""
@@ -559,11 +613,17 @@ class TSDF:
     ):
         """Downsample by a coarser frequency (parity: tsdf.py:764-776).
         Returns a ``_ResampledTSDF`` supporting chained ``.interpolate``."""
+        from tempo_tpu import plan
         from tempo_tpu import resample as rs
 
+        if plan.recording():
+            return self._plan_record("resample", params=dict(
+                freq=freq, func=func,
+                metricCols=tuple(metricCols) if metricCols else None,
+                prefix=prefix, fill=fill))
         return rs.resample(self, freq, func, metricCols, prefix, fill)
 
-    def calc_bars(self, freq: str, func=None, metricCols=None, fill=None) -> "TSDF":
+    def calc_bars(self, freq: str, func=None, metricCols=None, fill=None) -> "TSDF":  # plan-ok: eager-only
         """OHLC bars (parity: tsdf.py:813-826)."""
         from tempo_tpu import resample as rs
 
@@ -574,8 +634,12 @@ class TSDF:
         """Fused floor-resample + exact EMA in one device pass — the
         single-read form of ``resample(freq, 'floor')`` followed by
         ``EMA(..., exact=True)`` (tempo_tpu/resample.py:resample_ema)."""
+        from tempo_tpu import plan
         from tempo_tpu import resample as rs
 
+        if plan.recording():
+            return self._plan_record("resample_ema", params=dict(
+                freq=freq, colName=colName, exp_factor=exp_factor))
         return rs.resample_ema(self, freq, colName, exp_factor)
 
     def interpolate(
@@ -589,8 +653,16 @@ class TSDF:
         show_interpolated: bool = False,
     ) -> "TSDF":
         """Resample + fill missing values (parity: tsdf.py:778-811)."""
-        from tempo_tpu import interpol
+        from tempo_tpu import interpol, plan
 
+        if plan.recording():
+            return self._plan_record("interpolate", params=dict(
+                freq=freq, func=func, method=method,
+                target_cols=tuple(target_cols) if target_cols else None,
+                ts_col=ts_col,
+                partition_cols=tuple(partition_cols) if partition_cols
+                else None,
+                show_interpolated=show_interpolated))
         return interpol.interpolate_frame(
             self, freq, func, method, target_cols, ts_col, partition_cols,
             show_interpolated,
@@ -600,11 +672,17 @@ class TSDF:
         self, type: str = "range", colsToSummarize=None, rangeBackWindowSecs: int = 1000
     ) -> "TSDF":
         """Rolling range statistics (parity: tsdf.py:673-721)."""
-        from tempo_tpu import rolling
+        from tempo_tpu import plan, rolling
 
+        if plan.recording():
+            return self._plan_record("range_stats", params=dict(
+                type=type,
+                colsToSummarize=tuple(colsToSummarize)
+                if colsToSummarize else None,
+                rangeBackWindowSecs=rangeBackWindowSecs))
         return rolling.with_range_stats(self, type, colsToSummarize, rangeBackWindowSecs)
 
-    def withGroupedStats(self, metricCols=None, freq=None) -> "TSDF":
+    def withGroupedStats(self, metricCols=None, freq=None) -> "TSDF":  # plan-ok: eager-only
         """Tumbling-window grouped statistics (parity: tsdf.py:723-759)."""
         from tempo_tpu import rolling
 
@@ -618,12 +696,16 @@ class TSDF:
         computes the untruncated recursive EMA via an associative scan;
         ``inclusive_window=True`` matches the Scala 0..window lag range,
         EMA.scala:31)."""
-        from tempo_tpu import rolling
+        from tempo_tpu import plan, rolling
 
+        if plan.recording():
+            return self._plan_record("ema", params=dict(
+                colName=colName, window=window, exp_factor=exp_factor,
+                exact=exact, inclusive_window=inclusive_window))
         return rolling.ema(self, colName, window, exp_factor, exact,
                            inclusive_window)
 
-    def vwap(
+    def vwap(  # plan-ok: eager-only
         self, frequency: str = "m", volume_col: str = "volume", price_col: str = "price"
     ) -> "TSDF":
         """Volume-weighted average price (spec: scala TSDF.scala:378-401)."""

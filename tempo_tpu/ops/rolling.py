@@ -67,15 +67,39 @@ def pick_range_engine(n_elems: int, max_behind: int, max_ahead: int,
     """'shifted' | 'stream' | 'windowed' for a frame whose row extent
     is (max_behind, max_ahead) on a shard of ``n_elems`` values.
     ``pallas_small_ok``/``stream_ok``: the caller verified the
-    respective VMEM kernels can take this shard shape/dtype."""
+    respective VMEM kernels can take this shard shape/dtype.
+
+    When the lazy planner replays a node whose engine was hoisted to
+    plan time (tempo_tpu/plan/optimizer.py), the decision arrives as a
+    hint and wins — skipping the knob read — but only while it still
+    matches what the current shard's bounds would pick.  The three
+    engines differ in FMA/rounding order, so a cached plan replayed
+    over different data (same shapes, different row bounds) must
+    re-pick rather than force an engine eager execution would not
+    choose — that would break the planned==eager bit-identity contract
+    (MIGRATION.md v0.7).  Join hints have no such guard because every
+    join engine is bit-identical to the others."""
+    from tempo_tpu.ops import pallas_window as pw
+    from tempo_tpu.plan import hints as plan_hints
+
+    W = int(max_behind) + int(max_ahead)
+    hinted = plan_hints.get("range_engine")
+    if hinted in ("shifted", "stream", "windowed"):
+        fits_shifted = W <= shifted_row_budget(n_elems, pallas_small_ok)
+        fits_stream = stream_ok and W <= pw._stream_max_rows()
+        if hinted == "shifted" and fits_shifted:
+            return "shifted"
+        if hinted == "stream" and not fits_shifted and fits_stream:
+            return "stream"
+        if hinted == "windowed" and not fits_shifted and not fits_stream:
+            return "windowed"
+        # the data moved out from under the hoisted decision: fall
+        # through and re-pick (knob read included)
     forced = window_engine_override()
     if forced in ("shifted", "stream", "windowed"):
         return forced
-    W = int(max_behind) + int(max_ahead)
     if W <= shifted_row_budget(n_elems, pallas_small_ok):
         return "shifted"
-    from tempo_tpu.ops import pallas_window as pw
-
     if stream_ok and W <= pw._stream_max_rows():
         return "stream"
     return "windowed"

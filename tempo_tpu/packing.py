@@ -40,6 +40,13 @@ TS_PAD = np.int64(2**62)
 # tell real rows from padding with headroom on both sides.
 TS_REAL_MAX = np.int64(2**61)
 
+# Canonical name/order of the per-column aggregates withRangeStats
+# emits (`<stat>_<col>`, Spark's six plus the derived zscore).  The
+# stats kernels (ops/sortmerge, ops/pallas_window), the frame/mesh
+# unpack loops, and the planner's schema inference + fused program
+# (tempo_tpu/plan) must all agree on this tuple — define it once.
+RANGE_STATS = ("mean", "count", "min", "max", "sum", "stddev", "zscore")
+
 
 def compute_dtype() -> np.dtype:
     """Floating dtype for on-device metric math.

@@ -59,7 +59,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tempo_tpu.ops import asof as asof_ops
 from tempo_tpu.ops import rolling as rk
 
-from tempo_tpu.packing import TS_PAD, TS_REAL_MAX
+from tempo_tpu.packing import RANGE_STATS, TS_PAD, TS_REAL_MAX
 
 # sentinel smaller than any real ns timestamp, with headroom so
 # subtracting a window width cannot underflow int64 (mirror of TS_PAD)
@@ -186,9 +186,7 @@ def _build_range_stats(
         clipped = jax.lax.psum(local_clip, axes)
         return out, clipped
 
-    out_stats_spec = {
-        k: spec2 for k in ("mean", "count", "min", "max", "sum", "stddev", "zscore")
-    }
+    out_stats_spec = {k: spec2 for k in RANGE_STATS}
     fn = shard_map(
         kernel,
         mesh=mesh,

@@ -1,0 +1,69 @@
+"""Lazy query planner for TSDF / DistributedTSDF chains.
+
+The reference outsources query planning to Spark's Catalyst (SURVEY §1
+L0); the rebuild has every op but executed them op-by-op, so a chain
+like ``on_mesh().asofJoin().withRangeStats().EMA().collect()`` only
+reached fused-kernel rates when a human called the fused entry points
+by hand.  This package is the missing layer:
+
+* :mod:`~tempo_tpu.plan.ir` — deferred op nodes.  When planning is on
+  (``TEMPO_TPU_PLAN=1``; eager remains the default), the op methods of
+  :class:`~tempo_tpu.frame.TSDF` and
+  :class:`~tempo_tpu.dist.DistributedTSDF` record a :class:`~ir.Node`
+  instead of executing, and return a lazy wrapper
+  (:mod:`~tempo_tpu.plan.lazy`).
+* :mod:`~tempo_tpu.plan.optimizer` — rewrite passes over the recorded
+  plan: adjacent-node fusion onto the already-shipped fused kernels
+  (``resampleEMA``; the single-program mesh join→stats→EMA chain),
+  plan-time engine selection (``pick_join_engine`` /
+  ``pick_range_engine`` hoisted so knob reads happen once), dead-column
+  pruning before packing, and explicit host-materialisation barrier
+  marking.
+* :mod:`~tempo_tpu.plan.cache` — compiled executables keyed by
+  (optimized-plan signature, source shapes/dtypes, mesh) with an LRU
+  bound (``TEMPO_TPU_PLAN_CACHE_SIZE``) and hit/miss/evict counters
+  surfaced through :func:`tempo_tpu.profiling.plan_cache_stats`.
+* :mod:`~tempo_tpu.plan.render` — ``explain(cost=False)``: the logical
+  and optimized plans, per-node engine choices and barriers, and (with
+  ``cost=True``) XLA's post-compilation cost analysis — the analog of
+  the reference's ``explain cost`` display path.
+
+Recording is suspended inside the executor (and inside eager internals
+that planning must not re-enter) via :func:`suspended`, so replaying a
+plan through the eager methods never re-records.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+
+_SUSPENDED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "tempo_tpu_plan_suspended", default=False)
+
+
+def planning_enabled() -> bool:
+    """``TEMPO_TPU_PLAN`` truthiness (read live — tests and notebooks
+    toggle it mid-process)."""
+    from tempo_tpu import config
+
+    return config.get_bool("TEMPO_TPU_PLAN")
+
+
+def recording() -> bool:
+    """Should an op method record a plan node right now?  True only
+    when planning is enabled AND no executor/eager-internal frame is on
+    the stack (replaying a plan must not re-record)."""
+    return not _SUSPENDED.get() and planning_enabled()
+
+
+@contextlib.contextmanager
+def suspended():
+    """Run a block with plan recording off (the executor replays plans
+    through the eager API inside this; eager methods whose bodies call
+    other recorded methods wrap themselves too)."""
+    token = _SUSPENDED.set(True)
+    try:
+        yield
+    finally:
+        _SUSPENDED.reset(token)

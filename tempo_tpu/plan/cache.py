@@ -1,0 +1,94 @@
+"""Executable cache: compiled plan programs keyed by
+(optimized-plan signature, source shapes/dtypes, mesh).
+
+Serving millions of repeated queries needs plan-signature caching of
+compiled executables, not per-call retrace (ROADMAP north star): the
+second invocation of a structurally identical chain over same-shape
+frames reuses the cached executable — no re-optimization, no engine
+re-pick, and (because the underlying program builders are themselves
+keyed caches) zero new XLA compiles.  Counters are surfaced through
+:func:`tempo_tpu.profiling.plan_cache_stats`.
+
+The LRU bound is ``TEMPO_TPU_PLAN_CACHE_SIZE`` (default 64; 0 disables
+caching entirely).  A shape or dtype change on any source frame is a
+different key — a miss by design, since the compiled programs are
+shape-specialised.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional
+
+_DEFAULT_SIZE = 64
+
+
+def max_size() -> int:
+    from tempo_tpu import config
+
+    return config.get_int("TEMPO_TPU_PLAN_CACHE_SIZE", _DEFAULT_SIZE)
+
+
+class PlanCache:
+    """Thread-safe LRU of built executables + hit/miss/evict/build
+    counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.builds = 0          # executables constructed (cache misses
+        #                          + uncacheable plans)
+        self.uncacheable = 0     # runs that bypassed the cache entirely
+
+    def lookup(self, key: Optional[tuple]):
+        with self._lock:
+            if key is None:
+                self.uncacheable += 1
+                return None
+            exe = self._entries.get(key)
+            if exe is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return exe
+
+    def insert(self, key: Optional[tuple], exe) -> None:
+        with self._lock:
+            self.builds += 1
+            if key is None:
+                return
+            bound = max_size()
+            if bound <= 0:
+                return
+            self._entries[key] = exe
+            self._entries.move_to_end(key)
+            while len(self._entries) > bound:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "size": len(self._entries),
+                "max_size": max_size(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "builds": self.builds,
+                "uncacheable": self.uncacheable,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = self.misses = self.evictions = 0
+            self.builds = self.uncacheable = 0
+
+
+#: Process-wide executable cache.
+CACHE = PlanCache()

@@ -1,0 +1,34 @@
+"""Plan-time engine hints.
+
+The optimizer hoists engine selection (``pick_join_engine``,
+``pick_range_engine``) to plan time; while the executor replays a node
+whose annotations carry a hoisted decision, the hint is installed here
+and the pick functions return it without re-reading knobs or
+re-probing sizes.  Import-light on purpose: consulted from
+``tempo_tpu.profiling`` and ``tempo_tpu.ops.rolling`` without creating
+an import cycle.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Dict, Optional
+
+_HINTS: contextvars.ContextVar[Dict[str, object]] = contextvars.ContextVar(
+    "tempo_tpu_plan_hints", default={})
+
+
+def get(name: str) -> Optional[object]:
+    """The active hint value (``join_engine`` / ``range_engine``), or
+    None when no planned node is executing."""
+    return _HINTS.get().get(name)
+
+
+@contextlib.contextmanager
+def installed(hints: Dict[str, object]):
+    token = _HINTS.set(dict(hints))
+    try:
+        yield
+    finally:
+        _HINTS.reset(token)

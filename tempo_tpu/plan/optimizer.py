@@ -1,0 +1,440 @@
+"""Optimizer passes over a recorded plan.
+
+Four passes, in order:
+
+1. **Fusion** — rewrite adjacent nodes onto the already-shipped fused
+   kernels: ``resample(freq, 'floor')`` followed by
+   ``EMA(col, exact=True)`` over that single metric column becomes one
+   ``resampleEMA`` node (the PR-2 floor-resample+EMA VMEM kernel: the
+   column is read once); a mesh ``asofJoin -> withRangeStats [-> EMA]``
+   chain becomes one ``fused_asof_stats_ema`` node executed as a
+   SINGLE jitted program (plan/fused.py) instead of one dispatch per
+   op.  The resampleEMA rewrite produces exactly ``TSDF.resampleEMA``'s
+   output (bit-identical to calling the fused entry point by hand; the
+   unfused chain differs from it in float rounding, see MIGRATION.md).
+2. **Engine hoisting** — ``pick_join_engine`` / ``pick_range_engine``
+   run once at plan time; the decisions are annotated on the nodes
+   (rendered by ``explain()``) and installed as hints
+   (plan/hints.py) while the executor replays the node, so knob reads
+   and size probes happen once per plan instead of once per call.
+3. **Dead-column pruning** — when a downstream ``select`` (or a
+   ``count`` terminal) bounds the live column set, source frames are
+   pruned BEFORE packing: columns no op consumes and no output needs
+   never reach the device.
+4. **Barrier marking** — ops that force a device->host materialisation
+   (``collect``, ``withLookbackFeatures``, ``fourier_transform`` on a
+   resampled mesh view) are annotated explicitly so ``explain()``
+   shows where a chain leaves the device.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, FrozenSet, Optional, Union
+
+from tempo_tpu.plan import ir
+
+logger = logging.getLogger(__name__)
+
+#: sentinel: "every column may be needed"
+ALL = None
+
+
+def optimize(root: ir.Node) -> ir.Node:
+    """A new, annotated (possibly rewritten) plan DAG; the logical plan
+    is left untouched."""
+    root = _copy(root)
+    root = _fuse_resample_ema(root)
+    root = _fuse_mesh_chain(root)
+    _hoist_engines(root)
+    _prune_columns(root)
+    _mark_barriers(root)
+    return root
+
+
+def _copy(root: ir.Node) -> ir.Node:
+    memo: Dict[int, ir.Node] = {}
+
+    def rec(n: ir.Node) -> ir.Node:
+        if id(n) in memo:
+            return memo[id(n)]
+        c = ir.Node.__new__(ir.Node)
+        c.op = n.op
+        c.params = n.params
+        c.inputs = tuple(rec(i) for i in n.inputs)
+        c.payload = n.payload
+        c.objs = dict(n.objs)
+        c.ann = dict(n.ann)
+        memo[id(n)] = c
+        return c
+
+    return rec(root)
+
+
+def _rewrite(root: ir.Node, fn) -> ir.Node:
+    """Bottom-up node rewriter (``fn(node) -> node``)."""
+    memo: Dict[int, ir.Node] = {}
+
+    def rec(n: ir.Node) -> ir.Node:
+        if id(n) in memo:
+            return memo[id(n)]
+        n.inputs = tuple(rec(i) for i in n.inputs)
+        out = fn(n)
+        memo[id(n)] = out
+        return out
+
+    return rec(root)
+
+
+def _mesh_side(node: ir.Node) -> bool:
+    cur = node
+    while True:
+        if cur.op in ("on_mesh", "dist_source"):
+            return True
+        if not cur.inputs:
+            return False
+        cur = cur.inputs[0]
+
+
+# ----------------------------------------------------------------------
+# Pass 1a: floor-resample + exact EMA -> the fused resampleEMA kernel
+# ----------------------------------------------------------------------
+
+def _fuse_resample_ema(root: ir.Node) -> ir.Node:
+    def fn(n: ir.Node) -> ir.Node:
+        if n.op != "ema" or not n.inputs:
+            return n
+        rs = n.inputs[0]
+        if rs.op != "resample" or _mesh_side(rs):
+            return n
+        col = n.param("colName")
+        metric = rs.param("metricCols")
+        if (n.param("exact") is True
+                and rs.param("func") in ("floor", "closest_lead")
+                and rs.param("prefix") in (None, "")
+                and not rs.param("fill")
+                and metric == (col,)):
+            fused = ir.Node("resample_ema", params=dict(
+                freq=rs.param("freq"), colName=col,
+                exp_factor=n.param("exp_factor")), inputs=rs.inputs)
+            fused.ann["rewrite"] = (
+                "floor-resample + exact EMA -> resampleEMA fused kernel "
+                "(single column read)")
+            return fused
+        return n
+
+    return _rewrite(root, fn)
+
+
+# ----------------------------------------------------------------------
+# Pass 1b: mesh asofJoin -> withRangeStats [-> EMA] as ONE program
+# ----------------------------------------------------------------------
+
+def _plain_numeric_mesh_source(node: ir.Node) -> bool:
+    """True when the node is an on_mesh(source)/dist_source whose value
+    columns all ride plain numeric device planes (the fused program has
+    no host-gather / seq / resampled path)."""
+    import pandas as pd
+
+    if node.op == "dist_source":
+        p = node.payload
+        return (not p.resampled and p.seq is None and not p.host_cols
+                and p.time_axis is None
+                and all(c.ts_chunk is None and c.host_gather is None
+                        for c in p.cols.values()))
+    if node.op == "on_mesh" and node.inputs and node.inputs[0].op == "source":
+        if node.param("time_axis") is not None:
+            return False
+        t = node.inputs[0].payload
+        if t.sequence_col:
+            return False
+        structural = {t.ts_col, *t.partitionCols}
+        for c in t.df.columns:
+            if c in structural:
+                continue
+            dtype = t.df[c].dtype
+            if not (pd.api.types.is_numeric_dtype(dtype)
+                    and not pd.api.types.is_bool_dtype(dtype)):
+                return False
+        return True
+    return False
+
+
+def _fuse_mesh_chain(root: ir.Node) -> ir.Node:
+    def fn(n: ir.Node) -> ir.Node:
+        # the rewriter runs bottom-up: range_stats(asof_join) fuses
+        # first; an ema over a fused node then folds into it
+        if (n.op == "ema" and n.inputs
+                and n.inputs[0].op == "fused_asof_stats_ema"
+                and not n.inputs[0].param("has_ema")):
+            base = n.inputs[0]
+            params = dict(base.params)
+            params.update(
+                has_ema=True,
+                e_col=n.param("colName"), e_window=n.param("window"),
+                e_exp_factor=n.param("exp_factor"),
+                e_exact=n.param("exact"),
+                e_inclusive=n.param("inclusive_window"))
+            fused = ir.Node("fused_asof_stats_ema", params=params,
+                            inputs=base.inputs)
+            fused.ann.update(base.ann)
+            fused.ann["rewrite"] = (
+                "asofJoin + withRangeStats + EMA chained into ONE "
+                "jitted program (plan/fused.py)")
+            return fused
+        if n.op != "range_stats" or not _mesh_side(n) or not n.inputs:
+            return n
+        if n.param("strategy", "exact") != "exact":
+            return n
+        jn = n.inputs[0]
+        if jn.op != "asof_join" or len(jn.inputs) != 2:
+            return n
+        if not (jn.param("skipNulls") is True
+                and not jn.param("maxLookback")
+                and jn.param("tsPartitionVal") is None):
+            return n
+        left, right = jn.inputs
+        if not (_plain_numeric_mesh_source(left)
+                and _plain_numeric_mesh_source(right)):
+            return n
+        fused = ir.Node("fused_asof_stats_ema", params=dict(
+            j_left_prefix=jn.param("left_prefix"),
+            j_right_prefix=jn.param("right_prefix") or "right",
+            s_cols=n.param("colsToSummarize"),
+            s_window=n.param("rangeBackWindowSecs"),
+            has_ema=False,
+        ), inputs=(left, right))
+        fused.ann["rewrite"] = (
+            "asofJoin + withRangeStats chained into ONE jitted "
+            "program (plan/fused.py)")
+        return fused
+
+    return _rewrite(root, fn)
+
+
+# ----------------------------------------------------------------------
+# Pass 2: hoist engine selection to plan time
+# ----------------------------------------------------------------------
+
+def _source_frame(node: ir.Node):
+    """The concrete frame a source-adjacent node will execute over, if
+    it is directly available at plan time (payload of a source, or of
+    an on_mesh over a source)."""
+    if node.is_source():
+        return node.payload
+    if node.op == "on_mesh" and node.inputs and node.inputs[0].is_source():
+        return node.inputs[0].payload
+    return None
+
+
+def _hoist_engines(root: ir.Node) -> None:
+    from tempo_tpu import resilience
+
+    for n in root.walk():
+        if n.op in ("range_stats", "fused_asof_stats_ema"):
+            w = n.param("s_window" if n.op == "fused_asof_stats_ema"
+                        else "rangeBackWindowSecs", 1000)
+            engine = _plan_range_engine(n, float(w))
+            if engine is not None:
+                n.ann["range_engine"] = engine
+                n.ann.setdefault("hints", {})["range_engine"] = engine
+        if n.op in ("asof_join", "fused_asof_stats_ema"):
+            sides = [(_source_frame(c)) for c in n.inputs[:2]]
+            if all(s is not None for s in sides):
+                import numpy as np
+
+                from tempo_tpu import packing
+
+                lens = []
+                for s in sides:
+                    lay = getattr(s, "layout", None)
+                    if lay is None:
+                        lens = None
+                        break
+                    lens.append(packing.pad_length(
+                        int(np.max(lay.lengths, initial=0))))
+                if lens:
+                    limit = resilience.max_merged_lanes()
+                    est = sum(lens)
+                    from tempo_tpu import profiling
+
+                    engine = profiling.pick_join_engine(
+                        est, limit, chunked_ok=True)
+                    n.ann["join_engine"] = engine
+                    n.ann["merged_lanes_est"] = est
+                    n.ann.setdefault("hints", {})["join_engine"] = engine
+
+
+def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
+    """The engine the stats op will pick over this node's input chain,
+    computed once at plan time — the SAME decision function the eager
+    paths run per call (rolling.plan_range_engine for host frames,
+    dist's shared shard pick for mesh frames), so replaying the hint
+    can never change which kernel a planned chain runs.  None when the
+    shard shape is not derivable at plan time (e.g. stats after an
+    op that reshapes) — the executor then picks at run time, exactly
+    like eager."""
+    if not node.inputs:
+        return None
+    child = node.inputs[0]
+    try:
+        if _mesh_side(child):
+            from tempo_tpu import dist
+
+            if child.op == "dist_source":
+                engine, _, _ = child.payload._range_engine_choice(w)
+                return engine
+            # mesh chains pick on the LEFT frame's packed geometry; a
+            # join keeps it, so walk past source-preserving ops to an
+            # on_mesh(source) whose geometry is derivable pre-packing
+            cur = child
+            while cur.op in ("asof_join", "ema"):
+                cur = cur.inputs[0]
+            if cur.op == "on_mesh" and cur.inputs \
+                    and cur.inputs[0].op == "source":
+                t = cur.inputs[0].payload
+                mesh = cur.objs.get("mesh")
+                if mesh is None:
+                    from tempo_tpu.parallel.mesh import make_mesh
+
+                    mesh = make_mesh()
+                engine, _, _ = dist.plan_range_engine_choice(
+                    t.layout, mesh, cur.param("series_axis", "series"),
+                    cur.param("time_axis"), w)
+                return engine
+            return None
+        src = _source_frame(child)
+        if src is None:
+            return None
+        from tempo_tpu import rolling as frame_rolling
+
+        # the column count enters the host pick (C*K shard elements),
+        # so mirror the eager default exactly
+        pick = node.param("colsToSummarize")
+        cols = list(pick) if pick else src.summarizable_columns()
+        if not cols:
+            return None
+        engine = frame_rolling.plan_range_engine(src, cols, w)[0]
+        return engine
+    except Exception as e:  # pragma: no cover - probe must never kill a plan
+        logger.debug("plan: range-engine hoist skipped (%s)", e)
+        return None
+
+
+# ----------------------------------------------------------------------
+# Pass 3: dead-column pruning before packing
+# ----------------------------------------------------------------------
+
+Wanted = Union[None, FrozenSet[str]]  # None == ALL
+
+
+def _required_inputs(node: ir.Node, wanted: Wanted):
+    """Per-input wanted column sets for this node, given what its own
+    output must provide."""
+    n_in = len(node.inputs)
+    if node.op == "count":
+        return [frozenset()] * n_in
+    if node.op in ("collect", "on_mesh", "source", "dist_source"):
+        return [wanted] * n_in
+    if node.op == "select":
+        sel = node.param("cols", ())
+        if "*" in sel:
+            return [ALL]
+        return [frozenset(sel)]
+    if node.op == "ema":
+        if wanted is ALL:
+            return [ALL]
+        return [frozenset(wanted - {f"EMA_{node.param('colName')}"})
+                | {node.param("colName")}]
+    if node.op == "range_stats":
+        pick = node.param("colsToSummarize")
+        if wanted is ALL or pick is None:
+            return [ALL]
+        stats_out = {f"{s}_{c}" for c in pick
+                     for s in ir._range_stats_names()}
+        return [frozenset(wanted - stats_out) | set(pick)]
+    if node.op == "resample":
+        pick = node.param("metricCols")
+        return [frozenset(pick) if pick else ALL]
+    if node.op == "resample_ema":
+        return [frozenset({node.param("colName")})]
+    if node.op in ("interpolate", "interpolate_resampled"):
+        pick = node.param("target_cols")
+        return [frozenset(pick) if pick else ALL]
+    if node.op == "fourier":
+        return [frozenset({node.param("valueCol")})]
+    if node.op in ("asof_join", "fused_asof_stats_ema"):
+        if node.op == "fused_asof_stats_ema":
+            pick = node.param("s_cols")
+            extra = set(pick or ())
+            if node.param("has_ema"):
+                extra.add(node.param("e_col"))
+            if wanted is not ALL:
+                wanted = frozenset(wanted) | extra
+            elif pick is None:
+                wanted = ALL
+            lp, rp = node.param("j_left_prefix"), node.param("j_right_prefix")
+        else:
+            lp = node.param("left_prefix")
+            rp = node.param("right_prefix") or "right"
+        if wanted is ALL:
+            return [ALL, ALL]
+        l_cols = ir.output_columns(node.inputs[0])
+        r_cols = ir.output_columns(node.inputs[1])
+        if l_cols is None or r_cols is None:
+            return [ALL, ALL]
+        ren = (lambda c: f"{lp}_{c}") if lp else (lambda c: c)
+        lw = {c for c in l_cols if ren(c) in wanted}
+        rw = {c for c in r_cols if f"{rp}_{c}" in wanted}
+        return [frozenset(lw), frozenset(rw)]
+    # unknown op (with_column, lookback_features, ...): conservative
+    return [ALL] * n_in
+
+
+def _prune_columns(root: ir.Node) -> None:
+    wanted: Dict[int, Wanted] = {id(root): ALL}
+    order = list(root.walk())
+    for n in reversed(order):          # root first (reverse post-order)
+        w = wanted.get(id(n), ALL)
+        reqs = _required_inputs(n, w)
+        for child, req in zip(n.inputs, reqs):
+            prev = wanted.get(id(child), "unset")
+            if prev == "unset":
+                wanted[id(child)] = req
+            elif prev is ALL or req is ALL:
+                wanted[id(child)] = ALL
+            else:
+                wanted[id(child)] = frozenset(prev) | frozenset(req)
+    for n in order:
+        if n.op != "source":
+            continue
+        w = wanted.get(id(n), ALL)
+        if w is ALL:
+            continue
+        t = n.payload
+        structural = {t.ts_col, *t.partitionCols}
+        if t.sequence_col:
+            structural.add(t.sequence_col)
+        keep = [c for c in t.df.columns if c in structural or c in w]
+        if len(keep) < len(t.df.columns):
+            n.ann["prune_to"] = tuple(keep)
+            n.ann["pruned"] = tuple(c for c in t.df.columns
+                                    if c not in keep)
+
+
+# ----------------------------------------------------------------------
+# Pass 4: explicit materialisation barriers
+# ----------------------------------------------------------------------
+
+def _mark_barriers(root: ir.Node) -> None:
+    for n in root.walk():
+        if n.op == "collect":
+            n.ann["barrier"] = "device->host materialisation"
+        elif n.op == "lookback_features":
+            n.ann["barrier"] = ("host materialisation: collect_list "
+                                "semantics run on host (dist.py fallback)")
+        elif n.op == "fourier" and any(
+                c.op in ("resample", "interpolate") for c in n.walk()):
+            n.ann["barrier"] = ("host materialisation: fourier on a "
+                                "resampled (bucket-head) view collects "
+                                "to host (dist.py fallback)")

@@ -1,0 +1,123 @@
+"""``explain()`` rendering: logical plan, optimized plan, per-node
+engine choices, barriers, and (``cost=True``) XLA's compiled cost
+analysis — the analog of the reference's ``explain cost`` display path
+(python/tempo/tsdf.py).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tempo_tpu.plan import ir, optimizer
+
+
+def _param_str(node: ir.Node) -> str:
+    parts = []
+    for k, v in node.params:
+        if v is None or k == "mesh":
+            continue
+        if ir.is_opaque(v):
+            v = "<opaque>"
+        parts.append(f"{k}={v!r}")
+    return ", ".join(parts)
+
+
+def _node_line(node: ir.Node) -> str:
+    if node.op == "source":
+        t = node.payload
+        cols = node.ann.get("prune_to") or tuple(t.df.columns)
+        line = (f"source[host] rows={len(t.df)} ts={t.ts_col!r} "
+                f"keys={t.partitionCols} cols={list(cols)}")
+        if node.ann.get("pruned"):
+            line += f"  ! pruned before packing: {list(node.ann['pruned'])}"
+        return line
+    if node.op == "dist_source":
+        p = node.payload
+        axes = dict(p.mesh.shape)
+        return (f"source[mesh {axes}] packed=[{p.K_dev}, {p.L}] "
+                f"cols={list(p.cols)}")
+    line = f"{node.op}({_param_str(node)})"
+    notes = []
+    if "join_engine" in node.ann:
+        est = node.ann.get("merged_lanes_est")
+        notes.append(f"engine[join]={node.ann['join_engine']}"
+                     + (f" (~{est} merged lanes)" if est else ""))
+    if "range_engine" in node.ann:
+        notes.append(f"engine[stats]={node.ann['range_engine']}")
+    if "rewrite" in node.ann:
+        notes.append(f"rewrite: {node.ann['rewrite']}")
+    if "barrier" in node.ann:
+        notes.append(f"BARRIER: {node.ann['barrier']}")
+    if notes:
+        line += "  <- " + "; ".join(notes)
+    return line
+
+
+def _tree(node: ir.Node, depth: int = 0, out: List[str] = None) -> List[str]:
+    out = [] if out is None else out
+    prefix = "" if depth == 0 else "   " * (depth - 1) + "+- "
+    out.append(prefix + _node_line(node))
+    for child in node.inputs:
+        _tree(child, depth + 1, out)
+    return out
+
+
+def explain_text(root: ir.Node, cost: bool = False) -> str:
+    opt = optimizer.optimize(root)
+    lines = ["== Logical plan =="]
+    lines += _tree(root)
+    lines += ["", "== Optimized plan =="]
+    lines += _tree(opt)
+    barriers = [n.op for n in opt.walk() if "barrier" in n.ann]
+    lines += ["", "barriers: " + (", ".join(barriers) if barriers
+                                  else "none (chain stays on device)")]
+    if cost:
+        lines += ["", "== Compiled cost (XLA) =="]
+        lines += _cost_lines(opt)
+    from tempo_tpu.plan import cache
+
+    st = cache.CACHE.stats()
+    lines += ["plan cache: %d/%s entries, %d hits, %d misses, "
+              "%d evictions" % (st["size"], st["max_size"], st["hits"],
+                                st["misses"], st["evictions"])]
+    return "\n".join(lines)
+
+
+def _cost_lines(opt: ir.Node) -> List[str]:
+    """profiling.compiled_cost numbers for the plan's fused device
+    segment (host ops have no XLA program to cost)."""
+    from tempo_tpu import profiling
+    from tempo_tpu.plan import executor, fused
+
+    out = []
+    for n in opt.walk():
+        if n.op != "fused_asof_stats_ema":
+            continue
+        # evaluate the two (source-side) inputs to concrete frames so
+        # the program can be lowered at the real shapes
+        try:
+            frames = []
+            for child in n.inputs:
+                child_exe = executor.Executable(child)
+                frames.append(child_exe.run(
+                    [s.payload for s in child.sources()]))
+            c = fused.compiled_cost(frames[0], frames[1], n)
+        except Exception as e:  # pragma: no cover - backend-specific
+            out.append(f"fused_asof_stats_ema: cost unavailable ({e})")
+            continue
+        if c is None:
+            out.append("fused_asof_stats_ema: cost unavailable "
+                       "(run-time guard failed)")
+            continue
+        out.append("fused_asof_stats_ema: "
+                   + ", ".join(f"{k}={v}" for k, v in c.items()
+                               if v is not None))
+    if not out:
+        out.append("no fused device segment in this plan — per-op "
+                   "programs are costed by profiling.compiled_cost at "
+                   "execution time")
+    for n in opt.walk():
+        if n.op == "source":
+            out.append(f"source[host]: host_bytes="
+                       f"{profiling.host_bytes(n.payload.df)}")
+    return out

@@ -169,7 +169,21 @@ def pick_join_engine(est_lanes: int, limit: int,
     ``TEMPO_TPU_JOIN_ENGINE`` forces a specific engine (the
     ``bitonic`` value is a device-dispatch knob — the host path treats
     it as ``single`` and the sortmerge layer routes to the XLA bitonic
-    network)."""
+    network).  A plan-time hoisted decision (tempo_tpu/plan/hints.py)
+    wins while the planner replays the node — skipping the knob read —
+    but only when the caller's freshly-probed bounds still admit it
+    (a cached 'single' plan replayed past the compiler ceiling, or
+    'chunked' on a backend where the streaming kernel is unavailable,
+    falls through and re-picks)."""
+    from tempo_tpu.plan import hints as plan_hints
+
+    hinted = plan_hints.get("join_engine")
+    if hinted == "single" and (limit <= 0 or est_lanes <= limit):
+        return "single"
+    if hinted == "chunked" and chunked_ok:
+        return "chunked"
+    if hinted == "bracket":
+        return "bracket"
     forced = join_engine_override()
     if forced == "bitonic":
         return "single"
@@ -234,6 +248,17 @@ def comm_bytes_from_compiled(compiled) -> Dict[str, int]:
             nbytes += n * _DTYPE_BYTES[dt]
         out[kind] = out.get(kind, 0) + nbytes
     return out
+
+
+def plan_cache_stats() -> Dict[str, int]:
+    """Hit/miss/evict/build counters of the lazy planner's executable
+    cache (tempo_tpu/plan/cache.py; LRU bound
+    ``TEMPO_TPU_PLAN_CACHE_SIZE``).  The serving-loop health metric: a
+    steady-state query mix should be all hits — every miss re-runs the
+    optimizer and may compile."""
+    from tempo_tpu.plan.cache import CACHE
+
+    return CACHE.stats()
 
 
 def host_bytes(df: pd.DataFrame) -> int:

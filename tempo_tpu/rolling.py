@@ -36,6 +36,47 @@ def _packed_metric_stack(tsdf, cols: List[str]):
     return np.stack(vals), np.stack(valids)
 
 
+def plan_range_engine(tsdf, cols: List[str], rangeBackWindowSecs: int):
+    """``(engine, rowbounds, ts_long, w)`` the host ``withRangeStats``
+    three-way pick will choose for this frame/window — ONE function so
+    the eager path below and the lazy planner's plan-time hoist
+    (tempo_tpu/plan/optimizer.py) can never diverge.  ``rowbounds`` is
+    None when the static-shift forms cannot vouch for the frame (spans
+    past int32, no sort kernels) and the prefix+RMQ windowed form must
+    run.  ``ts_long``/``w`` (the rebased per-series seconds and the
+    clamped window) ride along so the eager caller does not redo the
+    O(K*L) packing work the pick already paid for."""
+    from tempo_tpu.ops import pallas_stats as _ps
+    from tempo_tpu.ops import pallas_window as _pw
+    from tempo_tpu.ops import sortmerge as sm
+
+    layout = tsdf.layout
+    if layout.n_rows == 0 or not cols:
+        return "windowed", None, None, None
+    # Spark cast-to-long seconds; 64-bit compares are emulated on TPU,
+    # so rebase to per-series int32 seconds when spans allow (range
+    # windows only ever compare within a series, so a per-series
+    # origin is safe)
+    ts_long = tsdf.packed_ts() // packing.NS_PER_S
+    ts_long, _ = packing.rebase_seconds(ts_long, ~tsdf.packed_mask())
+    # a window larger than any rebased span is equivalent to
+    # 'unbounded preceding'; clamp so huge windows cannot overflow the
+    # int32 path
+    w = min(int(rangeBackWindowSecs),
+            int(np.iinfo(ts_long.dtype).max) // 2)
+    rb = (packing.layout_rowbounds(layout, w)
+          if ts_long.dtype == np.int32 and sm.use_sort_kernels()
+          else None)
+    C = len(cols)
+    K, L = ts_long.shape
+    f32 = np.dtype(packing.compute_dtype()) == np.float32
+    pallas_ok = f32 and _ps.pallas_block_feasible(C * K, L)
+    stream_ok = f32 and _pw.stream_block_feasible(C * K, L)
+    engine = ("windowed" if rb is None else rk.pick_range_engine(
+        C * K * L, rb[0], rb[1], pallas_ok, stream_ok))
+    return engine, rb, ts_long, w
+
+
 def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
                      rangeBackWindowSecs: int = 1000):
     from tempo_tpu.frame import TSDF
@@ -50,21 +91,11 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
         # empty frame: emit the stat schema (Spark yields the columns
         # with zero rows) without dispatching zero-size reductions
         for c in cols:
-            for stat in ("mean", "count", "min", "max", "sum", "stddev",
-                         "zscore"):
+            for stat in packing.RANGE_STATS:
                 out[f"{stat}_{c}"] = np.zeros(
                     0, dtype=np.int64 if stat == "count" else np.float64
                 )
         return TSDF(out, tsdf.ts_col, tsdf.partitionCols, tsdf.sequence_col or None)
-    ts_long = tsdf.packed_ts() // packing.NS_PER_S   # Spark cast-to-long seconds
-    # 64-bit compares are emulated on TPU: rebase to per-series int32
-    # seconds when spans allow (range windows only ever compare within a
-    # series, so a per-series origin is safe)
-    ts_long, _ = packing.rebase_seconds(ts_long, ~tsdf.packed_mask())
-    # a window larger than any rebased span is equivalent to 'unbounded
-    # preceding'; clamp so huge windows cannot overflow the int32 path
-    w = min(int(rangeBackWindowSecs), int(np.iinfo(ts_long.dtype).max) // 2)
-
     vals, valids = _packed_metric_stack(tsdf, cols)
     C, K, L = vals.shape
     flat = lambda a: jnp.asarray(a).reshape(C * K, L)
@@ -76,20 +107,13 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     # streaming VMEM sweep (runtime-width, ops/pallas_window.py); the
     # general prefix-scan + RMQ form covers whatever remains (spans
     # past int32, no TPU, extents past TEMPO_TPU_STREAM_MAX_ROWS).
-    # Same picker as the mesh path (dist.withRangeStats).
+    # Same picker as the mesh path (dist.withRangeStats); under the
+    # lazy planner the decision is hoisted to plan time and arrives
+    # here as a hint (plan_range_engine + ops/rolling.pick_range_engine)
     from tempo_tpu.ops import sortmerge as sm
 
-    rb = (packing.layout_rowbounds(layout, w)
-          if ts_long.dtype == np.int32 and sm.use_sort_kernels()
-          else None)
-    from tempo_tpu.ops import pallas_stats as _ps
-    from tempo_tpu.ops import pallas_window as _pw
-
-    f32 = np.dtype(packing.compute_dtype()) == np.float32
-    pallas_ok = f32 and _ps.pallas_block_feasible(C * K, L)
-    stream_ok = f32 and _pw.stream_block_feasible(C * K, L)
-    engine = ("windowed" if rb is None else rk.pick_range_engine(
-        C * K * L, rb[0], rb[1], pallas_ok, stream_ok))
+    engine, rb, ts_long, w = plan_range_engine(tsdf, cols,
+                                               rangeBackWindowSecs)
     if engine == "shifted":
         stats = dict(sm.range_stats_shifted(
             tile(ts_long), flat(vals), flat(valids),
@@ -147,7 +171,7 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     stats = {k: stacked[i].reshape(C, K, L) for i, k in enumerate(names)}
 
     for ci, c in enumerate(cols):
-        for stat in ("mean", "count", "min", "max", "sum", "stddev", "zscore"):
+        for stat in packing.RANGE_STATS:
             flat = packing.unpack_column(stats[stat][ci], layout)
             if stat == "count":
                 out[f"{stat}_{c}"] = flat.astype(np.int64)

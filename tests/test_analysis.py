@@ -26,6 +26,7 @@ from tools.analysis.rules import (  # noqa: E402
     DynamicGatherRule,
     EnvKnobRule,
     GridCarryRule,
+    PlanRegistryRule,
     VmemBudgetRule,
     WeakDtypeRule,
 )
@@ -542,6 +543,141 @@ def test_bare_except_fires_and_suppresses(tmp_path):
     ), name="anyfile.py")
     assert len(found) == 1
     assert "bare 'except:'" in found[0].message
+
+
+# ----------------------------------------------------------------------
+# plan-registry
+# ----------------------------------------------------------------------
+
+_PLAN_REGISTRY_SRC = (
+    "PLANNED_METHODS = {\n"
+    "    'TSDF': ('asofJoin',),\n"
+    "}\n"
+)
+
+
+def _plan_tree(tmp_path, frame_src, registry_src=_PLAN_REGISTRY_SRC):
+    pkg = tmp_path / "tempo_tpu"
+    plan = pkg / "plan"
+    plan.mkdir(parents=True, exist_ok=True)
+    (plan / "ir.py").write_text(registry_src)
+    frame = pkg / "frame.py"
+    frame.write_text(frame_src)
+    return [core.ModuleSource(plan / "ir.py"), core.ModuleSource(frame)]
+
+
+def test_plan_registry_fires_on_unclassified_frame_method(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+        "    def shiny_new_op(self) -> 'TSDF':\n"
+        "        return TSDF()\n"
+    ))
+    found = PlanRegistryRule().check_project(tmp_path, files)
+    assert len(found) == 1
+    assert "shiny_new_op" in found[0].message
+    assert "plan-ok: eager-only" in found[0].message
+
+
+def test_plan_registry_passes_marker_and_recorder(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+        "    def filter(self, cond) -> 'TSDF':  # plan-ok: eager-only\n"
+        "        return TSDF()\n"
+        "    def count(self):\n"               # not frame-returning
+        "        return 0\n"
+    ))
+    assert PlanRegistryRule().check_project(tmp_path, files) == []
+
+
+def test_plan_registry_fires_on_declared_but_not_recording(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return TSDF()\n"
+    ))
+    found = PlanRegistryRule().check_project(tmp_path, files)
+    assert len(found) == 1
+    assert "never calls _plan_record" in found[0].message
+
+
+def test_plan_registry_fires_on_undeclared_recorder(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+        "    def stealth(self) -> 'TSDF':\n"
+        "        return self._plan_record('stealth')\n"
+    ))
+    found = PlanRegistryRule().check_project(tmp_path, files)
+    assert len(found) == 1
+    assert "not declared" in found[0].message
+
+
+def test_plan_registry_fires_on_dead_registry_entry(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+    ), registry_src=(
+        "PLANNED_METHODS = {\n"
+        "    'TSDF': ('asofJoin', 'vanished'),\n"
+        "}\n"
+    ))
+    found = PlanRegistryRule().check_project(tmp_path, files)
+    assert len(found) == 1
+    assert "dead registry entry" in found[0].message
+
+
+def test_plan_registry_lint_ok_suppression(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+        "    def odd(self) -> 'TSDF':"
+        "  # lint-ok: plan-registry: migration shim\n"
+        "        return TSDF()\n"
+    ))
+    assert PlanRegistryRule().check_project(tmp_path, files) == []
+
+
+def test_plan_registry_skips_properties_and_classmethods(tmp_path):
+    files = _plan_tree(tmp_path, (
+        "class TSDF:\n"
+        "    def _plan_record(self, *a):\n"
+        "        pass\n"
+        "    def asofJoin(self, right) -> 'TSDF':\n"
+        "        return self._plan_record('asof_join')\n"
+        "    @classmethod\n"
+        "    def from_thing(cls, df) -> 'TSDF':\n"
+        "        return cls(df)\n"
+        "    @property\n"
+        "    def view(self) -> 'TSDF':\n"
+        "        return TSDF()\n"
+    ))
+    assert PlanRegistryRule().check_project(tmp_path, files) == []
+
+
+def test_plan_registry_live_registry_matches_code():
+    """The real tree's registry<->code agreement, without the analyzer
+    subprocess: every PLANNED_METHODS entry records, every other
+    frame-returning op method is classified."""
+    files = core.load_sources([REPO / "tempo_tpu"])
+    found = PlanRegistryRule().check_project(REPO, files)
+    assert found == [], "\n".join(v.render() for v in found)
 
 
 # ----------------------------------------------------------------------

@@ -42,7 +42,7 @@ def test_bench_smoke_prints_one_json_line():
         "4_nbbo_skew_asof", "5_skew_1b_bracketed",
         "2b_range_stats_dense_50hz", "6_seq_tiebreak_asof",
         "7_frame_e2e_pipeline", "8_chunked_205k_k128",
-        "9_chunked_1m_single",
+        "9_chunked_1m_single", "10_planned_chain",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -63,6 +63,13 @@ def test_bench_smoke_prints_one_json_line():
                "describe", "autocorr_lag1"):
         assert sweep.get(op, {}).get("rows_per_sec", 0) > 0, \
             f"opsweep config {op} missing/empty: {sweep.get(op)}"
+    # config 10 (round 7): the planned chain must have run with a
+    # populated executable-cache record — the hit counters are the
+    # compile-free-repeat proof the acceptance reads
+    pc = rec.get("plan_chain") or {}
+    assert pc.get("plan_cache", {}).get("hits", 0) >= 2, pc
+    assert pc.get("plan_cache", {}).get("builds") == 1, pc
+    assert rec.get("planned_vs_fused") and rec["planned_vs_fused"] > 0
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)

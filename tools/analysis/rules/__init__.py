@@ -8,6 +8,7 @@ from tools.analysis.rules.weak_dtype import WeakDtypeRule
 from tools.analysis.rules.gather import DynamicGatherRule, GridCarryRule
 from tools.analysis.rules.env_knobs import EnvKnobRule
 from tools.analysis.rules.excepts import BareExceptRule
+from tools.analysis.rules.plan_registry import PlanRegistryRule
 
 ALL_RULES = (
     VmemBudgetRule(),
@@ -16,6 +17,7 @@ ALL_RULES = (
     GridCarryRule(),
     EnvKnobRule(),
     BareExceptRule(),
+    PlanRegistryRule(),
 )
 
 __all__ = [
@@ -26,4 +28,5 @@ __all__ = [
     "GridCarryRule",
     "EnvKnobRule",
     "BareExceptRule",
+    "PlanRegistryRule",
 ]

@@ -1,0 +1,200 @@
+"""plan-registry: every TSDF / DistributedTSDF op method that mutates
+data either records a plan node or is explicitly classified eager-only.
+
+The bug class: the lazy planner (tempo_tpu/plan/) only sees what the
+op methods record.  A new frame-returning method added without a
+``_plan_record`` preamble silently punches a hole in every plan that
+uses it — chains break at an op nobody marked as a boundary, and the
+optimizer's rewrites/pruning reason over an incomplete registry.  Like
+the env-knobs rule, the registry
+(``tempo_tpu.plan.ir.PLANNED_METHODS``) is the single source of truth
+and this rule keeps it and the code in lockstep both ways:
+
+* every method named in the registry must exist on its class and call
+  ``_plan_record`` in its body (registry -> code);
+* every *other* public frame-returning method of a registered class
+  (heuristic: a ``TSDF``/``DistributedTSDF`` return annotation, or a
+  ``return`` of a ``TSDF(...)`` / ``DistributedTSDF(...)`` /
+  ``self._with...(...)`` call) must carry an explicit
+  ``# plan-ok: eager-only`` marker on its ``def`` line (code ->
+  registry): eager-only is a decision someone made, not an accident;
+* a method that calls ``_plan_record`` without being declared in the
+  registry is flagged too — the registry must name every recorder.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis import dataflow as df
+from tools.analysis.core import ModuleSource, Rule, Violation
+
+_REGISTRY_REL = Path("tempo_tpu") / "plan" / "ir.py"
+_MARKER_RE = re.compile(r"#\s*plan-ok:\s*eager-only")
+_FRAME_CTORS = {"TSDF", "DistributedTSDF"}
+_SELF_CTORS = {"_with", "_with_df"}
+
+
+def _in_package(path: Path) -> bool:
+    return "tempo_tpu" in path.parts
+
+
+class PlanRegistryRule(Rule):
+    name = "plan-registry"
+    code = 128
+    doc = ("TSDF/DistributedTSDF op methods must record a plan node "
+           "(tempo_tpu.plan.ir.PLANNED_METHODS) or carry "
+           "'# plan-ok: eager-only'")
+
+    def applies(self, path: Path) -> bool:
+        # per-file pass unused; the whole check is project-level
+        return False
+
+    # -- project pass --------------------------------------------------
+
+    def check_project(self, root: Path,
+                      files: Sequence[ModuleSource]) -> List[Violation]:
+        registry = self._load_registry(files, root)
+        if registry is None:
+            return []  # no plan package in this tree (fixture runs)
+        reg_mod, methods = registry
+        out: List[Optional[Violation]] = []
+        found: Dict[Tuple[str, str], bool] = {}
+
+        for mod in files:
+            if not _in_package(mod.path) or mod.tree is None:
+                continue
+            if "plan" in mod.path.parts:
+                continue  # the lazy wrappers themselves do not re-record
+            for cls in ast.walk(mod.tree):
+                if not (isinstance(cls, ast.ClassDef)
+                        and cls.name in methods):
+                    continue
+                declared = set(methods[cls.name])
+                for fn in cls.body:
+                    if not isinstance(fn, ast.FunctionDef):
+                        continue
+                    if fn.name.startswith("_") or _decorated_out(fn):
+                        continue
+                    records = _calls_plan_record(fn)
+                    if fn.name in declared:
+                        found[(cls.name, fn.name)] = True
+                        if not records:
+                            out.append(self.violation(
+                                mod, fn.lineno,
+                                f"{cls.name}.{fn.name} is declared in "
+                                f"plan.ir.PLANNED_METHODS but never "
+                                f"calls _plan_record — record the op "
+                                f"or remove it from the registry"))
+                        continue
+                    if records:
+                        out.append(self.violation(
+                            mod, fn.lineno,
+                            f"{cls.name}.{fn.name} calls _plan_record "
+                            f"but is not declared in "
+                            f"plan.ir.PLANNED_METHODS — declare it so "
+                            f"the optimizer knows the op exists"))
+                        continue
+                    if _returns_frame(fn) and not _marked(mod, fn):
+                        out.append(self.violation(
+                            mod, fn.lineno,
+                            f"{cls.name}.{fn.name} returns a frame but "
+                            f"neither records a plan node nor carries "
+                            f"'# plan-ok: eager-only' — classify it: "
+                            f"add a _plan_record preamble (and declare "
+                            f"it in plan.ir.PLANNED_METHODS) or mark "
+                            f"the def line eager-only"))
+        for cls_name, names in methods.items():
+            for m in names:
+                if not found.get((cls_name, m)):
+                    out.append(self.violation(
+                        reg_mod, methods_line(reg_mod, m),
+                        f"plan.ir.PLANNED_METHODS declares "
+                        f"{cls_name}.{m} but no such method exists on "
+                        f"a scanned {cls_name} class — dead registry "
+                        f"entry"))
+        return [v for v in out if v is not None]
+
+    # -- registry loading ----------------------------------------------
+
+    def _load_registry(self, files: Sequence[ModuleSource], root: Path):
+        reg = None
+        for mod in files:
+            if mod.path.parts[-3:] == ("tempo_tpu", "plan", "ir.py"):
+                reg = mod
+                break
+        if reg is None:
+            cand = root / _REGISTRY_REL
+            if cand.exists():
+                reg = ModuleSource(cand)
+        if reg is None or reg.tree is None:
+            return None
+        for node in ast.walk(reg.tree):
+            if (isinstance(node, ast.Assign) and node.targets
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "PLANNED_METHODS"):
+                try:
+                    val = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+                if isinstance(val, dict):
+                    return reg, {str(k): tuple(v) for k, v in val.items()}
+        return None
+
+
+def methods_line(reg_mod: ModuleSource, method: str) -> int:
+    for i, line in enumerate(reg_mod.lines, start=1):
+        if f'"{method}"' in line or f"'{method}'" in line:
+            return i
+    return 1
+
+
+def _decorated_out(fn: ast.FunctionDef) -> bool:
+    """Skip properties / classmethods / staticmethods: they construct
+    or describe frames, they are not chainable op methods."""
+    for dec in fn.decorator_list:
+        name = df.terminal_name(dec) if not isinstance(dec, ast.Call) \
+            else df.terminal_name(dec.func)
+        if name in ("property", "classmethod", "staticmethod",
+                    "cached_property"):
+            return True
+    return False
+
+
+def _calls_plan_record(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and df.terminal_name(node.func) == "_plan_record":
+            return True
+    return False
+
+
+def _returns_frame(fn: ast.FunctionDef) -> bool:
+    """Frame-returning heuristic: a TSDF-ish return annotation, or a
+    return of a frame-constructor call."""
+    ann = fn.returns
+    if ann is not None:
+        text = ann.value if isinstance(ann, ast.Constant) else \
+            df.terminal_name(ann)
+        if isinstance(text, str) and "TSDF" in text:
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value,
+                                                       ast.Call):
+            name = df.terminal_name(node.value.func)
+            if name in _FRAME_CTORS or name in _SELF_CTORS:
+                return True
+    return False
+
+
+def _marked(mod: ModuleSource, fn: ast.FunctionDef) -> bool:
+    """``# plan-ok: eager-only`` anywhere on the (possibly multi-line)
+    def header — from the ``def`` line through the line the signature
+    closes on."""
+    for lineno in range(fn.lineno, fn.body[0].lineno + 1):
+        if _MARKER_RE.search(mod.line(lineno)):
+            return True
+    return False

@@ -22,11 +22,16 @@ env-knobs         16  os.environ outside tempo_tpu/config.py; registry vs
                       code vs BUILDING.md knob-table drift
 bare-except       32  bare 'except:' / silent 'except Exception: pass'
 parse-error       64  files that do not parse (or cannot be read)
+plan-registry    128  TSDF/DistributedTSDF op methods neither recording a
+                      plan node (plan.ir.PLANNED_METHODS) nor marked
+                      '# plan-ok: eager-only'; registry<->code drift
 ==============  ====  =====================================================
 
 The process exit code is the bitwise OR of the fired rules — a CI log's
-status alone names the failing families; 0 means clean.  Suppress one
-finding with ``# lint-ok: <rule>: <reason>`` on the flagged line.
+status names the failing families (for statuses >= 128 read the
+per-rule summary on stderr: the shell uses that range for signal
+deaths, which print no summary); 0 means clean.  Suppress one finding
+with ``# lint-ok: <rule>: <reason>`` on the flagged line.
 
 Usage::
 
