@@ -133,7 +133,8 @@ def _make_run(body):
 
     def small(out):
         def sl(k, v):
-            if k in ("stats_clipped", "clipped"):
+            if k in ("stats_clipped", "clipped") \
+                    or k.startswith("clipped_"):
                 # the truncation audit must be GLOBAL (ADVICE r3: a
                 # strided sample could miss clipped series) — the
                 # plane is [K, 1], cheap to carry whole
@@ -163,12 +164,23 @@ def _make_run(body):
     return run
 
 
-def _loop_rate(body, args, n_rows, label, want_outputs=False, run=None):
+def _loop_rate(body, args, n_rows, label, want_outputs=False, run=None,
+               bytes_per_iter=None):
     """Per-iteration rate of ``body(scale, *args) -> (out_dict)``,
     chained inside one fori_loop dispatch, timed by trip-count
     differencing, physics-audited against the HBM spec.
 
     Returns (rows_per_sec, implied_bw, t_iter[, out_small]).
+
+    ``bytes_per_iter`` is the config's real per-iteration plane
+    traffic (reads + writes + re-streamed intermediates) for the
+    implied-bandwidth report; when omitted the compulsory input reads
+    (``_tree_bytes(args)``) stand in — which printed "0 GB/s implied"
+    for the windowed engines, whose dominant traffic is the written
+    stat planes (VERDICT r5 / ISSUE 6 satellite).  The physics
+    assertion always uses the compulsory input reads: over-counting
+    writes/intermediates (some may stay in VMEM) must never abort a
+    valid run, while input reads are a hard floor.
 
     ``want_outputs`` threads a SUB_K-series f32 slice of the final
     iteration's outputs through the loop carry so the value audit can
@@ -209,16 +221,17 @@ def _loop_rate(body, args, n_rows, label, want_outputs=False, run=None):
     # compulsory traffic floor: the input arrays exceed VMEM, so every
     # iteration re-reads them from HBM (outputs/intermediates are extra)
     in_bytes = _tree_bytes(args)
-    implied_bw = in_bytes / t_iter
-    if implied_bw > V5E_HBM_BYTES_PER_SEC and jax.default_backend() == "tpu":
+    if in_bytes / t_iter > V5E_HBM_BYTES_PER_SEC \
+            and jax.default_backend() == "tpu":
         raise SystemExit(
             f"PHYSICS VIOLATION [{label}]: implied HBM read traffic "
-            f"{implied_bw / 1e9:.0f} GB/s exceeds the v5e spec "
+            f"{in_bytes / t_iter / 1e9:.0f} GB/s exceeds the v5e spec "
             f"{V5E_HBM_BYTES_PER_SEC / 1e9:.0f} GB/s "
             f"({in_bytes / 1e6:.0f} MB compulsory reads/iteration in "
             f"{t_iter * 1e6:.0f} us). Iterations were elided; the "
             f"measurement is invalid."
         )
+    implied_bw = (bytes_per_iter or in_bytes) / t_iter
     print(f"[{label}] {n_rows / t_iter:,.0f} rows/s  "
           f"({implied_bw / 1e9:.0f} GB/s implied)", file=sys.stderr,
           flush=True)
@@ -415,7 +428,11 @@ def bench_range_stats(data):
         ))
 
     rate, bw, t_iter, out_small = _loop_rate(
-        body, args, K * L, label="range_stats", want_outputs=True
+        body, args, K * L, label="range_stats", want_outputs=True,
+        # reads (i64 secs + x + valid) + the i32 jitter-cast re-stream
+        # + 8 written stat planes — the same per-row accounting the
+        # roofline record uses (_roofline_report)
+        bytes_per_iter=K * L * (8 + 4 + 1 + 8 + 8 * 4),
     )
     clipped = float(np.asarray(out_small["clipped"]).sum())
     assert clipped == 0, (
@@ -467,7 +484,8 @@ def bench_resample_ema(data):
         return {"resampled": res, "ema": ema}
 
     rate, bw, t_iter, out_small = _loop_rate(
-        body, args, K * L, label="resample_ema", want_outputs=True
+        body, args, K * L, label="resample_ema", want_outputs=True,
+        bytes_per_iter=K * L * (8 + 4 + 1 + 8 + 2 * 4),
     )
     _resample_audit(out_small, data)
     return rate, bw, t_iter
@@ -773,6 +791,12 @@ def _seq_audit(out_small, data, r_seq):
 # Config 2b: dense-data rolling regime (VERDICT r3 weak #5)
 # ----------------------------------------------------------------------
 
+# per-row plane traffic of the windowed-stats configs: reads (i64 ms +
+# f32 x + bool valid), the i32 jitter-cast re-stream (write + kernel
+# re-read), 8 written stat planes — keep in lockstep with the
+# _roofline_report hbm_frac entries for configs 2/2b
+_STATS_BYTES_ROW = 8 + 4 + 1 + 8 + 8 * 4
+
 def _dense_stats_data(mean_gap_ms, seed=2):
     """~1000/mean_gap_ms Hz ticks: a 10s window spans ~10000/gap rows.
     Gap jitter is ±25% so the densest stretch bounds the row extent at
@@ -813,8 +837,10 @@ def bench_dense_stats():
         ms, x, valid = _dense_stats_data(gap)
         args = [jax.device_put(a) for a in (ms, x, valid)]
         rate, bw, t = _loop_rate(body, args, K * L,
-                                 label=f"windowed_{name}", run=run)
-        out[name] = {"rows_per_sec": rate, "t_iter": t}
+                                 label=f"windowed_{name}", run=run,
+                                 bytes_per_iter=K * L * _STATS_BYTES_ROW)
+        out[name] = {"rows_per_sec": rate, "t_iter": t,
+                     "implied_gbps": round(bw / 1e9, 1)}
     return out
 
 
@@ -843,11 +869,12 @@ def bench_stream_stats():
                 (ms, x, valid, np.int32(behind), np.int32(ahead))]
         rate, bw, t, out_small = _loop_rate(
             body, args, K * L, label=f"stream_{name}", run=run,
-            want_outputs=True)
+            want_outputs=True, bytes_per_iter=K * L * _STATS_BYTES_ROW)
         clipped = float(np.asarray(out_small["clipped"]).sum())
         assert clipped == 0, f"stream_{name} truncated {clipped} rows"
         out[name] = {"rows_per_sec": rate, "t_iter": t,
-                     "max_behind": behind, "max_ahead": ahead}
+                     "max_behind": behind, "max_ahead": ahead,
+                     "implied_gbps": round(bw / 1e9, 1)}
     return out
 
 
@@ -873,7 +900,9 @@ def bench_shifted_medium():
     args = [jax.device_put(a) for a in (ms, x, valid)]
     rate, bw, t, out_small = _loop_rate(body, args, K * L,
                                         label="shifted_medium",
-                                        want_outputs=True)
+                                        want_outputs=True,
+                                        bytes_per_iter=K * L
+                                        * _STATS_BYTES_ROW)
     clipped = float(np.asarray(out_small["clipped"]).sum())
     assert clipped == 0, f"shifted_medium truncated {clipped} rows"
     return {"rows_per_sec": rate, "t_iter": t, "max_behind": mb}
@@ -1238,6 +1267,110 @@ def bench_chunked():
     return out
 
 
+def bench_pipelined():
+    """Explicit-DMA-ring and packed-column variants of the
+    HBM-stream-bound configs, measured so the main record can
+    *re-decide* configs 2/3 (and the knob priors) from data instead of
+    crowning an unmeasured mechanism:
+
+    * configs 2/3 kernel bodies at ``TEMPO_TPU_DMA_BUFFERS=4`` — the
+      N-deep input ring + async output staging of
+      ops/pallas_stream.py vs the implicit BlockSpec double buffer the
+      parent measures;
+    * the C=4 column-packed streaming kernel vs the same four columns
+      as four single-column passes — the measured value of reading the
+      key planes once per pack (the multi-column packing the frame/
+      mesh withRangeStats paths now use).
+
+    Runs in its own child process (fresh compiler) with the knob set
+    for the whole child; each sub-config via ``_attempt`` so one flaky
+    variant cannot zero the record."""
+    depth = 4
+    os.environ["TEMPO_TPU_DMA_BUFFERS"] = str(depth)
+    out = {"dma_buffers": depth}
+    try:
+        data = make_data()
+        res = _attempt("range_stats_ring",
+                       lambda: bench_range_stats(data))
+        if res is not None:
+            out["2_range_stats_10s"] = {
+                "rows_per_sec": round(res[0]), "t_iter": res[2]}
+        res = _attempt("resample_ema_ring",
+                       lambda: bench_resample_ema(data))
+        if res is not None:
+            out["3_resample_ema"] = {
+                "rows_per_sec": round(res[0]), "t_iter": res[2]}
+        res = _attempt("packed_stream", bench_packed_stream)
+        if res is not None:
+            out["packed_stream"] = res
+    finally:
+        os.environ.pop("TEMPO_TPU_DMA_BUFFERS", None)
+    return out
+
+
+def bench_packed_stream(n_cols: int = 4):
+    """The column-packed streaming window kernel vs per-column passes
+    on identical data: C metric columns over ONE ~50 Hz key plane (the
+    regime the streaming engine owns).  Both bodies are audited by the
+    on-device truncation count; ``packed_vs_single`` is the measured
+    packing win the BUILDING.md bytes-minimal model predicts at
+    (key_bytes + C*col_bytes) / (C*(key_bytes + col_bytes))."""
+    rng = np.random.default_rng(21)
+    ms, x, valid = _dense_stats_data(20)
+    xs = np.stack([x * np.float32(1.0 + 0.25 * c)
+                   for c in range(n_cols)])
+    vs = np.stack([valid if c == 0 else (rng.random(x.shape) > 0.1)
+                   for c in range(n_cols)])
+    behind, ahead = _measured_rowbounds(ms, 10_000)
+    w_ms = jnp.asarray(10_000, jnp.int32)
+
+    def packed_body(scale, ms, xs, vs, mb, ma):
+        ms32 = (ms + _jitter_secs(scale) * 1000).astype(jnp.int32)
+        return dict(rk.range_stats_streaming_packed(
+            ms32, xs, vs, w_ms, mb, ma, scales=scale))
+
+    def single_body(scale, ms, xs, vs, mb, ma):
+        ms32 = (ms + _jitter_secs(scale) * 1000).astype(jnp.int32)
+        out = {}
+        for c in range(n_cols):
+            st = rk.range_stats_streaming(ms32, xs[c], vs[c], w_ms,
+                                          mb, ma, scale=scale)
+            out.update({f"{k}_{c}": v for k, v in st.items()})
+        return out
+
+    args = [jax.device_put(a) for a in
+            (ms, xs, vs, np.int32(behind), np.int32(ahead))]
+    n_rows = n_cols * K * L
+    # packed bytes: key planes once + C payload columns + C*8 outputs
+    packed_bytes = K * L * (8 + 8 + n_cols * (4 + 1 + 8 * 4))
+    # single-column loop: the i64 key read and the i32 jitter-cast
+    # write also happen once per ITERATION (outside the column loop) —
+    # only the ms32 kernel re-read repeats per column, so billing the
+    # full _STATS_BYTES_ROW per column would overstate the baseline's
+    # traffic (and its implied GB/s) by the shared key bytes
+    single_bytes = K * L * (8 + 4 + n_cols * (4 + 4 + 1 + 8 * 4))
+    rec = {"cols": n_cols}
+    for name, body, nbytes in (("packed", packed_body, packed_bytes),
+                               ("single", single_body, single_bytes)):
+        res = _attempt(f"stream_{name}_c{n_cols}", lambda b=body, nb=nbytes: _loop_rate(
+            b, args, n_rows, label=f"stream_{name}_c{n_cols}",
+            want_outputs=True, bytes_per_iter=nb))
+        if res is None:
+            continue  # keep measuring: a flaky packed variant must not
+            # also drop the single-column baseline from the record
+        rate, bw, t, out_small = res
+        clipped = sum(float(np.asarray(v).sum())
+                      for k, v in out_small.items() if "clipped" in k)
+        assert clipped == 0, f"{name} packed-stream truncated {clipped}"
+        rec[f"{name}_rows_per_sec"] = round(rate)
+        rec[f"{name}_t_iter"] = t
+        rec[f"{name}_implied_gbps"] = round(bw / 1e9, 1)
+    if rec.get("single_rows_per_sec") and rec.get("packed_rows_per_sec"):
+        rec["packed_vs_single"] = round(
+            rec["packed_rows_per_sec"] / rec["single_rows_per_sec"], 2)
+    return rec
+
+
 def bench_frame_e2e():
     """Config 7: the user-facing frame chain
     ``TSDF.on_mesh().asofJoin().withRangeStats().EMA().collect()`` on a
@@ -1473,6 +1606,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-pipelined" in sys.argv:
+        res = _attempt("pipelined", bench_pipelined)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-opsweep" in sys.argv:
         res = _attempt("opsweep", bench_opsweep)
         if res is None:
@@ -1530,6 +1669,36 @@ def main():
     asof = _attempt("asof", lambda: bench_asof(data))
     stats = _attempt("range_stats", lambda: bench_range_stats(data))
     res = _attempt("resample_ema", lambda: bench_resample_ema(data))
+    pipelined = _config_subprocess("--only-pipelined", "pipelined",
+                                   timeout=2400)
+
+    # re-decide configs 2/3 between the measured default (implicit
+    # double-buffered BlockSpec pipeline) and the measured explicit DMA
+    # ring — never crowning an unmeasured variant: a missing/crashed
+    # pipelined child leaves the default standing and says so
+    def _redecide(key, default):
+        cand = (pipelined or {}).get(key)
+        if default is None and cand is None:
+            return None, {"winner": "unmeasured"}
+        if cand is None:
+            return default, {"winner": "blockspec-2", "ring": "unmeasured",
+                             "blockspec_rows_per_sec": round(default[0])}
+        decision = {
+            "blockspec_rows_per_sec":
+                round(default[0]) if default else None,
+            "ring_rows_per_sec": cand["rows_per_sec"],
+            "dma_buffers_measured": [2, (pipelined or {}).get(
+                "dma_buffers", 4)],
+        }
+        if default is None or cand["rows_per_sec"] > default[0]:
+            decision["winner"] = f"dma-ring({pipelined['dma_buffers']})"
+            bw = default[1] if default else 0.0
+            return (cand["rows_per_sec"], bw, cand["t_iter"]), decision
+        decision["winner"] = "blockspec-2"
+        return default, decision
+
+    stats, stats_decision = _redecide("2_range_stats_10s", stats)
+    res, res_decision = _redecide("3_resample_ema", res)
     nbbo = _nbbo_subprocess()
     skew_rs = bench_skew_1b(t_iter_fused)
     roof = _roofline_subprocess()
@@ -1654,6 +1823,15 @@ def main():
         "chunked": chunked,
         "opsweep": opsweep,
         "nbbo_slot_occupancy": (round(nbbo[2], 3) if nbbo else None),
+        # the DMA-pipeline/packing sweep + the per-config winner
+        # decisions (configs 2/3 above already report the winning
+        # variant's rate; the knob prior TEMPO_TPU_DMA_BUFFERS should
+        # track these winners)
+        "dma_pipeline": {
+            "sweep": pipelined,
+            "2_range_stats_10s": stats_decision,
+            "3_resample_ema": res_decision,
+        },
         "rolling_crossover": crossover,
         "roofline": roofline,
         "roofline_measured": roof,

@@ -75,6 +75,19 @@ KNOBS: Dict[str, Knob] = _knobs(
     Knob("TEMPO_TPU_STREAM_MAX_ROWS", "int", "16384",
          "tempo_tpu/ops/pallas_window",
          "row-extent ceiling of the streaming window engine"),
+    Knob("TEMPO_TPU_DMA_BUFFERS", "int", "2",
+         "tempo_tpu/ops/pallas_stream",
+         "HBM->VMEM buffer depth of the streaming kernels: 2 = the "
+         "implicit double-buffered BlockSpec pipeline; >2 = the "
+         "explicit N-deep DMA ring (copy/semaphore scratch)"),
+    Knob("TEMPO_TPU_PACK_COLS", "int", None,
+         "tempo_tpu/ops/pallas_stream",
+         "cap on metric columns packed into one window-kernel pass; "
+         "unset = largest width the VMEM budget folding admits"),
+    Knob("TEMPO_TPU_MEGACORE", "bool", "1",
+         "tempo_tpu/ops/pallas_stream",
+         "0 disables megacore grid partitioning (carry-free grid axes "
+         "marked 'parallel' so Mosaic splits them across TensorCores)"),
     Knob("TEMPO_TPU_STRICT_SQL", "bool", "0", "tempo_tpu/frame",
          "make selectExpr/filter re-raise instead of falling back to "
          "pandas eval/query"),

@@ -355,39 +355,57 @@ class DistributedTSDF:
         else:
             engine, rowbounds, sort_kernels = \
                 "shifted", None, _use_sort_kernels()
-        for c in cols:
-            col = self.cols[c]
-            if self.n_time > 1 and strategy == "halo":
-                halo = self._halo(self.L)
-                stats, clipped = _range_stats_halo(
-                    self.mesh, self.series_axis, self.time_axis, w, halo,
-                )(self.ts, col.values, col.valid)
-                audits.append((
-                    f"withRangeStats({c}): %d rows had windows truncated "
-                    f"at the time-shard halo ({halo} rows); increase the "
-                    f"halo_fraction or shard count", clipped,
-                ))
-            elif self.n_time > 1:
-                stats, rb_clipped = _range_stats_a2a(
+        if cols and (strategy == "exact" or self.n_time <= 1):
+            # (a single-shard "halo" strategy has no halo to exchange —
+            # it runs the local path exactly like the seed did)
+            # multi-column payload packing: ONE shard_map program over
+            # the [C, K, L] column stack — the timestamp planes stream
+            # once per kernel pack instead of once per column, and the
+            # per-op dispatch cost stops scaling with C.  Per-column
+            # results are bitwise-identical to the per-column programs
+            # (_range_stats_block_packed).
+            xs = jnp.stack([self.cols[c].values for c in cols])
+            vs = jnp.stack([self.cols[c].valid for c in cols])
+            if self.n_time > 1:
+                stats, rb_clipped = _range_stats_a2a_packed(
                     self.mesh, self.series_axis, self.time_axis, w,
                     rowbounds, sort_kernels, engine,
-                )(self.ts, col.values, col.valid)
+                )(self.ts, xs, vs)
             else:
-                stats, rb_clipped = _range_stats_local(
+                stats, rb_clipped = _range_stats_local_packed(
                     self.mesh, self.series_axis, w, rowbounds,
                     sort_kernels, engine,
-                )(self.ts, col.values, col.valid)
-            if strategy == "exact" and rowbounds is not None:
-                # deferred truncation audit of the shifted-window form:
-                # the host-derived row bounds must cover every frame
-                # (they do by construction — this catches bound-
-                # derivation bugs and device/layout ts divergence)
-                audits.append((
-                    f"withRangeStats({c}): %d rows had window frames "
-                    f"extending past the static row bounds {rowbounds}; "
-                    f"this is a tempo-tpu bug — please report it",
-                    rb_clipped,
-                ))
+                )(self.ts, xs, vs)
+            for ci, c in enumerate(cols):
+                if strategy == "exact" and rowbounds is not None:
+                    # deferred truncation audit of the shifted-window
+                    # form: the host-derived row bounds must cover
+                    # every frame (they do by construction — this
+                    # catches bound-derivation bugs and device/layout
+                    # ts divergence)
+                    audits.append((
+                        f"withRangeStats({c}): %d rows had window "
+                        f"frames extending past the static row bounds "
+                        f"{rowbounds}; this is a tempo-tpu bug — "
+                        f"please report it", rb_clipped[ci],
+                    ))
+                for stat in packing.RANGE_STATS:
+                    new_cols[f"{stat}_{c}"] = DistCol(
+                        stats[stat][ci], self.mask,
+                        int64=(stat == "count"),
+                    )
+            return self._with(cols=new_cols, audits=audits)
+        for c in cols:
+            col = self.cols[c]
+            halo = self._halo(self.L)
+            stats, clipped = _range_stats_halo(
+                self.mesh, self.series_axis, self.time_axis, w, halo,
+            )(self.ts, col.values, col.valid)
+            audits.append((
+                f"withRangeStats({c}): %d rows had windows truncated "
+                f"at the time-shard halo ({halo} rows); increase the "
+                f"halo_fraction or shard count", clipped,
+            ))
             for stat in packing.RANGE_STATS:
                 new_cols[f"{stat}_{c}"] = DistCol(
                     stats[stat], self.mask, int64=(stat == "count"),
@@ -1448,15 +1466,27 @@ def _range_stats_halo(mesh, series_axis, time_axis, window_secs, halo):
     return fn
 
 
-def _range_stats_block(ts, x, valid, w, rowbounds, engine="shifted"):
-    """Shard-local range stats: shifted gather-free form when static row
-    bounds are known (TPU), the streaming VMEM sweep for wider bounded
-    frames (``engine="stream"``), else bounds + prefix/RMQ form.
-    Returns (stats dict, clipped row count) — clipped is the window
-    kernels' truncation audit (zero by construction for the exact
-    form)."""
+def _range_stats_block_packed(ts, xs, valids, w, rowbounds,
+                              engine="shifted"):
+    """Shard-local range stats over a multi-column stack:
+    ``xs``/``valids`` are [C, K, L] planes sharing the shard's
+    timestamp plane, reduced with the key planes read ONCE per kernel
+    pack instead of once per column
+    (ops/rolling.range_stats_streaming_packed /
+    sortmerge.range_stats_shifted_packed); shifted gather-free form
+    when static row bounds are known (TPU), the streaming VMEM sweep
+    for wider bounded frames (``engine="stream"``), else bounds +
+    prefix/RMQ form.  Per-column results are bitwise-identical to C
+    single-column calls — the packed kernels trace the identical
+    per-column op sequence and the fallbacks ARE the single-column
+    paths — which is what keeps the eager chain, the planner replay,
+    and the fused single program (plan/fused.py) in exact agreement.
+    Returns (stats dict of [C, ...] planes, clipped [C] int64) —
+    clipped is the window kernels' truncation audit (zero by
+    construction for the exact form)."""
     from tempo_tpu.ops import sortmerge as sm
 
+    C = xs.shape[0]
     secs = ts // packing.NS_PER_S
     if rowbounds is not None:
         behind, ahead = rowbounds
@@ -1469,63 +1499,72 @@ def _range_stats_block(ts, x, valid, w, rowbounds, engine="shifted"):
         rb = jnp.minimum(secs - secs[:, :1], 2**31 - 1).astype(jnp.int32)
         w32 = jnp.asarray(w).astype(jnp.int32)
         if engine == "stream":
-            stats = rk.range_stats_streaming(
-                rb, x, valid, w32,
-                max_behind=int(behind), max_ahead=int(ahead),
-            )
+            stats = rk.range_stats_streaming_packed(
+                rb, xs, valids, w32,
+                max_behind=int(behind), max_ahead=int(ahead))
         else:
-            stats = sm.range_stats_shifted(
-                rb, x, valid, w32,
-                max_behind=int(behind), max_ahead=int(ahead),
-            )
-        clipped = jnp.sum(stats.pop("clipped")).astype(jnp.int64)
+            stats = sm.range_stats_shifted_packed(
+                rb, xs, valids, w32,
+                max_behind=int(behind), max_ahead=int(ahead))
+        clipped = jnp.sum(stats.pop("clipped"),
+                          axis=(1, 2)).astype(jnp.int64)
         return stats, clipped
     start, end = rk.range_window_bounds(secs, jnp.asarray(w))
-    return rk.windowed_stats(x, valid, start, end), jnp.int64(0)
+    per = [rk.windowed_stats(xs[c], valids[c], start, end)
+           for c in range(C)]
+    stats = {k: jnp.stack([p[k] for p in per]) for k in per[0]}
+    return stats, jnp.zeros((C,), jnp.int64)
 
 
 @functools.lru_cache(maxsize=256)
-def _range_stats_local(mesh, series_axis, window_secs, rowbounds=None,
-                       sort_kernels=False, engine="shifted"):
+def _range_stats_local_packed(mesh, series_axis, window_secs,
+                              rowbounds=None, sort_kernels=False,
+                              engine="shifted"):
+    """Series-sharded range stats over the whole column stack: ONE
+    shard_map program computes every summarized column ([C, K, L]
+    stacks) — C-1 fewer dispatches and the timestamp planes stream
+    once.  Replaces the former per-column ``_range_stats_local`` (a
+    width-1 stack reproduces it exactly)."""
     sp = _spec(mesh, series_axis, None)
+    sp3 = _spec(mesh, series_axis, None, ndim=3)
     w = window_secs
 
-    def kernel(ts, x, valid):
-        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds,
-                                            engine)
+    def kernel(ts, xs, valids):
+        stats, clipped = _range_stats_block_packed(ts, xs, valids, w,
+                                                   rowbounds, engine)
         return stats, jax.lax.psum(clipped, series_axis)
 
-    stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
-                                  "stddev", "zscore")}
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
+    stats_spec = {k: sp3 for k in packing.RANGE_STATS}
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp3, sp3),
                              out_specs=(stats_spec, P())))
 
 
 @functools.lru_cache(maxsize=256)
-def _range_stats_a2a(mesh, series_axis, time_axis, window_secs,
-                     rowbounds=None, sort_kernels=False,
-                     engine="shifted"):
-    """Exact range stats on a time-sharded mesh via the series-local
-    layout switch (all_to_all in, compute full rows, all_to_all out)."""
+def _range_stats_a2a_packed(mesh, series_axis, time_axis, window_secs,
+                            rowbounds=None, sort_kernels=False,
+                            engine="shifted"):
+    """Time-sharded twin of :func:`_range_stats_local_packed`
+    (series-local layout switch around the stats, like the former
+    per-column ``_range_stats_a2a`` it replaces): the all_to_all pair
+    moves the [C, K, L] stack in one collective each way."""
     sp = _spec(mesh, series_axis, time_axis)
+    sp3 = _spec(mesh, series_axis, time_axis, ndim=3)
     w = window_secs
 
-    def kernel(ts, x, valid):
-        fwd = lambda a: jax.lax.all_to_all(
-            a, time_axis, split_axis=0, concat_axis=1, tiled=True)
-        rev = lambda a: jax.lax.all_to_all(
-            a, time_axis, split_axis=1, concat_axis=0, tiled=True)
-        ts, x, valid = fwd(ts), fwd(x), fwd(valid)
-        stats, clipped = _range_stats_block(ts, x, valid, w, rowbounds,
-                                            engine)
-        # after the a2a each (series, time) device owns disjoint full
-        # rows, so a psum over both axes counts every series once
+    def kernel(ts, xs, valids):
+        fwd = lambda a, ax: jax.lax.all_to_all(
+            a, time_axis, split_axis=ax, concat_axis=ax + 1, tiled=True)
+        rev3 = lambda a: jax.lax.all_to_all(
+            a, time_axis, split_axis=2, concat_axis=1, tiled=True)
+        ts = fwd(ts, 0)
+        xs, valids = fwd(xs, 1), fwd(valids, 1)
+        stats, clipped = _range_stats_block_packed(ts, xs, valids, w,
+                                                   rowbounds, engine)
         clipped = jax.lax.psum(clipped, (series_axis, time_axis))
-        return {k: rev(v) for k, v in stats.items()}, clipped
+        return {k: rev3(v) for k, v in stats.items()}, clipped
 
-    stats_spec = {k: sp for k in ("mean", "count", "min", "max", "sum",
-                                  "stddev", "zscore")}
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp, sp),
+    stats_spec = {k: sp3 for k in packing.RANGE_STATS}
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp3, sp3),
                              out_specs=(stats_spec, P())))
 
 
@@ -1905,16 +1944,16 @@ def _bucket_stats_fn(mesh, series_axis, time_axis, step_ns, n_cols,
 
     def local(ts, mask, vals, valids):
         b, head, start, end, bid = _bucket_heads(ts, mask, step_ns)
-        outs = []
-        for i in range(n_cols):
-            stats = rk.bucket_stats(bid, vals[i], valids[i], start, end)
-            outs.append(jnp.stack([
-                stats["mean"], stats["count"], stats["min"], stats["max"],
-                stats["sum"], stats["stddev"],
-            ]))
+        # packed passes share the bucket-id plane across the column
+        # stack (bucket_pack_budget-sized groups); bitwise-identical to
+        # the per-column loop it replaced
+        stats = rk.bucket_stats_multi(bid, vals, valids, start, end)
         new_ts = jnp.where(mask, b, packing.TS_PAD)
         # [6, n_cols, K, L]
-        return new_ts, head, jnp.stack(outs, axis=1)
+        return new_ts, head, jnp.stack([
+            stats["mean"], stats["count"], stats["min"], stats["max"],
+            stats["sum"], stats["stddev"],
+        ])
 
     def kernel(ts, mask, vals, valids):
         if n_t > 1:
@@ -2178,6 +2217,9 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
             has = jnp.take_along_axis(has_real, last_phys, axis=-1)
             last = jnp.maximum(idx, 0)
 
+        if fkey >= 2:              # mean/min/max: one packed reduction
+            stats = rk.bucket_stats_multi(bid, vals, valids, start, end)
+            key = {2: "mean", 3: "min", 4: "max"}[fkey]
         outs = []
         oks = []
         for i in range(n_cols):
@@ -2190,10 +2232,8 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
                 oks.append(head & has
                            & jnp.take_along_axis(v, last, axis=-1))
             else:
-                stats = rk.bucket_stats(bid, x, v, start, end)
-                key = {2: "mean", 3: "min", 4: "max"}[fkey]
-                outs.append(stats[key])
-                oks.append(head & (stats["count"] > 0))
+                outs.append(stats[key][i])
+                oks.append(head & (stats["count"][i] > 0))
         new_ts = jnp.where(mask, b, packing.TS_PAD)
         return new_ts, head, jnp.stack(outs), jnp.stack(oks)
 

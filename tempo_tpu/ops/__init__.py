@@ -16,6 +16,7 @@ from tempo_tpu.ops.rolling import (
     range_window_bounds,
     windowed_stats,
     bucket_stats,
+    bucket_stats_multi,
     segment_stats,
     shifted_row_budget,
     ema_compat,
@@ -43,6 +44,7 @@ __all__ = [
     "range_window_bounds",
     "windowed_stats",
     "bucket_stats",
+    "bucket_stats_multi",
     "segment_stats",
     "shifted_row_budget",
     "bucket_stats_pallas",

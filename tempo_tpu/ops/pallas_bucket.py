@@ -35,6 +35,16 @@ Kernels:
 Reference semantics: resample.py:38-117 (aggregation), tsdf.py:615-635
 (EMA).  Engage for f32 on lane-aligned TPU blocks; XLA forms remain
 for CPU/f64/infeasible shapes.
+
+HBM-roofline mechanisms (PR 6, cf. ops/pallas_window.py):
+``bucket_stats_packed`` reduces a [C, K, L] column stack sharing ONE
+bucket-id plane and flag ladder per block (engaged through
+``rolling.bucket_stats_multi`` — the grouped-stats/resample mesh
+reductions in dist.py); ``TEMPO_TPU_DMA_BUFFERS``
+> 2 streams both kernels' slabs through the explicit DMA ring
+(ops/pallas_stream.py); carry-free row grids are declared
+megacore-parallel.  Bitwise identity across all forms is pinned in
+tests/test_pallas_bucket.py.
 """
 
 from __future__ import annotations
@@ -47,6 +57,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tempo_tpu.ops import pallas_kernels as pk
+from tempo_tpu.ops import pallas_stream as psr
 
 
 def _lane(shape):
@@ -112,16 +123,13 @@ def _head_tail(bid, shape):
     return head.astype(jnp.float32), tail.astype(jnp.float32)
 
 
-def _bucket_stats_kernel(bid_ref, x_ref, valid_ref,
-                         mean_ref, cnt_ref, mn_ref, mx_ref, sum_ref,
-                         std_ref, z_ref):
-    bid = bid_ref[:]
-    x = x_ref[:]
-    valid = valid_ref[:]
+def _bucket_math(bid, x, valid, head_f, tail_f):
+    """One column's full segmented reduction over a [bk, L] block — the
+    shared op sequence of the single-column, packed and DMA-ring kernel
+    forms (bitwise identity across the forms holds by construction).
+    The head/tail flag ladders depend only on ``bid`` and are computed
+    once per block by the callers."""
     shape = bid.shape
-
-    head_f, tail_f = _head_tail(bid, shape)
-
     f0 = jnp.float32(0.0)
     f1 = jnp.float32(1.0)
     validf = valid.astype(jnp.float32)
@@ -154,45 +162,118 @@ def _bucket_stats_kernel(bid_ref, x_ref, valid_ref,
     )
     std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, f0)), nan)
 
-    mean_ref[:] = mean
-    cnt_ref[:] = cnt
-    mn_ref[:] = jnp.where(cnt > 0, mn, nan)
-    mx_ref[:] = jnp.where(cnt > 0, mx, nan)
-    sum_ref[:] = jnp.where(cnt > 0, total, nan)
-    std_ref[:] = std
-    z_ref[:] = jnp.where(valid, (x - mean) / std, nan)
+    return (mean, cnt,
+            jnp.where(cnt > 0, mn, nan),
+            jnp.where(cnt > 0, mx, nan),
+            jnp.where(cnt > 0, total, nan),
+            std,
+            jnp.where(valid, (x - mean) / std, nan))
 
 
-_ARRAYS = 40  # 3 in + 7 out double-buffered + 5 scan planes + flags/temps
+def _make_bucket_kernel(n_cols: int):
+    """BlockSpec kernel over :func:`_bucket_math`.  With ``n_cols > 1``
+    the payload refs are [C, bk, L] stacks: the bucket-id plane and its
+    head/tail flag ladders are computed ONCE per block and shared by
+    every column — the multi-column packing that removes the per-column
+    re-stream of the segment keys."""
+
+    def kernel(bid_ref, x_ref, valid_ref, *out_refs):
+        bid = bid_ref[:]
+        head_f, tail_f = _head_tail(bid, bid.shape)
+        if n_cols == 1:
+            outs = _bucket_math(bid, x_ref[:], valid_ref[:], head_f,
+                                tail_f)
+            for r, o in zip(out_refs, outs):
+                r[:] = o
+            return
+        for c in range(n_cols):
+            outs = _bucket_math(bid, x_ref[c], valid_ref[c], head_f,
+                                tail_f)
+            for r, o in zip(out_refs, outs):
+                r[c] = o
+
+    return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _bucket_stats_call(bid, x, valid, interpret=False):
-    K, L = x.shape
-    plan = pk._plan(K, L, arrays=_ARRAYS, bk_max=32, budget=90 * 2**20)
+def _bucket_arrays(n_cols: int, depth: int = 2) -> int:
+    """[bk, L] f32 plane budget: 5 scan planes + flags/temps live per
+    column (columns run sequentially), I/O per the pipeline depth."""
+    base = 22                       # scan planes + flag ladders + temps
+    if depth <= 2:
+        return base + 18 * n_cols   # (x + valid) in + 7 out, 2x each
+    return base + depth * (1 + 2 * n_cols) + 14 * n_cols
+
+
+_ARRAYS = _bucket_arrays(1)  # == 40: the seed single-column budget
+
+
+def _ring_bucket_math(n_cols: int):
+    def ring_math(scalar_refs, slabs):
+        del scalar_refs
+        bid, x, valid = slabs
+        head_f, tail_f = _head_tail(bid, bid.shape)
+        if n_cols == 1:
+            return _bucket_math(bid, x, valid, head_f, tail_f)
+        per = [_bucket_math(bid, x[c], valid[c], head_f, tail_f)
+               for c in range(n_cols)]
+        return tuple(jnp.stack([per[c][t] for c in range(n_cols)])
+                     for t in range(7))
+
+    return ring_math
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _bucket_stats_call(bid, x, valid, depth=2, interpret=False):
+    if x.ndim == 3 and x.shape[0] == 1:
+        # width-1 stack (bucket_pack_budget returns 1 for infeasible /
+        # single-column cases): run the rank-2 single-column form — the
+        # identical op sequence — and restack; the rank-2 spec paths
+        # below would otherwise trace rank-2 BlockSpecs over the rank-3
+        # operands
+        outs = _bucket_stats_call(bid, x[0], valid[0], depth=depth,
+                                  interpret=interpret)
+        return tuple(o[None] for o in outs)
+    n_cols = 1 if x.ndim == 2 else x.shape[0]
+    K, L = x.shape[-2], x.shape[-1]
+    plan = psr.plan_with_ring(
+        K, L, lambda d: _bucket_arrays(n_cols, d), depth)
     if plan is None:
         raise ValueError(
-            f"bucket-stats kernel infeasible at L={L}; use the XLA "
-            f"windowed form"
+            f"bucket-stats kernel infeasible at L={L}, n_cols={n_cols};"
+            f" use the XLA windowed form (or narrow the pack)"
         )
-    grid, bk, K_pad = plan
+    grid, bk, K_pad, use_ring = plan
     bid = pk._pad_rows(bid, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
+
+    if use_ring:
+        out = psr.ring_call(
+            _ring_bucket_math(n_cols), [], [bid, x, valid], n_out=7,
+            out_like=1, bk=bk, depth=depth, interpret=interpret)
+        return tuple(o[..., :K, :] for o in out)
+
     with pk.x64_off():
-        spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
-                            memory_space=pltpu.VMEM)
+        spec2 = pl.BlockSpec((bk, L), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        if n_cols == 1:
+            spec3, out_shape = spec2, (K_pad, L)
+        else:
+            spec3 = pl.BlockSpec((n_cols, bk, L), lambda i: (0, i, 0),
+                                 memory_space=pltpu.VMEM)
+            out_shape = (n_cols, K_pad, L)
         out = pl.pallas_call(
-            _bucket_stats_kernel,
+            _make_bucket_kernel(n_cols),
             grid=grid,
-            in_specs=[spec] * 3,
-            out_specs=[spec] * 7,
-            out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 7,
+            in_specs=[spec2, spec3, spec3],
+            out_specs=[spec3] * 7,
+            out_shape=[jax.ShapeDtypeStruct(out_shape, jnp.float32)] * 7,
             compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
+                dimension_semantics=psr.grid_semantics(len(grid)),
             ),
             interpret=interpret,
         )(bid, x, valid)
-    return tuple(o[:K] for o in out)
+    return tuple(o[..., :K, :] for o in out)
 
 
 def bucket_stats_supported(x) -> bool:
@@ -215,6 +296,7 @@ def bucket_stats_pallas(bid, x, valid, interpret: bool = False):
     their own bucket; their outputs are masked by callers)."""
     with pk.interpret_scope(interpret):
         outs = _bucket_stats_call(bid.astype(jnp.int32), x, valid,
+                                  depth=psr.dma_buffers(),
                                   interpret=interpret)
     mean, cnt, mn, mx, total, std, z = outs
     return {
@@ -223,21 +305,46 @@ def bucket_stats_pallas(bid, x, valid, interpret: bool = False):
     }
 
 
+def bucket_stats_packed(bid, xs, valids, interpret: bool = False):
+    """Multi-column :func:`bucket_stats_pallas`: ``xs``/``valids`` are
+    [C, K, L] stacks sharing one [K, L] bucket-id plane, reduced in ONE
+    kernel pass — the id plane and its head/tail flag ladders cross HBM
+    (and the VPU) once instead of once per column.  Outputs are
+    [C, K, L]; per-column results are bitwise-equal to C single-column
+    calls (identical op sequence).  Size C against the VMEM budget with
+    :func:`bucket_pack_budget`."""
+    with pk.interpret_scope(interpret):
+        outs = _bucket_stats_call(bid.astype(jnp.int32), xs, valids,
+                                  depth=psr.dma_buffers(),
+                                  interpret=interpret)
+    mean, cnt, mn, mx, total, std, z = outs
+    return {
+        "mean": mean, "count": cnt, "min": mn, "max": mx, "sum": total,
+        "stddev": std, "zscore": z,
+    }
+
+
+def bucket_pack_budget(K: int, L: int, n_cols: int) -> int:
+    """Largest bucket-stats pack width (<= ``n_cols``) whose block plan
+    fits the VMEM budget (``pallas_stream.pack_budget`` over this
+    module's plane counts; cf. ``pallas_window.pack_cols_budget``)."""
+    depth = psr.dma_buffers()
+    return psr.pack_budget(K, L, n_cols,
+                           lambda c: _bucket_arrays(c, depth))
+
+
 # ----------------------------------------------------------------------
 # Fused floor-resample + EMA (bench config 3)
 # ----------------------------------------------------------------------
 
-def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
-                         x_ref, valid_ref, res_ref, ema_ref):
-    step = step_ref[0]
-    alpha = alpha_ref[0]
-    secs = secs_ref[:]
+def _resample_ema_math(step, alpha, scale, secs, x, valid):
+    """The fused floor-resample + EMA op sequence over one [bk, L]
+    block, shared by the BlockSpec and DMA-ring kernel forms."""
+    shape = secs.shape
     # the scale scalar folds the caller's elementwise pre-pass into
     # this kernel's single read of x (the pre-pass re-streamed the
     # column through HBM: 8B/row of pure overhead at bench scale)
-    x = x_ref[:] * scale_ref[0]
-    valid = valid_ref[:]
-    shape = secs.shape
+    x = x * scale
 
     # exact integer bucketing: i32 floor-divide lowers natively in
     # Mosaic (probed on v5e).  The first kernel revision multiplied by
@@ -249,7 +356,7 @@ def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
     head = ((lane == 0) | (bucket != _roll_back(bucket, 1))) & valid
 
     nan = jnp.float32(jnp.nan)
-    res_ref[:] = jnp.where(head, x, nan)
+    res = jnp.where(head, x, nan)
 
     # exact EMA ladder over head-masked samples (pallas_kernels._ema)
     f0 = jnp.float32(0.0)
@@ -265,21 +372,52 @@ def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
         v = v + d * v_prev
         d = d * d_prev
         span *= 2
-    ema_ref[:] = v
+    return res, v
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _resample_ema_call(secs, x, valid, step, alpha, scale,
+def _resample_ema_kernel(step_ref, alpha_ref, scale_ref, secs_ref,
+                         x_ref, valid_ref, res_ref, ema_ref):
+    res, ema = _resample_ema_math(step_ref[0], alpha_ref[0],
+                                  scale_ref[0], secs_ref[:], x_ref[:],
+                                  valid_ref[:])
+    res_ref[:] = res
+    ema_ref[:] = ema
+
+
+def _ring_resample_math(scalar_refs, slabs):
+    step_ref, alpha_ref, scale_ref = scalar_refs
+    secs, x, valid = slabs
+    return _resample_ema_math(step_ref[0], alpha_ref[0], scale_ref[0],
+                              secs, x, valid)
+
+
+def _resample_arrays(depth: int = 2) -> int:
+    return 24 if depth <= 2 else 14 + depth * 3
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _resample_ema_call(secs, x, valid, step, alpha, scale, depth=2,
                        interpret=False):
     K, L = x.shape
-    plan = pk._plan(K, L, arrays=24, bk_max=32, budget=90 * 2**20)
+    plan = psr.plan_with_ring(K, L, _resample_arrays, depth)
     if plan is None:
         raise ValueError(
             f"resample-ema kernel infeasible at L={L}; use the XLA form"
         )
-    grid, bk, K_pad = plan
+    grid, bk, K_pad, use_ring = plan
     secs = pk._pad_rows(secs, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
+    scalars = (jnp.asarray([step], jnp.int32),
+               jnp.asarray([alpha], jnp.float32),
+               jnp.asarray(scale, jnp.float32).reshape(1))
+
+    if use_ring:
+        out = psr.ring_call(
+            _ring_resample_math, list(scalars), [secs, x, valid],
+            n_out=2, out_like=1, bk=bk, depth=depth,
+            interpret=interpret)
+        return out[0][:K], out[1][:K]
+
     with pk.x64_off():
         spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
@@ -292,11 +430,10 @@ def _resample_ema_call(secs, x, valid, step, alpha, scale,
             out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 2,
             compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
+                dimension_semantics=psr.grid_semantics(len(grid)),
             ),
             interpret=interpret,
-        )(jnp.asarray([step], jnp.int32),
-          jnp.asarray([alpha], jnp.float32),
-          jnp.asarray(scale, jnp.float32).reshape(1), secs, x, valid)
+        )(*scalars, secs, x, valid)
     return out[0][:K], out[1][:K]
 
 
@@ -337,6 +474,6 @@ def resample_ema_pallas(secs, x, valid, step: float, alpha: float,
             jnp.asarray(step_i, jnp.int32),
             jnp.asarray(alpha, jnp.float32),
             jnp.float32(1.0) if scale is None else scale,
-            interpret=interpret,
+            depth=psr.dma_buffers(), interpret=interpret,
         )
     return res, ema

@@ -248,10 +248,13 @@ def _feasible(shape, arrays: int, bk_max: int) -> bool:
 
 
 def _pad_rows(arr, K_pad: int):
-    K = arr.shape[0]
+    """Pad the row axis (axis -2: [..., K, L] -> [..., K_pad, L])."""
+    K = arr.shape[-2]
     if K_pad == K:
         return arr
-    return jnp.pad(arr, ((0, K_pad - K), (0, 0)))
+    pad = [(0, 0)] * arr.ndim
+    pad[-2] = (0, K_pad - K)
+    return jnp.pad(arr, pad)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

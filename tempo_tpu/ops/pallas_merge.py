@@ -88,6 +88,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tempo_tpu.ops import pallas_kernels as pk
+from tempo_tpu.ops import pallas_stream as psr
 
 # left/right side marker added to the within-side position to form the
 # tie-break key: right rows (sec = pos) sort before left rows
@@ -937,11 +938,17 @@ def _chunk_plane_counts(C: int, nsq: int, segmented: bool, keyed: bool,
     return n_keys, n_payload, n_out
 
 
-def _plan_chunk_lanes(n_payload: int, n_keys: int, override=None):
+def _plan_chunk_lanes(n_payload: int, n_keys: int, override=None,
+                      depth=None):
     """Largest power-of-two chunk width whose program fits the VMEM
     budget — the single-plan footprint model plus the recorded unmerge
     masks and ~2 plane-slots of carry scratch.  None when even a
-    256-lane chunk does not fit (absurd column counts)."""
+    256-lane chunk does not fit (absurd column counts).  ``depth``
+    (``TEMPO_TPU_DMA_BUFFERS`` when unset) folds the payload prefetch
+    ring at its full N-deep size — exactly the accounting the static
+    analyzer's vmem-budget rule applies to the declared scratch."""
+    if depth is None:
+        depth = psr.dma_buffers()
     if override:
         Cm = int(override)
         if Cm < 256 or Cm & (Cm - 1):
@@ -953,7 +960,8 @@ def _plan_chunk_lanes(n_payload: int, n_keys: int, override=None):
     Cm = 256
     while Cm <= (1 << 15):
         n_masks = Cm.bit_length() - 1
-        planes = 6 * (n_payload + n_keys) + n_masks + 2
+        planes = (6 * n_keys + (4 + max(depth, 2)) * n_payload
+                  + n_masks + 2)
         if 8 * Cm * 4 * planes > _VMEM_CAP:
             break
         best = Cm
@@ -963,7 +971,8 @@ def _plan_chunk_lanes(n_payload: int, n_keys: int, override=None):
 
 def _make_chunked_kernel(n_payload: int, n_out: int, Cm: int, n_keys: int,
                          segmented: bool, keyed_fill: bool,
-                         chunk_rows: int, windowed: bool):
+                         chunk_rows: int, windowed: bool,
+                         depth: int = 2, bk: int = 8, nc: int = 1):
     """Streaming kernel closure: one full merge + ffill + unmerge
     network per [bk, Cm] chunk block, with the cross-chunk fill state
     carried in VMEM scratch across the (sequential) chunk grid axis —
@@ -985,7 +994,16 @@ def _make_chunked_kernel(n_payload: int, n_out: int, Cm: int, n_keys: int,
     slot whose source sits more than the horizon (a runtime SMEM
     scalar — one compile per shape for any cap) merged rows back nulls
     out, which is exact for last-valid fills: any earlier candidate is
-    further away still."""
+    further away still.
+
+    ``depth > 2``: the payload planes (the bulk of the chunk traffic)
+    arrive through an explicit ``depth``-slot DMA ring instead of the
+    implicit double-buffered BlockSpec pipeline — chunk ``c+depth-1``'s
+    copy is in flight while chunk ``c``'s merge network computes, which
+    smooths the network's long, chunk-count-independent compute tail.
+    The ring rides the SEQUENTIAL chunk axis (it is itself a cross-step
+    carry, like the fill scratch), so the megacore split stays on the
+    row axis only — the grid-carry legality rule in BUILDING.md."""
     CL = Cm // 2
 
     def kernel(*refs):
@@ -1009,7 +1027,43 @@ def _make_chunked_kernel(n_payload: int, n_out: int, Cm: int, n_keys: int,
                 sid_carry[...] = jnp.full(sid_carry.shape, -1, jnp.int32)
 
         keys = [r[:] for r in key_refs]
-        payload = [r[:] for r in payload_refs]
+        if depth > 2:
+            # payload refs live in HBM (memory_space=ANY): stream chunk
+            # slabs through the prefetch ring.  Ring + semaphores are
+            # the last two scratch operands.
+            ring, psem = refs[-2], refs[-1]
+            i = pl.program_id(0)
+
+            def pdma(cc, p, slot):
+                return pltpu.make_async_copy(
+                    payload_refs[p].at[pl.ds(i * bk, bk),
+                                       pl.ds(cc * Cm, Cm)],
+                    ring.at[slot, p],
+                    psem.at[slot, p],
+                )
+
+            @pl.when(c == 0)
+            def _warm():
+                # the chunk axis restarts at every row block, so the
+                # warm-up refills the ring per block (megacore-safe:
+                # each core owns whole row blocks)
+                for q in range(min(depth - 1, nc)):
+                    for p in range(n_payload):
+                        pdma(q, p, q).start()
+
+            nxt = c + depth - 1
+
+            @pl.when(nxt < nc)
+            def _prefetch():
+                for p in range(n_payload):
+                    pdma(nxt, p, nxt % depth).start()
+
+            slot = c % depth
+            for p in range(n_payload):
+                pdma(c, p, slot).wait()
+            payload = [ring[slot, p] for p in range(n_payload)]
+        else:
+            payload = [r[:] for r in payload_refs]
 
         takes = []
         span = Cm // 2
@@ -1072,18 +1126,20 @@ def _make_chunked_kernel(n_payload: int, n_out: int, Cm: int, n_keys: int,
 @functools.partial(
     jax.jit,
     static_argnames=("n_payload", "n_out", "Cm", "segmented",
-                     "keyed_fill", "chunk_rows", "windowed",
+                     "keyed_fill", "chunk_rows", "windowed", "depth",
                      "interpret"),
 )
 def _chunked_call(keys, payload, n_payload, n_out, Cm, segmented,
                   keyed_fill, chunk_rows, windowed=False, ml=None,
-                  interpret=False):
+                  depth=2, interpret=False):
     K = keys[0].shape[0]
     nc = keys[0].shape[1] // Cm
     n_keys = len(keys)
     CL = Cm // 2
     bk = 8
     K_pad = -(-K // bk) * bk
+    # the payload ring needs at least two chunks to overlap anything
+    use_ring = depth > 2 and nc >= 2
     args = [pk._pad_rows(a, K_pad) for a in (*keys, *payload)]
     if windowed:
         # the horizon is a runtime SMEM scalar: one compiled program
@@ -1094,26 +1150,38 @@ def _chunked_call(keys, payload, n_payload, n_out, Cm, segmented,
                             memory_space=pltpu.VMEM)
         ospec = pl.BlockSpec((bk, CL), lambda i, c: (i, c),
                              memory_space=pltpu.VMEM)
+        # ring mode keeps the payload planes in HBM and streams them
+        # through the explicit prefetch ring (scratch below)
+        pspec = (pl.BlockSpec(memory_space=pltpu.ANY) if use_ring
+                 else spec)
         sspec = [pl.BlockSpec(memory_space=pltpu.SMEM)] if windowed \
             else []
         scratch = [pltpu.VMEM((n_payload, bk, 128), jnp.float32)]
         if segmented:
             scratch.append(pltpu.VMEM((bk, 128), jnp.int32))
-        out = pl.pallas_call(  # lint-ok: vmem-budget: Cm is sized by _plan_chunk_lanes in every caller (asof_merge_*_chunked)
+        if use_ring:
+            scratch.append(pltpu.VMEM((depth, n_payload, bk, Cm),
+                                      jnp.float32))
+            scratch.append(pltpu.SemaphoreType.DMA((depth, n_payload)))
+        out = pl.pallas_call(  # lint-ok: vmem-budget: Cm (and the ring depth) is sized by _plan_chunk_lanes in every caller (asof_merge_*_chunked)
             _make_chunked_kernel(n_payload, n_out, Cm, n_keys,
                                  segmented, keyed_fill, chunk_rows,
-                                 windowed),
+                                 windowed,
+                                 depth=depth if use_ring else 2,
+                                 bk=bk, nc=nc),
             # row blocks are independent (parallel); the chunk axis
-            # carries the fill state and MUST run sequentially
+            # carries the fill state AND the prefetch ring and MUST
+            # run sequentially (pallas_stream.grid_semantics)
             grid=(K_pad // bk, nc),
-            in_specs=sspec + [spec] * (n_keys + n_payload),
+            in_specs=sspec + [spec] * n_keys + [pspec] * n_payload,
             out_specs=[ospec] * n_out,
             out_shape=[jax.ShapeDtypeStruct((K_pad, nc * CL),
                                             jnp.float32)] * n_out,
             scratch_shapes=scratch,
             compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
-                dimension_semantics=("parallel", "arbitrary"),
+                dimension_semantics=psr.grid_semantics(
+                    2, carry_axes=(1,)),
             ),
             interpret=interpret,
         )(*args)
@@ -1196,7 +1264,8 @@ def asof_merge_values_chunked(l_ts, r_ts, r_valids, r_values,
             n_payload=meta["n_payload"], n_out=meta["n_out"],
             Cm=plan.merged_lanes, segmented=l_sid is not None,
             keyed_fill=not skip_nulls, chunk_rows=plan.chunk_rows,
-            windowed=ml > 0, ml=float(ml), interpret=interpret,
+            windowed=ml > 0, ml=float(ml), depth=psr.dma_buffers(),
+            interpret=interpret,
         )
     return chunked_outputs(out, plan, meta["C"], int(np.asarray(l_ts).shape[1]))
 

@@ -45,6 +45,29 @@ inside the kernel — downstream consumers that previously re-streamed
 the column through a separate elementwise pass (bench bodies, fused
 pipelines) fold it here for free.
 
+HBM-roofline mechanisms (PR 6 — BENCH_r05 put these kernels at
+0.18-0.28 of the measured stream rate):
+
+* **multi-column payload packing** (``range_stats_stream_packed`` /
+  ``range_stats_unrolled_packed``): one kernel pass reduces a stacked
+  [C, K, L] payload, reading the key planes (secs + per-column valids
+  ride the payload) ONCE instead of streaming a tiled timestamp copy
+  per metric column — the frame/mesh ``withRangeStats`` callers used
+  to materialise C broadcast copies of ``secs``.  The pack width is
+  sized by the same VMEM-budget folding the static analyzer applies
+  (:func:`pack_cols_budget`, capped by ``TEMPO_TPU_PACK_COLS``);
+  per-column math is the identical op sequence, so packed outputs are
+  bitwise-equal to C single-column calls (tests pin this).
+* **explicit DMA pipelining** (``TEMPO_TPU_DMA_BUFFERS`` > 2): the
+  slab loop moves into the kernel and inputs stream through the
+  N-deep ``pltpu.make_async_copy`` ring of ``ops/pallas_stream.py``,
+  overlapping the copy of slab i+N-1 and the writeback of slab i-1
+  with the compute of slab i.  Depth 2 (default) keeps Mosaic's
+  implicit BlockSpec pipeline.
+* **megacore partitioning**: the row-block grid axis is carry-free, so
+  it is declared ``"parallel"`` (``pallas_stream.grid_semantics``)
+  and Mosaic may split it across TensorCores on megacore parts.
+
 Semantics are identical to ``range_stats_shifted`` including the
 ``clipped`` truncation audit; parity is pinned in
 tests/test_pallas_window.py against both the XLA shifted form and a
@@ -61,20 +84,42 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from tempo_tpu.ops import pallas_kernels as pk
+from tempo_tpu.ops import pallas_stream as psr
 
 _I32_BIG = 2**31 - 1     # python ints: capture as consts inside kernels
 _I32_MIN = -(2**31)
 
-# Live-plane budgets for the block plan.  The streaming form keeps O(1)
-# planes whatever the window (inputs + folded keys + centred values +
-# 5 accumulators + rotate temps + pipelined I/O); the unrolled form
-# inherits the per-shift live-temporary growth measured on the legacy
-# kernel (ops/pallas_stats._plan_arrays).
-_STREAM_ARRAYS = 44
+# Live-plane budgets for the block plan, in [bk, L] f32 plane units.
+# The streaming form keeps O(1) temporaries per column whatever the
+# window (folded keys + centred values + 5 accumulators + rotate
+# temps); the unrolled form inherits the per-shift live-temporary
+# growth measured on the legacy kernel (ops/pallas_stats._plan_arrays).
+# Columns are processed sequentially inside the kernel, so only ONE
+# column's temporaries are live at a time — the per-column cost is the
+# pipelined I/O (x + valid in, 8 planes out), not the sweep state.
+_COL_TEMPS = 20          # one column's live sweep temporaries
+_COL_IO = 20             # (x + valid) in + 8 out, double-buffered
+
+
+def _plan_arrays(n_cols: int, max_behind: int, max_ahead: int,
+                 unroll: bool, depth: int) -> int:
+    """Conservative count of simultaneously-live [bk, L] f32 planes for
+    the block plan (``pallas_kernels._plan``).  The explicit DMA ring
+    trades the BlockSpec pipeline's 2x I/O for ``depth`` input slots
+    plus a double-buffered output stage — same formula, depth-scaled
+    input term."""
+    base = _COL_TEMPS + (max_behind + max_ahead if unroll else 4)
+    if depth <= 2:
+        return base + _COL_IO * n_cols
+    in_planes = 1 + 2 * n_cols            # secs + (x, valid) per column
+    return base + depth * in_planes + 16 * n_cols
+
+
+_STREAM_ARRAYS = _plan_arrays(1, 0, 0, unroll=False, depth=2)   # == 44
 
 
 def _unroll_arrays(max_behind: int, max_ahead: int) -> int:
-    return 40 + max_behind + max_ahead
+    return _plan_arrays(1, max_behind, max_ahead, unroll=True, depth=2)
 
 
 # Largest window the *unrolled* twin may take: beyond this the
@@ -96,12 +141,30 @@ def _stream_max_rows() -> int:
     return config.get_int("TEMPO_TPU_STREAM_MAX_ROWS", 16384)
 
 
-def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,
+def pack_cols_budget(K: int, L: int, n_cols: int,
+                     max_behind: int = 0, max_ahead: int = 0,
+                     unroll: bool = False) -> int:
+    """Largest payload pack width (<= ``n_cols``, capped by
+    ``TEMPO_TPU_PACK_COLS``) whose [C, bk, L] block plan still fits
+    the VMEM budget (``pallas_stream.pack_budget`` over this module's
+    plane counts) — consulted by the frame/mesh ``withRangeStats``
+    packers before stacking metric columns."""
+    depth = psr.dma_buffers()
+    return psr.pack_budget(
+        K, L, n_cols,
+        lambda c: _plan_arrays(c, max_behind, max_ahead, unroll, depth))
+
+
+def _window_math(max_behind: int, max_ahead: int, unroll: bool,
                  interpret: bool = False):
-    """Kernel factory.  ``unroll=True`` bakes the trip counts
+    """The window sweep as a function of *arrays*: one metric column's
+    full pass, shared verbatim by every kernel form (single-column
+    BlockSpec, multi-column packed, explicit DMA ring) — bitwise
+    identity across the forms holds by construction because they trace
+    this exact op sequence.  ``unroll=True`` bakes the trip counts
     (python-int rotate amounts, fully unrolled passes); otherwise the
-    bounds ride in SMEM and the sweep is a ``fori_loop`` whose rotate
-    amount is the loop index."""
+    bounds ride in as runtime scalars and the sweep is a ``fori_loop``
+    whose rotate amount is the loop index."""
 
     def _roll(p, shift):
         # interpret mode avoids roll_p: its fallback lowering re-derives
@@ -112,14 +175,8 @@ def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,
             return jnp.roll(p, shift, axis=1)
         return pltpu.roll(p, shift=shift, axis=1)
 
-    def kernel(p_ref, scale_ref, secs_ref, x_ref, valid_ref,
-               mean_ref, cnt_ref, mn_ref, mx_ref, sum_ref, std_ref,
-               z_ref, clip_ref):
-        w = p_ref[0]
-        wa = p_ref[1]
-        secs = secs_ref[:]
-        valid = valid_ref[:]
-        x = x_ref[:] * scale_ref[0]
+    def math(w, wa, mb_r, ma_r, scale, secs, x, valid):
+        x = x * scale
         shape = secs.shape
         L = shape[1]
         lane = jax.lax.broadcasted_iota(jnp.int32, shape, dimension=1)
@@ -192,8 +249,8 @@ def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,
             mb = jnp.int32(max_behind)
             ma = jnp.int32(max_ahead)
         else:
-            mb = p_ref[2]
-            ma = p_ref[3]
+            mb = mb_r
+            ma = ma_r
             # a bound >= L has no row beyond it; clamping also keeps
             # the rotate amounts inside [0, L)
             carry = jax.lax.fori_loop(
@@ -234,65 +291,149 @@ def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,
                 (sj >= lo) & (sj <= hi) & (valid | (vj > f0))
             )
 
-        mean_ref[:] = mean
-        cnt_ref[:] = cnt
-        mn_ref[:] = jnp.where(cnt > 0, mn + center, nan)
-        mx_ref[:] = jnp.where(cnt > 0, mx + center, nan)
-        sum_ref[:] = jnp.where(cnt > 0, total, nan)
-        std_ref[:] = std
-        z_ref[:] = jnp.where(valid, (x - mean) / std, nan)
-        clip_ref[:] = clipped.astype(jnp.float32)
+        return (mean, cnt,
+                jnp.where(cnt > 0, mn + center, nan),
+                jnp.where(cnt > 0, mx + center, nan),
+                jnp.where(cnt > 0, total, nan),
+                std,
+                jnp.where(valid, (x - mean) / std, nan),
+                clipped.astype(jnp.float32))
+
+    return math
+
+
+def _make_kernel(max_behind: int, max_ahead: int, unroll: bool,
+                 interpret: bool = False, n_cols: int = 1):
+    """BlockSpec-kernel factory over :func:`_window_math`.  With
+    ``n_cols > 1`` the payload refs are [C, bk, L] stacks and the key
+    planes are read once per block — columns run sequentially through
+    the identical per-column op sequence."""
+    math = _window_math(max_behind, max_ahead, unroll, interpret)
+
+    def kernel(p_ref, scale_ref, secs_ref, x_ref, valid_ref,
+               *out_refs):
+        secs = secs_ref[:]
+        if n_cols == 1:
+            outs = math(p_ref[0], p_ref[1], p_ref[2], p_ref[3],
+                        scale_ref[0], secs, x_ref[:], valid_ref[:])
+            for r, o in zip(out_refs, outs):
+                r[:] = o
+            return
+        for c in range(n_cols):
+            outs = math(p_ref[0], p_ref[1], p_ref[2], p_ref[3],
+                        scale_ref[c], secs, x_ref[c], valid_ref[c])
+            for r, o in zip(out_refs, outs):
+                r[c] = o
 
     return kernel
 
 
-def _call(secs, x, valid, params, scale, kernel, arrays, interpret):
-    K, L = x.shape
-    plan = pk._plan(K, L, arrays=arrays, bk_max=32, budget=90 * 2**20)
+def _ring_math(max_behind: int, max_ahead: int, unroll: bool,
+               interpret: bool, n_cols: int):
+    """Per-slab math adapter for the explicit DMA ring
+    (``pallas_stream.ring_call``): same :func:`_window_math` sequence,
+    outputs restacked to the packed [C, bk, L] template."""
+    math = _window_math(max_behind, max_ahead, unroll, interpret)
+
+    def ring_math(scalar_refs, slabs):
+        p_ref, scale_ref = scalar_refs
+        secs, x, valid = slabs
+        if n_cols == 1:
+            return math(p_ref[0], p_ref[1], p_ref[2], p_ref[3],
+                        scale_ref[0], secs, x, valid)
+        per = [math(p_ref[0], p_ref[1], p_ref[2], p_ref[3],
+                    scale_ref[c], secs, x[c], valid[c])
+               for c in range(n_cols)]
+        return tuple(jnp.stack([per[c][t] for c in range(n_cols)])
+                     for t in range(8))
+
+    return ring_math
+
+
+def _call(secs, x, valid, params, scale, max_behind, max_ahead,
+          unroll, depth, interpret):
+    """Shared dispatch for every kernel form.  ``x``/``valid`` are
+    [K, L] (single column) or [C, K, L] (packed); ``secs`` is always
+    [K, L].  ``depth > 2`` streams the slabs through the explicit DMA
+    ring where its plan is feasible, else the standard double-buffered
+    BlockSpec pipeline with the row grid declared megacore-parallel."""
+    if x.ndim == 3 and x.shape[0] == 1:
+        # width-1 pack (a single summarized column, or the leftover of
+        # a C % pack_cols_budget split): run the rank-2 single-column
+        # form — the identical op sequence — and restack; the rank-2
+        # spec paths below would otherwise trace rank-2 BlockSpecs over
+        # the rank-3 operands
+        outs = _call(secs, x[0], valid[0], params, scale, max_behind,
+                     max_ahead, unroll, depth, interpret)
+        return tuple(o[None] for o in outs)
+    n_cols = 1 if x.ndim == 2 else x.shape[0]
+    K, L = x.shape[-2], x.shape[-1]
+    plan = psr.plan_with_ring(
+        K, L, lambda d: _plan_arrays(n_cols, max_behind, max_ahead,
+                                     unroll, d), depth)
     if plan is None:
         raise ValueError(
-            f"streaming window kernel infeasible at L={L}: even an "
-            f"[8, {L}] block exceeds the VMEM budget; use the XLA forms"
+            f"streaming window kernel infeasible at L={L}, "
+            f"n_cols={n_cols}: even an [8, {L}] block exceeds the VMEM "
+            f"budget; use the XLA forms (or narrow the pack — "
+            f"pack_cols_budget)"
         )
-    grid, bk, K_pad = plan
+    grid, bk, K_pad, use_ring = plan
     secs = pk._pad_rows(secs, K_pad)
     x, valid = pk._pad_rows(x, K_pad), pk._pad_rows(valid, K_pad)
+
+    if use_ring:
+        out = psr.ring_call(
+            _ring_math(max_behind, max_ahead, unroll, interpret,
+                       n_cols),
+            [params, scale], [secs, x, valid], n_out=8, out_like=1,
+            bk=bk, depth=depth, interpret=interpret)
+        return tuple(o[..., :K, :] for o in out)
+
     with pk.x64_off():
-        spec = pl.BlockSpec((bk, L), lambda i: (i, 0),
-                            memory_space=pltpu.VMEM)
+        spec2 = pl.BlockSpec((bk, L), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+        if n_cols == 1:
+            spec3 = spec2
+            out_shape = (K_pad, L)
+        else:
+            spec3 = pl.BlockSpec((n_cols, bk, L), lambda i: (0, i, 0),
+                                 memory_space=pltpu.VMEM)
+            out_shape = (n_cols, K_pad, L)
         out = pl.pallas_call(
-            kernel,
+            _make_kernel(max_behind, max_ahead, unroll, interpret,
+                         n_cols),
             grid=grid,
             in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2
-            + [spec] * 3,
-            out_specs=[spec] * 8,
-            out_shape=[jax.ShapeDtypeStruct((K_pad, L), jnp.float32)] * 8,
+            + [spec2, spec3, spec3],
+            out_specs=[spec3] * 8,
+            out_shape=[jax.ShapeDtypeStruct(out_shape, jnp.float32)] * 8,
             compiler_params=pk.tpu_compiler_params(
                 vmem_limit_bytes=100 * 1024 * 1024,
+                dimension_semantics=psr.grid_semantics(len(grid)),
             ),
             interpret=interpret,
         )(params, scale, secs, x, valid)
-    return tuple(o[:K] for o in out)
+    return tuple(o[..., :K, :] for o in out)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _stream_call(secs, x, valid, params, scale, interpret=False):
-    """ONE compiled program per [K, L] shape: window size and row
-    bounds are runtime scalars."""
-    return _call(secs, x, valid, params, scale,
-                 _make_kernel(0, 0, unroll=False, interpret=interpret),
-                 _STREAM_ARRAYS, interpret)
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _stream_call(secs, x, valid, params, scale, depth=2,
+                 interpret=False):
+    """ONE compiled program per [K, L] shape (and pack width): window
+    size and row bounds are runtime scalars."""
+    return _call(secs, x, valid, params, scale, 0, 0, unroll=False,
+                 depth=depth, interpret=interpret)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_behind", "max_ahead", "interpret")
+    jax.jit,
+    static_argnames=("max_behind", "max_ahead", "depth", "interpret"),
 )
 def _unrolled_call(secs, x, valid, params, scale, max_behind, max_ahead,
-                   interpret=False):
-    return _call(secs, x, valid, params, scale,
-                 _make_kernel(max_behind, max_ahead, unroll=True,
-                              interpret=interpret),
-                 _unroll_arrays(max_behind, max_ahead), interpret)
+                   depth=2, interpret=False):
+    return _call(secs, x, valid, params, scale, max_behind, max_ahead,
+                 unroll=True, depth=depth, interpret=interpret)
 
 
 def _as_dict(outs):
@@ -317,10 +458,13 @@ def _params(window, window_ahead, max_behind, max_ahead):
     ])
 
 
-def _scale(scale):
+def _scale(scale, n_cols: int = 1):
     if scale is None:
-        return jnp.ones((1,), jnp.float32)
-    return jnp.asarray(scale, jnp.float32).reshape(1)
+        return jnp.ones((n_cols,), jnp.float32)
+    s = jnp.asarray(scale, jnp.float32).reshape(-1)
+    if s.shape[0] == n_cols:
+        return s
+    return jnp.broadcast_to(s, (n_cols,))
 
 
 def stream_supported(x, L_mult: int = 128) -> bool:
@@ -379,7 +523,7 @@ def range_stats_stream(secs, x, valid, window, max_behind, max_ahead,
         outs = _stream_call(
             secs.astype(jnp.int32), x, valid,
             _params(window, window_ahead, max_behind, max_ahead),
-            _scale(scale), interpret=interpret,
+            _scale(scale), depth=psr.dma_buffers(), interpret=interpret,
         )
     return _as_dict(outs)
 
@@ -395,7 +539,47 @@ def range_stats_unrolled(secs, x, valid, window, max_behind, max_ahead,
             secs.astype(jnp.int32), x, valid,
             _params(window, window_ahead, max_behind, max_ahead),
             _scale(scale), max_behind=int(max_behind),
-            max_ahead=int(max_ahead), interpret=interpret,
+            max_ahead=int(max_ahead), depth=psr.dma_buffers(),
+            interpret=interpret,
+        )
+    return _as_dict(outs)
+
+
+def range_stats_stream_packed(secs, xs, valids, window, max_behind,
+                              max_ahead, window_ahead=0, scales=None,
+                              interpret: bool = False):
+    """Multi-column :func:`range_stats_stream`: ``xs``/``valids`` are
+    [C, K, L] stacks sharing one [K, L] key plane, reduced in ONE
+    kernel pass — the key planes cross HBM once instead of once per
+    column.  Outputs are [C, K, L] ([C, K, 1] for ``clipped``);
+    per-column results are bitwise-equal to C single-column calls
+    (identical op sequence — tests/test_pallas_window.py pins the
+    matrix).  ``scales`` is None, a scalar, or a [C] vector.  Callers
+    size C with :func:`pack_cols_budget`."""
+    C = xs.shape[0]
+    with pk.interpret_scope(interpret):
+        outs = _stream_call(
+            secs.astype(jnp.int32), xs, valids,
+            _params(window, window_ahead, max_behind, max_ahead),
+            _scale(scales, C), depth=psr.dma_buffers(),
+            interpret=interpret,
+        )
+    return _as_dict(outs)
+
+
+def range_stats_unrolled_packed(secs, xs, valids, window, max_behind,
+                                max_ahead, window_ahead=0, scales=None,
+                                interpret: bool = False):
+    """Multi-column :func:`range_stats_unrolled` (see
+    :func:`range_stats_stream_packed`)."""
+    C = xs.shape[0]
+    with pk.interpret_scope(interpret):
+        outs = _unrolled_call(
+            secs.astype(jnp.int32), xs, valids,
+            _params(window, window_ahead, max_behind, max_ahead),
+            _scale(scales, C), max_behind=int(max_behind),
+            max_ahead=int(max_ahead), depth=psr.dma_buffers(),
+            interpret=interpret,
         )
     return _as_dict(outs)
 

@@ -134,6 +134,72 @@ def range_stats_streaming(secs, x, valid, window, max_behind, max_ahead,
     return stats
 
 
+def packed_column_dispatch(n_cols, scales, gate, packed_group,
+                           single_col):
+    """Shared group/fallback/concat loop of the ``*_packed``
+    multi-column entry points (here and
+    ``sortmerge.range_stats_shifted_packed``).  Walks the column axis:
+    where ``gate(c0)`` holds, ``packed_group(c0, scales_vec)`` reduces
+    a kernel-pack-sized group in one pass and returns ``(width,
+    stats-dict of [width, ...] planes)``; elsewhere ``single_col(c0,
+    scale)`` runs the single-column dispatcher (results bitwise-equal
+    to unpacked calls either way — the packed kernels trace the
+    identical per-column op sequence).  Returns [C, ...] planes."""
+    scv = None if scales is None else \
+        jnp.broadcast_to(jnp.asarray(scales, jnp.float32).reshape(-1),
+                         (n_cols,))
+    parts = []
+    c0 = 0
+    while c0 < n_cols:
+        if gate(c0):
+            width, part = packed_group(c0, scv)
+        else:
+            width = 1
+            single = single_col(c0, None if scv is None else scv[c0])
+            part = {k: v[None] for k, v in single.items()}
+        parts.append(part)
+        c0 += width
+    if len(parts) == 1:
+        return parts[0]
+    return {k: jnp.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+
+def range_stats_streaming_packed(secs, xs, valids, window, max_behind,
+                                 max_ahead, scales=None):
+    """Multi-column :func:`range_stats_streaming`: ``xs``/``valids``
+    are [C, K, L] stacks over one [K, L] key plane.  On TPU the
+    columns run as packed kernel passes (``pack_cols_budget``-sized
+    groups — the key planes cross HBM once per group instead of once
+    per column); elsewhere, and for any residual infeasible group, a
+    per-column loop of :func:`range_stats_streaming` whose results are
+    bitwise-identical to the unpacked calls.  Output planes are
+    [C, K, L] ([C, K, 1] for ``clipped``)."""
+    from tempo_tpu.ops import pallas_window as pw
+
+    secs = jnp.asarray(secs)
+    xs = jnp.asarray(xs)
+    valids = jnp.asarray(valids)
+    C, K, L = xs.shape
+
+    def gate(c0):
+        return (secs.dtype == jnp.int32 and pw.stream_supported(xs[c0])
+                and window_engine_override() != "windowed")
+
+    def packed_group(c0, scv):
+        width = pw.pack_cols_budget(K, L, C - c0)
+        return width, pw.range_stats_stream_packed(
+            secs, xs[c0:c0 + width], valids[c0:c0 + width], window,
+            max_behind, max_ahead,
+            scales=None if scv is None else scv[c0:c0 + width])
+
+    def single_col(c0, scale):
+        return range_stats_streaming(secs, xs[c0], valids[c0], window,
+                                     max_behind, max_ahead, scale=scale)
+
+    return packed_column_dispatch(C, scales, gate, packed_group,
+                                  single_col)
+
+
 def shifted_row_budget(n_elems: int, pallas_ok: bool = False) -> int:
     """Largest row extent the shifted form may take for a shard of
     ``n_elems`` values.  The XLA form materialises ~2.4 shifted operand
@@ -299,6 +365,36 @@ def bucket_stats(bid, x, valid, start, end):
     if pb.bucket_stats_supported(x):
         return pb.bucket_stats_pallas(bid, x, valid)
     return windowed_stats(x, valid, start, end)
+
+
+def bucket_stats_multi(bid, xs, valids, start, end):
+    """Multi-column :func:`bucket_stats`: ``xs``/``valids`` are
+    [C, K, L] stacks over one [K, L] bucket-id plane.  On TPU the
+    columns run as packed kernel passes
+    (``pallas_bucket.bucket_pack_budget``-sized groups — the id plane
+    and its head/tail flag ladders cross HBM and the VPU once per group
+    instead of once per column); elsewhere, and for any infeasible
+    column, the single-column dispatch.  Returns [C, K, L] planes,
+    bitwise-identical to C :func:`bucket_stats` calls."""
+    from tempo_tpu.ops import pallas_bucket as pb
+
+    xs = jnp.asarray(xs)
+    valids = jnp.asarray(valids)
+    C, K, L = xs.shape
+
+    def gate(c0):
+        return pb.bucket_stats_supported(xs[c0])
+
+    def packed_group(c0, scv):
+        width = pb.bucket_pack_budget(K, L, C - c0)
+        return width, pb.bucket_stats_packed(
+            bid, xs[c0:c0 + width], valids[c0:c0 + width])
+
+    def single_col(c0, scale):
+        return bucket_stats(bid, xs[c0], valids[c0], start, end)
+
+    return packed_column_dispatch(C, None, gate, packed_group,
+                                  single_col)
 
 
 @functools.partial(jax.jit, static_argnames=("num_segments",))

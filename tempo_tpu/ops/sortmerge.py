@@ -614,6 +614,51 @@ def range_stats_shifted(
                                     max_ahead=max_ahead)
 
 
+def range_stats_shifted_packed(secs, xs, valids, window, max_behind,
+                               max_ahead, scales=None):
+    """Multi-column :func:`range_stats_shifted`: ``xs``/``valids`` are
+    [C, K, L] stacks over one [K, L] key plane.  On TPU, packable
+    groups run through the unrolled pallas_window kernel in single
+    passes that read the key planes once
+    (``pallas_window.range_stats_unrolled_packed``, group width from
+    ``pack_cols_budget``); every other configuration (legacy kernel,
+    XLA form, int64 keys) loops the single-column dispatcher, so the
+    per-column results are bitwise-identical to unpacked calls either
+    way.  Output planes are [C, K, L] ([C, K, 1] for ``clipped``)."""
+    from tempo_tpu.ops import pallas_window as pw
+    from tempo_tpu.ops.rolling import (packed_column_dispatch,
+                                       window_engine_override)
+
+    secs = jnp.asarray(secs)
+    xs = jnp.asarray(xs)
+    valids = jnp.asarray(valids)
+    C, K, L = xs.shape
+
+    def gate(c0):
+        return (secs.dtype == jnp.int32
+                and window_engine_override() != "legacy"
+                and pw.unrolled_supported(xs[c0], max_behind,
+                                          max_ahead))
+
+    def packed_group(c0, scv):
+        width = pw.pack_cols_budget(K, L, C - c0,
+                                    max_behind=int(max_behind),
+                                    max_ahead=int(max_ahead),
+                                    unroll=True)
+        return width, pw.range_stats_unrolled_packed(
+            secs, xs[c0:c0 + width], valids[c0:c0 + width], window,
+            max_behind, max_ahead,
+            scales=None if scv is None else scv[c0:c0 + width])
+
+    def single_col(c0, scale):
+        return dict(range_stats_shifted(
+            secs, xs[c0], valids[c0], window, max_behind, max_ahead,
+            scale=scale))
+
+    return packed_column_dispatch(C, scales, gate, packed_group,
+                                  single_col)
+
+
 @functools.partial(jax.jit, static_argnames=("max_behind", "max_ahead"))
 def _range_stats_shifted_xla(
     secs: jnp.ndarray,

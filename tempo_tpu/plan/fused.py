@@ -6,7 +6,7 @@ plus the alignment programs between them — every dispatch pays the
 launch/tunnel latency and re-reads its inputs from HBM.  The optimizer
 rewrites the chain onto this module (``fused_asof_stats_ema`` node),
 which traces the SAME shard-local kernels the eager ops use
-(``dist._asof_planes``, ``dist._range_stats_block``,
+(``dist._asof_planes``, ``dist._range_stats_block_packed``,
 ``pallas_kernels.ema_scan`` / ``ops.rolling.ema_compat``) into ONE
 jitted program: one dispatch, results bitwise-identical to the
 op-by-op chain (identical kernel functions over identical inputs),
@@ -195,23 +195,29 @@ def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
                 return lvals[i], lvalids[i]
             return right_vals[i], found_b[i]
 
-        stat_planes = []
-        clip_list = []
-        for src in stats_srcs:
-            x, v = plane(src)
-            st, clipped = dist._range_stats_block(l_ts, x, v, w,
-                                                  rowbounds, engine)
-            # pin the op boundary: in the eager chain each stats dict
-            # is a program OUTPUT (its own fusion-cluster root); the
-            # [S, 7, K, L] stack below would otherwise reshape the
-            # clusters and flip FMA-contraction decisions in the
-            # var/stddev math — visible as last-ulp drift exactly at
-            # the cancellation-sensitive windows
-            st = jax.lax.optimization_barrier(st)
-            stat_planes.append(jnp.stack([st[k] for k in _STATS]))
-            clip_list.append(jax.lax.psum(clipped, series_axis))
-        stats = jnp.stack(stat_planes)            # [S, 7, K, L]
-        clips = jnp.stack(clip_list)              # [S]
+        # multi-column payload packing: ONE packed range-stats pass
+        # over the [S, K, L] source stack — the timestamp planes cross
+        # HBM once per kernel pack instead of once per summarized
+        # column.  The packed block fn is the SAME function the eager
+        # mesh chain now runs (dist.withRangeStats — per-column math
+        # bitwise-identical to the unpacked kernels), so the
+        # planned==eager bit-identity contract is preserved by
+        # construction.
+        planes_sv = [plane(src) for src in stats_srcs]
+        xs = jnp.stack([x for x, _ in planes_sv])
+        vs = jnp.stack([v for _, v in planes_sv])
+        st, clipped = dist._range_stats_block_packed(l_ts, xs, vs, w,
+                                                     rowbounds, engine)
+        # pin the op boundary: in the eager chain the packed stats
+        # dict is a program OUTPUT (its own fusion-cluster root); the
+        # [S, 7, K, L] stack below would otherwise reshape the
+        # clusters and flip FMA-contraction decisions in the
+        # var/stddev math — visible as last-ulp drift exactly at the
+        # cancellation-sensitive windows
+        st = jax.lax.optimization_barrier(st)
+        stats = jnp.stack([jnp.stack([st[k][si] for k in _STATS])
+                           for si in range(n_stats)])  # [S, 7, K, L]
+        clips = jax.lax.psum(clipped, series_axis)     # [S]
         if ema_src is not None:
             x, v = plane(ema_src)
             ema_y = (pk.ema_scan(x, v, alpha) if exact

@@ -100,6 +100,8 @@ def window_roofline(
     restream_bytes_per_row: float = 0.0,
     t_iter: Optional[float] = None,
     stream_bytes_per_sec: Optional[float] = None,
+    n_cols: int = 1,
+    key_bytes_per_row: float = 0.0,
 ) -> Dict[str, float]:
     """Roofline accounting for a windowed/streaming config: bytes-moved
     vs bytes-minimal, and their fractions of a *measured* stream rate.
@@ -118,14 +120,29 @@ def window_roofline(
       moved twice; below 1.0 quantifies exactly the re-streaming that
       kernel fusion (scale/jitter scalars riding SMEM,
       ops/pallas_window.py / ops/pallas_bucket.py) removes.
+
+    **Column packing** (``n_cols`` > 1): the shared key planes
+    (``key_bytes_per_row`` — timestamps/bucket ids) are compulsory
+    traffic ONCE per pass, while ``read_bytes_per_row`` /
+    ``write_bytes_per_row`` count one *column's* payload and scale by
+    ``n_cols``.  An unpacked implementation re-streams the keys per
+    column — model that by putting the extra (n_cols-1) x key bytes
+    into ``restream_bytes_per_row``; the packed kernels
+    (ops/pallas_window.py ``range_stats_*_packed``) reclaim exactly
+    that term.  ``n_rows`` stays the per-column row count; the
+    per-row figures below are per base row.
     """
-    bytes_min = float(n_rows) * (read_bytes_per_row + write_bytes_per_row)
+    per_row_min = key_bytes_per_row + n_cols * (
+        read_bytes_per_row + write_bytes_per_row)
+    bytes_min = float(n_rows) * per_row_min
     bytes_moved = bytes_min + float(n_rows) * restream_bytes_per_row
     out: Dict[str, float] = {
-        "bytes_minimal_per_row": read_bytes_per_row + write_bytes_per_row,
+        "bytes_minimal_per_row": per_row_min,
         "bytes_moved_per_row": bytes_moved / max(n_rows, 1),
         "stream_efficiency": round(bytes_min / max(bytes_moved, 1.0), 3),
     }
+    if n_cols > 1:
+        out["packed_cols"] = n_cols
     if t_iter and stream_bytes_per_sec:
         out["achieved_frac"] = round(
             bytes_moved / t_iter / stream_bytes_per_sec, 3)

@@ -67,13 +67,19 @@ def plan_range_engine(tsdf, cols: List[str], rangeBackWindowSecs: int):
     rb = (packing.layout_rowbounds(layout, w)
           if ts_long.dtype == np.int32 and sm.use_sort_kernels()
           else None)
-    C = len(cols)
     K, L = ts_long.shape
     f32 = np.dtype(packing.compute_dtype()) == np.float32
-    pallas_ok = f32 and _ps.pallas_block_feasible(C * K, L)
-    stream_ok = f32 and _pw.stream_block_feasible(C * K, L)
+    # feasibility and the HBM budget are per COLUMN since the packed
+    # rewire: the pallas engines block [C<=pack, bk, L] (columns
+    # sequenced inside the kernel, pack width folded separately by
+    # pack_cols_budget) and the XLA fallbacks loop single [K, L]
+    # columns — the old C*K flattened gate modeled the tiled layout
+    # that no longer runs.  This matches the mesh path's per-column
+    # pick (dist._pick_range_engine_for_shard).
+    pallas_ok = f32 and _ps.pallas_block_feasible(K, L)
+    stream_ok = f32 and _pw.stream_block_feasible(K, L)
     engine = ("windowed" if rb is None else rk.pick_range_engine(
-        C * K * L, rb[0], rb[1], pallas_ok, stream_ok))
+        K * L, rb[0], rb[1], pallas_ok, stream_ok))
     return engine, rb, ts_long, w
 
 
@@ -115,8 +121,12 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
     engine, rb, ts_long, w = plan_range_engine(tsdf, cols,
                                                rangeBackWindowSecs)
     if engine == "shifted":
-        stats = dict(sm.range_stats_shifted(
-            tile(ts_long), flat(vals), flat(valids),
+        # multi-column payload packing: the [C, K, L] metric stack
+        # shares ONE [K, L] key plane — the packed kernels read it once
+        # per pack where the seed path materialised a C-wide broadcast
+        # copy of the timestamps (`tile`) and streamed it per column
+        stats = dict(sm.range_stats_shifted_packed(
+            jnp.asarray(ts_long), jnp.asarray(vals), jnp.asarray(valids),
             jnp.asarray(np.int32(w)),
             max_behind=int(rb[0]), max_ahead=int(rb[1]),
         ))
@@ -124,8 +134,8 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
         # stats below (the axon tunnel has a >1s per-transfer latency
         # floor — one extra scalar round trip would double it)
     elif engine == "stream":
-        stats = dict(rk.range_stats_streaming(
-            tile(ts_long), flat(vals), flat(valids),
+        stats = dict(rk.range_stats_streaming_packed(
+            jnp.asarray(ts_long), jnp.asarray(vals), jnp.asarray(valids),
             jnp.asarray(np.int32(w)),
             max_behind=int(rb[0]), max_ahead=int(rb[1]),
         ))
@@ -167,8 +177,10 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
                 f"withRangeStats: {clipped_total} rows exceeded the "
                 f"derived row bounds {rb}; this is a tempo-tpu bug"
             )
-    stacked = buf.reshape(len(names), C * K, L)
-    stats = {k: stacked[i].reshape(C, K, L) for i, k in enumerate(names)}
+    # packed engines yield [C, K, L] planes, the windowed fallback
+    # [C*K, L] — the element order is identical either way
+    stacked = buf.reshape(len(names), C, K, L)
+    stats = {k: stacked[i] for i, k in enumerate(names)}
 
     for ci, c in enumerate(cols):
         for stat in packing.RANGE_STATS:

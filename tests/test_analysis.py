@@ -212,6 +212,52 @@ def test_vmem_suppression(tmp_path):
     assert found == []
 
 
+def _ring_kernel_src(ring_shape: str, suppress: str = "") -> str:
+    """Manual-DMA pipeline fixture: ANY-space operands (HBM-resident,
+    zero VMEM) + an N-deep ring scratch whose declared shape carries
+    the full multi-buffer cost + DMA semaphores (zero VMEM)."""
+    return PRELUDE + (
+        "def kernel(x_hbm, o_hbm, ring, sem):\n"
+        "    o_hbm[:] = ring[0]\n"
+        "def call(x):\n"
+        "    return pl.pallas_call(kernel,%s\n"
+        "        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],\n"
+        "        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),\n"
+        "        scratch_shapes=[\n"
+        "            pltpu.VMEM(%s, jnp.float32),\n"
+        "            pltpu.SemaphoreType.DMA((4, 3)),\n"
+        "        ],\n"
+        "        out_shape=jax.ShapeDtypeStruct((4096, 8192),"
+        " jnp.float32))(x)\n"
+    ) % (suppress, ring_shape)
+
+
+def test_vmem_folds_ring_scratch_at_full_depth(tmp_path):
+    """A 4-deep [4, 512, 8192] f32 ring is 64 MiB — the N-fold cost
+    must fire against the 16 MiB default even though the ANY-space
+    operands themselves count zero."""
+    found = check(VmemBudgetRule(), tmp_path,
+                  _ring_kernel_src("(4, 512, 8192)"))
+    assert len(found) == 1
+    assert "budget" in found[0].message
+
+
+def test_vmem_any_space_operands_count_zero(tmp_path):
+    """The same manual-DMA site with a small ring passes: ANY operands
+    stay in HBM (the [4096, 8192] out_shape must NOT be billed to
+    VMEM) and DMA semaphores are not VMEM either."""
+    found = check(VmemBudgetRule(), tmp_path,
+                  _ring_kernel_src("(4, 8, 128)"))
+    assert found == []
+
+
+def test_vmem_ring_suppression(tmp_path):
+    found = check(VmemBudgetRule(), tmp_path, _ring_kernel_src(
+        "(4, 512, 8192)",
+        suppress="  # lint-ok: vmem-budget: ring sized by ring_plan"))
+    assert found == []
+
+
 # ----------------------------------------------------------------------
 # weak-dtype
 # ----------------------------------------------------------------------

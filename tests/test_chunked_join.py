@@ -390,3 +390,33 @@ def test_flag_matrix_per_combination_counts():
     logging.getLogger(__name__).info(
         "chunked fuzz matrix counts: %s",
         {str(k): v for k, v in sorted(_matrix_runs.items())})
+
+
+def test_chunked_ring_depth_bitwise(monkeypatch):
+    """TEMPO_TPU_DMA_BUFFERS > 2 streams the payload planes through
+    the explicit chunk-axis prefetch ring (ISSUE 6) — outputs must be
+    IDENTICAL to the BlockSpec-pipelined kernel, including across the
+    cross-chunk carry (the ring must never outrun the fill state)."""
+    from tempo_tpu.ops import pallas_merge as pm
+
+    rng = np.random.default_rng(41)
+    K, L = 8, 1024
+    l_ts = np.cumsum(rng.integers(1, 3, (K, L)).astype(np.int64),
+                     axis=-1) * 1_000_000
+    r_ts = np.cumsum(rng.integers(1, 3, (K, L)).astype(np.int64),
+                     axis=-1) * 1_000_000
+    r_values = rng.standard_normal((2, K, L)).astype(np.float32)
+    r_valids = rng.random((2, K, L)) > 0.1
+    r_valids[0, 3] = False                  # NaN runs straddle chunks
+    monkeypatch.delenv("TEMPO_TPU_DMA_BUFFERS", raising=False)
+    base = pm.asof_merge_values_chunked(
+        l_ts, r_ts, r_valids, r_values, chunk_lanes=512, interpret=True)
+    for depth in (3, 4):
+        monkeypatch.setenv("TEMPO_TPU_DMA_BUFFERS", str(depth))
+        ring = pm.asof_merge_values_chunked(
+            l_ts, r_ts, r_valids, r_values, chunk_lanes=512,
+            interpret=True)
+        for a, b, name in zip(base, ring, ("vals", "found", "idx")):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"depth={depth}:{name}")

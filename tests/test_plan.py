@@ -21,7 +21,7 @@ import pytest
 import tempo_tpu  # noqa: F401  (jax config side effects)
 import jax
 
-from tempo_tpu import TSDF, profiling
+from tempo_tpu import TSDF, packing, profiling
 from tempo_tpu.parallel import make_mesh
 from tempo_tpu.plan import cache as plan_cache
 from tempo_tpu.plan import hints as plan_hints
@@ -203,6 +203,31 @@ def test_host_chain_bitwise_vs_eager(monkeypatch, chain):
     plan_cache.CACHE.clear()
     planned = fn(lt, rt).df
     pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+def test_packed_mesh_stats_matches_per_column(plan_off):
+    """The multi-column payload packing (ISSUE 6): one packed
+    withRangeStats program over every summarized column must produce
+    per-column values bitwise-equal to C single-column programs —
+    the invariant that lets the planner's fused program and the eager
+    chain share the packed block fn without breaking the
+    planned==eager contract."""
+    lt, rt = make_frames(seed=17, nulls=True)
+    dl = lt.on_mesh(_mesh()).asofJoin(rt.on_mesh(_mesh()))
+    multi = dl.withRangeStats(rangeBackWindowSecs=WINDOW).collect().df
+    cols = [c for c in ("x", "right_v0", "right_v1")
+            if any(col.startswith(f"mean_{c}") for col in multi.columns)]
+    assert len(cols) >= 2, multi.columns
+    for c in cols:
+        single = dl.withRangeStats(
+            colsToSummarize=[c], rangeBackWindowSecs=WINDOW,
+        ).collect().df
+        stat_cols = [col for col in single.columns
+                     if col.endswith(f"_{c}")
+                     and col.split("_")[0] in packing.RANGE_STATS]
+        assert stat_cols
+        pd.testing.assert_frame_equal(
+            multi[stat_cols], single[stat_cols], check_exact=True)
 
 
 def test_randomized_chain_matrix_bitwise(monkeypatch):

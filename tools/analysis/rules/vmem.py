@@ -11,10 +11,15 @@ time over every call site:
 * Block shapes (BlockSpec), ``out_shape`` dtypes, and
   ``scratch_shapes`` are folded to constants where the source allows.
   A fully resolved site whose worst-case per-step bytes — VMEM-blocked
-  inputs and outputs double-buffered (Mosaic pipelines I/O), scratch
-  single — exceed the budget (``vmem_limit_bytes`` from
-  ``compiler_params`` when given, else the 16 MiB scoped default) is a
-  violation outright.
+  inputs and outputs double-buffered (Mosaic pipelines I/O: the
+  implicit 2x multi-buffering), scratch at its FULL declared shape —
+  exceed the budget (``vmem_limit_bytes`` from ``compiler_params``
+  when given, else the 16 MiB scoped default) is a violation outright.
+  An explicit N-deep DMA ring (ops/pallas_stream.py) declares its
+  buffering as the ring scratch's leading dim, so the N-fold cost is
+  counted through the same shape folding; its ``memory_space=ANY``
+  operands stay in HBM and count ZERO VMEM (the ring scratch IS their
+  on-chip footprint), and DMA semaphores live in semaphore memory.
 * A site with *unresolvable* extents (runtime ``K``/``L``) must sit in
   a function that consults a chunking/feasibility planner (a call
   whose name mentions plan/feasible/supported/chunk — ``_plan``,
@@ -135,8 +140,11 @@ class VmemBudgetRule(Rule):
                 unresolved = True
                 continue
             for spec in specs:
-                if spec.memory_space == "SMEM":
-                    continue  # scalar prefetch lives outside VMEM
+                if spec.memory_space in ("SMEM", "ANY"):
+                    # scalar prefetch lives outside VMEM; ANY operands
+                    # stay in HBM — a manual-DMA kernel's on-chip bytes
+                    # are its declared ring/stage scratch
+                    continue
                 if spec.bytes_per_block is UNKNOWN:
                     unresolved = True
                 else:
@@ -238,8 +246,10 @@ class VmemBudgetRule(Rule):
             space = df.terminal_name(ms) or "VMEM"
         shape_node = call.args[0] if call.args else _kw(call, "block_shape")
         if shape_node is None:
-            # whole-operand block: sized by the runtime operand
-            return _Spec(0 if space == "SMEM" else UNKNOWN, space)
+            # whole-operand block: sized by the runtime operand (0 for
+            # the non-VMEM spaces — SMEM scalars, HBM-resident ANY)
+            return _Spec(0 if space in ("SMEM", "ANY") else UNKNOWN,
+                         space)
         shape = df.fold(shape_node, env, fallback)
         return _Spec(_shape_bytes(shape, 4), space)
 
@@ -267,7 +277,10 @@ class VmemBudgetRule(Rule):
             return lhs + rhs
         if isinstance(node, ast.Call):
             name = df.terminal_name(node.func)
-            if name in ("SMEM", "SemaphoreType"):
+            if name in ("SMEM", "SemaphoreType", "DMA", "REGULAR",
+                        "BARRIER"):
+                # SMEM scalars and semaphores (pltpu.SemaphoreType.DMA
+                # calls resolve to their rightmost attr) are not VMEM
                 return 0
             if name == "VMEM":
                 shape = df.fold(call_arg(node, 0), env, fallback)
