@@ -119,6 +119,13 @@ KNOBS: Dict[str, Knob] = _knobs(
          "LRU bound of the planner's compiled-executable cache "
          "(entries keyed by plan signature + shapes + mesh; 0 disables "
          "caching)"),
+    Knob("TEMPO_TPU_CONTRACT_LANES", "int", "32",
+         "tempo_tpu/plan/contracts",
+         "compile-shape budget of the compiled-contract tier (tools/"
+         "analyze.py --compiled): per-series padded row count L of the "
+         "representative shapes the production-program registry is "
+         "compiled at (clamped [16, 4096]; bigger = slower, closer to "
+         "production extents)"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

@@ -574,7 +574,12 @@ class DistributedTSDF:
             vstack = jnp.stack([right.mask] * len(planes))
         pstack = jnp.stack(planes)
 
-        align3 = _align3_fn(self.mesh, self.series_axis, self.time_axis)
+        # pstack/vstack are freshly-stacked temporaries and the output
+        # shape matches when the packed K agrees — donate their HBM to
+        # the aligned copies (align2's operands are frame-owned: never
+        # donated)
+        align3 = _align3_fn(self.mesh, self.series_axis, self.time_axis,
+                            donate=(right.K_dev == self.K_dev))
         pstack = align3(pstack, perm, ok, np.nan)
         vstack = align3(vstack, perm, ok, False)
 
@@ -1509,7 +1514,13 @@ def _range_stats_block_packed(ts, xs, valids, w, rowbounds,
         clipped = jnp.sum(stats.pop("clipped"),
                           axis=(1, 2)).astype(jnp.int64)
         return stats, clipped
-    start, end = rk.range_window_bounds(secs, jnp.asarray(w))
+    # window operand: over integer seconds ANY width folds to an exact
+    # integer compare (rk.range_window_width) — the bare jnp.asarray(w)
+    # this replaces minted weak-f64 bound arithmetic under the f32
+    # policy (caught by the compiled no-f64-leak contract,
+    # tools/analyze.py --compiled)
+    start, end = rk.range_window_bounds(secs,
+                                        rk.range_window_width(secs, w))
     per = [rk.windowed_stats(xs[c], valids[c], start, end)
            for c in range(C)]
     stats = {k: jnp.stack([p[k] for p in per]) for k in per[0]}
@@ -1786,14 +1797,21 @@ def _align_fn(mesh, series_axis, time_axis):
 
 
 @functools.lru_cache(maxsize=256)
-def _align3_fn(mesh, series_axis, time_axis):
+def _align3_fn(mesh, series_axis, time_axis, donate=False):
+    """``donate=True`` (caller asserts the left/right packed K match,
+    so input and output shapes are equal) donates the plane stack: the
+    aligned copy reuses the pre-alignment stack's HBM instead of
+    doubling the join's biggest transient.  The donation-applied
+    compiled contract (plan/contracts.py) verifies the input-output
+    alias on the compiled executable."""
     sharding = NamedSharding(mesh, _spec(mesh, series_axis, time_axis, 3))
 
     def fn(arr, perm, ok, fill):
         g = jnp.take(arr, jnp.clip(perm, 0, arr.shape[1] - 1), axis=1)
         return jnp.where(ok[None, :, None], g, jnp.asarray(fill, arr.dtype))
 
-    return jax.jit(fn, out_shardings=sharding, static_argnums=(3,))
+    return jax.jit(fn, out_shardings=sharding, static_argnums=(3,),
+                   donate_argnums=(0,) if donate else ())
 
 
 @functools.lru_cache(maxsize=256)

@@ -122,7 +122,7 @@ def range_stats_streaming(secs, x, valid, window, max_behind, max_ahead,
                                      max_behind, max_ahead, scale=scale)
     if scale is not None:
         x = x * jnp.asarray(scale, x.dtype)
-    start, end = range_window_bounds(secs, jnp.asarray(window, secs.dtype))
+    start, end = range_window_bounds(secs, range_window_width(secs, window))
     try:
         max_w = 1 << (int(max_behind) + int(max_ahead) + 1).bit_length()
     except TypeError:
@@ -268,6 +268,29 @@ def _range_query(table: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray, reduc
         jnp.take_along_axis(flat, p1, axis=1),
         jnp.take_along_axis(flat, p2, axis=1),
     )
+
+
+def range_window_width(ts_long: jnp.ndarray, window_secs) -> jnp.ndarray:
+    """Exact window-width operand for :func:`range_window_bounds` over
+    an INTEGER seconds axis.  Membership ``ts >= t - w`` with integer
+    keys equals ``ts >= t - floor(w)`` (a fractional remainder can
+    never be met exactly by integer timestamps), so every width folds
+    to the axis dtype: no float compare — neither the weak-f64 bound
+    arithmetic a bare ``jnp.asarray(w)`` mints under the library's
+    global x64 mode (the compiled no-f64-leak contract class) nor the
+    epoch-scale rounding a float32 cast would inflict (~128 s
+    resolution at 1.7e9).  The ONE way dist.py / parallel/halo.py /
+    rolling.py build the operand; fractional widths keep exact Spark
+    ``rangeBetween`` semantics.  A traced (jit-operand) width floors
+    in its own dtype before the integer cast."""
+    import math
+
+    if isinstance(window_secs, jax.core.Tracer):
+        w = jnp.asarray(window_secs)
+        if jnp.issubdtype(w.dtype, jnp.integer):
+            return w.astype(ts_long.dtype)
+        return jnp.floor(w).astype(ts_long.dtype)
+    return jnp.asarray(ts_long.dtype.type(math.floor(float(window_secs))))
 
 
 @jax.jit

@@ -168,7 +168,11 @@ def _build_range_stats(
         L_ext = ext_ts.shape[-1]
         Ll = ts_l.shape[-1]
 
-        start, end = rk.range_window_bounds(ext_ts, jnp.asarray(window_secs))
+        # exact integer window compare for any width — no weak-f64 op
+        # under the f32 compute policy (the compiled no-f64-leak
+        # contract) and no float rounding at epoch-scale seconds
+        start, end = rk.range_window_bounds(
+            ext_ts, rk.range_window_width(ext_ts, window_secs))
         stats = rk.windowed_stats(ext_x, ext_v, start, end)
         out = {k: v[..., halo:halo + Ll] for k, v in stats.items()}
 

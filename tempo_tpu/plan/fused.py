@@ -119,7 +119,8 @@ def run(dl, dr, node: ir.Node):
     lvalids = jnp.stack([dl.cols[c].valid for c in l_names])
     rvals = jnp.stack([dr.cols[c].values for c in r_names])
     rvalids = jnp.stack([dr.cols[c].valid for c in r_names])
-    out = program(dl.ts, lvals, lvalids, dr.ts, dr.mask, rvals, rvalids,
+    planes, vstack = _right_stacks(dr.ts, dr.mask, rvals, rvalids)
+    out = program(dl.ts, lvals, lvalids, dr.ts, planes, vstack,
                   jnp.asarray(perm), jnp.asarray(ok))
     vals, found, stats, clips, ema_y = out
 
@@ -154,14 +155,48 @@ def run(dl, dr, node: ir.Node):
                     ts_col=rename(dl.ts_col), seq=None, seq_col="")
 
 
+#: ``donate_argnums`` of the fused program — the right-side payload
+#: plane stack and its validity stack, freshly built per call by
+#: :func:`_right_stacks` (never frame-owned), whose HBM buffers XLA
+#: reuses for the equal-shaped ``raw``/``found`` outputs.  A single
+#: source of truth: the jit declaration below AND the donation-applied
+#: compiled contract (tempo_tpu/plan/contracts.py) both read it.
+DONATE_ARGNUMS = (4, 5)
+
+
+def _right_stacks(r_ts, r_mask, rvals, rvalids):
+    """The right side's [n+3, K, L] payload-plane stack (values + the
+    three 21-bit ts-chunk planes) and its validity stack.  Built
+    OUTSIDE the fused program so both can be donated: each is exactly
+    the shape/dtype of a program output (``raw``/``found``), so the
+    two biggest input buffers of the chain are reused for the two
+    biggest outputs instead of doubling the working set.  Integer
+    shift/concat ops only — bitwise identical to the former in-program
+    construction."""
+    dt = rvals.dtype
+    chunk_mask = jnp.int64((1 << 21) - 1)
+    ts_chunks = jnp.stack([
+        ((r_ts >> shift) & chunk_mask).astype(dt)
+        for shift in (42, 21, 0)
+    ])
+    planes = jnp.concatenate([rvals, ts_chunks])
+    vstack = jnp.concatenate(
+        [rvalids, jnp.broadcast_to(r_mask[None], (3,) + r_mask.shape)])
+    return planes, vstack
+
+
 @functools.lru_cache(maxsize=64)
 def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
                    w: float, rowbounds, engine: str, sort_kernels: bool,
                    ema_src, alpha: float, exact: bool, n_taps: int):
     """One jitted program for the whole chain.  The global section
-    (timestamp chunk planes, key-space alignment) and the shard_map'd
-    local section (join fill, range stats, EMA scan) compile together;
-    on a series mesh the collective-free kernels partition trivially."""
+    (key-space alignment) and the shard_map'd local section (join
+    fill, range stats, EMA scan) compile together; on a series mesh
+    the collective-free kernels partition trivially.  The right-side
+    stacks arrive pre-built (:func:`_right_stacks`) and DONATED
+    (:data:`DONATE_ARGNUMS`): their buffers alias the ``raw``/``found``
+    outputs in the compiled executable — verified against the compiled
+    HLO by the donation-applied contract rule."""
     from tempo_tpu import dist
     from tempo_tpu.ops import pallas_kernels as pk
     from tempo_tpu.ops import rolling as rk
@@ -206,7 +241,15 @@ def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
         planes_sv = [plane(src) for src in stats_srcs]
         xs = jnp.stack([x for x, _ in planes_sv])
         vs = jnp.stack([v for _, v in planes_sv])
-        st, clipped = dist._range_stats_block_packed(l_ts, xs, vs, w,
+        # pin the stats INPUTS too: in the eager chain (ts, xs, vs)
+        # are program inputs of the packed stats program — their own
+        # cluster roots.  Without this barrier the input-output
+        # aliasing that donation declares (DONATE_ARGNUMS) reshapes
+        # the stats fusion clusters and the var/stddev FMA-contraction
+        # decisions drift in the last ulp, breaking the bitwise
+        # planned==eager contract.
+        s_ts, xs, vs = jax.lax.optimization_barrier((l_ts, xs, vs))
+        st, clipped = dist._range_stats_block_packed(s_ts, xs, vs, w,
                                                      rowbounds, engine)
         # pin the op boundary: in the eager chain the packed stats
         # dict is a program OUTPUT (its own fusion-cluster root); the
@@ -233,16 +276,7 @@ def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
         out_specs=(sp3, sp3, sp4, jax.sharding.PartitionSpec(None),
                    sp2))
 
-    def fn(l_ts, lvals, lvalids, r_ts, r_mask, rvals, rvalids, perm, ok):
-        dt = rvals.dtype
-        chunk_mask = jnp.int64((1 << 21) - 1)
-        ts_chunks = jnp.stack([
-            ((r_ts >> shift) & chunk_mask).astype(dt)
-            for shift in (42, 21, 0)
-        ])
-        planes = jnp.concatenate([rvals, ts_chunks])
-        vstack = jnp.concatenate(
-            [rvalids, jnp.broadcast_to(r_mask[None], (3,) + r_mask.shape)])
+    def fn(l_ts, lvals, lvalids, r_ts, planes, vstack, perm, ok):
         # key-space alignment (dist._align_fn / _align3_fn bodies)
         r_ts_al = jnp.where(
             ok[:, None],
@@ -256,7 +290,7 @@ def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
             ok[None, :, None], jnp.take(vstack, clip2, axis=1), False)
         return sharded(l_ts, lvals, lvalids, r_ts_al, vstack, pstack)
 
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=DONATE_ARGNUMS)
 
 
 def compiled_cost(dl, dr, node: ir.Node):
@@ -292,6 +326,7 @@ def compiled_cost(dl, dr, node: ir.Node):
     lvalids = jnp.stack([c.valid for c in dl.cols.values()])
     rvals = jnp.stack([c.values for c in dr.cols.values()])
     rvalids = jnp.stack([c.valid for c in dr.cols.values()])
+    planes, vstack = _right_stacks(dr.ts, dr.mask, rvals, rvalids)
     return profiling.compiled_cost(
-        program, dl.ts, lvals, lvalids, dr.ts, dr.mask, rvals, rvalids,
+        program, dl.ts, lvals, lvalids, dr.ts, planes, vstack,
         jnp.asarray(perm), jnp.asarray(ok))

@@ -213,12 +213,31 @@ def pick_join_engine(est_lanes: int, limit: int,
 
 _COLLECTIVE_OPS = ("collective-permute", "all-to-all", "all-gather",
                    "all-reduce")
+
+#: Per-collective tolerance of a modeled-vs-compiled comm-bytes audit:
+#: ``model <= measured <= tol * model``.  ONE table shared by the
+#: dryrun multichip audit (__graft_entry__.py) and the
+#: collective-inventory compiled-contract rule
+#: (tools/analysis/compiled/), so "how much XLA padding is
+#: acceptable" is decided once.  The CPU-mesh measurements are
+#: byte-exact (ratio 1.0, MULTICHIP_r05 + the round-8 contract
+#: baselines); the headroom covers XLA padding/fusion round-up on
+#: real ICI, and all-reduce gets extra slack because scalar audit
+#: reductions ride tuple-combined all-reduces whose shapes XLA may
+#: widen.
+COLLECTIVE_TOLERANCE: Dict[str, float] = {
+    "collective-permute": 1.25,
+    "all-to-all": 1.25,
+    "all-gather": 1.25,
+    "all-reduce": 2.0,
+}
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8,
                 "s32": 4, "u64": 8, "u32": 4, "s16": 2, "u16": 2,
                 "s8": 1, "u8": 1, "pred": 1}
 
 
-def comm_bytes_from_compiled(compiled) -> Dict[str, int]:
+def comm_bytes_from_compiled(compiled,
+                             text: Optional[str] = None) -> Dict[str, int]:
     """Per-kind ICI/DCN communication bytes of a compiled program, read
     from its optimized HLO: every collective instruction's result shape
     (per-shard, SPMD) summed by op kind.  The measured side of the
@@ -227,7 +246,8 @@ def comm_bytes_from_compiled(compiled) -> Dict[str, int]:
     does."""
     import re
 
-    text = compiled.as_text()
+    if text is None:
+        text = compiled.as_text()
     out: Dict[str, int] = {}
     # e.g.  %all-to-all.1 = f32[4,16]{1,0} all-to-all(...)
     #       ROOT %cp = (f32[2,4]{...}, u32[]) collective-permute(...)
@@ -264,6 +284,68 @@ def comm_bytes_from_compiled(compiled) -> Dict[str, int]:
                     n *= int(d)
             nbytes += n * _DTYPE_BYTES[dt]
         out[kind] = out.get(kind, 0) + nbytes
+    return out
+
+
+def donated_params_from_compiled(compiled,
+                                 text: Optional[str] = None) -> set:
+    """Parameter indices the compiled executable aliases to outputs —
+    the *applied* side of ``donate_argnums``, read from the
+    ``input_output_alias={ {out}: (param, {}, may-alias) }`` header of
+    the optimized HLO.  A declared donation XLA could not match (shape/
+    dtype mismatch with every output) does NOT appear here — exactly
+    the drift the donation-applied compiled contract exists to catch."""
+    import re
+
+    if text is None:
+        text = compiled.as_text()
+    start = text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    # scan to the matching close brace (entries nest one level:
+    # ``{ {out_idx}: (param, {}, may-alias), ... }``) — no length cap:
+    # a truncated window would silently drop aliases and mint false
+    # 'declared donation NOT applied' findings
+    i = text.index("{", start)
+    depth = 0
+    close = None
+    for j in range(i, len(text)):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                close = j
+                break
+    if close is None:  # malformed header: no aliases rather than
+        return set()   # scanning arbitrary HLO for ': (N,' matches
+    body = text[i:close + 1]
+    return {int(p) for p in re.findall(r":\s*\((\d+),", body)}
+
+
+#: HLO markers of a device->host (or host->device) transfer inside a
+#: compiled program: infeed/outfeed, send/recv pairs, and the python
+#: callback custom-calls (io_callback / pure_callback / debug prints).
+_HOST_TRANSFER_MARKERS = (
+    " infeed(", " outfeed(", " send(", " recv(", " send-done(",
+    " recv-done(", "xla_python_cpu_callback", "xla_ffi_python_cpu_callback",
+    "xla_python_gpu_callback", "CustomCallWithHostTransfer",
+)
+
+
+def host_transfers_from_compiled(compiled,
+                                 text: Optional[str] = None) -> list:
+    """The host-transfer instructions of a compiled program (op line
+    snippets), empty for a clean device-resident program.  The
+    no-host-transfer compiled contract asserts this is empty outside
+    declared materialization barriers."""
+    out = []
+    if text is None:
+        text = compiled.as_text()
+    for line in text.splitlines():
+        stripped = line.strip()
+        if any(m in stripped for m in _HOST_TRANSFER_MARKERS):
+            out.append(stripped[:160])
     return out
 
 

@@ -140,8 +140,9 @@ def with_range_stats(tsdf, type: str = "range", colsToSummarize=None,
             max_behind=int(rb[0]), max_ahead=int(rb[1]),
         ))
     else:
+        ts_arr = jnp.asarray(ts_long)
         start, end = rk.range_window_bounds(
-            jnp.asarray(ts_long), jnp.asarray(ts_long.dtype.type(w))
+            ts_arr, rk.range_window_width(ts_arr, w)
         )
         # static row bound for the min/max sparse tables: a 10s window
         # over 1Hz data needs 4 levels, not log2(L); bucket to a power

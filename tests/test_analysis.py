@@ -479,6 +479,119 @@ def test_grid_carry_ignores_parallel_only_grids(tmp_path):
     assert check(GridCarryRule(), tmp_path, src) == []
 
 
+def test_grid_carry_split_module_keeps_names_stable():
+    """Round-8 split: the rule moved to rules/grid_carry.py but the
+    CLI name, exit bit and suppression token are unchanged, and the
+    old import path still resolves (compat re-export)."""
+    from tools.analysis.rules import gather as gather_mod
+    from tools.analysis.rules import grid_carry as carry_mod
+
+    assert carry_mod.GridCarryRule is gather_mod.GridCarryRule
+    rule = carry_mod.GridCarryRule()
+    assert rule.name == "grid-carry"
+    assert rule.code == 8
+
+
+def _grid_semantics_site(kernel_src: str, carry_axes: str = "(1,)",
+                         call: str = "pl.pallas_call(kernel,",
+                         preamble: str = "",
+                         semantics: str = "") -> str:
+    """A pallas_call site whose dimension_semantics comes from the PR-6
+    pallas_stream.grid_semantics factory instead of a literal tuple
+    (``semantics`` overrides the inline call with a name/expression)."""
+    sem = semantics or f"grid_semantics(2, carry_axes={carry_axes})"
+    return PRELUDE + (
+        "from tempo_tpu.ops.pallas_stream import grid_semantics\n"
+        + kernel_src
+        + "def call(x):\n" + preamble +
+        "    spec = pl.BlockSpec((8, 128), lambda i, c: (i, c),\n"
+        "                        memory_space=pltpu.VMEM)\n"
+        "    return " + call + " grid=(1, 4), in_specs=[spec],\n"
+        "        out_specs=spec,\n"
+        "        out_shape=jax.ShapeDtypeStruct((8, 512), jnp.float32),\n"
+        "        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],\n"
+        "        compiler_params=pltpu.CompilerParams(\n"
+        "            dimension_semantics=" + sem + "))(x)\n"
+    )
+
+
+def test_grid_carry_resolves_grid_semantics_carry_axes(tmp_path):
+    """A grid_semantics(n, carry_axes=(..,)) call declares a sequential
+    carry axis — the write-before-read check must fire through it (the
+    _chunked_call idiom the one-level folding used to skip)."""
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "def kernel(x_ref, o_ref, carry_ref):\n"
+        "    carry_ref[...] = x_ref[:]\n"
+        "    o_ref[:] = carry_ref[...]\n"
+    ))
+    assert len(found) == 1
+    assert "written before it is read" in found[0].message
+
+
+def test_grid_carry_grid_semantics_pass_and_no_carry_axes(tmp_path):
+    """Read-first through grid_semantics passes; empty carry_axes
+    declares no sequential carry, so write-first scratch is legal."""
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "def kernel(x_ref, o_ref, carry_ref):\n"
+        "    prev = carry_ref[...]\n"
+        "    o_ref[:] = x_ref[:] + prev\n"
+        "    carry_ref[...] = x_ref[:]\n"
+    ))
+    assert found == []
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "def kernel(x_ref, o_ref, tmp_ref):\n"
+        "    tmp_ref[...] = x_ref[:]\n"
+        "    o_ref[:] = tmp_ref[...]\n",
+        carry_axes="()",
+    ))
+    assert found == []
+
+
+def test_grid_carry_resolves_name_bound_grid_semantics(tmp_path):
+    """``sems = grid_semantics(...)`` then ``dimension_semantics=sems``
+    resolves the same as the inline call — the carry check must not be
+    skippable by hoisting the factory call to a local."""
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "def kernel(x_ref, o_ref, carry_ref):\n"
+        "    carry_ref[...] = x_ref[:]\n"
+        "    o_ref[:] = carry_ref[...]\n",
+        preamble="    sems = grid_semantics(2, carry_axes=(1,))\n",
+        semantics="sems",
+    ))
+    assert len(found) == 1
+    assert "written before it is read" in found[0].message
+
+
+def test_grid_carry_resolves_aliased_grid_semantics_import(tmp_path):
+    """``from ... import grid_semantics as gs`` must not bypass the
+    carry check — the same aliased-import gap dynamic-gather closes."""
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "from tempo_tpu.ops.pallas_stream import grid_semantics as gs\n"
+        "def kernel(x_ref, o_ref, carry_ref):\n"
+        "    carry_ref[...] = x_ref[:]\n"
+        "    o_ref[:] = carry_ref[...]\n",
+        semantics="gs(2, carry_axes=(1,))",
+    ))
+    assert len(found) == 1
+    assert "written before it is read" in found[0].message
+
+
+def test_grid_carry_resolves_name_bound_factory_kernel(tmp_path):
+    """The ring_call idiom: ``kernel = _make_kernel(...)`` then
+    ``pl.pallas_call(kernel, ...)`` resolves through the bound factory
+    call to the inner def."""
+    found = check(GridCarryRule(), tmp_path, _grid_semantics_site(
+        "def _make_kernel(n):\n"
+        "    def inner(x_ref, o_ref, carry_ref):\n"
+        "        carry_ref[...] = x_ref[:]\n"
+        "        o_ref[:] = carry_ref[...]\n"
+        "    return inner\n",
+        preamble="    kernel = _make_kernel(3)\n",
+    ))
+    assert len(found) == 1
+    assert "written before it is read" in found[0].message
+
+
 # ----------------------------------------------------------------------
 # env-knobs
 # ----------------------------------------------------------------------
@@ -724,6 +837,142 @@ def test_plan_registry_live_registry_matches_code():
     files = core.load_sources([REPO / "tempo_tpu"])
     found = PlanRegistryRule().check_project(REPO, files)
     assert found == [], "\n".join(v.render() for v in found)
+
+
+# ----------------------------------------------------------------------
+# dead-suppression audit
+# ----------------------------------------------------------------------
+
+def _run_all(path):
+    return core.run(list(ALL_RULES), [core.ModuleSource(path)])
+
+
+def test_dead_suppression_fires_on_stale_marker(tmp_path):
+    """A lint-ok whose rule finds nothing on that line is reported with
+    its own exit bit."""
+    path = tmp_path / "pallas_stale.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "x = 1  # lint-ok: weak-dtype: once excused a float here\n"
+    )
+    violations, code = _run_all(path)
+    assert code == core.DEAD_SUPPRESSION_CODE
+    assert violations[0].rule == "dead-suppression"
+    assert "no longer fires" in violations[0].message
+
+
+def test_dead_suppression_flags_unknown_rule_name(tmp_path):
+    """A typo'd rule name suppresses nothing — reported, not rotted."""
+    path = tmp_path / "pallas_typo.py"
+    path.write_text("y = 2  # lint-ok: wek-dtype: typo'd\n")
+    violations, code = _run_all(path)
+    assert code == core.DEAD_SUPPRESSION_CODE
+    assert "unknown rule" in violations[0].message
+
+
+def test_dead_suppression_passes_live_marker(tmp_path):
+    """A marker that actually silences a finding is NOT dead — and the
+    silenced rule's bit stays clear."""
+    path = tmp_path / "pallas_live.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "def host(x, q):\n"
+        "    return jnp.take(x, q)  # lint-ok: dynamic-gather: host\n"
+    )
+    violations, code = _run_all(path)
+    assert violations == []
+    assert code == 0
+
+
+def test_dead_suppression_ignores_docstring_mentions(tmp_path):
+    """Doc prose describing the marker syntax is not a suppression;
+    only real COMMENT tokens are audited."""
+    path = tmp_path / "pallas_doc.py"
+    path.write_text(
+        '"""Suppress with ``# lint-ok: vmem-budget: <reason>``."""\n'
+        "MSG = 'annotate # lint-ok: weak-dtype: like this'\n"
+    )
+    violations, code = _run_all(path)
+    assert violations == [] and code == 0
+
+
+def test_dead_suppression_ignores_prose_and_reasonless_markers(tmp_path):
+    """The audit's pattern mirrors the suppressor's exactly: a comment
+    that merely TALKS about adding a marker (no '#' anchor before
+    ``lint-ok:``) and a reasonless marker (which suppresses nothing —
+    its rule still fires) are not dead suppressions."""
+    prose = tmp_path / "pallas_prose.py"
+    prose.write_text(
+        "x = 1  # TODO: consider adding a lint-ok: vmem-budget: "
+        "marker at the call site\n")
+    violations, code = _run_all(prose)
+    assert violations == [] and code == 0
+
+    reasonless = tmp_path / "pallas_reasonless.py"
+    reasonless.write_text(
+        "import jax.numpy as jnp\n"
+        "def host(x, q):\n"
+        "    return jnp.take(x, q)  # lint-ok: dynamic-gather:\n"
+    )
+    violations, code = _run_all(reasonless)
+    # the bare marker does not suppress, so dynamic-gather itself
+    # fires — but the audit must NOT pile a contradictory
+    # 'no longer fires on this line' finding on top
+    assert [v.rule for v in violations] == ["dynamic-gather"]
+    assert code == DynamicGatherRule().code
+
+
+def test_dead_suppression_skips_compiled_tier_markers(tmp_path):
+    """BUILDING.md's documented compiled-tier suppression (a
+    ``# lint-ok: no-f64-leak: ...`` at a contracts.py @register site)
+    must not be flagged unknown/dead by the AST tier — the marker
+    belongs to the other tier, whose liveness is judged against built
+    artifacts."""
+    path = tmp_path / "pallas_xtier.py"
+    path.write_text(
+        "# lint-ok: no-f64-leak: golden-parity engine, f64 by design\n"
+        "def _build():\n"
+        "    ...\n")
+    violations, code = _run_all(path)
+    assert violations == [] and code == 0
+
+
+def test_dead_suppression_is_itself_suppressible(tmp_path):
+    path = tmp_path / "pallas_meta.py"
+    path.write_text(
+        "x = 1  # lint-ok: weak-dtype: kept for a pending revert"
+        "  # lint-ok: dead-suppression: revert lands next round\n"
+    )
+    violations, code = _run_all(path)
+    assert violations == [] and code == 0
+
+
+def test_dead_suppression_skipped_on_filtered_runs(tmp_path):
+    """Under --rule filtering an unused marker may belong to an
+    unselected rule — the audit must not run (core.run(audit=False))."""
+    path = tmp_path / "pallas_filtered.py"
+    path.write_text("x = 1  # lint-ok: weak-dtype: excused elsewhere\n")
+    violations, code = core.run([VmemBudgetRule()],
+                                [core.ModuleSource(path)], audit=False)
+    assert violations == [] and code == 0
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"),
+         "--rule", "vmem-budget", "--root", str(tmp_path), str(path)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_analyze_cli_folds_high_bits_nonzero(tmp_path):
+    """A run where ONLY the dead-suppression family fires must still
+    exit nonzero despite the 8-bit status byte (256 & 0xFF == 0)."""
+    path = tmp_path / "pallas_fold.py"
+    path.write_text("x = 1  # lint-ok: weak-dtype: stale\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"),
+         "--root", str(tmp_path), str(path)],
+        capture_output=True, text=True)
+    assert proc.returncode == 255, proc.stdout + proc.stderr
+    assert "dead-suppression" in proc.stdout
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,519 @@
+"""Fixture tests for the compiled-contract analyzer tier
+(tools/analysis/compiled/, ``python tools/analyze.py --compiled``):
+every rule fires on a deliberately broken compiled artifact (an f64
+literal in a jitted body, a dropped donation, a stage-boundary
+sharding mismatch, an unmodeled collective, a host callback), passes a
+known-good twin, and is silenced by a ``# lint-ok: <rule>: <reason>``
+marker at the builder's ``@register`` site — mirroring the AST tier's
+fixture pattern one level up the stack (test_analysis.py).  The live
+gate at the bottom keeps the production-program registry
+(tempo_tpu/plan/contracts.py) analyzer-clean at HEAD."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct invocation outside pytest rootdir
+    sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import tempo_tpu  # noqa: E402,F401  (x64 + platform config)
+from tempo_tpu import profiling  # noqa: E402
+from tempo_tpu.plan import contracts  # noqa: E402
+from tempo_tpu.plan.contracts import (  # noqa: E402
+    Chain,
+    CompiledProgram,
+    Contract,
+    Link,
+)
+from tools.analysis.compiled import COMPILED_RULES  # noqa: E402
+from tools.analysis.compiled.core import (  # noqa: E402
+    BUILD_ERROR_CODE,
+    run_compiled,
+)
+from tools.analysis.compiled.rules import (  # noqa: E402
+    CollectiveInventoryRule,
+    DonationAppliedRule,
+    NoF64LeakRule,
+    NoHostTransferRule,
+    RecompileCoverageRule,
+    StageShardingMatchRule,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
+
+
+def _compile(fn, *args, **jit_kw):
+    return jax.jit(fn, **jit_kw).lower(*args).compile()
+
+
+def _program(fn, *args, name="fixture", contract=None, **jit_kw):
+    return CompiledProgram(name, _compile(fn, *args, **jit_kw),
+                           contract or Contract())
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()[:8]), ("d",))
+
+
+def _codes(findings, exit_code, rule):
+    """Assert exactly this one rule family fired, with its bit."""
+    assert exit_code == rule.code, (exit_code, [f.render() for f in findings])
+    assert findings and all(f.rule == rule.name for f in findings)
+
+
+# ----------------------------------------------------------------------
+# no-f64-leak (exit 1)
+# ----------------------------------------------------------------------
+
+def test_f64_leak_fires_on_f64_literal_array():
+    """The broken fixture of the acceptance list: a non-scalar f64
+    literal in a jitted body (the weak-float class that broke 22
+    interpret tests) must fail with exit bit 1."""
+    p = _program(lambda x: x + jnp.asarray([1.0, 2.0], jnp.float64).sum(),
+                 np.ones(2, np.float32))
+    findings, code = run_compiled([NoF64LeakRule()], [p], [], {})
+    _codes(findings, code, NoF64LeakRule())
+    assert "f64" in findings[0].message
+
+
+def test_f64_leak_passes_f32_program():
+    """An f32-only artifact passes — weak python scalars stay in the
+    operand dtype (the rule also tolerates folded scalar ``f64[]``
+    constants by regex design; only f64 ARRAYS mean real f64 compute)."""
+    p = _program(lambda x: x * 2.0 + 1.0, np.ones(4, np.float32))
+    findings, code = run_compiled([NoF64LeakRule()], [p], [], {})
+    assert findings == [] and code == 0
+
+
+def test_f64_leak_allow_f64_contract():
+    """Golden/f64-policy programs declare allow_f64 and are exempt."""
+    p = _program(lambda x: x + jnp.asarray([1.0], jnp.float64).sum(),
+                 np.ones(2, np.float32),
+                 contract=Contract(allow_f64=True))
+    findings, code = run_compiled([NoF64LeakRule()], [p], [], {})
+    assert findings == [] and code == 0
+
+
+# ----------------------------------------------------------------------
+# no-host-transfer (exit 2)
+# ----------------------------------------------------------------------
+
+def _callback_fn(x):
+    y = jax.pure_callback(lambda a: np.asarray(a),
+                          jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y + 1
+
+
+def test_host_transfer_fires_on_python_callback():
+    p = _program(_callback_fn, np.ones(4, np.float32))
+    findings, code = run_compiled([NoHostTransferRule()], [p], [], {})
+    _codes(findings, code, NoHostTransferRule())
+    assert "host-transfer" in findings[0].message
+
+
+def test_host_transfer_pass_and_declared_barrier():
+    clean = _program(lambda x: x + 1, np.ones(4, np.float32))
+    findings, code = run_compiled([NoHostTransferRule()], [clean], [], {})
+    assert findings == [] and code == 0
+    declared = _program(
+        _callback_fn, np.ones(4, np.float32),
+        contract=Contract(host_transfer_ok="fourier host fallback "
+                                           "(materialization barrier)"))
+    findings, code = run_compiled([NoHostTransferRule()], [declared],
+                                  [], {})
+    assert findings == [] and code == 0
+
+
+# ----------------------------------------------------------------------
+# collective-inventory (exit 4)
+# ----------------------------------------------------------------------
+
+def _gather_program(contract):
+    from tempo_tpu.parallel.halo import shard_map
+
+    mesh = _mesh()
+    fn = shard_map(lambda x: jax.lax.all_gather(x, "d", tiled=True),
+                   mesh=mesh, in_specs=(P("d"),), out_specs=P(None))
+    x = jax.device_put(np.ones((8, 16), np.float32),
+                       NamedSharding(mesh, P("d")))
+    c = _compile(fn, x)
+    return CompiledProgram("fixture.gather", c, contract), c
+
+
+def test_collective_unmodeled_kind_fires():
+    """The acceptance list's unmodeled collective: an all-gather the
+    contract neither models nor declares incidental, exit bit 4."""
+    p, _ = _gather_program(Contract())
+    findings, code = run_compiled([CollectiveInventoryRule()], [p], [], {})
+    _codes(findings, code, CollectiveInventoryRule())
+    assert "UNMODELED" in findings[0].message
+
+
+def test_collective_model_match_passes_and_bounds_fire():
+    p, c = _gather_program(Contract())
+    measured = profiling.comm_bytes_from_compiled(c)["all-gather"]
+    assert measured > 0
+
+    exact = CompiledProgram(
+        "fixture.gather", c, Contract(collectives={"all-gather": measured}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [exact],
+                                  [], {})
+    assert findings == [] and code == 0
+
+    # modeled at half the real bytes: measured = 2x model > 1.25x tol
+    low = CompiledProgram(
+        "fixture.gather", c,
+        Contract(collectives={"all-gather": measured // 2}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [low], [], {})
+    _codes(findings, code, CollectiveInventoryRule())
+    assert "outside" in findings[0].message
+
+    # a per-kind tolerance override in the contract widens the bound
+    wide = CompiledProgram(
+        "fixture.gather", c,
+        Contract(collectives={"all-gather": measured // 2},
+                 tolerances={"all-gather": 4.0}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [wide],
+                                  [], {})
+    assert findings == [] and code == 0
+
+
+def test_collective_declared_kind_absent_fires():
+    """A modeled kind missing from the HLO means the comm the model
+    budgets for no longer happens — also a finding."""
+    _, c = _gather_program(Contract())
+    measured = profiling.comm_bytes_from_compiled(c)["all-gather"]
+    p = CompiledProgram(
+        "fixture.gather", c,
+        Contract(collectives={"all-to-all": 1024,
+                              "all-gather": measured}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [p], [], {})
+    _codes(findings, code, CollectiveInventoryRule())
+    assert "ABSENT" in findings[0].message
+
+
+def test_collective_incidental_ceiling():
+    p, c = _gather_program(Contract())
+    measured = profiling.comm_bytes_from_compiled(c)["all-gather"]
+    under = CompiledProgram(
+        "fixture.gather", c,
+        Contract(incidental={"all-gather": measured}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [under],
+                                  [], {})
+    assert findings == [] and code == 0
+    over = CompiledProgram(
+        "fixture.gather", c,
+        Contract(incidental={"all-gather": measured - 1}))
+    findings, code = run_compiled([CollectiveInventoryRule()], [over],
+                                  [], {})
+    _codes(findings, code, CollectiveInventoryRule())
+    assert "ceiling" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# donation-applied (exit 8)
+# ----------------------------------------------------------------------
+
+def test_donation_dropped_fires():
+    """The acceptance list's dropped donation: the contract declares
+    donate_argnums the executable does not alias, exit bit 8."""
+    p = _program(lambda x: x + 1, np.ones((8, 8), np.float32),
+                 name="fixture.donate",
+                 contract=Contract(donate_argnums=(0,)))
+    findings, code = run_compiled([DonationAppliedRule()], [p], [], {})
+    _codes(findings, code, DonationAppliedRule())
+    assert "NOT" in findings[0].message
+
+
+def test_donation_applied_passes():
+    p = _program(lambda x: x + 1, np.ones((8, 8), np.float32),
+                 name="fixture.donate",
+                 contract=Contract(donate_argnums=(0,)),
+                 donate_argnums=(0,))
+    findings, code = run_compiled([DonationAppliedRule()], [p], [], {})
+    assert findings == [] and code == 0
+
+
+def test_donation_undeclared_alias_fires():
+    """The drift's other direction: the jit donates but the contract
+    does not know — both must read one source of truth."""
+    p = _program(lambda x: x + 1, np.ones((8, 8), np.float32),
+                 name="fixture.donate", donate_argnums=(0,))
+    findings, code = run_compiled([DonationAppliedRule()], [p], [], {})
+    _codes(findings, code, DonationAppliedRule())
+    assert "not declare" in findings[0].message
+
+
+# ----------------------------------------------------------------------
+# stage-sharding-match (exit 16)
+# ----------------------------------------------------------------------
+
+def _stage(fn, x, out_spec, mesh, name):
+    sharding = NamedSharding(mesh, out_spec)
+    c = _compile(fn, x, out_shardings=sharding)
+    return CompiledProgram(name, c, Contract())
+
+
+def _sharded_input(mesh, spec, shape=(8, 16)):
+    return jax.device_put(np.ones(shape, np.float32),
+                          NamedSharding(mesh, spec))
+
+
+def test_stage_sharding_match_passes():
+    mesh = _mesh()
+    x = _sharded_input(mesh, P("d"))
+    prod = _stage(lambda a: a * 2, x, P("d"), mesh, "stage.a")
+    cons = _stage(lambda a: a + 1, x, P("d"), mesh, "stage.b")
+    chain = Chain("fixture.chain", (Link("stage.a", 0, "stage.b", 0),))
+    findings, code = run_compiled([StageShardingMatchRule()],
+                                  [prod, cons], [chain], {})
+    assert findings == [] and code == 0
+
+
+def test_stage_sharding_mismatch_fires():
+    """The acceptance list's stage-boundary sharding mismatch: the
+    producer writes P('d') rows, the consumer expects replicated —
+    chaining would insert an implicit reshard; exit bit 16."""
+    mesh = _mesh()
+    x_sh = _sharded_input(mesh, P("d"))
+    x_rep = _sharded_input(mesh, P(None))
+    prod = _stage(lambda a: a * 2, x_sh, P("d"), mesh, "stage.a")
+    cons = _stage(lambda a: a + 1, x_rep, P(None), mesh, "stage.b")
+    chain = Chain("fixture.chain", (Link("stage.a", 0, "stage.b", 0),))
+    findings, code = run_compiled([StageShardingMatchRule()],
+                                  [prod, cons], [chain], {})
+    _codes(findings, code, StageShardingMatchRule())
+    assert "mismatch" in findings[0].message
+
+
+def test_stage_sharding_sharded_dropped_axis_fires():
+    """drop_leading axes must be unsharded: host-slicing a sharded
+    leading axis changes device ownership in flight."""
+    mesh = _mesh()
+    x = _sharded_input(mesh, P("d", None))
+    prod = _stage(lambda a: a * 2, x, P("d", None), mesh, "stage.a")
+    y = _sharded_input(mesh, P(None), shape=(16,))
+    cons = _stage(lambda a: a + 1, y, P(None), mesh, "stage.b")
+    chain = Chain("fixture.chain",
+                  (Link("stage.a", 0, "stage.b", 0, drop_leading=1),))
+    findings, code = run_compiled([StageShardingMatchRule()],
+                                  [prod, cons], [chain], {})
+    _codes(findings, code, StageShardingMatchRule())
+    assert "SHARDED" in findings[0].message
+
+
+def test_stage_sharding_finding_suppressible_at_chain_site(tmp_path):
+    """Chains carry the declaring builder's source site, so a known
+    stage-boundary mismatch can be waived with the standard marker
+    while a reshard change lands."""
+    mesh = _mesh()
+    x_sh = _sharded_input(mesh, P("d"))
+    x_rep = _sharded_input(mesh, P(None))
+    prod = _stage(lambda a: a * 2, x_sh, P("d"), mesh, "stage.a")
+    cons = _stage(lambda a: a + 1, x_rep, P(None), mesh, "stage.b")
+    chain = Chain("fixture.chain", (Link("stage.a", 0, "stage.b", 0),))
+    src = tmp_path / "builders.py"
+    src.write_text(
+        "# lint-ok: stage-sharding-match: reshard lands next round\n"
+        "@register('fixture.chain')\n"
+        "def _build():\n"
+        "    ...\n")
+    chain.source_file, chain.source_line = str(src), 3
+    findings, code = run_compiled([StageShardingMatchRule()],
+                                  [prod, cons], [chain], {})
+    assert findings == [] and code == 0
+
+
+def test_stage_sharding_bad_link_indices_fire():
+    mesh = _mesh()
+    x = _sharded_input(mesh, P("d"))
+    prod = _stage(lambda a: a * 2, x, P("d"), mesh, "stage.a")
+    cons = _stage(lambda a: a + 1, x, P("d"), mesh, "stage.b")
+    chain = Chain("fixture.chain", (
+        Link("stage.a", 3, "stage.b", 0),
+        Link("stage.a", 0, "stage.gone", 0),
+    ))
+    findings, code = run_compiled([StageShardingMatchRule()],
+                                  [prod, cons], [chain], {})
+    assert code == StageShardingMatchRule().code
+    msgs = " | ".join(f.message for f in findings)
+    assert "out of range" in msgs and "did not build" in msgs
+
+
+# ----------------------------------------------------------------------
+# recompile-coverage (exit 32)
+# ----------------------------------------------------------------------
+
+class _FakeFrame:
+    def _plan_record(self, op, others=(), params=None, objs=None):
+        return self
+
+    def covered(self, colName, window):
+        return self._plan_record("covered", (),
+                                 dict(colName=colName, window=window))
+
+    def leaky(self, colName, window):
+        # 'window' feeds the computation but NOT the plan node: two
+        # calls differing only in window share a plan signature
+        return self._plan_record("leaky", (), dict(colName=colName))
+
+    def waived(self, colName, window):  # lint-ok: recompile-coverage: fixture
+        return self._plan_record("waived", (), dict(colName=colName))
+
+
+def test_recompile_coverage_fires_on_unrecorded_param():
+    rule = RecompileCoverageRule()
+    found = rule._check_method("TSDF", _FakeFrame, "leaky")
+    assert found is not None and "window" in found.message
+    assert rule.code == 32
+
+
+def test_recompile_coverage_passes_recorded_params():
+    rule = RecompileCoverageRule()
+    assert rule._check_method("TSDF", _FakeFrame, "covered") is None
+
+
+def test_recompile_coverage_suppressible_at_method_def():
+    """Registry-level findings anchor to the planned METHOD's def
+    line, so the standard same-site marker suppresses them."""
+    rule = RecompileCoverageRule()
+    assert rule._check_method("TSDF", _FakeFrame, "waived") is None
+
+
+def test_recompile_coverage_live_registry_clean():
+    """Every PLANNED_METHODS op method at HEAD records all its
+    parameters — cache hits can never replay a stale executable."""
+    rule = RecompileCoverageRule()
+    found = rule.check_registry(REPO)
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+# ----------------------------------------------------------------------
+# engine: suppression, build-error, exit-bit OR
+# ----------------------------------------------------------------------
+
+def test_lint_ok_at_register_site_suppresses(tmp_path):
+    """A ``# lint-ok: <rule>: <reason>`` comment at the builder's
+    @register site silences that rule for that program — the AST
+    tier's convention, anchored where the program is declared."""
+    p = _program(lambda x: x + jnp.asarray([1.0], jnp.float64).sum(),
+                 np.ones(2, np.float32), name="fixture.suppressed")
+    src = tmp_path / "builders.py"
+    src.write_text(
+        "# lint-ok: no-f64-leak: golden-parity artifact, f64 by design\n"
+        "@register('fixture.suppressed')\n"
+        "def _build():\n"
+        "    ...\n")
+    p.source_file, p.source_line = str(src), 3
+    findings, code = run_compiled([NoF64LeakRule()], [p], [], {})
+    assert findings == [] and code == 0
+
+
+def test_build_error_exit_bit():
+    """A registry entry that fails to build reports as build-error
+    (exit 64) instead of crashing the run."""
+    findings, code = run_compiled(
+        list(COMPILED_RULES), [], [],
+        {"fixture.broken": "ValueError: boom"})
+    assert code == BUILD_ERROR_CODE
+    assert findings[0].rule == "build-error"
+    assert "boom" in findings[0].message
+
+
+def test_build_all_collects_builder_exceptions(monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_COMPUTE_DTYPE", "float32")
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+
+    @contracts.register("fixture.raises")
+    def _build():
+        raise ValueError("shape mismatch")
+
+    try:
+        programs, chains, skipped, errors = contracts.build_all(
+            only=["fixture.raises"])
+        assert programs == [] and chains == []
+        assert "ValueError: shape mismatch" in errors["fixture.raises"]
+    finally:
+        contracts._BUILDERS.pop("fixture.raises")
+        contracts._BUILDER_META.pop("fixture.raises")
+
+
+def test_exit_bits_or_across_rules():
+    """Distinct power-of-two bits OR, mirroring the AST tier."""
+    p = _program(lambda x: x + jnp.asarray([1.0], jnp.float64).sum(),
+                 np.ones(2, np.float32), name="fixture.both",
+                 contract=Contract(donate_argnums=(0,)))
+    findings, code = run_compiled(
+        [NoF64LeakRule(), DonationAppliedRule()], [p], [], {})
+    assert code == NoF64LeakRule().code | DonationAppliedRule().code
+    assert {f.rule for f in findings} == {"no-f64-leak",
+                                          "donation-applied"}
+
+
+def test_rule_bits_are_distinct_powers_of_two():
+    codes = [r.code for r in COMPILED_RULES] + [BUILD_ERROR_CODE]
+    assert len(set(codes)) == len(codes)
+    for c in codes:
+        assert c > 0 and (c & (c - 1)) == 0
+
+
+# ----------------------------------------------------------------------
+# live gate: the production registry is analyzer-clean at HEAD
+# ----------------------------------------------------------------------
+
+def test_compiled_tier_clean_at_head():
+    """``python tools/analyze.py --compiled`` over the full
+    production-program registry exits 0 — the compiled twin of the
+    AST tier's analyzer-clean-at-HEAD gate.  Subprocess: the tier
+    pins TEMPO_TPU_COMPUTE_DTYPE/SORT_KERNELS before jax wakes up,
+    which an in-process check cannot re-arrange."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"), "--compiled"],
+        capture_output=True, text=True, timeout=580)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "compiled contracts clean" in proc.stderr
+
+
+def test_env_precondition_failure_is_usage_error():
+    """A misconfigured environment (the f64 golden-parity knob left
+    exported) exits 2 with a message — not a traceback whose exit 1
+    reads as the no-f64-leak bit to CI."""
+    import os
+
+    env = dict(os.environ, TEMPO_TPU_COMPUTE_DTYPE="float64")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"), "--compiled"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "compiled tier cannot run" in proc.stderr
+
+
+def test_unknown_compiled_rule_is_usage_error_not_build_error():
+    """A typo'd --rule under --compiled exits 2 (argparse's usage
+    status), NOT the build-error bit 64 — the documented bit table
+    must stay honest for CI scripts keying off it."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"),
+         "--compiled", "--rule", "no-such-rule"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "unknown compiled rule" in proc.stderr
+
+
+def test_contract_docs_rule_table_agrees():
+    """BUILDING.md's compiled-rule table names every rule with its
+    exit bit (the three-way style of the env-knobs rule)."""
+    text = (REPO / "BUILDING.md").read_text()
+    for rule in COMPILED_RULES:
+        assert rule.name in text, f"BUILDING.md missing {rule.name}"
+    assert "build-error" in text

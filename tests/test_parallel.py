@@ -96,6 +96,48 @@ class TestSeriesShardedOps:
             )
 
 
+class TestRangeWindowWidth:
+    """range_window_width: the ONE window-operand builder — exact at
+    epoch scale for any width, fractional included (review round 8:
+    an f32 fractional cast rounded epoch seconds onto a ~128 s grid
+    and silently widened windows)."""
+
+    def test_fractional_window_exact_at_epoch_scale(self):
+        ts = jnp.asarray(
+            np.int64(1_700_000_000) + np.array([[0, 1, 1, 3]]))
+        w = rk.range_window_width(ts, 2.5)
+        assert w.dtype == ts.dtype  # integer compare, no float op
+        start, _ = rk.range_window_bounds(ts, w)
+        # f64 oracle: ts >= t - 2.5 — row 3 (t0+3) excludes t0 (3.0s back)
+        oracle = np.searchsorted(
+            np.asarray(ts)[0], np.asarray(ts, np.float64)[0] - 2.5,
+            side="left")
+        np.testing.assert_array_equal(np.asarray(start)[0], oracle)
+        np.testing.assert_array_equal(np.asarray(start)[0], [0, 0, 0, 1])
+
+    def test_windowed_dist_path_fractional_f32_policy(self, monkeypatch):
+        """The dist windowed fallback (rowbounds unknowable) under the
+        TPU f32 compute policy: fractional-window membership must match
+        the f64 oracle over epoch-scale timestamps."""
+        monkeypatch.setenv("TEMPO_TPU_COMPUTE_DTYPE", "float32")
+        from tempo_tpu import dist as dist_mod
+
+        base = np.int64(1_700_000_000)
+        secs = base + np.array([[0, 1, 1, 3, 6]])
+        ts = jnp.asarray(secs * np.int64(1_000_000_000))
+        xs = jnp.asarray(
+            np.arange(5, dtype=np.float32).reshape(1, 1, 5))
+        valids = jnp.ones((1, 1, 5), bool)
+        stats, clipped = dist_mod._range_stats_block_packed(
+            ts, xs, valids, 2.5, None, "windowed")
+        # counts from the f64 oracle: |{j : t_i - 2.5 <= t_j <= t_i}|
+        diffs = secs[0][:, None] - secs[0][None, :]
+        want = ((diffs <= 2.5) & (diffs >= 0)).sum(axis=1)
+        np.testing.assert_array_equal(
+            np.asarray(stats["count"])[0, 0], want)
+        assert int(np.asarray(clipped)[0]) == 0
+
+
 class TestTimeSharded:
     """Sequence-parallel path: halo exchange over the time axis."""
 

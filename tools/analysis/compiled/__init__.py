@@ -1,0 +1,97 @@
+"""Compiled-contract analyzer tier (``python tools/analyze.py
+--compiled``).
+
+Second static-analysis tier of the project: where ``tools/analysis``
+checks *source*, this tier checks the **compiled artifacts** of the
+production-program registry (``tempo_tpu/plan/contracts.py``) against
+the contracts declared next to the programs — sharding, donation,
+collectives, dtype and host-transfer guarantees that only exist in
+what XLA actually compiled.  See ``core.py`` (engine), ``rules.py``
+(the battery), and BUILDING.md "Compiled contracts".
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from tools.analysis.compiled.core import (  # noqa: F401
+    BUILD_ERROR_CODE,
+    CompiledRule,
+    Finding,
+    run_compiled,
+)
+from tools.analysis.compiled.rules import COMPILED_RULES  # noqa: F401
+
+_REPO = Path(__file__).resolve().parent.parent.parent.parent
+
+
+def _prepare_environment() -> None:
+    """Arrange the dryrun-style build environment BEFORE jax
+    initialises: the f32 TPU compute policy + sort-kernel forms (the
+    artifacts under contract are the production TPU shapes, not the
+    f64 golden-parity shapes) and the virtual multi-device mesh when
+    no accelerator is attached.  No-ops when the caller (conftest.py,
+    a TPU image) already arranged them."""
+    os.environ.setdefault("TEMPO_TPU_COMPUTE_DTYPE", "float32")
+    os.environ.setdefault("TEMPO_TPU_SORT_KERNELS", "1")
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+
+def main(programs: Optional[Sequence[str]] = None,
+         rules: Optional[Sequence[str]] = None) -> int:
+    """Build the registry (or the named subset), run the battery,
+    print findings, return the compiled tier's exit-bit OR."""
+    _prepare_environment()
+
+    from tempo_tpu.plan import contracts
+
+    battery = list(COMPILED_RULES)
+    if rules:
+        known = {r.name: r for r in COMPILED_RULES}
+        unknown = [n for n in rules if n not in known]
+        if unknown:
+            # a CLI usage error, NOT a build-error finding: exit 2,
+            # the same status argparse uses for the AST tier's
+            # malformed invocations (the bit table stays honest)
+            print(f"unknown compiled rule(s): {', '.join(unknown)} "
+                  f"(see analyze.py --list-rules)", file=sys.stderr)
+            return 2
+        battery = [known[n] for n in rules]
+
+    try:
+        built, chains, skipped, errors = contracts.build_all(
+            only=programs)
+    except (RuntimeError, KeyError) as e:
+        # environment-precondition / unknown-program failures are
+        # USAGE errors (exit 2, argparse's status), not findings —
+        # exiting 1 would read as the no-f64-leak bit to CI
+        print(f"compiled tier cannot run: {e}", file=sys.stderr)
+        return 2
+    for name, why in sorted(skipped.items()):
+        print(f"compiled:{name}: skipped ({why})", file=sys.stderr)
+
+    findings, exit_code = run_compiled(battery, built, chains, errors,
+                                       root=_REPO)
+    for f in findings:
+        print(f.render())
+    summary = (f"{len(built)} program(s), {len(chains)} chain(s), "
+               f"{len(skipped)} skipped")
+    if findings:
+        by_rule = {}
+        for f in findings:
+            by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        detail = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"{len(findings)} compiled-contract finding(s) ({detail}) "
+              f"over {summary}; exit code {exit_code}", file=sys.stderr)
+    else:
+        print(f"compiled contracts clean over {summary}",
+              file=sys.stderr)
+    return exit_code

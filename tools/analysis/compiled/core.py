@@ -1,0 +1,127 @@
+"""Engine of the compiled-contract analyzer tier.
+
+Mirrors ``tools/analysis/core.py`` one level up the stack: where the
+AST tier's unit is a parsed source file, this tier's unit is a
+**compiled artifact** — a production program from the registry in
+``tempo_tpu/plan/contracts.py``, lowered and compiled at small
+representative shapes, checked against the contract declared next to
+it.
+
+Conventions shared with the AST tier:
+
+* every rule owns a power-of-two exit bit — but in a SEPARATE bit
+  space (the two tiers are separate ``tools/analyze.py`` invocations,
+  so their statuses never mix);
+* a registry entry that fails to *build* reports as ``build-error``
+  (:data:`BUILD_ERROR_CODE`) instead of crashing the run — the moral
+  twin of the AST tier's ``parse-error``;
+* one finding is silenced by a ``# lint-ok: <rule>: <reason>`` comment
+  on (or immediately around) the program builder's ``@register`` line.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: Exit bit for registry programs that fail to build/compile at all.
+BUILD_ERROR_CODE = 64
+
+
+@dataclass(frozen=True)
+class Finding:
+    program: str            # registry program (or chain) name
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"compiled:{self.program}: [{self.rule}] {self.message}"
+
+
+class CompiledRule:
+    """Base class: one decidable compiled-artifact bug class."""
+
+    #: kebab-case id — the suppression token and CLI name.
+    name: str = ""
+    #: distinct power-of-two exit bit (compiled-tier space).
+    code: int = 0
+    #: one-line description shown by ``analyze.py --list-rules``.
+    doc: str = ""
+
+    def check_program(self, program) -> List[Finding]:
+        """Findings for one ``contracts.CompiledProgram``."""
+        return []
+
+    def check_chains(self, programs: Sequence, chains: Sequence
+                     ) -> List[Finding]:
+        """Findings over the declared stage chains (runs once)."""
+        return []
+
+    def check_registry(self, root: Path) -> List[Finding]:
+        """Registry-level consistency pass needing no artifacts
+        (runs once)."""
+        return []
+
+    # -- helpers -------------------------------------------------------
+
+    def finding(self, program, message: str) -> Optional[Finding]:
+        """A finding against ``program``, honouring a same-site
+        ``# lint-ok: <rule>: <reason>`` suppression."""
+        if _suppressed(program, self.name):
+            return None
+        name = program if isinstance(program, str) else program.name
+        return Finding(name, self.name, message)
+
+
+def _suppressed(program, rule_name: str) -> bool:
+    """True when the builder's ``@register`` site carries
+    ``# lint-ok: <rule>: <reason>`` (the decorator lines and the def
+    line — the same convention as the AST tier, anchored to where the
+    program is declared)."""
+    src = getattr(program, "source_file", "")
+    line = getattr(program, "source_line", 0)
+    if not src or not line:
+        return False
+    try:
+        lines = Path(src).read_text().splitlines()
+    except OSError:
+        return False
+    pat = re.compile(rf"#\s*lint-ok:\s*{re.escape(rule_name)}\s*:\s*\S")
+    lo = max(0, line - 4)
+    hi = min(len(lines), line + 2)
+    return any(pat.search(lines[i]) for i in range(lo, hi))
+
+
+def run_compiled(rules: Sequence[CompiledRule], programs: Sequence,
+                 chains: Sequence, errors: Dict[str, str],
+                 root: Optional[Path] = None
+                 ) -> Tuple[List[Finding], int]:
+    """Run every compiled rule over every built artifact (+ the chain
+    and registry passes).  ``errors`` (builder name -> message) become
+    ``build-error`` findings.  Returns (findings, exit code)."""
+    findings: List[Finding] = []
+    exit_code = 0
+    for name, msg in sorted(errors.items()):
+        findings.append(Finding(
+            name, "build-error",
+            f"registry program failed to build/compile: {msg}"))
+        exit_code |= BUILD_ERROR_CODE
+    for rule in rules:
+        fired = False
+        for program in programs:
+            found = rule.check_program(program)
+            findings.extend(found)
+            fired = fired or bool(found)
+        found = rule.check_chains(programs, chains)
+        findings.extend(found)
+        fired = fired or bool(found)
+        if root is not None:
+            found = rule.check_registry(Path(root))
+            findings.extend(found)
+            fired = fired or bool(found)
+        if fired:
+            exit_code |= rule.code
+    findings.sort(key=lambda f: (f.program, f.rule))
+    return findings, exit_code

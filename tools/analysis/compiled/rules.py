@@ -1,0 +1,392 @@
+"""Rule battery of the compiled-contract tier.
+
+Each rule checks one compiled-artifact guarantee against the contract
+declared next to the program (``tempo_tpu/plan/contracts.py``).  Exit
+bits live in the compiled tier's own space (the tier is its own
+``tools/analyze.py --compiled`` invocation):
+
+==================== ====  ============================================
+no-f64-leak             1  non-scalar f64 ops in a compiled artifact
+                           that declares the f32 policy
+no-host-transfer        2  infeed/outfeed/send/recv/python-callback
+                           custom-calls outside a declared barrier
+collective-inventory    4  compiled collectives vs the declared model
+                           (per-kind bytes within the shared tolerance;
+                           no unmodeled kinds; no vanished kinds)
+donation-applied        8  declared donate_argnums must appear as
+                           input-output aliases in the executable
+stage-sharding-match   16  chained stage N out-sharding == stage N+1
+                           in-sharding (no implicit resharding)
+recompile-coverage     32  every parameter of a PLANNED_METHODS op
+                           feeds the recorded plan node (cache keys can
+                           never replay a stale executable)
+build-error            64  registry programs that fail to build
+==================== ====  ============================================
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import re
+import textwrap
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+from dataclasses import dataclass
+
+from tools.analysis.compiled.core import CompiledRule, Finding
+
+
+@dataclass(frozen=True)
+class _Site:
+    """A suppressible finding anchor that is not a CompiledProgram:
+    registry-level findings point at the offending METHOD's def line,
+    so the standard ``# lint-ok: <rule>: <reason>`` works there too."""
+
+    name: str
+    source_file: str
+    source_line: int
+
+#: non-scalar f64 shapes in HLO text: ``f64[`` followed by a digit.
+#: Scalar ``f64[]`` constants are tolerated — they fold at compile
+#: time (a weak python float cast to a typed scalar), while an ARRAY
+#: of f64 means real double-precision compute the TPU cannot run
+#: (silent f32 demotion = cross-backend bitwise drift).
+_F64_ARRAY_RE = re.compile(r"\bf64\[\d")
+
+
+class NoF64LeakRule(CompiledRule):
+    name = "no-f64-leak"
+    code = 1
+    doc = ("no non-scalar f64 ops in compiled artifacts built under "
+           "the f32 compute policy")
+
+    def check_program(self, program) -> List[Finding]:
+        if program.contract.allow_f64:
+            return []
+        text = program.hlo_text()
+        hits = []
+        for line in text.splitlines():
+            m = _F64_ARRAY_RE.search(line)
+            if m:
+                hits.append(line.strip()[:120])
+        if not hits:
+            return []
+        f = self.finding(
+            program,
+            f"{len(hits)} non-scalar f64 op(s) in the compiled HLO of "
+            f"an f32-policy program (weak python floats / dtype-less "
+            f"asarray re-traced f64 — the 22-test interpret regression "
+            f"class; TPU would silently demote and drift bitwise).  "
+            f"First: {hits[0]}")
+        return [f] if f else []
+
+
+class NoHostTransferRule(CompiledRule):
+    name = "no-host-transfer"
+    code = 2
+    doc = ("no infeed/outfeed/send/recv/python-callback ops outside a "
+           "declared materialization barrier")
+
+    def check_program(self, program) -> List[Finding]:
+        if program.contract.host_transfer_ok is not None:
+            return []
+        from tempo_tpu import profiling
+
+        hits = profiling.host_transfers_from_compiled(
+            program.compiled, text=program.hlo_text())
+        if not hits:
+            return []
+        f = self.finding(
+            program,
+            f"{len(hits)} host-transfer op(s) compiled into a program "
+            f"declared device-resident (declare the barrier in the "
+            f"contract if it is intentional).  First: {hits[0]}")
+        return [f] if f else []
+
+
+class CollectiveInventoryRule(CompiledRule):
+    name = "collective-inventory"
+    code = 4
+    doc = ("compiled collectives match the declared per-kind byte "
+           "model within the shared tolerance; no unmodeled kinds")
+
+    def check_program(self, program) -> List[Finding]:
+        from tempo_tpu import profiling
+
+        contract = program.contract
+        measured = profiling.comm_bytes_from_compiled(
+            program.compiled, text=program.hlo_text())
+        out: List[Optional[Finding]] = []
+        for kind, model in sorted(contract.collectives.items()):
+            got = measured.get(kind, 0)
+            tol = contract.tolerances.get(
+                kind, profiling.COLLECTIVE_TOLERANCE.get(kind, 1.25))
+            if got == 0:
+                out.append(self.finding(
+                    program,
+                    f"declared collective '{kind}' ({model} B/shard "
+                    f"modeled) is ABSENT from the compiled HLO — the "
+                    f"comm the model budgets for no longer happens "
+                    f"(or was renamed/fused); re-derive the model"))
+            elif not (model <= got <= tol * model):
+                out.append(self.finding(
+                    program,
+                    f"collective '{kind}' moved {got} B/shard vs the "
+                    f"modeled {model} B/shard (outside [1x, {tol}x] — "
+                    f"an extra collective, a wrong halo width, or XLA "
+                    f"padding past the shared tolerance)"))
+        for kind, got in sorted(measured.items()):
+            if kind in contract.collectives:
+                continue
+            ceiling = contract.incidental.get(kind)
+            if ceiling is None:
+                out.append(self.finding(
+                    program,
+                    f"UNMODELED collective '{kind}' ({got} B/shard) in "
+                    f"the compiled HLO — declare a model (or an "
+                    f"incidental ceiling for audit scalars) so the "
+                    f"comm-bytes budget stays honest"))
+            elif got > ceiling:
+                out.append(self.finding(
+                    program,
+                    f"incidental collective '{kind}' moved {got} "
+                    f"B/shard, over its declared {ceiling} B ceiling"))
+        return [f for f in out if f is not None]
+
+
+class DonationAppliedRule(CompiledRule):
+    name = "donation-applied"
+    code = 8
+    doc = ("declared donate_argnums appear as input-output aliases in "
+           "the compiled executable (no silently dropped donation)")
+
+    def check_program(self, program) -> List[Finding]:
+        from tempo_tpu import profiling
+
+        declared = set(program.contract.donate_argnums)
+        applied = profiling.donated_params_from_compiled(
+            program.compiled, text=program.hlo_text())
+        out: List[Optional[Finding]] = []
+        dropped = sorted(declared - applied)
+        if dropped:
+            out.append(self.finding(
+                program,
+                f"declared donation of parameter(s) {dropped} was NOT "
+                f"applied (no input_output_alias in the executable): "
+                f"XLA found no shape/dtype-matching output — the donated "
+                f"buffers are silently kept live and the program's HBM "
+                f"working set doubles"))
+        undeclared = sorted(applied - declared)
+        if undeclared:
+            out.append(self.finding(
+                program,
+                f"executable aliases parameter(s) {undeclared} that the "
+                f"contract does not declare — the jit's donate_argnums "
+                f"and the contract drifted apart (both must read one "
+                f"source of truth)"))
+        return [f for f in out if f is not None]
+
+
+def _flat_shardings(compiled):
+    import jax
+
+    ins = compiled.input_shardings
+    if isinstance(ins, tuple) and len(ins) == 2 and isinstance(
+            ins[1], dict):
+        ins = ins[0]
+    return (list(jax.tree_util.tree_leaves(ins)),
+            list(jax.tree_util.tree_leaves(compiled.output_shardings)))
+
+
+def _spec_tuple(sharding) -> Optional[Tuple]:
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return tuple(spec)
+
+
+def _strip(spec: Tuple) -> Tuple:
+    spec = tuple(spec)
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+class StageShardingMatchRule(CompiledRule):
+    name = "stage-sharding-match"
+    code = 16
+    doc = ("declared chain links: producer out-sharding equals "
+           "consumer in-sharding (no implicit resharding between "
+           "chained programs)")
+
+    def check_chains(self, programs: Sequence, chains: Sequence
+                     ) -> List[Finding]:
+        by_name = {p.name: p for p in programs}
+        out: List[Optional[Finding]] = []
+        for chain in chains:
+            for link in chain.links:
+                out.append(self._check_link(chain, link, by_name))
+        return [f for f in out if f is not None]
+
+    def _check_link(self, chain, link, by_name) -> Optional[Finding]:
+        prod = by_name.get(link.producer)
+        cons = by_name.get(link.consumer)
+        if prod is None or cons is None:
+            return self.finding(
+                chain,
+                f"chain link {link.producer}[{link.out_idx}] -> "
+                f"{link.consumer}[{link.in_idx}] names a program that "
+                f"did not build")
+        _, outs = _flat_shardings(prod.compiled)
+        ins, _ = _flat_shardings(cons.compiled)
+        if link.out_idx >= len(outs) or link.in_idx >= len(ins):
+            return self.finding(
+                chain,
+                f"chain link {link.producer}[{link.out_idx}] -> "
+                f"{link.consumer}[{link.in_idx}] is out of range "
+                f"({len(outs)} outputs / {len(ins)} inputs)")
+        p_spec = _spec_tuple(outs[link.out_idx])
+        c_spec = _spec_tuple(ins[link.in_idx])
+        if p_spec is None or c_spec is None:
+            return self.finding(
+                chain,
+                f"chain link {link.producer}[{link.out_idx}] -> "
+                f"{link.consumer}[{link.in_idx}]: sharding carries no "
+                f"named spec (unverifiable — jit the stage with "
+                f"NamedShardings)")
+        if link.drop_leading:
+            dropped = p_spec[:link.drop_leading]
+            if any(d is not None for d in dropped):
+                return self.finding(
+                    chain.name,
+                    f"chain link {link.producer}[{link.out_idx}]: the "
+                    f"{link.drop_leading} host-sliced leading axis(es) "
+                    f"are SHARDED ({dropped}) — slicing them changes "
+                    f"device ownership in flight")
+            p_spec = p_spec[link.drop_leading:]
+        if _strip(p_spec) != _strip(c_spec):
+            return self.finding(
+                chain,
+                f"stage-boundary sharding mismatch at "
+                f"{link.producer}[{link.out_idx}] -> "
+                f"{link.consumer}[{link.in_idx}]: producer writes "
+                f"{p_spec}, consumer expects {c_spec} — chaining these "
+                f"programs inserts an implicit reshard (ROADMAP item "
+                f"2's precondition is an exact match)")
+        return None
+
+
+class RecompileCoverageRule(CompiledRule):
+    name = "recompile-coverage"
+    code = 32
+    doc = ("every parameter of a PLANNED_METHODS op method feeds the "
+           "recorded plan node (params dict or frame operands) — cache "
+           "hits can never replay a stale executable")
+
+    def check_registry(self, root: Path) -> List[Finding]:
+        from tempo_tpu import dist as dist_mod
+        from tempo_tpu import frame as frame_mod
+        from tempo_tpu.plan import ir
+
+        classes = {"TSDF": frame_mod.TSDF,
+                   "DistributedTSDF": dist_mod.DistributedTSDF}
+        out: List[Optional[Finding]] = []
+        for cls_name, methods in ir.PLANNED_METHODS.items():
+            cls = classes.get(cls_name)
+            if cls is None:
+                out.append(self.finding(
+                    f"registry:{cls_name}",
+                    f"PLANNED_METHODS class {cls_name!r} not found"))
+                continue
+            for m in methods:
+                out.append(self._check_method(cls_name, cls, m))
+        return [f for f in out if f is not None]
+
+    def _check_method(self, cls_name: str, cls, method: str
+                      ) -> Optional[Finding]:
+        site = f"registry:{cls_name}.{method}"
+        fn = getattr(cls, method, None)
+        if fn is None:
+            return self.finding(
+                site, "method missing (PLANNED_METHODS drift — the "
+                      "plan-registry AST rule should have caught this)")
+        try:
+            sig = inspect.signature(fn)
+            src = textwrap.dedent(inspect.getsource(fn))
+            # anchor the finding at the method's def so a same-site
+            # ``# lint-ok: recompile-coverage: <reason>`` suppresses,
+            # like every other compiled finding
+            site = _Site(site, inspect.getsourcefile(fn) or "",
+                         inspect.getsourcelines(fn)[1])
+        except (OSError, TypeError, ValueError) as e:
+            return self.finding(site, f"source unavailable: {e}")
+        recorded, operands = self._recorded_names(src)
+        if recorded is None:
+            return self.finding(
+                site, "no _plan_record call found in the method body")
+        missing = []
+        for p in sig.parameters.values():
+            if p.name in ("self", "cls"):
+                continue
+            if p.kind is inspect.Parameter.VAR_KEYWORD:
+                continue
+            if p.name not in recorded and p.name not in operands:
+                missing.append(p.name)
+        if missing:
+            return self.finding(
+                site,
+                f"parameter(s) {missing} are NOT recorded into the "
+                f"plan node (neither a params key nor a frame "
+                f"operand): two calls differing only there share a "
+                f"plan signature, so a cache hit would replay a STALE "
+                f"executable built for the other value")
+        return None
+
+    @staticmethod
+    def _recorded_names(src: str):
+        """(params-dict keys, operand Name ids) of the method's
+        ``_plan_record(op, others, params, objs)`` call, or
+        (None, None) when no call is found."""
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            return None, None
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_plan_record"):
+                continue
+            others = node.args[1] if len(node.args) > 1 else None
+            params = node.args[2] if len(node.args) > 2 else None
+            for kw in node.keywords:
+                if kw.arg == "others":
+                    others = kw.value
+                elif kw.arg == "params":
+                    params = kw.value
+            keys = set()
+            if isinstance(params, ast.Call):        # dict(colName=...)
+                keys |= {kw.arg for kw in params.keywords if kw.arg}
+            elif isinstance(params, ast.Dict):      # {"colName": ...}
+                keys |= {k.value for k in params.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)}
+            operands = set()
+            if isinstance(others, (ast.Tuple, ast.List)):
+                for elt in others.elts:
+                    for sub in ast.walk(elt):
+                        if isinstance(sub, ast.Name):
+                            operands.add(sub.id)
+            return keys, operands
+        return None, None
+
+
+COMPILED_RULES: Tuple[CompiledRule, ...] = (
+    NoF64LeakRule(),
+    NoHostTransferRule(),
+    CollectiveInventoryRule(),
+    DonationAppliedRule(),
+    StageShardingMatchRule(),
+    RecompileCoverageRule(),
+)

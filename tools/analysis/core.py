@@ -23,6 +23,15 @@ Contract
   silences that rule there; the reason is mandatory (a bare marker
   does not suppress).  Rules may also declare ``legacy_markers``
   (e.g. ``# gather-ok:``) kept for pre-framework annotations.
+* Dead-suppression audit — a ``# lint-ok:`` comment whose rule never
+  fires on that line is itself a violation
+  (:data:`DEAD_SUPPRESSION_CODE`): the code it once excused has moved
+  or been fixed, and a stale marker left in place would silently
+  swallow the next *real* finding on that line.  Only actual comment
+  tokens count (docstrings and string literals that merely *mention*
+  the marker syntax are ignored), and the audit runs only when the
+  full battery does — a ``--rule``-filtered run cannot know whether an
+  unselected rule would have used a marker.
 * Exit codes — :func:`run` returns the bitwise OR of the ``code`` of
   every rule that fired, so a CI log's exit status alone names the
   failing rule families (``parse-error`` contributes
@@ -32,7 +41,9 @@ Contract
 from __future__ import annotations
 
 import ast
+import io
 import re
+import tokenize
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterable, List, Optional, Sequence, Tuple
@@ -46,7 +57,21 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 #: authoritative breakdown (signal deaths print no summary).
 PARSE_ERROR_CODE = 64
 
+#: Exit bit of the dead-suppression audit.  NOTE: past bit 7 the
+#: 8-bit process exit status can no longer carry the raw OR —
+#: ``tools/analyze.py`` folds it (nonzero-preserving) and the stderr
+#: per-rule summary remains the authoritative breakdown; the full
+#: integer is still what :func:`run` returns to in-process callers.
+DEAD_SUPPRESSION_CODE = 256
+
 _SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", ".hypothesis"}
+
+#: The audit's marker pattern mirrors :meth:`ModuleSource.suppressed`
+#: exactly — '#'-anchored and reason-required — so prose that merely
+#: mentions the syntax ("consider adding a lint-ok: ...") and
+#: reasonless markers (which suppress nothing; their rule still
+#: fires) are never reported as dead suppressions.
+_LINT_OK_RE = re.compile(r"#\s*lint-ok:\s*([A-Za-z0-9_-]+)\s*:\s*\S")
 
 
 @dataclass(frozen=True)
@@ -68,6 +93,10 @@ class ModuleSource:
         self.path = Path(path)
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
+        #: (lineno, rule name) pairs whose ``# lint-ok:`` marker
+        #: actually silenced a would-be violation this run — the
+        #: evidence the dead-suppression audit checks against.
+        self.suppression_hits: set = set()
         try:
             self.text = self.path.read_text() if text is None else text
         except (OSError, UnicodeDecodeError) as e:
@@ -94,8 +123,27 @@ class ModuleSource:
         text = self.line(lineno)
         if re.search(rf"#\s*lint-ok:\s*{re.escape(rule.name)}\s*:\s*\S",
                      text):
+            self.suppression_hits.add((lineno, rule.name))
             return True
         return any(marker in text for marker in rule.legacy_markers)
+
+    def lint_ok_comments(self) -> List[Tuple[int, str]]:
+        """(lineno, rule name) of every ``lint-ok:`` marker appearing
+        in an actual COMMENT token — docstrings/string literals that
+        merely mention the syntax do not count.  Multi-line comments
+        attribute each marker to its own physical line."""
+        out: List[Tuple[int, str]] = []
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                for m in _LINT_OK_RE.finditer(tok.string):
+                    out.append((tok.start[0], m.group(1)))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return []  # untokenizable: skip the audit for this file
+        return out
 
 
 class Rule:
@@ -157,11 +205,72 @@ def load_sources(paths: Iterable[Path]) -> List[ModuleSource]:
     return [ModuleSource(p) for p in iter_py_files(paths)]
 
 
+class _DeadSuppressionProbe(Rule):
+    """Identity the audit presents to ``ModuleSource.suppressed`` so a
+    dead-suppression finding can itself be silenced the usual way
+    (``# lint-ok: dead-suppression: <reason>``)."""
+
+    name = "dead-suppression"
+    code = DEAD_SUPPRESSION_CODE
+    doc = ("# lint-ok: markers whose rule never fires on that line "
+           "(stale suppressions rot in place)")
+
+
+def audit_suppressions(rules: Sequence[Rule],
+                       files: Sequence[ModuleSource]) -> List[Violation]:
+    """Dead-suppression audit: every ``# lint-ok: <rule>: ...`` comment
+    must have silenced a real would-be finding of ``<rule>`` on its
+    line during this run (``ModuleSource.suppression_hits``).  Markers
+    naming a rule outside the battery are reported as unknown — a typo
+    in the rule name suppresses nothing and rots just the same.  Run
+    only with the FULL battery: under ``--rule`` filtering an unused
+    marker may belong to an unselected rule."""
+    probe = _DeadSuppressionProbe()
+    known = {r.name for r in rules}
+    # markers naming a COMPILED-tier rule (BUILDING.md's documented
+    # suppression at a contracts.py @register site) belong to the
+    # other tier: not unknown, and their liveness is judged against
+    # built artifacts, which a source sweep cannot do — skip them
+    try:
+        from tools.analysis.compiled.rules import COMPILED_RULES
+        other_tier = {r.name for r in COMPILED_RULES} | {"build-error"}
+    except ImportError:
+        other_tier = set()
+    out: List[Violation] = []
+    for mod in files:
+        if mod.parse_error is not None:
+            continue
+        for lineno, rname in mod.lint_ok_comments():
+            if rname == probe.name or rname in other_tier:
+                continue  # self-markers / the compiled tier's markers
+            if rname not in known:
+                v = probe.violation(
+                    mod, lineno,
+                    f"suppression names unknown rule {rname!r} — it "
+                    f"silences nothing (see analyze.py --list-rules); "
+                    f"fix the name or delete the marker")
+                if v is not None:
+                    out.append(v)
+            elif (lineno, rname) not in mod.suppression_hits:
+                v = probe.violation(
+                    mod, lineno,
+                    f"dead suppression: rule '{rname}' no longer fires "
+                    f"on this line — the finding it excused has moved "
+                    f"or been fixed; delete the marker (a stale one "
+                    f"would silently swallow the next real finding "
+                    f"here)")
+                if v is not None:
+                    out.append(v)
+    return out
+
+
 def run(rules: Sequence[Rule], files: Sequence[ModuleSource],
-        root: Optional[Path] = None) -> Tuple[List[Violation], int]:
+        root: Optional[Path] = None,
+        audit: bool = True) -> Tuple[List[Violation], int]:
     """Run every rule over every applicable file (plus each rule's
-    project pass).  Returns (violations, exit code) where the exit
-    code ORs the bits of the rules that fired."""
+    project pass), then the dead-suppression audit (``audit=False``
+    for ``--rule``-filtered runs).  Returns (violations, exit code)
+    where the exit code ORs the bits of the rules that fired."""
     violations: List[Violation] = []
     exit_code = 0
     for mod in files:
@@ -185,5 +294,11 @@ def run(rules: Sequence[Rule], files: Sequence[ModuleSource],
             violations.extend(found)
             if found:
                 exit_code |= rule.code
+    if audit:
+        # must run LAST: it needs every rule's suppression_hits
+        found = audit_suppressions(rules, files)
+        violations.extend(found)
+        if found:
+            exit_code |= DEAD_SUPPRESSION_CODE
     violations.sort(key=lambda v: (str(v.path), v.line))
     return violations, exit_code

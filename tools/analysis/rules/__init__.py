@@ -5,7 +5,8 @@ engine's default battery, in exit-bit order."""
 
 from tools.analysis.rules.vmem import VmemBudgetRule
 from tools.analysis.rules.weak_dtype import WeakDtypeRule
-from tools.analysis.rules.gather import DynamicGatherRule, GridCarryRule
+from tools.analysis.rules.gather import DynamicGatherRule
+from tools.analysis.rules.grid_carry import GridCarryRule
 from tools.analysis.rules.env_knobs import EnvKnobRule
 from tools.analysis.rules.excepts import BareExceptRule
 from tools.analysis.rules.plan_registry import PlanRegistryRule

@@ -1,12 +1,12 @@
-"""dynamic-gather + grid-carry: data-movement discipline in the Pallas
-kernel modules.
+"""dynamic-gather: data-movement discipline in the Pallas kernel
+modules.
 
-``dynamic-gather`` supersedes ``tools/check_no_dynamic_gather.py``
-(now a shim): per-lane dynamic gathers are the one data-movement
-primitive this hardware cannot do at speed (the ~96 ms
-``take_along_axis`` levels behind the BENCH_r05 dense-regime loss) and
-Mosaic cannot lower them in-kernel at all.  The legacy script matched
-call *names* only; this rule adds the dataflow it punted on:
+Supersedes ``tools/check_no_dynamic_gather.py`` (now a shim): per-lane
+dynamic gathers are the one data-movement primitive this hardware
+cannot do at speed (the ~96 ms ``take_along_axis`` levels behind the
+BENCH_r05 dense-regime loss) and Mosaic cannot lower them in-kernel at
+all.  The legacy script matched call *names* only; this rule adds the
+dataflow it punted on:
 
 * aliased imports — ``from jax.numpy import take_along_axis as g`` /
   ``h = jnp.take`` are resolved through the module alias map;
@@ -16,18 +16,11 @@ call *names* only; this rule adds the dataflow it punted on:
 * ``x.at[idx].get()`` / ``.set()`` / ``.add()`` — the indexed-update
   forms the legacy tool explicitly left to review.
 
-``grid-carry``: a scratch ref on a *sequential* grid axis
-(``dimension_semantics`` containing ``"arbitrary"``) is a carry — the
-only state that survives between grid steps.  A kernel whose first
-unguarded access to such a ref is a WRITE destroys the previous step's
-carry before reading it (cross-chunk forward-fill state, PR 3's
-correctness linchpin); initialisation writes belong under a
-``@pl.when(step == 0)`` guard.  Refs bound as ``*refs`` varargs are
-not attributable and are skipped.
-
-Suppressions: ``# lint-ok: dynamic-gather: <reason>`` (the legacy
-``# gather-ok: <reason>`` marker is still honoured) and
-``# lint-ok: grid-carry: <reason>``.
+Suppression: ``# lint-ok: dynamic-gather: <reason>`` (the legacy
+``# gather-ok: <reason>`` marker is still honoured).  The grid-carry
+rule that used to share this module lives in
+``tools/analysis/rules/grid_carry.py`` since round 8 (same rule name,
+exit bit and suppression token; re-exported here for compatibility).
 """
 
 from __future__ import annotations
@@ -38,6 +31,10 @@ from typing import List, Optional, Set
 
 from tools.analysis.core import ModuleSource, Rule, Violation
 from tools.analysis import dataflow as df
+from tools.analysis.rules.grid_carry import (  # noqa: F401  (compat re-export)
+    GridCarryRule,
+    _kernel_module,
+)
 
 BANNED = {
     "take_along_axis",
@@ -56,16 +53,6 @@ BANNED = {
 _ARRAY_LIBS = ("jax.numpy", "jax.lax", "numpy", "jax")
 
 _AT_METHODS = {"get", "set", "add", "mul", "min", "max", "apply"}
-
-
-def _kernel_module(path: Path) -> bool:
-    """The files under kernel discipline: the Pallas op modules plus
-    the tool/test helpers the analyzer sweeps."""
-    return (
-        path.name.startswith("pallas_")
-        or "tools" in path.parts
-        or path.name == "helpers.py"
-    )
 
 
 class DynamicGatherRule(Rule):
@@ -144,172 +131,3 @@ class DynamicGatherRule(Rule):
             return self._flag(mod, node.lineno,
                               f".at[...].{fn.attr}", "(indexed update)")
         return None
-
-
-class GridCarryRule(Rule):
-    name = "grid-carry"
-    code = 8
-    doc = ("scratch refs on sequential grid axes must be read before "
-           "any unguarded write within a step")
-
-    def applies(self, path: Path) -> bool:
-        return path.suffix == ".py" and _kernel_module(path)
-
-    def check(self, mod: ModuleSource) -> List[Violation]:
-        if "pallas_call" not in mod.text:
-            return []
-        tree = mod.tree
-        module_env = df.assignment_env(tree.body)
-        func_of = df.enclosing_function_map(tree)
-        defs = {}
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef):
-                defs.setdefault(node.name, node)
-        out: List[Optional[Violation]] = []
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and df.terminal_name(node.func) == "pallas_call"):
-                continue
-            enclosing = func_of.get(node)
-            env = (df.assignment_env(enclosing.body)
-                   if enclosing is not None else module_env)
-            fallback = module_env if enclosing is not None else None
-            out.extend(self._check_site(mod, node, env, fallback, defs))
-        return [v for v in out if v is not None]
-
-    def _check_site(self, mod, call, env, fallback, defs):
-        if not self._sequential(call, env, fallback):
-            return []
-        n_scratch = self._scratch_count(call, env, fallback)
-        if not n_scratch:
-            return []
-        kernel = self._resolve_kernel(call, env, fallback, defs)
-        if kernel is None or kernel.args.vararg is not None:
-            return []  # factory-built or *refs kernels: not attributable
-        params = [a.arg for a in kernel.args.args]
-        if len(params) < n_scratch:
-            return []
-        out = []
-        for ref in params[len(params) - n_scratch:]:
-            first_write = self._first_unguarded_write_before_read(
-                kernel, ref)
-            if first_write is not None:
-                out.append(self.violation(
-                    mod, first_write,
-                    f"scratch ref '{ref}' rides a sequential grid axis "
-                    f"(dimension_semantics 'arbitrary') but is written "
-                    f"before it is read within the step — the previous "
-                    f"grid step's carry is destroyed; read it first, or "
-                    f"guard initialisation with @pl.when(step == 0)"))
-        return out
-
-    def _sequential(self, call, env, fallback) -> bool:
-        for kw in call.keywords:
-            if kw.arg != "compiler_params":
-                continue
-            if isinstance(kw.value, ast.Call):
-                for inner in kw.value.keywords:
-                    if inner.arg == "dimension_semantics":
-                        sem = df.fold(inner.value, env, fallback)
-                        if isinstance(sem, tuple) and "arbitrary" in sem:
-                            return True
-        return False
-
-    def _scratch_count(self, call, env, fallback) -> int:
-        for kw in call.keywords:
-            if kw.arg == "scratch_shapes":
-                node = kw.value
-                if isinstance(node, ast.Name):
-                    for scope in (env, fallback or {}):
-                        if node.id in scope:
-                            node = scope[node.id]
-                            break
-                if isinstance(node, (ast.List, ast.Tuple)):
-                    return len(node.elts)
-                return 0
-        return 0
-
-    def _resolve_kernel(self, call, env, fallback, defs):
-        if not call.args:
-            return None
-        fn = call.args[0]
-        if isinstance(fn, ast.Name):
-            kernel = defs.get(fn.id)
-            if kernel is not None:
-                return kernel
-            for scope in (env, fallback or {}):
-                if fn.id in scope and isinstance(scope[fn.id], ast.Lambda):
-                    return None
-        if isinstance(fn, ast.FunctionDef):
-            return fn
-        # factory call: _make_x_kernel(...) returning an inner def —
-        # follow one level to the FunctionDef the factory returns
-        if isinstance(fn, ast.Call):
-            factory = defs.get(df.terminal_name(fn.func))
-            if factory is not None:
-                inner = {n.name: n for n in ast.walk(factory)
-                         if isinstance(n, ast.FunctionDef)
-                         and n is not factory}
-                for node in ast.walk(factory):
-                    if isinstance(node, ast.Return) \
-                            and isinstance(node.value, ast.Name) \
-                            and node.value.id in inner:
-                        return inner[node.value.id]
-        return None
-
-    def _first_unguarded_write_before_read(self, kernel: ast.FunctionDef,
-                                           ref: str) -> Optional[int]:
-        """Line of the first unguarded write to ``ref[...]`` that
-        precedes any read, else None.  Accesses inside a
-        ``@pl.when(...)``-decorated inner def are guarded — they run
-        conditionally (the init-at-step-0 idiom) and do not order."""
-        state = {"read": False, "write_line": None}
-
-        def visit(node: ast.AST):
-            if state["read"] or state["write_line"] is not None:
-                return
-            if isinstance(node, ast.FunctionDef) and any(
-                    isinstance(d, ast.Call)
-                    and df.terminal_name(d.func) == "when"
-                    for d in node.decorator_list):
-                return  # guarded block
-            if isinstance(node, (ast.Assign, ast.AugAssign)):
-                targets = (node.targets if isinstance(node, ast.Assign)
-                           else [node.target])
-                # reads on the RHS happen before the store
-                visit_expr(node.value)
-                if state["read"]:
-                    return
-                for tgt in targets:
-                    if self._is_ref_access(tgt, ref):
-                        state["write_line"] = tgt.lineno
-                        return
-                    visit_expr(tgt)  # subscript indices may read the ref
-                return
-            if isinstance(node, ast.expr):
-                visit_expr(node)
-                return
-            for child in ast.iter_child_nodes(node):
-                visit(child)
-                if state["read"] or state["write_line"] is not None:
-                    return
-
-        def visit_expr(node: ast.AST):
-            for sub in ast.walk(node):
-                if self._is_ref_access(sub, ref) or (
-                        isinstance(sub, ast.Name) and sub.id == ref
-                        and isinstance(sub.ctx, ast.Load)):
-                    state["read"] = True
-                    return
-
-        for stmt in kernel.body:
-            visit(stmt)
-            if state["read"] or state["write_line"] is not None:
-                break
-        return state["write_line"]
-
-    @staticmethod
-    def _is_ref_access(node: ast.AST, ref: str) -> bool:
-        return (isinstance(node, ast.Subscript)
-                and isinstance(node.value, ast.Name)
-                and node.value.id == ref)

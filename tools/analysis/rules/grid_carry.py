@@ -1,0 +1,274 @@
+"""grid-carry: sequential-grid scratch carries must be read before
+they are overwritten.
+
+A scratch ref on a *sequential* grid axis (``dimension_semantics``
+containing ``"arbitrary"``, or a declared carry axis of
+``pallas_stream.grid_semantics``) is a carry — the only state that
+survives between grid steps.  A kernel whose first unguarded access to
+such a ref is a WRITE destroys the previous step's carry before
+reading it (cross-chunk forward-fill state, PR 3's correctness
+linchpin); initialisation writes belong under a ``@pl.when(step == 0)``
+guard.
+
+Resolution (round 8): ``dimension_semantics`` built by the PR-6
+``pallas_stream.grid_semantics(n_axes, carry_axes=...)`` factory is
+understood without folding — a non-empty ``carry_axes`` declares a
+sequential carry axis by construction (and an unfoldable carry_axes
+argument is treated as sequential, conservatively).  Kernels are
+resolved through a direct factory call (``_make_x_kernel(...)``) AND
+through a name bound to a factory call a few lines up (the
+``pallas_stream.ring_call`` idiom: ``kernel = _make_ring_kernel(...)``
+then ``pl.pallas_call(kernel, ...)``).  Refs bound as ``*refs``
+varargs remain unattributable and are skipped.
+
+Suppression: ``# lint-ok: grid-carry: <reason>``.  Split out of
+``rules/gather.py`` in round 8; the rule name, exit bit (8) and
+suppression token are unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from tools.analysis.core import ModuleSource, Rule, Violation
+from tools.analysis import dataflow as df
+
+
+def _kernel_module(path: Path) -> bool:
+    """The files under kernel discipline: the Pallas op modules plus
+    the tool/test helpers the analyzer sweeps."""
+    return (
+        path.name.startswith("pallas_")
+        or "tools" in path.parts
+        or path.name == "helpers.py"
+    )
+
+
+class GridCarryRule(Rule):
+    name = "grid-carry"
+    code = 8
+    doc = ("scratch refs on sequential grid axes must be read before "
+           "any unguarded write within a step")
+
+    def applies(self, path: Path) -> bool:
+        return path.suffix == ".py" and _kernel_module(path)
+
+    def check(self, mod: ModuleSource) -> List[Violation]:
+        if "pallas_call" not in mod.text:
+            return []
+        tree = mod.tree
+        module_env = df.assignment_env(tree.body)
+        func_of = df.enclosing_function_map(tree)
+        aliases = df.build_aliases(tree)
+        defs = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+        out: List[Optional[Violation]] = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and df.terminal_name(node.func) == "pallas_call"):
+                continue
+            enclosing = func_of.get(node)
+            env = (df.assignment_env(enclosing.body)
+                   if enclosing is not None else module_env)
+            fallback = module_env if enclosing is not None else None
+            out.extend(self._check_site(mod, node, env, fallback, defs,
+                                        aliases))
+        return [v for v in out if v is not None]
+
+    def _check_site(self, mod, call, env, fallback, defs, aliases):
+        if not self._sequential(call, env, fallback, aliases):
+            return []
+        n_scratch = self._scratch_count(call, env, fallback)
+        if not n_scratch:
+            return []
+        kernel = self._resolve_kernel(call, env, fallback, defs)
+        if kernel is None or kernel.args.vararg is not None:
+            return []  # factory-built or *refs kernels: not attributable
+        params = [a.arg for a in kernel.args.args]
+        if len(params) < n_scratch:
+            return []
+        out = []
+        for ref in params[len(params) - n_scratch:]:
+            first_write = self._first_unguarded_write_before_read(
+                kernel, ref)
+            if first_write is not None:
+                out.append(self.violation(
+                    mod, first_write,
+                    f"scratch ref '{ref}' rides a sequential grid axis "
+                    f"(dimension_semantics 'arbitrary') but is written "
+                    f"before it is read within the step — the previous "
+                    f"grid step's carry is destroyed; read it first, or "
+                    f"guard initialisation with @pl.when(step == 0)"))
+        return out
+
+    def _sequential(self, call, env, fallback, aliases) -> bool:
+        for kw in call.keywords:
+            if kw.arg != "compiler_params":
+                continue
+            if isinstance(kw.value, ast.Call):
+                for inner in kw.value.keywords:
+                    if inner.arg == "dimension_semantics":
+                        return self._semantics_sequential(
+                            inner.value, env, fallback, aliases)
+        return False
+
+    @staticmethod
+    def _is_grid_semantics(func, aliases) -> bool:
+        """The call target is pallas_stream.grid_semantics, resolved
+        through the module alias map (``from ... import grid_semantics
+        as gs`` must not bypass the carry check — the same aliased-
+        import gap dynamic-gather closes)."""
+        origin = df.dotted_name(func, aliases) or df.terminal_name(func)
+        return origin.split(".")[-1] == "grid_semantics"
+
+    def _semantics_sequential(self, node, env, fallback, aliases) -> bool:
+        """True when a ``dimension_semantics`` value declares (or may
+        declare) a sequential axis: a foldable tuple containing
+        ``"arbitrary"``, or a ``grid_semantics(n, carry_axes=...)``
+        factory call whose ``carry_axes`` is non-empty (a declared
+        carry IS the sequential contract; the megacore knob only
+        widens the remaining axes, never a carry axis).  A name bound
+        to either form (``sems = grid_semantics(...)`` then
+        ``dimension_semantics=sems``) resolves the same way."""
+        if isinstance(node, ast.Name):
+            for scope in (env, fallback or {}):
+                if node.id in scope:
+                    node = scope[node.id]
+                    break
+        sem = df.fold(node, env, fallback)
+        if isinstance(sem, tuple):
+            return "arbitrary" in sem
+        if (isinstance(node, ast.Call)
+                and self._is_grid_semantics(node.func, aliases)):
+            carry = None
+            for kw in node.keywords:
+                if kw.arg == "carry_axes":
+                    carry = kw.value
+            if carry is None and len(node.args) >= 2:
+                carry = node.args[1]
+            if carry is None:
+                return False  # no declared carry axes: parallel-or-knob
+            folded = df.fold(carry, env, fallback)
+            if isinstance(folded, tuple):
+                return len(folded) > 0
+            return True  # unfoldable carry declaration: assume carry
+        return False
+
+    def _scratch_count(self, call, env, fallback) -> int:
+        for kw in call.keywords:
+            if kw.arg == "scratch_shapes":
+                node = kw.value
+                if isinstance(node, ast.Name):
+                    for scope in (env, fallback or {}):
+                        if node.id in scope:
+                            node = scope[node.id]
+                            break
+                if isinstance(node, (ast.List, ast.Tuple)):
+                    return len(node.elts)
+                return 0
+        return 0
+
+    def _resolve_kernel(self, call, env, fallback, defs):
+        if not call.args:
+            return None
+        fn = call.args[0]
+        if isinstance(fn, ast.Name):
+            kernel = defs.get(fn.id)
+            if kernel is not None:
+                return kernel
+            for scope in (env, fallback or {}):
+                if fn.id in scope:
+                    bound = scope[fn.id]
+                    if isinstance(bound, ast.Lambda):
+                        return None
+                    # ``kernel = _make_ring_kernel(...)`` then
+                    # ``pallas_call(kernel, ...)`` — the ring_call
+                    # idiom: follow the bound factory call
+                    if isinstance(bound, ast.Call):
+                        resolved = self._from_factory(bound, defs)
+                        if resolved is not None:
+                            return resolved
+                    break
+        if isinstance(fn, ast.FunctionDef):
+            return fn
+        # factory call: _make_x_kernel(...) returning an inner def —
+        # follow one level to the FunctionDef the factory returns
+        if isinstance(fn, ast.Call):
+            return self._from_factory(fn, defs)
+        return None
+
+    @staticmethod
+    def _from_factory(fn: ast.Call, defs):
+        factory = defs.get(df.terminal_name(fn.func))
+        if factory is None:
+            return None
+        inner = {n.name: n for n in ast.walk(factory)
+                 if isinstance(n, ast.FunctionDef)
+                 and n is not factory}
+        for node in ast.walk(factory):
+            if isinstance(node, ast.Return) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in inner:
+                return inner[node.value.id]
+        return None
+
+    def _first_unguarded_write_before_read(self, kernel: ast.FunctionDef,
+                                           ref: str) -> Optional[int]:
+        """Line of the first unguarded write to ``ref[...]`` that
+        precedes any read, else None.  Accesses inside a
+        ``@pl.when(...)``-decorated inner def are guarded — they run
+        conditionally (the init-at-step-0 idiom) and do not order."""
+        state = {"read": False, "write_line": None}
+
+        def visit(node: ast.AST):
+            if state["read"] or state["write_line"] is not None:
+                return
+            if isinstance(node, ast.FunctionDef) and any(
+                    isinstance(d, ast.Call)
+                    and df.terminal_name(d.func) == "when"
+                    for d in node.decorator_list):
+                return  # guarded block
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                # reads on the RHS happen before the store
+                visit_expr(node.value)
+                if state["read"]:
+                    return
+                for tgt in targets:
+                    if self._is_ref_access(tgt, ref):
+                        state["write_line"] = tgt.lineno
+                        return
+                    visit_expr(tgt)  # subscript indices may read the ref
+                return
+            if isinstance(node, ast.expr):
+                visit_expr(node)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+                if state["read"] or state["write_line"] is not None:
+                    return
+
+        def visit_expr(node: ast.AST):
+            for sub in ast.walk(node):
+                if self._is_ref_access(sub, ref) or (
+                        isinstance(sub, ast.Name) and sub.id == ref
+                        and isinstance(sub.ctx, ast.Load)):
+                    state["read"] = True
+                    return
+
+        for stmt in kernel.body:
+            visit(stmt)
+            if state["read"] or state["write_line"] is not None:
+                break
+        return state["write_line"]
+
+    @staticmethod
+    def _is_ref_access(node: ast.AST, ref: str) -> bool:
+        return (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == ref)

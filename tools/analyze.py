@@ -25,19 +25,37 @@ parse-error       64  files that do not parse (or cannot be read)
 plan-registry    128  TSDF/DistributedTSDF op methods neither recording a
                       plan node (plan.ir.PLANNED_METHODS) nor marked
                       '# plan-ok: eager-only'; registry<->code drift
+dead-suppression 256  '# lint-ok:' comments whose rule never fires on
+                      that line (stale or typo'd suppressions; audited
+                      only on full-battery runs)
 ==============  ====  =====================================================
 
-The process exit code is the bitwise OR of the fired rules — a CI log's
-status names the failing families (for statuses >= 128 read the
-per-rule summary on stderr: the shell uses that range for signal
-deaths, which print no summary); 0 means clean.  Suppress one finding
-with ``# lint-ok: <rule>: <reason>`` on the flagged line.
+The in-process exit code (``core.run``) is the bitwise OR of the fired
+rules.  The *process* status folds it into 8 bits nonzero-preserving
+(bits past 128 no longer fit the shell's exit byte — a status of 255
+means "only high-bit families fired"); the per-rule summary on stderr
+is always the authoritative breakdown (statuses >= 128 can also be
+signal deaths, which print no summary).  0 means clean.  Suppress one
+finding with ``# lint-ok: <rule>: <reason>`` on the flagged line.
+
+A second, *compiled-artifact* tier checks contracts against what XLA
+actually compiled (sharding, donation, collectives, dtype,
+host-transfer) for the registry of production programs declared in
+``tempo_tpu/plan/contracts.py``::
+
+    python tools/analyze.py --compiled             # whole registry
+    python tools/analyze.py --compiled --program fused.asof_stats_ema
+
+The compiled tier owns its own exit-bit space (see
+``tools/analysis/compiled``) — the two tiers are separate invocations,
+so their statuses never mix.
 
 Usage::
 
     python tools/analyze.py                  # default sweep, all rules
     python tools/analyze.py --rule vmem-budget [paths...]
-    python tools/analyze.py --list-rules
+    python tools/analyze.py --list-rules     # both tiers
+    python tools/analyze.py --compiled
 """
 
 from __future__ import annotations
@@ -74,15 +92,45 @@ def main(argv=None) -> int:
     ap.add_argument("--rule", action="append", dest="rules", default=None,
                     metavar="NAME", help="run only the named rule(s)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--compiled", action="store_true",
+                    help="run the compiled-artifact contract tier over "
+                         "the production-program registry "
+                         "(tempo_tpu/plan/contracts.py) instead of the "
+                         "AST tier")
+    ap.add_argument("--program", action="append", dest="programs",
+                    default=None, metavar="NAME",
+                    help="with --compiled: check only the named "
+                         "registry program(s)")
     ap.add_argument("--root", type=Path, default=_REPO,
                     help="project root for whole-tree consistency passes "
                          "(BUILDING.md / knob registry)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
+        from tools.analysis import compiled as compiled_tier
+
+        print("AST tier (python tools/analyze.py):")
         for rule in ALL_RULES:
-            print(f"{rule.name:16s} exit {rule.code:3d}  {rule.doc}")
+            print(f"  {rule.name:18s} exit {rule.code:3d}  {rule.doc}")
+        print(f"  {'dead-suppression':18s} exit "
+              f"{core.DEAD_SUPPRESSION_CODE:3d}  stale '# lint-ok:' "
+              f"markers whose rule never fires on that line")
+        print("compiled tier (python tools/analyze.py --compiled; "
+              "separate exit-bit space):")
+        for rule in compiled_tier.COMPILED_RULES:
+            print(f"  {rule.name:18s} exit {rule.code:3d}  {rule.doc}")
+        print(f"  {'build-error':18s} exit "
+              f"{compiled_tier.BUILD_ERROR_CODE:3d}  registry programs "
+              f"that fail to build/compile at all")
         return 0
+
+    if args.programs and not args.compiled:
+        ap.error("--program requires --compiled")
+    if args.compiled:
+        from tools.analysis import compiled as compiled_tier
+
+        return _fold_status(compiled_tier.main(
+            programs=args.programs, rules=args.rules))
 
     rules = list(ALL_RULES)
     if args.rules:
@@ -104,7 +152,10 @@ def main(argv=None) -> int:
     else:
         paths = [p for p in default_paths() if p.exists()]
     files = core.load_sources(paths)
-    violations, exit_code = core.run(rules, files, root=args.root)
+    # the dead-suppression audit needs the WHOLE battery's hits to
+    # judge a marker dead — a filtered run skips it
+    violations, exit_code = core.run(rules, files, root=args.root,
+                                     audit=args.rules is None)
 
     for v in violations:
         print(v.render())
@@ -115,7 +166,18 @@ def main(argv=None) -> int:
         summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
         print(f"{len(violations)} violation(s) ({summary}); "
               f"exit code {exit_code}", file=sys.stderr)
-    return exit_code
+    return _fold_status(exit_code)
+
+
+def _fold_status(exit_code: int) -> int:
+    """Fold a rule-bit OR into the shell's 8-bit exit status without
+    ever folding a failure to 0: families past bit 7 (dead-suppression
+    = 256) cannot ride the status byte, so a run where ONLY such
+    families fired exits 255 and the stderr summary carries the
+    breakdown."""
+    if exit_code <= 0xFF:
+        return exit_code
+    return (exit_code & 0xFF) or 0xFF
 
 
 if __name__ == "__main__":
