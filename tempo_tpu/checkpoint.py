@@ -317,6 +317,13 @@ def load(path: str, mesh=None, series_axis: str = "series",
     man = _manifest(path)
     if verify:
         _verify_file_checksums(path, man)
+    if man["kind"] == "stream_state":
+        raise CheckpointError(
+            f"{path!r} holds a serving StreamState snapshot, not a "
+            f"frame: restore it with checkpoint.load_state or "
+            f"tempo_tpu.serve.StreamingTSDF.resume",
+            kind=FailureKind.PERMANENT,
+        )
     if man["kind"] == "host":
         return _load_host(path, man)
     if mesh is None:
@@ -346,6 +353,67 @@ def _verify_file_checksums(path: str, man: dict) -> None:
 def _npz_checksums(man: dict, npz_name: str) -> Optional[Dict[str, int]]:
     sums = man.get("array_checksums") or {}
     return sums.get(npz_name)
+
+
+# ----------------------------------------------------------------------
+# Raw-array state snapshots (the serving engine's StreamState)
+# ----------------------------------------------------------------------
+
+def save_state(arrays: Dict[str, np.ndarray], path: str,
+               meta: Optional[dict] = None) -> None:
+    """Atomic, CRC'd snapshot of a flat ``name -> array`` dict — the
+    durability primitive behind ``StreamingTSDF.snapshot``.  Same
+    guarantees as :func:`save`: the directory appears fully written or
+    not at all (three-step swap, ``.bak`` fallback), every array CRC-32
+    is recorded in the manifest and verified on load, and snapshots
+    written under a ``step_NNNNN`` family compose with
+    :func:`list_steps` / :func:`latest` / :func:`prune` (keep-last-K).
+    ``meta`` rides in the manifest (JSON-serializable only).
+    Single-process: serving streams are single-writer by contract."""
+    tmp = path + ".tmp"
+    bak = path + ".bak"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        host = {k: np.asarray(v) for k, v in arrays.items()}
+        sums = _savez(os.path.join(tmp, "state.npz"), host)
+        man = {
+            "kind": "stream_state",
+            "array_checksums": {"state.npz": sums},
+            "meta": meta or {},
+        }
+        _write_manifest(tmp, man)
+        if os.path.exists(bak):
+            shutil.rmtree(bak)
+        if os.path.exists(path):
+            os.replace(path, bak)
+        os.replace(tmp, path)
+        shutil.rmtree(bak, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def load_state(path: str, verify: bool = True):
+    """Restore a :func:`save_state` snapshot: ``(arrays dict, meta)``.
+    ``verify=True`` checks every array against the manifest CRCs and
+    raises :class:`CheckpointError` naming the corrupt array; stale
+    ``.tmp`` residue is cleaned and a crash mid-swap falls back to
+    ``.bak`` exactly like :func:`load`."""
+    _clean_stale_tmp(path)
+    if not os.path.exists(os.path.join(path, "manifest.json")) \
+            and os.path.exists(os.path.join(path + ".bak",
+                                            "manifest.json")):
+        path = path + ".bak"
+    man = _manifest(path)
+    if man["kind"] != "stream_state":
+        raise CheckpointError(
+            f"{path!r} is a {man['kind']!r} checkpoint, not a "
+            f"StreamState snapshot: restore frames with checkpoint.load")
+    arrs = _load_npz(os.path.join(path, "state.npz"),
+                     _npz_checksums(man, "state.npz"), verify=verify)
+    return dict(arrs), man.get("meta") or {}
 
 
 # ----------------------------------------------------------------------

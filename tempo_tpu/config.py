@@ -126,6 +126,21 @@ KNOBS: Dict[str, Knob] = _knobs(
          "representative shapes the production-program registry is "
          "compiled at (clamped [16, 4096]; bigger = slower, closer to "
          "production extents)"),
+    Knob("TEMPO_TPU_SERVE_BATCH_ROWS", "int", "64",
+         "tempo_tpu/serve/executor",
+         "per-series row cap of one serving micro-batch: the executor "
+         "cuts a coalesced run when any series reaches it, bounding "
+         "the padded-bucket ladder (and therefore the cached-"
+         "executable set) the steady state cycles through"),
+    Knob("TEMPO_TPU_SERVE_QUEUE_DEPTH", "int", "1024",
+         "tempo_tpu/serve/executor",
+         "bound of the serving executor's tick queue; a full queue "
+         "blocks submit() — the backpressure signal"),
+    Knob("TEMPO_TPU_SERVE_CKPT_EVERY", "int", "0",
+         "tempo_tpu/serve/stream",
+         "snapshot the serving StreamState every N acked events "
+         "(CRC'd keep-last-K via checkpoint.save_state; 0 disables "
+         "automatic snapshots — snapshot() stays available)"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

@@ -1399,6 +1399,46 @@ def build_chunked_planes(l_ts, r_ts, r_valids, r_values,
     return keys, planes, plan, meta
 
 
+def asof_carry_init(n_cols: int, n_series: int):
+    """Explicit-array form of the chunked kernel's cross-chunk carry
+    scratch, for callers that thread the AS-OF fill state through
+    jitted programs instead of a VMEM grid (the online serving engine,
+    ``tempo_tpu/serve/state.py``).
+
+    The kernel carries, per series row: the last filled value of every
+    payload plane (NaN = nothing yet), the live series id, and — for
+    maxLookback — the source's global merged position.  Lifted out of
+    scratch that is exactly, per series ``k``:
+
+    * ``last_val [C, K] f32``  — last *valid* right value per column
+      (NaN-encoded, the per-column skipNulls=True fill state);
+    * ``last_src [C, K] i64``  — merged-stream position of that source
+      (the psrc plane; init far-negative so any horizon is expired);
+    * ``lock_val [C, K] f32`` / ``lock_valid [C, K] bool`` /
+      ``lock_src [K] i64`` — the single last right row (values, raw;
+      validity flags; merged position): the lockstep skipNulls=False
+      fill state AND the unconditional last-right-row channel;
+    * ``last_ridx [K] i64`` — that row's within-side index (-1 none);
+    * ``n_merged [K] i64`` — merged positions consumed so far (both
+      sides count, exactly like lanes of the merged stream).
+
+    Fills select values, they never compute, so a carry threaded across
+    any batch split reproduces the batch join bit-for-bit — the same
+    argument that makes the chunked kernel bit-identical to the
+    single-plan form at any chunk width."""
+    C, K = int(n_cols), int(n_series)
+    far = np.int64(-(1 << 62))
+    return {
+        "last_val": np.full((C, K), np.nan, np.float32),
+        "last_src": np.full((C, K), far, np.int64),
+        "lock_val": np.full((C, K), np.nan, np.float32),
+        "lock_valid": np.zeros((C, K), bool),
+        "lock_src": np.full((K,), far, np.int64),
+        "last_ridx": np.full((K,), -1, np.int64),
+        "n_merged": np.zeros((K,), np.int64),
+    }
+
+
 def asof_merge_indices_chunked(l_ts, r_ts, r_valids,
                                l_sid=None, r_sid=None,
                                l_seq=None, r_seq=None,

@@ -468,6 +468,42 @@ def ema_compat(x: jnp.ndarray, valid: jnp.ndarray, window: int, exp_factor: floa
     return y[:, 0, :]
 
 
+def ema_scan(x: jnp.ndarray, valid: jnp.ndarray, alpha,
+             y0: jnp.ndarray = None):
+    """Sequential (``lax.scan``) twin of :func:`ema_exact` with an
+    explicit carry: ``(ys, y_end)`` where ``ys`` is the EMA at every
+    position and ``y_end`` the carry after the last one.
+
+    Same recurrence — ``y_t = decay_t * y_{t-1} + inp_t`` with
+    ``decay = 1-a`` / ``inp = a*x`` at valid rows and ``1`` / ``0`` at
+    null rows — but evaluated strictly left-to-right, ONE multiply-add
+    per element.  That makes it **split-invariant bitwise**: feeding
+    ``y_end`` back as ``y0`` across any batch boundary reproduces the
+    unsplit run bit-for-bit, which is the contract the online serving
+    engine is built on (``tempo_tpu/serve/state.py``).
+    :func:`ema_exact`'s ``associative_scan`` computes the same values
+    through a combine tree whose bracketing — and therefore f32
+    rounding — depends on the total length, so it cannot be resumed
+    mid-stream exactly.  ``y0=None`` starts from the zero carry, which
+    matches the scan's implicit start exactly (``0*d + i == i``)."""
+    a = jnp.asarray(alpha, x.dtype)
+    one = jnp.asarray(1.0, x.dtype)
+    zero = jnp.asarray(0.0, x.dtype)
+    decay = jnp.where(valid, one - a, one)
+    inp = jnp.where(valid, a * x, zero)
+    if y0 is None:
+        y0 = jnp.zeros(x.shape[:-1], x.dtype)
+
+    def step(y, di):
+        d, i = di
+        y2 = d * y + i
+        return y2, y2
+
+    y_end, ys = jax.lax.scan(
+        step, y0, (jnp.moveaxis(decay, -1, 0), jnp.moveaxis(inp, -1, 0)))
+    return jnp.moveaxis(ys, 0, -1), y_end
+
+
 @jax.jit
 def ema_exact(x: jnp.ndarray, valid: jnp.ndarray, alpha: float) -> jnp.ndarray:
     """Exact infinite-horizon EMA y_t = (1-a) y_{t-1} + a x_t via an

@@ -71,6 +71,19 @@ class PlanCache:
                 self._entries.popitem(last=False)
                 self.evictions += 1
 
+    def get_or_build(self, key: Optional[tuple], build):
+        """Cached executable for ``key``, invoking ``build()`` (and
+        recording the build) on a miss.  The lookup/insert pair every
+        steady-state consumer wants — the serving engine's per-bucket
+        step programs go through here, so its zero-recompile claim is
+        checkable from the same counters as the planner's
+        (``profiling.plan_cache_stats``)."""
+        exe = self.lookup(key)
+        if exe is None:
+            exe = build()
+            self.insert(key, exe)
+        return exe
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {

@@ -47,6 +47,10 @@ Registry coverage map (program -> production user):
 ``engine.join_bitonic`` /       ``pick_range_engine`` XLA engine forms
 ``engine.range_shifted`` /      (ops/sortmerge.py, ops/pallas_merge.py
 ``engine.range_windowed``       bitonic network, ops/rolling.py RMQ)
+``serve.step``                  the online serving engine's
+                                steady-state push step
+                                (tempo_tpu/serve/state.py: AS-OF +
+                                EMA + window carries, donated)
 ==============================  =======================================
 
 The Mosaic-lowered engines (lane-chunked join, streaming window
@@ -437,6 +441,29 @@ def _build_mesh_chain():
              drop_leading=1),
     ))
     return programs, [chain]
+
+
+@register("serve.step")
+def _build_serve_step():
+    """The steady-state serving push step (serve/state.py): ONE jitted
+    program advancing the AS-OF join carry, the EMA carry and the
+    ring-buffer window state per micro-batch.  Contract: every retired
+    state buffer is donated (the steady state must update in place —
+    a dropped donation doubles serving HBM per tick), no f64 creep
+    (f32 value planes, integer timestamp/position math), no host
+    transfers (the executor loop may never bounce through python
+    mid-tick)."""
+    from tempo_tpu.serve import state as serve_state
+
+    cfg = serve_state.StreamConfig(
+        n_series=CONTRACT_SERIES, n_cols=2, skip_nulls=True,
+        max_lookback=16, window_ns=serve_state.window_ns(_WINDOW_SECS),
+        rows_bound=8, ema_alpha=0.2)
+    Lb = 8
+    fn, n_state = serve_state.push_jitted(cfg, Lb)
+    compiled = fn.lower(*serve_state.push_avals(cfg, Lb)).compile()
+    contract = Contract(donate_argnums=tuple(range(n_state)))
+    return CompiledProgram("serve.step", compiled, contract)
 
 
 @register("dist.range_stats_windowed", requires_devices=CONTRACT_SERIES)

@@ -1,0 +1,24 @@
+"""Online serving engine: incremental StreamingTSDF operators behind
+an async micro-batch executor.
+
+The batch library re-touches history on every answer; this package is
+the long-lived-process form of the same operators: explicit carry
+state (``serve/state.py`` — the chunked merge kernel's cross-chunk
+scratch lifted into jitted-function carries), a streaming frame
+(``serve/stream.py`` — ``push`` / ``push_left`` emitting results for
+exactly the new rows, bitwise-equal to the batch operators over the
+concatenated history), a shape-bucketing background executor
+(``serve/executor.py`` — bounded queue, backpressure, p50/p99 latency
+stamps, zero-recompile steady state through the planner's executable
+cache), and crash-resume via CRC'd StreamState snapshots
+(``tempo_tpu/checkpoint.py:save_state`` / ``StreamingTSDF.resume``).
+"""
+
+from tempo_tpu.serve.executor import MicroBatchExecutor, Ticket
+from tempo_tpu.serve.state import StreamConfig, init_state, window_stats_batch
+from tempo_tpu.serve.stream import LateTickError, StreamingTSDF
+
+__all__ = [
+    "StreamingTSDF", "MicroBatchExecutor", "Ticket", "LateTickError",
+    "StreamConfig", "init_state", "window_stats_batch",
+]

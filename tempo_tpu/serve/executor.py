@@ -1,0 +1,235 @@
+"""Async micro-batch executor: the serving front door.
+
+A background worker drains a **bounded** tick queue
+(``TEMPO_TPU_SERVE_QUEUE_DEPTH``; a full queue blocks ``submit`` — the
+backpressure signal) into shape-bucketed, padded micro-batches: ticks
+are coalesced greedily, split into side-homogeneous runs **in arrival
+order** (a push and a query can never be reordered around each other —
+that would change merged-stream positions), capped at
+``TEMPO_TPU_SERVE_BATCH_ROWS`` rows per series, and dispatched through
+``StreamingTSDF.push`` / ``push_left``.  Padded row counts land on a
+handful of power-of-two buckets, so the steady state runs a small
+fixed set of cached executables (``plan/cache.py``) with zero
+recompiles — asserted, not hoped, by the serving bench.
+
+Every tick carries latency stamps (submit -> batch completion, queue
+wait included — the number a caller actually experiences);
+``latency_stats()`` reports p50/p99 per side.  ``close()`` drains
+gracefully: everything already submitted completes, then the worker
+exits.  A batch failure is delivered on each affected ticket's
+``result()``, never swallowed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tempo_tpu import config
+from tempo_tpu.serve import stream as stream_mod
+
+_CLOSE = object()
+
+
+class Ticket:
+    """One submitted tick: a waitable handle for its per-row result."""
+
+    __slots__ = ("kind", "series", "ts", "seq", "values", "t_submit",
+                 "t_done", "_event", "_result", "_exc")
+
+    def __init__(self, kind, series, ts, seq, values):
+        self.kind = kind
+        self.series = series
+        self.ts = ts
+        self.seq = seq
+        self.values = values
+        self.t_submit = time.perf_counter()
+        self.t_done = None
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def _finish(self, result=None, exc=None):
+        self._result, self._exc = result, exc
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Per-row emission dict for this tick (blocks until its
+        micro-batch completes); re-raises the batch's failure."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("tick not processed yet")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+
+class MicroBatchExecutor:
+    """See module docstring.  While an executor is attached, all
+    traffic must go through it (``StreamingTSDF`` itself is
+    single-writer)."""
+
+    def __init__(self, stream, queue_depth: Optional[int] = None,
+                 batch_rows: Optional[int] = None):
+        if queue_depth is None:
+            queue_depth = config.get_int("TEMPO_TPU_SERVE_QUEUE_DEPTH",
+                                         1024)
+        if batch_rows is None:
+            batch_rows = config.get_int("TEMPO_TPU_SERVE_BATCH_ROWS", 64)
+        self.stream = stream
+        self.batch_rows = max(1, int(batch_rows))
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
+        self._latencies: Dict[str, List[float]] = {"right": [],
+                                                   "left": []}
+        self.batches = 0
+        self.ticks = 0
+        self.bucket_hist: Dict[int, int] = {}
+        self._closed = False
+        # serializes the closed-check+enqueue against close(): without
+        # it a tick can land BEHIND the close sentinel and hang its
+        # result() forever
+        self._submit_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tempo-serve-executor")
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------
+
+    def submit(self, kind: str, series, ts, values=None, seq=None,
+               timeout: Optional[float] = None) -> Ticket:
+        """Enqueue one tick (``kind`` 'right' = data, 'left' = query).
+        Blocks while the queue is full (backpressure); a ``timeout``
+        surfaces ``queue.Full`` instead of waiting forever."""
+        if kind not in ("right", "left"):
+            raise ValueError(f"kind must be 'right' or 'left', got "
+                             f"{kind!r}")
+        t = Ticket(kind, series, ts, seq, values)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("executor is closed")
+            self._q.put(t, block=True, timeout=timeout)
+        return t
+
+    def close(self, timeout: Optional[float] = None):
+        """Graceful drain: stop accepting, process everything already
+        queued, stop the worker."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_CLOSE)
+        self._thread.join(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    # -- worker side ---------------------------------------------------
+
+    def _run(self):
+        closing = False
+        while not closing:
+            item = self._q.get()
+            if item is _CLOSE:
+                break
+            group = [item]
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is _CLOSE:
+                    closing = True
+                    break
+                group.append(nxt)
+            for batch in self._split(group):
+                self._process(batch)
+
+    def _split(self, group: List[Ticket]):
+        """Side-homogeneous runs in arrival order, cut when any series
+        reaches the per-batch row cap."""
+        batch: List[Ticket] = []
+        counts: Dict[object, int] = {}
+        for t in group:
+            if batch and (t.kind != batch[0].kind
+                          or counts.get(t.series, 0) >= self.batch_rows):
+                yield batch
+                batch, counts = [], {}
+            batch.append(t)
+            counts[t.series] = counts.get(t.series, 0) + 1
+        if batch:
+            yield batch
+
+    def _process(self, batch: List[Ticket]):
+        kind = batch[0].kind
+        try:
+            # conversions live INSIDE the failure boundary: a bad
+            # ts/seq/value payload poisons its own batch, not the
+            # worker thread
+            series = [t.series for t in batch]
+            ts = np.array([t.ts for t in batch], np.int64)
+            seq = None
+            if any(t.seq is not None for t in batch):
+                seq = np.array([np.nan if t.seq is None else t.seq
+                                for t in batch], np.float64)
+            if kind == "right":
+                cols = self.stream.value_cols
+                values = {c: np.array([t.values[c] for t in batch],
+                                      np.float32) for c in cols}
+                out = self.stream.push(series, ts, values, seq=seq)
+            else:
+                out = self.stream.push_left(series, ts, seq=seq)
+        except Exception as e:       # delivered on each ticket's
+            for t in batch:          # result(); the worker lives on
+                t._finish(exc=e)
+            return
+        self.batches += 1
+        self.ticks += len(batch)
+        counts: Dict[object, int] = {}
+        for t in batch:
+            counts[t.series] = counts.get(t.series, 0) + 1
+        b = stream_mod._bucket(max(counts.values()))
+        self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1
+        for i, t in enumerate(batch):
+            t._finish(result={k: v[i] for k, v in out.items()})
+            lat = t.latency_s
+            if lat is not None:
+                self._latencies[kind].append(lat)
+
+    # -- metrics -------------------------------------------------------
+
+    def latency_stats(self) -> Dict[str, dict]:
+        """p50/p99 (milliseconds) + count per side, and pooled."""
+        out = {}
+        pooled: List[float] = []
+        for kind, lats in self._latencies.items():
+            pooled.extend(lats)
+            out[kind] = self._pcts(lats)
+        out["all"] = self._pcts(pooled)
+        return out
+
+    @staticmethod
+    def _pcts(lats: List[float]) -> dict:
+        if not lats:
+            return {"count": 0, "p50_ms": None, "p99_ms": None}
+        s = sorted(lats)
+        pick = lambda q: s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+        return {"count": len(s),
+                "p50_ms": round(pick(0.50) * 1e3, 3),
+                "p99_ms": round(pick(0.99) * 1e3, 3)}

@@ -1,0 +1,431 @@
+"""Incremental operator state for the online serving engine.
+
+Three operator states, each with an ``init / update(batch) / query``
+contract, all held as explicit device arrays threaded through jitted
+step programs (carries in, carries out, retired buffers donated):
+
+* **AS-OF join carry** — the chunked merge kernel's cross-chunk VMEM
+  scratch (``ops/pallas_merge.py:_make_chunked_kernel``: last filled
+  value per payload plane, live series id, maxLookback source
+  positions) lifted into named arrays
+  (``pallas_merge.asof_carry_init``).  Fills *select* values, they
+  never compute, so threading the carry across any push split is
+  bit-identical to the batch join over the concatenated history — the
+  same argument that makes the chunked engine bit-identical to the
+  single-plan kernel.
+* **EMA scan carry** — ``ops/rolling.py:ema_scan``'s ``y`` carry: one
+  multiply-add per element, strictly left-to-right, so resuming from
+  the carry is exact (``ema_exact``'s associative-scan tree is not
+  resumable bitwise; see ``ema_scan``'s docstring).
+* **ring-buffer window state** — the last ``rows_bound + 1`` right
+  rows per series (timestamps, values, validity).  Range/rows stats
+  for a new row are computed by the same masked shifted-pass loop
+  (``_window_passes``) over ``[ring | batch]`` that the batch
+  reference :func:`window_stats_batch` runs over ``[fill | history]``
+  — identical op sequence over identical operands, hence bitwise
+  identity by construction.  NOTE these serving stats are the *causal,
+  uncentred* window form: ``withRangeStats``'s engines centre every
+  series on its full-history mean (``sortmerge.range_stats_shifted``)
+  — a value that changes when future rows arrive — so their per-row
+  bits are unknowable mid-stream by construction.  The serving form
+  drops the centring (and the Spark following-ties extension) and is
+  its own batch operator.
+
+Every step program is AOT-compiled once per (config, padded-batch
+bucket) and cached in the planner's executable cache
+(``tempo_tpu/plan/cache.py``), so the steady state is recompile-free
+and the claim is checkable via ``profiling.plan_cache_stats()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.ops import pallas_merge as pm
+from tempo_tpu.ops import rolling as ops_rolling
+from tempo_tpu.packing import TS_PAD
+
+_FAR_PAST = np.int64(-(1 << 62))
+
+
+def window_ns(window_secs) -> int:
+    """Window width in integer nanoseconds.  Membership ``ts >= t - w``
+    over int64-ns keys equals ``ts >= t - floor(w_ns)`` (the
+    ``rolling.range_window_width`` argument, applied in the ns domain):
+    every float width folds to an exact integer compare, no float
+    timestamp math anywhere in the serving programs."""
+    return int(math.floor(float(window_secs) * 1e9))
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Static configuration of one stream: everything that shapes the
+    compiled step programs (state-array layout included)."""
+
+    n_series: int                       # K lane rows, fixed for life
+    n_cols: int                         # C metric columns
+    skip_nulls: bool = True
+    max_lookback: int = 0               # merged-row horizon; 0 = off
+    window_ns: Optional[int] = None     # range-stats width; None = off
+    rows_bound: int = 64                # ring capacity D (declared max
+    #                                     rows any window reaches back)
+    ema_alpha: Optional[float] = None   # EMA factor; None = off
+
+    @property
+    def has_window(self) -> bool:
+        return self.window_ns is not None
+
+    @property
+    def has_ema(self) -> bool:
+        return self.ema_alpha is not None
+
+    def state_names(self) -> Tuple[str, ...]:
+        names = ["last_val", "last_src", "lock_val", "lock_valid",
+                 "lock_src", "last_ridx", "r_count", "n_merged"]
+        if self.has_ema:
+            names.append("ema_y")
+        if self.has_window:
+            names += ["ring_ts", "ring_x", "ring_valid", "clipped"]
+        return tuple(names)
+
+    def key(self) -> tuple:
+        return (self.n_series, self.n_cols, self.skip_nulls,
+                self.max_lookback, self.window_ns, self.rows_bound,
+                self.ema_alpha)
+
+
+def init_state(cfg: StreamConfig) -> Dict[str, np.ndarray]:
+    """Fresh carry arrays for every operator the config enables (the
+    ``init`` leg of the operator contract)."""
+    C, K = cfg.n_cols, cfg.n_series
+    state = pm.asof_carry_init(C, K)
+    state["r_count"] = np.zeros((K,), np.int64)
+    if cfg.has_ema:
+        state["ema_y"] = np.zeros((C, K), np.float32)
+    if cfg.has_window:
+        R = cfg.rows_bound + 1   # +1 keeps the truncation-audit row
+        state["ring_ts"] = np.full((K, R), TS_PAD, np.int64)
+        state["ring_x"] = np.zeros((C, K, R), np.float32)
+        state["ring_valid"] = np.zeros((C, K, R), bool)
+        state["clipped"] = np.zeros((K,), np.int64)
+    return {name: state[name] for name in cfg.state_names()}
+
+
+# ----------------------------------------------------------------------
+# Shared window-pass structure (streaming step == batch reference)
+# ----------------------------------------------------------------------
+
+def _lag(a, d: int):
+    """out[..., i] = a[..., i - d] (the ``sortmerge._shift_back``
+    shape, re-stated here so both window forms trace the identical
+    op).  Fill lanes are never consumed by in-range outputs — the ring
+    prefix guarantees ``i - d >= 0`` for every emitted lane — but the
+    constant must still match across the two forms, which sharing this
+    helper enforces."""
+    if d == 0:
+        return a
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        fill = jnp.asarray(np.iinfo(np.int64).max, a.dtype)
+    elif a.dtype == jnp.bool_:
+        fill = False
+    else:
+        fill = jnp.float32(0.0)
+    pad = jnp.full(a.shape[:-1] + (d,), fill, a.dtype)
+    return jnp.concatenate([pad, a[..., :-d]], axis=-1)
+
+
+def _window_passes(ext_ts, ext_xs, ext_valids, w_ns: int, D: int,
+                   n_out: int):
+    """Causal range-window stats for the trailing ``n_out`` lanes of an
+    extended layout ``[prefix(D+1) | rows]``: ``D+1`` masked shifted
+    passes (self + up to ``D`` preceding rows), accumulation order
+    d = 0, 1, ..., D — the uncentred twin of
+    ``sortmerge._range_stats_shifted_xla``'s loop.  The prefix is the
+    ring (streaming) or inert fill (batch); rows beyond it never enter
+    a window because their keys sit >= ``w_ns`` above any real key
+    (TS_PAD headroom), the same pad argument as the batch engine's.
+
+    Returns ``(stats dict of [C, K, n_out] planes, clipped [K, n_out]
+    bool)`` where ``clipped`` marks rows whose true window reaches past
+    the declared ``D``-row bound (the pass-``D+1`` audit — the reason
+    the prefix holds ``D+1`` rows)."""
+    f32 = jnp.float32
+    ts = ext_ts[:, -n_out:]
+    lo = ts - jnp.asarray(w_ns, ext_ts.dtype)
+    x_self = ext_xs[..., -n_out:]
+    v_self = ext_valids[..., -n_out:]
+    pinf = f32(jnp.inf)
+
+    cnt = jnp.zeros_like(x_self)
+    s1 = jnp.zeros_like(x_self)
+    s2 = jnp.zeros_like(x_self)
+    mn = jnp.full_like(x_self, pinf)
+    mx = jnp.full_like(x_self, -pinf)
+    for d in range(D + 1):
+        sj = _lag(ext_ts, d)[:, -n_out:]
+        xj = _lag(ext_xs, d)[..., -n_out:]
+        vj = _lag(ext_valids, d)[..., -n_out:]
+        inw = ((sj >= lo) & (sj <= ts))[None] & vj
+        cnt = cnt + inw.astype(jnp.float32)
+        s1 = s1 + jnp.where(inw, xj, f32(0.0))
+        s2 = s2 + jnp.where(inw, xj * xj, f32(0.0))
+        mn = jnp.minimum(mn, jnp.where(inw, xj, pinf))
+        mx = jnp.maximum(mx, jnp.where(inw, xj, -pinf))
+
+    one = f32(1.0)
+    mean = jnp.where(cnt > 0, s1 / jnp.maximum(cnt, one), f32(jnp.nan))
+    var = jnp.where(
+        cnt > 1,
+        (s2 - s1 * s1 / jnp.maximum(cnt, one))
+        / jnp.maximum(cnt - one, one),
+        f32(jnp.nan))
+    std = jnp.where(cnt > 1, jnp.sqrt(jnp.maximum(var, f32(0.0))),
+                    f32(jnp.nan))
+    stats = {
+        "mean": mean,
+        "count": cnt,
+        "min": jnp.where(cnt > 0, mn, f32(jnp.nan)),
+        "max": jnp.where(cnt > 0, mx, f32(jnp.nan)),
+        "sum": jnp.where(cnt > 0, s1, f32(jnp.nan)),
+        "stddev": std,
+        "zscore": jnp.where(v_self, (x_self - mean) / std, f32(jnp.nan)),
+    }
+    sjD = _lag(ext_ts, D + 1)[:, -n_out:]
+    vD = _lag(ext_valids, D + 1)[..., -n_out:]
+    clip = ((sjD >= lo) & (sjD <= ts))[None] & (v_self | vD)
+    return stats, jnp.any(clip, axis=0)
+
+
+def window_stats_batch(ts, xs, valids, w_ns: int, rows_bound: int):
+    """Batch reference of the serving window stats: the identical
+    ``_window_passes`` loop over ``[fill | full history]``.  Streaming
+    the same history through any push split reproduces these planes
+    bit-for-bit (tests/test_serve.py pins it).  Returns ``(stats dict
+    of [C, K, L] planes, clipped-row count [K])``."""
+    ts = jnp.asarray(ts)
+    xs = jnp.asarray(xs)
+    valids = jnp.asarray(valids)
+    C, K, L = xs.shape
+    R = int(rows_bound) + 1
+    ext_ts = jnp.concatenate(
+        [jnp.full((K, R), TS_PAD, ts.dtype), ts], axis=-1)
+    ext_xs = jnp.concatenate(
+        [jnp.zeros((C, K, R), xs.dtype), xs], axis=-1)
+    ext_valids = jnp.concatenate(
+        [jnp.zeros((C, K, R), bool), valids], axis=-1)
+    stats, clip = _window_passes(ext_ts, ext_xs, ext_valids, int(w_ns),
+                                 int(rows_bound), L)
+    return stats, jnp.sum(clip, axis=-1).astype(jnp.int64)
+
+
+# ----------------------------------------------------------------------
+# The jitted step programs
+# ----------------------------------------------------------------------
+
+_STAT_KEYS = ("mean", "count", "min", "max", "sum", "stddev", "zscore")
+
+
+def _last_lane(cond, lanes):
+    """(index of the last True lane, any True) per row — the carry
+    update's only primitive: a max-select, never arithmetic."""
+    idx = jnp.max(jnp.where(cond, lanes, jnp.int64(-1)), axis=-1)
+    return idx, idx >= 0
+
+
+def _at_lane(plane, idx):
+    """plane[..., idx] per row (idx clamped; callers mask on has)."""
+    return jnp.take_along_axis(
+        plane, jnp.maximum(idx, 0)[..., None], axis=-1)[..., 0]
+
+
+def _push_fn(cfg: StreamConfig, Lb: int):
+    """The steady-state serving step: ONE jitted program advancing the
+    AS-OF carry, the EMA carry, and the ring-buffer window state with a
+    right-side micro-batch, emitting stats/EMA planes for exactly the
+    new rows.  ``[K, Lb]`` batches are left-aligned per series (``mask``
+    a prefix mask, ``counts`` its row sums); pad lanes carry TS_PAD
+    keys and NaN values so every masked op ignores them."""
+    C, K = cfg.n_cols, cfg.n_series
+    lanes64 = jnp.arange(Lb, dtype=jnp.int64)
+
+    def step(*args):
+        names = cfg.state_names()
+        st = dict(zip(names, args[:len(names)]))
+        ts, xs, mask, counts = args[len(names):]
+        valids = mask[None] & ~jnp.isnan(xs)          # packing invariant
+        new = {}
+
+        # ---- AS-OF carry update (selection only, bit-exact) ----------
+        lidx, lhas = _last_lane(valids, lanes64[None, None])   # [C, K]
+        new["last_val"] = jnp.where(lhas, _at_lane(xs, lidx),
+                                    st["last_val"])
+        new["last_src"] = jnp.where(
+            lhas, st["n_merged"][None] + lidx, st["last_src"])
+        rows_has = counts > 0
+        last = jnp.maximum(counts - 1, 0)
+        new["lock_val"] = jnp.where(
+            rows_has[None], _at_lane(xs, last[None].repeat(C, 0)),
+            st["lock_val"])
+        new["lock_valid"] = jnp.where(
+            rows_has[None], _at_lane(valids, last[None].repeat(C, 0)),
+            st["lock_valid"])
+        new["lock_src"] = jnp.where(
+            rows_has, st["n_merged"] + counts - 1, st["lock_src"])
+        new["last_ridx"] = jnp.where(
+            rows_has, st["r_count"] + counts - 1, st["last_ridx"])
+        new["r_count"] = st["r_count"] + counts
+        new["n_merged"] = st["n_merged"] + counts
+
+        emits = {}
+        # ---- EMA scan carry ------------------------------------------
+        if cfg.has_ema:
+            ys, y_end = ops_rolling.ema_scan(
+                xs, valids, np.float32(cfg.ema_alpha), y0=st["ema_y"])
+            new["ema_y"] = y_end
+            emits["ema"] = ys
+
+        # ---- ring-buffer window stats --------------------------------
+        if cfg.has_window:
+            R = cfg.rows_bound + 1
+            ext_ts = jnp.concatenate([st["ring_ts"], ts], axis=-1)
+            ext_xs = jnp.concatenate([st["ring_x"], xs], axis=-1)
+            ext_valids = jnp.concatenate([st["ring_valid"], valids],
+                                         axis=-1)
+            stats, clip = _window_passes(ext_ts, ext_xs, ext_valids,
+                                         cfg.window_ns, cfg.rows_bound,
+                                         Lb)
+            emits.update(stats)
+            new["clipped"] = st["clipped"] + jnp.sum(
+                clip & mask, axis=-1).astype(jnp.int64)
+            # retire the oldest ``counts`` rows: the new ring is the
+            # last R real rows of [ring | batch] (batches are
+            # left-aligned, so real rows end at lane R + counts - 1)
+            ridx = (jnp.arange(R, dtype=jnp.int64)[None]
+                    + counts[:, None])                     # [K, R]
+            new["ring_ts"] = jnp.take_along_axis(ext_ts, ridx, axis=-1)
+            new["ring_x"] = jnp.take_along_axis(
+                ext_xs, ridx[None].repeat(C, 0), axis=-1)
+            new["ring_valid"] = jnp.take_along_axis(
+                ext_valids, ridx[None].repeat(C, 0), axis=-1)
+
+        return tuple(new[n] for n in cfg.state_names()), emits
+
+    return step
+
+
+def _query_fn(cfg: StreamConfig, Lb: int):
+    """The AS-OF query step: answers for a left micro-batch straight
+    from the carry (every right row in history precedes every row of an
+    accepted left batch in merged order — the push-ordering contract),
+    with per-row maxLookback expiry on the carried source positions.
+    Left rows consume merged positions, so the carry's ``n_merged``
+    advances — querying mutates state."""
+    lanes64 = jnp.arange(Lb, dtype=jnp.int64)
+    ml = int(cfg.max_lookback)
+
+    C, K = cfg.n_cols, cfg.n_series
+
+    def step(last_val, last_src, lock_val, lock_valid, lock_src,
+             last_ridx, r_count, n_merged, counts):
+        pos = n_merged[:, None] + lanes64[None]           # [K, Lb]
+        ok_row = jnp.broadcast_to((r_count > 0)[:, None], (K, Lb))
+        if ml:
+            ok_row = ok_row & (pos - lock_src[:, None] <= ml)
+        if cfg.skip_nulls:
+            found = jnp.broadcast_to(
+                ~jnp.isnan(last_val)[:, :, None], (C, K, Lb))
+            if ml:
+                found = found & (pos[None] - last_src[:, :, None] <= ml)
+            vals = jnp.where(
+                found, last_val[:, :, None], jnp.float32(jnp.nan))
+        else:
+            found = ok_row[None] & lock_valid[:, :, None]
+            vals = jnp.where(
+                found, lock_val[:, :, None], jnp.float32(jnp.nan))
+        idx = jnp.where(ok_row, last_ridx[:, None],
+                        jnp.int64(-1)).astype(jnp.int32)
+        return n_merged + counts, (vals, found, idx)
+
+    return step
+
+
+def _abstract(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def state_avals(cfg: StreamConfig):
+    """ShapeDtypeStructs of the state tuple, in ``state_names`` order."""
+    return tuple(_abstract(a.shape, a.dtype)
+                 for a in init_state(cfg).values())
+
+
+def push_avals(cfg: StreamConfig, Lb: int):
+    C, K = cfg.n_cols, cfg.n_series
+    return state_avals(cfg) + (
+        _abstract((K, Lb), np.int64),        # ts
+        _abstract((C, K, Lb), np.float32),   # xs
+        _abstract((K, Lb), np.bool_),        # mask
+        _abstract((K,), np.int64),           # counts
+    )
+
+
+def push_jitted(cfg: StreamConfig, Lb: int):
+    """``(jitted push step, n_state)`` — the retired state buffers are
+    donated, so the steady state updates in place (the compiled
+    artifact's input_output_aliases; checked by the ``serve.step``
+    compiled contract)."""
+    n_state = len(cfg.state_names())
+    fn = jax.jit(_push_fn(cfg, Lb),
+                 donate_argnums=tuple(range(n_state)))
+    return fn, n_state
+
+
+_QUERY_STATE = ("last_val", "last_src", "lock_val", "lock_valid",
+                "lock_src", "last_ridx", "r_count", "n_merged")
+
+
+def query_jitted(cfg: StreamConfig, Lb: int):
+    # only n_merged is retired by a query
+    return jax.jit(_query_fn(cfg, Lb), donate_argnums=(7,))
+
+
+def query_avals(cfg: StreamConfig, Lb: int):
+    base = dict(zip(cfg.state_names(), state_avals(cfg)))
+    K = cfg.n_series
+    return tuple(base[n] for n in _QUERY_STATE) + (
+        _abstract((K,), np.int64),)
+
+
+def _cache_key(kind: str, cfg: StreamConfig, Lb: int):
+    return ("serve", kind, cfg.key(), Lb, jax.default_backend())
+
+
+def push_executable(cfg: StreamConfig, Lb: int):
+    """AOT-compiled push program for one padded-batch bucket, through
+    the planner's LRU executable cache (hit/miss/build counters in
+    ``profiling.plan_cache_stats`` — the zero-recompile steady state is
+    a checked invariant, not a hope)."""
+    from tempo_tpu.plan.cache import CACHE
+
+    def build():
+        fn, _ = push_jitted(cfg, Lb)
+        return fn.lower(*push_avals(cfg, Lb)).compile()
+
+    return CACHE.get_or_build(_cache_key("push", cfg, Lb), build)
+
+
+def query_executable(cfg: StreamConfig, Lb: int):
+    from tempo_tpu.plan.cache import CACHE
+
+    def build():
+        return query_jitted(cfg, Lb).lower(
+            *query_avals(cfg, Lb)).compile()
+
+    return CACHE.get_or_build(_cache_key("query", cfg, Lb), build)

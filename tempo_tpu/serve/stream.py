@@ -1,0 +1,380 @@
+"""``StreamingTSDF``: the online serving frame.
+
+A long-lived stream over a fixed set of series: ``push(...)`` ingests
+right-side ticks (advancing the AS-OF join carry, the EMA carry and
+the ring-buffer window state, emitting stats/EMA for exactly the new
+rows), ``push_left(...)`` answers AS-OF queries for new left rows from
+the carry.  Emissions are **bitwise-equal** to running the batch
+operators over the concatenated history at any push split — ties, NaN
+runs, sequence columns and maxLookback expiry straddling push
+boundaries included (tests/test_serve.py pins the full matrix against
+``ops/sortmerge.asof_merge_values`` / ``serve.state.window_stats_batch``
+/ ``ops/rolling.ema_scan``).
+
+**Ordering contract**: events must arrive in each series' merged-stream
+order — non-decreasing ``(ts, seq, side)`` with right rows before left
+rows on full key ties (the batch sort's tie-break, rec_ind -1 < 1).  A
+violating tick raises :class:`LateTickError` naming the offender; it is
+never silently reordered (MIGRATION.md v0.9).  The constraint is
+per-series: series are independent merged streams.
+
+**Durability**: ``snapshot()`` writes the full carry (CRC'd, atomic,
+keep-last-K via ``tempo_tpu/checkpoint.py``); ``StreamingTSDF.resume``
+restores the newest intact snapshot and reports ``acked`` — the number
+of events already folded in — so a restarted server replays only the
+unacknowledged tail and lands on byte-identical output.
+``TEMPO_TPU_SERVE_CKPT_EVERY`` makes snapshots automatic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tempo_tpu import checkpoint as ckpt
+from tempo_tpu import config, resilience
+from tempo_tpu.packing import TS_PAD
+from tempo_tpu.serve import state as sst
+
+_SIDE_RIGHT = 0
+_SIDE_LEFT = 1
+_SIDE_NAMES = {_SIDE_RIGHT: "right", _SIDE_LEFT: "left"}
+
+
+class LateTickError(ValueError):
+    """An event arrived behind its series' merged-stream watermark.
+
+    The serving engine answers queries from a carry that only ever
+    moves forward; accepting a late tick would silently change answers
+    already emitted, so it is rejected by name instead of reordered."""
+
+    def __init__(self, series, ts, seq, side, wm):
+        self.series, self.ts, self.seq, self.side = series, ts, seq, side
+        super().__init__(
+            f"late {_SIDE_NAMES[side]} tick for series {series!r}: key "
+            f"(ts={ts}, seq={seq}) is behind the watermark "
+            f"(ts={wm[0]}, seq={wm[1]}, side={_SIDE_NAMES[wm[2]]}) — "
+            f"out-of-order events are rejected, not reordered")
+
+
+def _bucket(n: int) -> int:
+    """Padded per-series row count: next power of two, floor 8 — a
+    small fixed set of shapes so the steady state reuses a handful of
+    cached executables."""
+    b = 8
+    while b < n:
+        b *= 2
+    return b
+
+
+class StreamingTSDF:
+    """See module docstring.  ``series`` fixes the lane rows for the
+    stream's lifetime; ``value_cols`` the metric columns.  Operators
+    are opt-in: ``window_secs``/``window_rows_bound`` enable the
+    causal range-window stats (``rows_bound`` declares the most rows
+    any window may reach back — wider true windows are truncated and
+    counted on ``clipped``, the batch engines' declared-bound
+    contract), ``ema_alpha`` the EMA, ``max_lookback`` the merged-row
+    join horizon, ``skip_nulls`` the per-column vs lockstep fill."""
+
+    def __init__(self, series: Sequence, value_cols: Sequence[str], *,
+                 skip_nulls: bool = True, max_lookback: int = 0,
+                 window_secs=None, window_rows_bound: int = 64,
+                 ema_alpha=None, checkpoint_dir: Optional[str] = None,
+                 ckpt_every: Optional[int] = None, keep_last: int = 3):
+        self.series = list(series)
+        self.value_cols = [str(c) for c in value_cols]
+        if len(set(self.series)) != len(self.series):
+            raise ValueError("duplicate series keys")
+        self._row = {s: k for k, s in enumerate(self.series)}
+        K, C = len(self.series), len(self.value_cols)
+        self.cfg = sst.StreamConfig(
+            n_series=K, n_cols=C, skip_nulls=bool(skip_nulls),
+            max_lookback=int(max_lookback),
+            window_ns=(None if window_secs is None
+                       else sst.window_ns(window_secs)),
+            rows_bound=int(window_rows_bound),
+            ema_alpha=(None if ema_alpha is None else float(ema_alpha)))
+        self._state = sst.init_state(self.cfg)
+        self._wm_ts = np.full(K, sst._FAR_PAST, np.int64)
+        self._wm_seq = np.full(K, -np.inf, np.float64)
+        self._wm_side = np.zeros(K, np.int8)
+        self.acked = 0            # events folded into the carry
+        self.checkpoint_dir = checkpoint_dir
+        self.keep_last = int(keep_last)
+        if ckpt_every is None:
+            ckpt_every = config.get_int("TEMPO_TPU_SERVE_CKPT_EVERY", 0)
+        self.ckpt_every = int(ckpt_every or 0)
+        self._next_ckpt = self.ckpt_every or None
+        # per-stream strong references to the step executables, keyed
+        # (kind, bucket).  The shared planner LRU provides cross-stream
+        # reuse and the observability counters, but it may be disabled
+        # (TEMPO_TPU_PLAN_CACHE_SIZE=0) or evicted under mixed query
+        # pressure — the zero-recompile steady state of a LIVE stream
+        # must not hinge on either, so whatever this stream has built
+        # stays pinned for its lifetime (bounded by its bucket ladder)
+        self._exes = {}
+
+    # -- ordering ------------------------------------------------------
+
+    def _admit(self, rows, ts, seq, side: int):
+        """Validate merged-stream order per series and assign in-batch
+        lanes.  Returns ``(lanes, counts, commit)`` where ``commit()``
+        advances the watermarks — callers invoke it only after the
+        step program succeeded, so ANY failed batch (late tick, bad
+        payload, executable error) leaves the stream untouched and the
+        corrected batch replays cleanly."""
+        n = len(rows)
+        lanes = np.zeros(n, np.int64)
+        counts = np.zeros(self.cfg.n_series, np.int64)
+        wm_ts = self._wm_ts.copy()
+        wm_seq = self._wm_seq.copy()
+        wm_side = self._wm_side.copy()
+        for i in range(n):
+            k = rows[i]
+            key = (ts[i], seq[i], side)
+            wm = (wm_ts[k], wm_seq[k], int(wm_side[k]))
+            if key < wm:
+                raise LateTickError(self.series[k], ts[i], seq[i],
+                                    side, wm)
+            wm_ts[k], wm_seq[k], wm_side[k] = ts[i], seq[i], side
+            lanes[i] = counts[k]
+            counts[k] += 1
+
+        def commit():
+            self._wm_ts, self._wm_seq, self._wm_side = \
+                wm_ts, wm_seq, wm_side
+
+        return lanes, counts, commit
+
+    def _executable(self, kind: str, Lb: int):
+        exe = self._exes.get((kind, Lb))
+        if exe is None:
+            build = (sst.push_executable if kind == "push"
+                     else sst.query_executable)
+            exe = build(self.cfg, Lb)
+            self._exes[(kind, Lb)] = exe
+        return exe
+
+    def _rows_of(self, series_ids) -> List[int]:
+        try:
+            return [self._row[s] for s in series_ids]
+        except KeyError as e:
+            raise ValueError(
+                f"unknown series {e.args[0]!r}: a StreamingTSDF's "
+                f"series set is fixed at construction") from None
+
+    @staticmethod
+    def _check_lengths(n, ts, seq):
+        if len(ts) != n:
+            raise ValueError(
+                f"series_ids and ts are parallel arrays: got {n} "
+                f"series ids but {len(ts)} timestamps")
+        if seq is not None and len(seq) != n:
+            raise ValueError(
+                f"seq must align with series_ids: {len(seq)} != {n}")
+
+    def _values_planes(self, values, n):
+        """All value columns as aligned f32 arrays, validated BEFORE
+        any state (watermarks included) moves."""
+        out = []
+        for col in self.value_cols:
+            if col not in values:
+                raise ValueError(
+                    f"push() is missing value column {col!r} "
+                    f"(stream columns: {self.value_cols})")
+            v = np.atleast_1d(np.asarray(values[col], np.float32))
+            if len(v) != n:
+                raise ValueError(
+                    f"values[{col!r}] must align with series_ids: "
+                    f"{len(v)} != {n}")
+            out.append(v)
+        return out
+
+    @staticmethod
+    def _seq_array(seq, n):
+        if seq is None:
+            return np.full(n, -np.inf, np.float64)
+        s = np.asarray(seq, np.float64)
+        return np.where(np.isnan(s), -np.inf, s)   # NULLS FIRST
+
+    # -- ingest --------------------------------------------------------
+
+    def push(self, series_ids, ts, values: Dict[str, np.ndarray],
+             seq=None) -> Dict[str, np.ndarray]:
+        """Ingest right-side ticks (one event per element of the
+        parallel arrays; ``values`` maps column name -> array, NaN =
+        null).  Returns per-event emissions for the enabled operators
+        (``<col>_ema``, ``<col>_mean`` ... in input order), bitwise
+        what the batch operators emit for those rows over the
+        concatenated history."""
+        rows = self._rows_of(series_ids)
+        ts = np.atleast_1d(np.asarray(ts, np.int64))
+        n = len(rows)
+        self._check_lengths(n, ts, seq)
+        planes = self._values_planes(values, n)
+        seqf = self._seq_array(seq, n)
+        lanes, counts, commit = self._admit(rows, ts, seqf, _SIDE_RIGHT)
+
+        K, C = self.cfg.n_series, self.cfg.n_cols
+        Lb = _bucket(int(counts.max()) if n else 1)
+        ts_p = np.full((K, Lb), TS_PAD, np.int64)
+        xs = np.full((C, K, Lb), np.nan, np.float32)
+        mask = np.zeros((K, Lb), bool)
+        ts_p[rows, lanes] = ts
+        mask[rows, lanes] = True
+        for c, v in enumerate(planes):
+            xs[c, rows, lanes] = v
+
+        exe = self._executable("push", Lb)
+        new_state, emits = exe(*self._state.values(), ts_p, xs, mask,
+                               counts)
+        commit()
+        self._state = dict(zip(self.cfg.state_names(), new_state))
+        self.acked += n
+        self._maybe_snapshot()
+
+        out: Dict[str, np.ndarray] = {}
+        for key, plane in emits.items():
+            plane = np.asarray(plane)            # [C, K, Lb]
+            for c, col in enumerate(self.value_cols):
+                out[f"{col}_{key}"] = plane[c, rows, lanes]
+        return out
+
+    def push_left(self, series_ids, ts, seq=None) -> Dict[str, np.ndarray]:
+        """Answer AS-OF queries for new left rows: per event, each
+        column's joined value + found flag and the last right row index
+        within the lookback horizon — bitwise the batch join's answer
+        for these rows over the concatenated history."""
+        rows = self._rows_of(series_ids)
+        ts = np.atleast_1d(np.asarray(ts, np.int64))
+        n = len(rows)
+        self._check_lengths(n, ts, seq)
+        seqf = self._seq_array(seq, n)
+        lanes, counts, commit = self._admit(rows, ts, seqf, _SIDE_LEFT)
+        Lb = _bucket(int(counts.max()) if n else 1)
+
+        exe = self._executable("query", Lb)
+        args = [self._state[name] for name in sst._QUERY_STATE]
+        new_n_merged, (vals, found, idx) = exe(*args, counts)
+        commit()
+        self._state["n_merged"] = new_n_merged
+        self.acked += n
+        self._maybe_snapshot()
+
+        vals = np.asarray(vals)
+        found = np.asarray(found)
+        out: Dict[str, np.ndarray] = {}
+        for c, col in enumerate(self.value_cols):
+            out[col] = vals[c, rows, lanes]
+            out[f"{col}_found"] = found[c, rows, lanes]
+        out["right_row_idx"] = np.asarray(idx)[rows, lanes]
+        return out
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def clipped(self) -> int:
+        """Rows whose true stats window exceeded the declared
+        ``window_rows_bound`` (truncated — the declared-bound audit)."""
+        if not self.cfg.has_window:
+            return 0
+        return int(np.asarray(self._state["clipped"]).sum())
+
+    def warmup(self, max_rows: int) -> int:
+        """Pre-build the push/query executables for every padded-batch
+        bucket up to ``max_rows``, so a fresh process reaches the
+        zero-recompile steady state before traffic.  Returns the
+        number of bucket shapes covered."""
+        shapes = []
+        b = _bucket(1)
+        while True:
+            shapes.append(b)
+            if b >= max_rows:
+                break
+            b *= 2
+        for Lb in shapes:
+            self._executable("push", Lb)
+            self._executable("query", Lb)
+        return len(shapes)
+
+    # -- durability ----------------------------------------------------
+
+    def _config_meta(self) -> dict:
+        return {
+            "value_cols": self.value_cols,
+            "skip_nulls": self.cfg.skip_nulls,
+            "max_lookback": self.cfg.max_lookback,
+            "window_ns": self.cfg.window_ns,
+            "rows_bound": self.cfg.rows_bound,
+            "ema_alpha": self.cfg.ema_alpha,
+        }
+
+    def snapshot(self) -> str:
+        """Write a CRC'd atomic snapshot of the full carry under
+        ``checkpoint_dir`` (step = events acked), pruning to
+        ``keep_last``.  IO rides the resilience retry policy."""
+        if not self.checkpoint_dir:
+            raise ValueError("StreamingTSDF has no checkpoint_dir")
+        arrays = {k: np.asarray(v) for k, v in self._state.items()}
+        arrays["wm_ts"] = self._wm_ts
+        arrays["wm_seq"] = self._wm_seq
+        arrays["wm_side"] = self._wm_side
+        meta = {"serve_config": self._config_meta(),
+                "series": self.series, "acked": self.acked}
+        path = os.path.join(self.checkpoint_dir,
+                            f"step_{self.acked:010d}")
+        resilience.retrying(resilience.DEFAULT_IO_POLICY,
+                            label="serve-snapshot")(ckpt.save_state)(
+            arrays, path, meta)
+        ckpt.prune(self.checkpoint_dir, keep_last=self.keep_last)
+        return path
+
+    def _maybe_snapshot(self):
+        if self._next_ckpt is not None and self.acked >= self._next_ckpt \
+                and self.checkpoint_dir:
+            self.snapshot()
+            self._next_ckpt = self.acked + self.ckpt_every
+
+    @classmethod
+    def resume(cls, checkpoint_dir: str, verify: bool = True,
+               **overrides) -> "StreamingTSDF":
+        """Restore the newest intact snapshot under ``checkpoint_dir``
+        (corrupt candidates are skipped with a warning, exactly like
+        pipeline resume).  The returned stream's ``acked`` tells the
+        caller where to restart its event source — replay everything
+        after it and the output tail is byte-identical to a run that
+        never died."""
+        path = ckpt.latest(checkpoint_dir, verify=verify)
+        if path is None:
+            raise ckpt.CheckpointError(
+                f"no intact stream snapshot under {checkpoint_dir!r}")
+        arrays, meta = ckpt.load_state(path, verify=verify)
+        scfg = meta["serve_config"]
+        stream = cls(
+            meta["series"], scfg["value_cols"],
+            skip_nulls=scfg["skip_nulls"],
+            max_lookback=scfg["max_lookback"],
+            window_secs=None, ema_alpha=scfg["ema_alpha"],
+            window_rows_bound=scfg["rows_bound"],
+            checkpoint_dir=overrides.pop("checkpoint_dir",
+                                         checkpoint_dir),
+            **overrides)
+        if scfg["window_ns"] is not None:
+            # reconstruct the exact integer width (window_secs would
+            # re-floor; the snapshot already holds the folded int)
+            stream.cfg = dataclasses.replace(stream.cfg,
+                                             window_ns=scfg["window_ns"])
+            stream._state = sst.init_state(stream.cfg)
+        for name in stream.cfg.state_names():
+            stream._state[name] = np.ascontiguousarray(arrays[name])
+        stream._wm_ts = np.asarray(arrays["wm_ts"], np.int64)
+        stream._wm_seq = np.asarray(arrays["wm_seq"], np.float64)
+        stream._wm_side = np.asarray(arrays["wm_side"], np.int8)
+        stream.acked = int(meta["acked"])
+        if stream.ckpt_every:
+            stream._next_ckpt = stream.acked + stream.ckpt_every
+        return stream

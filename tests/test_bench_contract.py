@@ -43,6 +43,7 @@ def test_bench_smoke_prints_one_json_line():
         "2b_range_stats_dense_50hz", "6_seq_tiebreak_asof",
         "7_frame_e2e_pipeline", "8_chunked_205k_k128",
         "9_chunked_1m_single", "10_planned_chain",
+        "11_serving_ticks_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -70,6 +71,14 @@ def test_bench_smoke_prints_one_json_line():
     assert pc.get("plan_cache", {}).get("hits", 0) >= 2, pc
     assert pc.get("plan_cache", {}).get("builds") == 1, pc
     assert rec.get("planned_vs_fused") and rec["planned_vs_fused"] > 0
+    # config 11 (round 8): the serving engine must have run under the
+    # Poisson load with latency percentiles, the zero-recompile steady
+    # state asserted, and the streamed==batch bitwise audit performed
+    sv = rec.get("serving") or {}
+    assert sv.get("ticks_per_sec", 0) > 0, sv
+    assert sv.get("p50_ms") is not None and sv.get("p99_ms") is not None
+    assert sv.get("zero_builds_steady_state") is True
+    assert "bitwise" in sv.get("value_audit", "")
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)

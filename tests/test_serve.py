@@ -1,0 +1,620 @@
+"""Online serving engine: incremental-vs-batch bitwise identity.
+
+The contract under test: a ``StreamingTSDF`` fed the history in ANY
+split of push/push_left micro-batches emits, for exactly the new rows,
+the bits the batch operators produce over the concatenated history —
+``ops/sortmerge.asof_merge_values`` for the AS-OF join (every flag:
+seq ties, skipNulls both ways, maxLookback expiry straddling push
+boundaries, NaN runs), ``serve.state.window_stats_batch`` for the
+causal window stats, ``ops/rolling.ema_scan`` for the EMA.  Plus: the
+ordering contract (late ticks rejected by name), the async executor
+(order preservation, backpressure, latency stamps, graceful drain),
+the zero-recompile steady state, and chaos kill/resume with a
+byte-identical tail.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tempo_tpu import checkpoint, profiling
+from tempo_tpu.ops import rolling as ops_rolling
+from tempo_tpu.ops import sortmerge as sm
+from tempo_tpu.packing import TS_PAD
+from tempo_tpu.serve import (LateTickError, MicroBatchExecutor,
+                             StreamingTSDF)
+from tempo_tpu.serve import state as sst
+from tempo_tpu.testing import faults
+
+COLS = ["px", "qty"]
+C = len(COLS)
+
+
+# ----------------------------------------------------------------------
+# Event-stream generation + batch oracle
+# ----------------------------------------------------------------------
+
+def _gen_events(rng, K, n, p_left=0.35, tie_heavy=False, seq=False,
+                p_nan=0.3):
+    """A VALID per-series-ordered event list: per series, events sorted
+    by (ts, seq, side) — rights before lefts on full ties — then
+    globally interleaved by ts (any interleave across series is
+    legal).  Returns [(k, side, ts, seq_or_None, vals[C])] with NaN
+    runs in column 0."""
+    span = 6 if tie_heavy else 40
+    per_series = []
+    for k in range(K):
+        m = int(rng.integers(n // (2 * K), max(n // K, 2) + 1))
+        ts = np.sort(rng.integers(-3, span, m)).astype(np.int64) * 10**9
+        sq = (np.round(rng.standard_normal(m), 1)
+              if seq else np.full(m, np.nan))
+        sq = np.where(rng.random(m) < 0.2, np.nan, sq) if seq else sq
+        side = (rng.random(m) < p_left).astype(int)   # 1 = left
+        sqk = np.where(np.isnan(sq), -np.inf, sq)
+        order = np.lexsort((side, sqk, ts))
+        evs = []
+        for i in order:
+            vals = rng.standard_normal(C).astype(np.float32)
+            if rng.random() < p_nan:
+                vals[0] = np.nan
+            evs.append((k, "left" if side[i] else "right", ts[i],
+                        None if (not seq or np.isnan(sq[i])) else sq[i],
+                        vals))
+        per_series.append(evs)
+    merged = [e for evs in per_series for e in evs]
+    merged.sort(key=lambda e: e[2])    # stable: per-series order kept
+    return merged
+
+
+def _pack_oracle(events, K):
+    """Concatenated-history packed arrays for the batch operators."""
+    lefts = [[] for _ in range(K)]
+    rights = [[] for _ in range(K)]
+    any_seq = any(e[3] is not None for e in events)
+    for k, side, ts, sq, vals in events:
+        (lefts if side == "left" else rights)[k].append((ts, sq, vals))
+    Ll = max(1, max(len(x) for x in lefts))
+    Lr = max(1, max(len(x) for x in rights))
+    l_ts = np.full((K, Ll), TS_PAD, np.int64)
+    r_ts = np.full((K, Lr), TS_PAD, np.int64)
+    l_seq = np.full((K, Ll), -np.inf, np.float64) if any_seq else None
+    r_seq = np.full((K, Lr), -np.inf, np.float64) if any_seq else None
+    # pad rows are NULL rows (NaN), the packing invariant — zero-filled
+    # pads would read as valid and trip the window truncation audit
+    # against the TS_PAD prefix (key ties at TS_PAD)
+    r_vals = np.full((C, K, Lr), np.nan, np.float32)
+    for k in range(K):
+        for j, (t, sq, _) in enumerate(lefts[k]):
+            l_ts[k, j] = t
+            if any_seq and sq is not None:
+                l_seq[k, j] = sq
+        for j, (t, sq, v) in enumerate(rights[k]):
+            r_ts[k, j] = t
+            r_vals[:, k, j] = v
+            if any_seq and sq is not None:
+                r_seq[k, j] = sq
+    return l_ts, l_seq, r_ts, r_seq, r_vals, ~np.isnan(r_vals)
+
+
+def _stream_events(stream, events, rng, max_batch=9):
+    """Feed ``events`` in random uneven segments, each split into
+    side-homogeneous runs in order.  Returns (left emissions,
+    right emissions) as [(run events, out dict)]."""
+    emis_l, emis_r = [], []
+    i = 0
+    while i < len(events):
+        j = min(len(events), i + int(rng.integers(1, max_batch)))
+        run = []
+        for e in events[i:j] + [None]:
+            if run and (e is None or e[1] != run[0][1]):
+                ks = [f"s{x[0]}" for x in run]
+                ts = [x[2] for x in run]
+                sq = [x[3] for x in run]
+                sq = None if all(s is None for s in sq) else \
+                    [np.nan if s is None else s for s in sq]
+                if run[0][1] == "right":
+                    vals = {c: np.array([x[4][ci] for x in run],
+                                        np.float32)
+                            for ci, c in enumerate(COLS)}
+                    emis_r.append((run, stream.push(ks, ts, vals,
+                                                    seq=sq)))
+                else:
+                    emis_l.append((run, stream.push_left(ks, ts,
+                                                         seq=sq)))
+                run = []
+            if e is not None:
+                run.append(e)
+        i = j
+    return emis_l, emis_r
+
+
+def _check_join(emis_l, want, K, label=""):
+    wv, wf, wi = (np.asarray(a) for a in want)
+    lpos = [0] * K
+    n = 0
+    for run, out in emis_l:
+        for i, (k, _, ts, sq, _) in enumerate(run):
+            j = lpos[k]
+            lpos[k] += 1
+            for ci, c in enumerate(COLS):
+                got_f, want_f = bool(out[f"{c}_found"][i]), bool(wf[ci, k, j])
+                assert got_f == want_f, \
+                    (label, "found", k, j, c, got_f, want_f)
+                if got_f:
+                    assert np.float32(out[c][i]).tobytes() == \
+                        np.float32(wv[ci, k, j]).tobytes(), \
+                        (label, "val", k, j, c, out[c][i], wv[ci, k, j])
+            assert int(out["right_row_idx"][i]) == int(wi[k, j]), \
+                (label, "idx", k, j, out["right_row_idx"][i], wi[k, j])
+            n += 1
+    return n
+
+
+def _check_right(emis_r, stats, ema_ys, K, label=""):
+    rpos = [0] * K
+    n = 0
+    for run, out in emis_r:
+        for i, (k, _, ts, sq, _) in enumerate(run):
+            j = rpos[k]
+            rpos[k] += 1
+            for ci, c in enumerate(COLS):
+                if ema_ys is not None:
+                    assert np.float32(out[f"{c}_ema"][i]).tobytes() == \
+                        np.float32(ema_ys[ci, k, j]).tobytes(), \
+                        (label, "ema", k, j, c)
+                if stats is not None:
+                    for skey in sst._STAT_KEYS:
+                        assert np.float32(
+                            out[f"{c}_{skey}"][i]).tobytes() == \
+                            np.float32(stats[skey][ci, k, j]).tobytes(), \
+                            (label, skey, k, j, c,
+                             out[f"{c}_{skey}"][i], stats[skey][ci, k, j])
+            n += 1
+    return n
+
+
+def _run_identity(seed, *, seq, skip_nulls, ml, tie_heavy=True, K=3,
+                  n=120, window_secs=9.0, rows_bound=24, alpha=0.2):
+    rng = np.random.default_rng(seed)
+    events = _gen_events(rng, K, n, tie_heavy=tie_heavy, seq=seq)
+    stream = StreamingTSDF(
+        [f"s{k}" for k in range(K)], COLS, skip_nulls=skip_nulls,
+        max_lookback=ml, window_secs=window_secs,
+        window_rows_bound=rows_bound, ema_alpha=alpha)
+    emis_l, emis_r = _stream_events(stream, events, rng)
+    l_ts, l_seq, r_ts, r_seq, r_vals, r_valids = _pack_oracle(events, K)
+    want = sm.asof_merge_values(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_vals),
+        l_seq=None if l_seq is None else jnp.asarray(l_seq),
+        r_seq=None if r_seq is None else jnp.asarray(r_seq),
+        skip_nulls=skip_nulls, max_lookback=ml)
+    nl = _check_join(emis_l, want, K, label=f"seed{seed}")
+    stats, clip = sst.window_stats_batch(
+        r_ts, r_vals, r_valids, sst.window_ns(window_secs), rows_bound)
+    stats = {k: np.asarray(v) for k, v in stats.items()}
+    ema_ys, _ = ops_rolling.ema_scan(
+        jnp.asarray(r_vals), jnp.asarray(r_valids), np.float32(alpha))
+    nr = _check_right(emis_r, stats, np.asarray(ema_ys), K,
+                      label=f"seed{seed}")
+    assert stream.clipped == int(np.asarray(clip).sum())
+    assert nl > 5 and nr > 5, "degenerate case generated"
+
+
+# ----------------------------------------------------------------------
+# The randomized push-split matrix
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("seq", [False, True])
+@pytest.mark.parametrize("skip_nulls", [True, False])
+@pytest.mark.parametrize("ml", [0, 7])
+def test_identity_matrix(seq, skip_nulls, ml):
+    """Uneven push splits × seq ties × NaN runs × maxLookback expiry
+    straddling push boundaries: streamed emissions == batch bits."""
+    seed = 1000 + 100 * seq + 10 * skip_nulls + ml
+    _run_identity(seed, seq=seq, skip_nulls=skip_nulls, ml=ml)
+
+
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_identity_fuzz_more_series(seed):
+    _run_identity(seed, seq=(seed % 2 == 0), skip_nulls=True,
+                  ml=(17 if seed == 6 else 0), K=5, n=200)
+
+
+def test_single_row_pushes_equal_one_big_push():
+    """The extreme split: every event its own push — same bits as one
+    push per side (split invariance end to end)."""
+    rng = np.random.default_rng(42)
+    events = _gen_events(rng, 2, 60, tie_heavy=True)
+    mk = lambda: StreamingTSDF(["s0", "s1"], COLS, window_secs=9.0,
+                               window_rows_bound=24, ema_alpha=0.3,
+                               max_lookback=5)
+    s1 = mk()
+    one_l, one_r = _stream_events(s1, events, rng, max_batch=2)
+    s2 = mk()
+    fine_l, fine_r = [], []
+    for e in events:
+        ks, ts = [f"s{e[0]}"], [e[2]]
+        if e[1] == "right":
+            vals = {c: np.array([e[4][ci]], np.float32)
+                    for ci, c in enumerate(COLS)}
+            fine_r.append(([e], s2.push(ks, ts, vals)))
+        else:
+            fine_l.append(([e], s2.push_left(ks, ts)))
+
+    def flat(emis, key):
+        return np.concatenate([np.atleast_1d(out[key])
+                               for _, out in emis]) if emis else \
+            np.zeros(0)
+
+    for key in [f"{c}_{s}" for c in COLS for s in ("ema", "mean",
+                                                   "stddev", "sum")]:
+        np.testing.assert_array_equal(flat(one_r, key), flat(fine_r, key))
+    for key in COLS + [f"{c}_found" for c in COLS] + ["right_row_idx"]:
+        np.testing.assert_array_equal(flat(one_l, key), flat(fine_l, key))
+
+
+# ----------------------------------------------------------------------
+# Ordering contract
+# ----------------------------------------------------------------------
+
+def test_tie_straddling_push_boundary_right_wins():
+    s = StreamingTSDF(["a"], COLS)
+    s.push(["a"], [10**9], {"px": [1.0], "qty": [2.0]})
+    out = s.push_left(["a"], [10**9])       # full tie: right wins
+    assert out["px"][0] == np.float32(1.0) and out["px_found"][0]
+    assert out["right_row_idx"][0] == 0
+
+
+def test_late_right_after_left_tie_rejected():
+    """A right tick at a key already answered for a left row would
+    sort BEFORE that left row in the batch merge — late, rejected."""
+    s = StreamingTSDF(["a"], COLS)
+    s.push_left(["a"], [10**9])
+    with pytest.raises(LateTickError, match="late right tick.*'a'"):
+        s.push(["a"], [10**9], {"px": [1.0], "qty": [1.0]})
+    # strictly later is fine
+    s.push(["a"], [2 * 10**9], {"px": [1.0], "qty": [1.0]})
+
+
+def test_out_of_order_ts_rejected_and_state_untouched():
+    s = StreamingTSDF(["a", "b"], COLS)
+    s.push(["a"], [5 * 10**9], {"px": [1.0], "qty": [1.0]})
+    with pytest.raises(LateTickError, match="behind the watermark"):
+        s.push(["a", "a"], [6 * 10**9, 4 * 10**9],
+               {"px": [1.0, 2.0], "qty": [1.0, 2.0]})
+    # the whole offending batch was rejected atomically: row 0 of it
+    # (ts=6s) did NOT advance the watermark
+    s.push(["a"], [5 * 10**9], {"px": [3.0], "qty": [3.0]})
+    out = s.push_left(["a"], [5 * 10**9])
+    assert out["px"][0] == np.float32(3.0)
+    # other series unaffected
+    s.push(["b"], [10**9], {"px": [9.0], "qty": [9.0]})
+
+
+def test_seq_order_and_null_seq_first():
+    s = StreamingTSDF(["a"], COLS)
+    s.push(["a", "a"], [10**9, 10**9],
+           {"px": [1.0, 2.0], "qty": [0.0, 0.0]},
+           seq=[np.nan, 1.0])               # null seq first (NULLS FIRST)
+    with pytest.raises(LateTickError):
+        s.push(["a"], [10**9], {"px": [3.0], "qty": [0.0]},
+               seq=[0.5])                   # behind seq=1.0 watermark
+    out = s.push_left(["a"], [10**9], seq=[2.0])
+    assert out["px"][0] == np.float32(2.0)
+
+
+def test_unknown_series_rejected():
+    s = StreamingTSDF(["a"], COLS)
+    with pytest.raises(ValueError, match="unknown series"):
+        s.push(["zz"], [10**9], {"px": [1.0], "qty": [1.0]})
+
+
+def test_lookback_expiry_across_pushes():
+    """maxLookback measures MERGED rows: left queries consume positions
+    too, so a horizon can expire between pushes with no new data."""
+    s = StreamingTSDF(["a"], COLS, max_lookback=3)
+    s.push(["a"], [10**9], {"px": [7.0], "qty": [7.0]})
+    out = s.push_left(["a"] * 3, [2 * 10**9, 3 * 10**9, 4 * 10**9])
+    assert list(out["px_found"]) == [True, True, True]
+    out = s.push_left(["a"], [5 * 10**9])   # 4 merged rows back now
+    assert not out["px_found"][0] and out["right_row_idx"][0] == -1
+
+
+def test_clipped_counts_declared_bound_truncation():
+    """A window wider (in rows) than window_rows_bound is truncated and
+    audited — matching the batch twin's clipped count exactly."""
+    K, L = 1, 24
+    ts = (np.arange(L, dtype=np.int64) + 1) * 10**9   # 1s grid
+    vals = np.ones((L,), np.float32)
+    s = StreamingTSDF(["a"], COLS, window_secs=10.0, window_rows_bound=4)
+    for i in range(L):
+        s.push(["a"], [ts[i]], {"px": [vals[i]], "qty": [vals[i]]})
+    xs = np.broadcast_to(vals, (C, K, L)).copy()
+    _, clip = sst.window_stats_batch(ts[None], xs, ~np.isnan(xs),
+                                     sst.window_ns(10.0), 4)
+    assert s.clipped == int(np.asarray(clip).sum()) > 0
+
+
+# ----------------------------------------------------------------------
+# Executor
+# ----------------------------------------------------------------------
+
+def test_executor_identity_and_latency():
+    """A mixed feed through the async executor: per-ticket answers
+    equal the batch oracle; latency stamps populate; order preserved."""
+    rng = np.random.default_rng(3)
+    K = 3
+    events = _gen_events(rng, K, 90, tie_heavy=True)
+    stream = StreamingTSDF([f"s{k}" for k in range(K)], COLS,
+                           ema_alpha=0.2)
+    tickets = []
+    with MicroBatchExecutor(stream, batch_rows=8,
+                            queue_depth=64) as ex:
+        for (k, side, ts, sq, vals) in events:
+            if side == "right":
+                tickets.append((True, ex.submit(
+                    "right", f"s{k}", ts,
+                    {c: vals[ci] for ci, c in enumerate(COLS)})))
+            else:
+                tickets.append((False, ex.submit("left", f"s{k}", ts)))
+        results = [(r, t.result(timeout=120)) for r, t in tickets]
+    l_ts, _, r_ts, _, r_vals, r_valids = _pack_oracle(events, K)
+    wv, wf, wi = (np.asarray(a) for a in sm.asof_merge_values(
+        jnp.asarray(l_ts), jnp.asarray(r_ts), jnp.asarray(r_valids),
+        jnp.asarray(r_vals)))
+    ema_ys, _ = ops_rolling.ema_scan(
+        jnp.asarray(r_vals), jnp.asarray(r_valids), np.float32(0.2))
+    ema_ys = np.asarray(ema_ys)
+    lpos = [0] * K
+    rpos = [0] * K
+    for (k, side, ts, sq, vals), (is_r, res) in zip(events, results):
+        if is_r:
+            j = rpos[k]; rpos[k] += 1
+            for ci, c in enumerate(COLS):
+                assert np.float32(res[f"{c}_ema"]).tobytes() == \
+                    np.float32(ema_ys[ci, k, j]).tobytes()
+        else:
+            j = lpos[k]; lpos[k] += 1
+            for ci, c in enumerate(COLS):
+                assert bool(res[f"{c}_found"]) == bool(wf[ci, k, j])
+                if res[f"{c}_found"]:
+                    assert np.float32(res[c]).tobytes() == \
+                        np.float32(wv[ci, k, j]).tobytes()
+    lat = ex.latency_stats()
+    assert lat["all"]["count"] == len(events)
+    assert lat["all"]["p50_ms"] is not None \
+        and lat["all"]["p99_ms"] >= lat["all"]["p50_ms"]
+    assert ex.batches >= 2 and ex.ticks == len(events)
+
+
+def test_executor_backpressure_and_close():
+    import queue as queue_mod
+    import threading
+
+    stream = StreamingTSDF(["a"], COLS)
+    gate = threading.Event()
+    orig_push = stream.push
+
+    def slow_push(*a, **k):
+        gate.wait(30)
+        return orig_push(*a, **k)
+
+    stream.push = slow_push
+    ex = MicroBatchExecutor(stream, queue_depth=1)
+    tickets = [ex.submit("right", "a", 10**9, {"px": 1.0, "qty": 1.0})]
+    # the worker is stalled inside push; the bounded queue must refuse
+    # further ticks within a couple of submissions (backpressure)
+    with pytest.raises(queue_mod.Full):
+        for i in range(3):
+            tickets.append(ex.submit(
+                "right", "a", (i + 2) * 10**9,
+                {"px": 1.0, "qty": 1.0}, timeout=0.05))
+    gate.set()
+    ex.close()                                  # graceful drain
+    assert ex.ticks == len(tickets)
+    for t in tickets:
+        t.result(timeout=60)
+    with pytest.raises(RuntimeError, match="closed"):
+        ex.submit("right", "a", 10**12, {"px": 1.0, "qty": 1.0})
+    with pytest.raises(ValueError, match="kind"):
+        MicroBatchExecutor(stream).submit("sideways", "a", 1)
+
+
+def test_executor_delivers_late_tick_error_on_ticket():
+    stream = StreamingTSDF(["a"], COLS)
+    with MicroBatchExecutor(stream) as ex:
+        t1 = ex.submit("right", "a", 5 * 10**9, {"px": 1.0, "qty": 1.0})
+        t1.result(timeout=60)
+        t2 = ex.submit("right", "a", 10**9, {"px": 2.0, "qty": 2.0})
+        with pytest.raises(LateTickError):
+            t2.result(timeout=60)
+        # the worker survives a poisoned batch
+        t3 = ex.submit("right", "a", 6 * 10**9, {"px": 3.0, "qty": 3.0})
+        t3.result(timeout=60)
+
+
+def test_executor_survives_bad_payload():
+    """A malformed tick (unconvertible ts) poisons its own batch, not
+    the worker thread: later ticks still process."""
+    stream = StreamingTSDF(["a"], COLS)
+    with MicroBatchExecutor(stream) as ex:
+        bad = ex.submit("right", "a", "not-a-timestamp",
+                        {"px": 1.0, "qty": 1.0})
+        with pytest.raises(Exception):
+            bad.result(timeout=60)
+        ok = ex.submit("right", "a", 10**9, {"px": 1.0, "qty": 1.0})
+        assert isinstance(ok.result(timeout=60), dict)
+    assert ex.ticks == 1               # only the good tick counted
+
+
+def test_failed_push_leaves_watermarks_untouched():
+    """A push that fails validation AFTER ordering checks (missing
+    value column) must not advance the watermark: the corrected batch
+    replays cleanly instead of raising LateTickError."""
+    s = StreamingTSDF(["a"], COLS)
+    with pytest.raises(ValueError, match="missing value column"):
+        s.push(["a", "a"], [10**9, 2 * 10**9], {"px": [1.0, 2.0]})
+    # same keys again: accepted (nothing was committed)
+    out = s.push(["a", "a"], [10**9, 2 * 10**9],
+                 {"px": [1.0, 2.0], "qty": [3.0, 4.0]})
+    assert s.acked == 2
+    q = s.push_left(["a"], [2 * 10**9])
+    assert q["px"][0] == np.float32(2.0)
+
+
+def test_zero_recompile_survives_disabled_plan_cache(monkeypatch):
+    """The live stream pins its own executables: even with the shared
+    planner LRU disabled, warmed buckets never rebuild."""
+    monkeypatch.setenv("TEMPO_TPU_PLAN_CACHE_SIZE", "0")
+    stream = StreamingTSDF(["a"], COLS, ema_alpha=0.4)
+    stream.warmup(8)
+    builds0 = profiling.plan_cache_stats()["builds"]
+    t = 10**9
+    for i in range(6):
+        t += 10**9
+        stream.push(["a"], [t], {"px": [1.0], "qty": [2.0]})
+        t += 10**9
+        stream.push_left(["a"], [t])
+    assert profiling.plan_cache_stats()["builds"] == builds0
+
+
+def test_zero_recompile_steady_state():
+    """After warmup, pushes/queries on warmed bucket shapes build no
+    new executables — the checked invariant of the serving loop."""
+    stream = StreamingTSDF(["a", "b"], COLS, ema_alpha=0.5,
+                           window_secs=4.0, window_rows_bound=8)
+    stream.warmup(16)
+    builds0 = profiling.plan_cache_stats()["builds"]
+    t = 10**9
+    for i in range(12):
+        t += 10**9
+        stream.push(["a", "b"], [t, t], {"px": [1.0, 2.0],
+                                         "qty": [3.0, 4.0]})
+        t += 10**9
+        stream.push_left(["a"], [t])
+    stats = profiling.plan_cache_stats()
+    assert stats["builds"] == builds0, stats
+
+
+# ----------------------------------------------------------------------
+# Durability: snapshots, resume, chaos
+# ----------------------------------------------------------------------
+
+def test_snapshot_roundtrip_and_corrupt_fallback(tmp_path):
+    parent = str(tmp_path / "stream_ckpt")
+    s = StreamingTSDF(["a", "b"], COLS, ema_alpha=0.2, window_secs=5.0,
+                      window_rows_bound=8, checkpoint_dir=parent,
+                      ckpt_every=4)
+    t = 0
+    for i in range(12):
+        t += 10**9
+        s.push(["a", "b"], [t, t],
+               {"px": [float(i), float(-i)], "qty": [1.0, 2.0]})
+    steps = checkpoint.list_steps(parent)
+    assert len(steps) >= 2
+    # corrupt the newest snapshot: resume falls back to an older one
+    newest = steps[0][1]
+    faults.corrupt_npz_array(os.path.join(newest, "state.npz"))
+    r = StreamingTSDF.resume(parent)
+    assert r.acked < s.acked and r.acked > 0
+    # load() refuses a stream_state dir with a pointer to load_state
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="StreamState|load_state"):
+        checkpoint.load(steps[1][1])
+
+
+@pytest.mark.chaos
+def test_resume_replay_tail_is_byte_identical(tmp_path):
+    """The acceptance scenario: kill mid-stream, resume from the
+    newest intact snapshot, replay the unacknowledged tail — the
+    stitched output equals the fault-free run byte for byte."""
+    rng = np.random.default_rng(9)
+    K = 2
+    events = [e for e in _gen_events(rng, K, 80, tie_heavy=True)
+              if e[1] == "right"]
+    batches = []
+    i = 0
+    while i < len(events):
+        j = min(len(events), i + int(rng.integers(1, 6)))
+        batches.append(events[i:j])
+        i = j
+
+    def push_all(stream, batches):
+        outs = []
+        for b in batches:
+            ks = [f"s{x[0]}" for x in b]
+            ts = [x[2] for x in b]
+            vals = {c: np.array([x[4][ci] for x in b], np.float32)
+                    for ci, c in enumerate(COLS)}
+            outs.append(stream.push(ks, ts, vals))
+        return outs
+
+    series = [f"s{k}" for k in range(K)]
+    golden = push_all(StreamingTSDF(series, COLS, ema_alpha=0.2,
+                                    window_secs=8.0,
+                                    window_rows_bound=16), batches)
+
+    parent = str(tmp_path / "ck")
+    s = StreamingTSDF(series, COLS, ema_alpha=0.2, window_secs=8.0,
+                      window_rows_bound=16, checkpoint_dir=parent,
+                      ckpt_every=10)
+    kill_at = len(batches) // 2 + 1
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(StreamingTSDF, "push", call_no=kill_at)
+        with pytest.raises(faults.SimulatedKill):
+            push_all(s, batches)
+    assert any(r.action == "kill" for r in fi.records)
+
+    r = StreamingTSDF.resume(parent)
+    assert 0 < r.acked < sum(len(b) for b in batches)
+    # replay the unacknowledged tail (snapshots land on push
+    # boundaries, so acked is a prefix of whole batches)
+    done = 0
+    tail_from = None
+    for bi, b in enumerate(batches):
+        if done == r.acked:
+            tail_from = bi
+            break
+        done += len(b)
+    assert tail_from is not None, "acked not on a push boundary"
+    tail = push_all(r, batches[tail_from:])
+    for got, want in zip(tail, golden[tail_from:]):
+        assert set(got) == set(want)
+        for key in want:
+            np.testing.assert_array_equal(got[key], want[key],
+                                          err_msg=key)
+
+
+# ----------------------------------------------------------------------
+# Registry / misc
+# ----------------------------------------------------------------------
+
+def test_serve_step_contract_registered():
+    from tempo_tpu.plan import contracts
+
+    assert "serve.step" in contracts.names()
+
+
+def test_window_stats_batch_matches_windowed_semantics():
+    """Sanity (not bitwise): the causal stats agree with the classic
+    engine where the semantics coincide — single column, no ties, no
+    following rows, window within bounds."""
+    rng = np.random.default_rng(1)
+    K, L = 2, 40
+    secs = np.cumsum(rng.integers(2, 5, (K, L)), axis=-1).astype(np.int64)
+    ts = secs * 10**9
+    xs = rng.standard_normal((1, K, L)).astype(np.float32)
+    valids = np.ones((1, K, L), bool)
+    stats, clip = sst.window_stats_batch(ts, xs, valids,
+                                         sst.window_ns(10.0), 8)
+    ref = sm._range_stats_shifted_xla(
+        jnp.asarray(secs), jnp.asarray(xs[0]), jnp.asarray(valids[0]),
+        jnp.asarray(10, jnp.int64), max_behind=8, max_ahead=0)
+    assert int(np.asarray(clip).sum()) == 0
+    np.testing.assert_allclose(np.asarray(stats["mean"][0]),
+                               np.asarray(ref["mean"]), rtol=2e-5)
+    np.testing.assert_array_equal(np.asarray(stats["count"][0]),
+                                  np.asarray(ref["count"]))

@@ -13,6 +13,12 @@ python tools/analyze.py || exit $?
 echo "== compiled contracts (tools/analyze.py --compiled) =="
 JAX_PLATFORMS=cpu python tools/analyze.py --compiled || exit $?
 
+echo "== serving identity (tests/test_serve.py) =="
+# the streamed==batch bitwise contract, surfaced as its own gate (it
+# also runs inside tier-1 below; a fast fail here names the subsystem)
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
