@@ -1039,16 +1039,20 @@ def bench_opsweep():
     return out
 
 
-def _config_subprocess(flag, label, timeout=3600):
+def _config_subprocess(flag, label, timeout=3600, extra_args=(),
+                       env=None):
     """Fresh-process runner for an --only-<flag> bench mode (compile
     hygiene: the axon remote compiler hangs on a second
-    structurally-similar large compile in one process)."""
+    structurally-similar large compile in one process).  ``env``
+    overrides the child environment (the mesh-scaling sweep forces
+    per-child virtual device counts)."""
     import subprocess
 
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True, text=True, timeout=timeout,
+            [sys.executable, os.path.abspath(__file__), flag,
+             *extra_args],
+            capture_output=True, text=True, timeout=timeout, env=env,
         )
         sys.stderr.write(proc.stderr)
         if proc.returncode != 0:
@@ -1681,6 +1685,228 @@ def bench_serving(seed=11):
     }
 
 
+def _mesh_scaling_frames(n_dev, seed=11):
+    """Config-7-shaped frames for the mesh sweep: K series over the
+    frame API, same data at every device count so rates compare."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+
+    rng = np.random.default_rng(seed)
+    Kf, Lf = (K, L)
+    secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                     axis=-1)
+    syms = np.repeat(np.arange(Kf), Lf)
+    df_l = pd.DataFrame({
+        "sym": syms, "event_ts": secs.ravel(),
+        "x": rng.standard_normal(Kf * Lf),
+    })
+    r_secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(np.int64),
+                       axis=-1)
+    df_r = pd.DataFrame({
+        "sym": syms, "event_ts": r_secs.ravel(),
+        "v0": rng.standard_normal(Kf * Lf),
+        "v1": rng.standard_normal(Kf * Lf),
+    })
+    return TSDF(df_l, "event_ts", ["sym"]), TSDF(df_r, "event_ts", ["sym"])
+
+
+def _mesh_stage_comm_audit(mesh, dl, dr, n_dev):
+    """Per-stage comm bytes of the 4-stage mesh chain AND the fused
+    planner program at the bench shapes, asserted against
+    ``profiling.comm_bytes_from_compiled`` within the shared
+    ``COLLECTIVE_TOLERANCE``.  Declared inventory per stage: the key
+    alignment all-gathers the right stacks once; join/EMA are
+    collective-free; stats carry only the incidental clipped-count
+    all-reduce.  Any other kind in any stage's compiled HLO is an
+    UNDECLARED collective and fails the audit (tentpole contract:
+    zero implicit resharding between chained stages)."""
+    from tempo_tpu import dist, profiling
+    from tempo_tpu.ops.sortmerge import use_sort_kernels
+    from tempo_tpu.plan import fused as plan_fused
+
+    nbytes = lambda *arrs: int(sum(a.size * a.dtype.itemsize
+                                   for a in arrs))
+    rvals = jnp.stack([dr.cols[c].values for c in dr.cols])
+    rvalids = jnp.stack([dr.cols[c].valid for c in dr.cols])
+    planes, vstack = plan_fused._right_stacks(dr.ts, dr.mask, rvals,
+                                              rvalids)
+    perm, ok = dist._key_perm(dl.layout.key_frame, dr.layout.key_frame,
+                              dl.partitionCols, dl.K_dev)
+    sk = use_sort_kernels()
+    engine, rowbounds, _ = dl._range_engine_choice(float(WINDOW_SECS))
+    xs = dl.cols["x"].values[None]
+    vs = dl.cols["x"].valid[None]
+
+    align_c = dist._align3_fn(mesh, "series", None, donate=True) \
+        .lower(planes, jnp.asarray(perm), jnp.asarray(ok),
+               float("nan")).compile()
+    join_c = dist._asof_local(mesh, "series", sort_kernels=sk) \
+        .lower(dl.ts, dl.mask, dr.ts, dr.mask, vstack, planes).compile()
+    stats_c = dist._range_stats_local_packed(
+        mesh, "series", float(WINDOW_SECS), rowbounds, sk, engine) \
+        .lower(dl.ts, xs, vs).compile()
+    ema_c = dist._ema_local(mesh, "series", 0.2, True, 30) \
+        .lower(dl.cols["x"].values, dl.cols["x"].valid).compile()
+    fused_prog = plan_fused._fused_program(
+        mesh, "series", (("l", 0),), float(WINDOW_SECS), rowbounds,
+        engine, sk, ("l", 0), 0.2, True, 30)
+    fused_c = fused_prog.lower(
+        dl.ts, dl.cols["x"].values[None], dl.cols["x"].valid[None],
+        dr.ts, planes, vstack, jnp.asarray(perm),
+        jnp.asarray(ok)).compile()
+
+    stages = {
+        "align3": (align_c, {"all-gather": nbytes(planes)}, {}),
+        "asof_local": (join_c, {}, {}),
+        "range_stats": (stats_c, {}, {"all-reduce": 1 * 8 * 4}),
+        "ema": (ema_c, {}, {}),
+        "fused_chain": (fused_c,
+                        {"all-gather": nbytes(dr.ts, planes, vstack)},
+                        {"all-reduce": 1 * 8 * 4}),
+    }
+    out = {}
+    for name, (compiled, models, incidental) in stages.items():
+        measured = profiling.comm_bytes_from_compiled(compiled)
+        out[name] = {"measured": measured, "modeled": models}
+        undeclared = [k for k in measured
+                      if k not in models and k not in incidental]
+        assert not undeclared, (
+            f"mesh-scaling comm audit: UNDECLARED collective kind(s) "
+            f"{undeclared} in stage {name!r} at {n_dev} devices "
+            f"({measured}) — an implicit reshard crept between stages")
+        for kind, ceiling in incidental.items():
+            got = measured.get(kind, 0)
+            assert got <= ceiling, (
+                f"incidental {kind} in {name}: {got} B > {ceiling} B")
+        if n_dev == 1:
+            continue   # 1-device meshes compile collectives away
+        for kind, model in models.items():
+            got = measured.get(kind, 0)
+            tol = profiling.COLLECTIVE_TOLERANCE[kind]
+            assert model <= got <= tol * model, (
+                f"mesh-scaling comm audit: {name} {kind} moved {got} "
+                f"B/shard vs modeled {model} (outside [1x, {tol}x]) "
+                f"at {n_dev} devices")
+    return out
+
+
+def bench_mesh_scaling_one(n_dev):
+    """One point of the --only-mesh-scaling sweep: config 7's
+    frame-level chain on an ``n_dev``-device series mesh under
+    TEMPO_TPU_PLAN=1 (the fused planner path), with the in-bench
+    planned==eager bitwise audit and the per-stage comm-bytes audit."""
+    import pandas as pd
+
+    from tempo_tpu import profiling
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.plan import cache as plan_cache
+
+    devs = jax.devices()
+    if len(devs) < n_dev:
+        return {"skipped": f"needs {n_dev} devices, have {len(devs)}"}
+    # clear an inherited plan knob BEFORE packing: with it set, on_mesh
+    # would return lazy wrappers and the "eager" reference below would
+    # silently run through the planner — the bitwise audit would then
+    # compare the planner against itself
+    os.environ.pop("TEMPO_TPU_PLAN", None)
+    lt, rt = _mesh_scaling_frames(n_dev)
+    mesh = make_mesh({"series": n_dev}, devices=devs[:n_dev])
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+
+    def chain():
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW_SECS)
+                .EMA("x", exact=True)
+                .collect().df)
+
+    print(f"[mesh_scaling:{n_dev}] eager reference...", file=sys.stderr,
+          flush=True)
+    eager_ref = chain()
+    os.environ["TEMPO_TPU_PLAN"] = "1"
+    try:
+        plan_cache.CACHE.clear()
+        planned_ref = chain()
+        pd.testing.assert_frame_equal(eager_ref, planned_ref,
+                                      check_exact=True)
+        del eager_ref, planned_ref
+        print(f"[mesh_scaling:{n_dev}] timing...", file=sys.stderr,
+              flush=True)
+        ts = []
+        for _ in range(max(ITERS, 2)):
+            t0 = time.perf_counter()
+            res = chain()
+            ts.append(time.perf_counter() - t0)
+            del res
+        t_iter = float(np.median(ts))
+    finally:
+        os.environ.pop("TEMPO_TPU_PLAN", None)
+    comm = _mesh_stage_comm_audit(mesh, dl, dr, n_dev)
+    rows = K * L
+    return {
+        "devices": n_dev,
+        "rows": rows,
+        "rows_per_sec": rows / t_iter,
+        "t_iter": t_iter,
+        "comm_bytes_per_stage": comm,
+        "value_audit": "planned == eager bitwise "
+                       "(assert_frame_equal check_exact)",
+        "comm_audit": "per-stage comm bytes within COLLECTIVE_TOLERANCE "
+                      "of profiling.comm_bytes_from_compiled; zero "
+                      "undeclared collective kinds between stages",
+    }
+
+
+def bench_mesh_scaling():
+    """Config 12 (--only-mesh-scaling): sweep config 7's frame-level
+    chain over 1 -> 2 -> 4 -> 8 devices (one fresh child process per
+    device count — on CPU each child forces that many virtual host
+    devices), reporting rows/s per device count, scaling efficiency
+    vs the 1-device run, and the per-stage comm audit.  The ladder's
+    ceiling is ``TEMPO_TPU_MESH_DEVICES`` (ROADMAP item 2 acceptance:
+    >= 6x at 8 devices on real chips; virtual CPU devices share one
+    core and report honestly sub-linear numbers)."""
+    import re
+
+    from tempo_tpu import config as tt_config
+
+    ceiling = tt_config.get_int("TEMPO_TPU_MESH_DEVICES", None)
+    backend = jax.default_backend()
+    avail = 8 if backend == "cpu" else len(jax.devices())
+    top = min(ceiling or 8, avail)
+    ladder = (1, 2) if os.environ.get("TEMPO_BENCH_SMOKE") else (1, 2, 4, 8)
+    counts = [n for n in ladder if n <= top]
+    per_dev = {}
+    for n in counts:
+        env = dict(os.environ)
+        if backend == "cpu":
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                           "", env.get("XLA_FLAGS", ""))
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        rec = _config_subprocess("--only-mesh-scaling-one",
+                                 f"mesh_scaling:{n}", timeout=2400,
+                                 extra_args=(str(n),), env=env)
+        if rec is not None:
+            per_dev[str(n)] = rec
+    rate = lambda n: (per_dev.get(str(n)) or {}).get("rows_per_sec")
+    base = rate(1)
+    scaling = {str(n): round(rate(n) / base, 2)
+               for n in counts if rate(n) and base}
+    efficiency = {str(n): round(rate(n) / (n * base), 3)
+                  for n in counts if rate(n) and base and n > 1}
+    return {
+        "device_counts": counts,
+        "backend": backend,
+        "per_device_count": per_dev,
+        "scaling_vs_1dev": scaling,
+        "scaling_efficiency": efficiency,
+    }
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -1801,6 +2027,19 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-mesh-scaling-one" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--only-mesh-scaling-one") + 1])
+        res = _attempt("mesh_scaling_one", lambda: bench_mesh_scaling_one(n))
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
+    if "--only-mesh-scaling" in sys.argv:
+        res = _attempt("mesh_scaling", bench_mesh_scaling)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
 
     data = make_data()
     # host-only denominator first: immune to device-worker state
@@ -1882,6 +2121,8 @@ def main():
                                     timeout=2400)
     serving = _config_subprocess("--only-serving", "serving",
                                  timeout=2400)
+    mesh_scaling = _config_subprocess("--only-mesh-scaling",
+                                      "mesh_scaling", timeout=7200)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
     # three engines ran on identical data; at 50 Hz the unrolled forms
     # cannot legally run, so the record is streaming vs windowed —
@@ -1979,7 +2220,21 @@ def main():
             # python/dispatch-bound by design
             "11_serving_ticks_per_sec": (
                 round(serving["ticks_per_sec"]) if serving else None),
+            # config 7's chain at the sweep's top device count (the
+            # multi-chip headline; scaling detail in "mesh_scaling")
+            "12_mesh_scaling_top": (
+                round(((mesh_scaling["per_device_count"]
+                        .get(str(max(mesh_scaling["device_counts"])))
+                        or {}).get("rows_per_sec", 0))) or None
+                if mesh_scaling and mesh_scaling.get("per_device_count")
+                and mesh_scaling.get("device_counts")
+                else None),
         },
+        # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
+        # device count, scaling efficiency vs 1 device, per-stage comm
+        # bytes asserted against profiling.comm_bytes_from_compiled and
+        # the in-bench planned==eager bitwise audit (ROADMAP item 2)
+        "mesh_scaling": mesh_scaling,
         "serving": serving,
         # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
         # within ~1.2x is the claim being measured

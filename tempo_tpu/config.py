@@ -115,6 +115,18 @@ KNOBS: Dict[str, Knob] = _knobs(
          "1 turns on the lazy query planner: recorded op chains are "
          "optimized (kernel fusion, engine hoisting, column pruning) "
          "and executed at collect(); eager is the default"),
+    Knob("TEMPO_TPU_RESHARD_PLACEMENT", "enum(auto|declarative|explicit)",
+         "auto", "tempo_tpu/plan/optimizer",
+         "plan-placed resharding of time-sharded mesh chains: auto = "
+         "explicit reshard nodes around maximal series-local op runs "
+         "(interior all_to_all pairs eliminated, reshard-back sunk "
+         "until a blocker); explicit = reshard around every such op, "
+         "never eliminated; declarative = no plan nodes, each op keeps "
+         "its internal all_to_all pair"),
+    Knob("TEMPO_TPU_MESH_DEVICES", "int", None, "bench.py",
+         "device-count ceiling of the --only-mesh-scaling bench sweep "
+         "(the 1->2->4->8 ladder is clipped here; unset = up to 8 or "
+         "the available device count)"),
     Knob("TEMPO_TPU_PLAN_CACHE_SIZE", "int", "64", "tempo_tpu/plan/cache",
          "LRU bound of the planner's compiled-executable cache "
          "(entries keyed by plan signature + shapes + mesh; 0 disables "

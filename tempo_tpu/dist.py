@@ -89,10 +89,22 @@ class DistCol:
     host_gather: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
 
 
-def _spec(mesh: Mesh, series_axis: str, time_axis: Optional[str],
+def _spec(mesh: Mesh, series_axis, time_axis: Optional[str],
           ndim: int = 2) -> P:
     lead = [None] * (ndim - 2)
     return P(*(lead + [series_axis, time_axis]))
+
+
+def _ns(mesh: Mesh, spec: P) -> NamedSharding:
+    """NamedSharding for a stage-boundary declaration: every chained
+    shard_map program below jits with explicit ``in_shardings`` /
+    ``out_shardings`` built from its own shard specs, so stage N's
+    output layout IS stage N+1's input layout by construction — a
+    mis-laid operand raises at dispatch instead of compiling an
+    implicit reshard (the zero-undeclared-collectives contract of the
+    mesh chain, checked compiled-side by the stage-sharding-match rule
+    in tools/analysis/compiled)."""
+    return NamedSharding(mesh, spec)
 
 
 class DistributedTSDF:
@@ -136,6 +148,12 @@ class DistributedTSDF:
 
     @property
     def n_series_shards(self) -> int:
+        # a series-LOCAL re-laid frame (reshard_frame) shards its K axis
+        # jointly over ('series', 'time'): the axis name is a tuple and
+        # the shard count is the product
+        if isinstance(self.series_axis, tuple):
+            return int(np.prod([self.mesh.shape[a]
+                                for a in self.series_axis]))
         return self.mesh.shape[self.series_axis]
 
     @property
@@ -346,6 +364,27 @@ class DistributedTSDF:
                 if colsToSummarize else None,
                 rangeBackWindowSecs=rangeBackWindowSecs,
                 strategy=strategy))
+        if strategy == "exact" and self.n_time > 1:
+            # exact stats on a time-sharded mesh: ONE explicit
+            # whole-frame reshard to the series-local layout
+            # (reshard_frame — the same program the planner's
+            # plan-placed reshard nodes run), the SAME local stats
+            # program every series-local frame runs, and one switch
+            # back.  The former in-kernel all_to_all sandwich
+            # (_range_stats_a2a_packed) compiled the collectives INTO
+            # the stats program, and XLA's FMA-contraction decisions
+            # around the cancellation-sensitive var/stddev math
+            # drifted in the last ulp vs the series-local program —
+            # which would have broken the plan optimizer's
+            # reshard-elimination bitwise contract (planned chains
+            # elide the interior switches and so MUST run the
+            # series-local program).
+            local = reshard_frame(self, RESHARD_SERIES_LOCAL)
+            out = local.withRangeStats(
+                colsToSummarize=colsToSummarize,
+                rangeBackWindowSecs=rangeBackWindowSecs,
+                strategy=strategy)
+            return reshard_frame(out, RESHARD_TIME_SHARDED)
         cols = colsToSummarize or self.numeric_columns()
         w = float(rangeBackWindowSecs)
         new_cols = dict(self.cols)
@@ -366,16 +405,10 @@ class DistributedTSDF:
             # (_range_stats_block_packed).
             xs = jnp.stack([self.cols[c].values for c in cols])
             vs = jnp.stack([self.cols[c].valid for c in cols])
-            if self.n_time > 1:
-                stats, rb_clipped = _range_stats_a2a_packed(
-                    self.mesh, self.series_axis, self.time_axis, w,
-                    rowbounds, sort_kernels, engine,
-                )(self.ts, xs, vs)
-            else:
-                stats, rb_clipped = _range_stats_local_packed(
-                    self.mesh, self.series_axis, w, rowbounds,
-                    sort_kernels, engine,
-                )(self.ts, xs, vs)
+            stats, rb_clipped = _range_stats_local_packed(
+                self.mesh, self.series_axis, w, rowbounds,
+                sort_kernels, engine,
+            )(self.ts, xs, vs)
             for ci, c in enumerate(cols):
                 if strategy == "exact" and rowbounds is not None:
                     # deferred truncation audit of the shifted-window
@@ -577,9 +610,16 @@ class DistributedTSDF:
         # pstack/vstack are freshly-stacked temporaries and the output
         # shape matches when the packed K agrees — donate their HBM to
         # the aligned copies (align2's operands are frame-owned: never
-        # donated)
+        # donated).  The layouts must also agree: a series-LOCAL left
+        # frame (plan-placed reshard) aligning a time-sharded right
+        # stack has different per-device buffer shapes, so XLA could
+        # not apply the alias and would silently keep both live.
         align3 = _align3_fn(self.mesh, self.series_axis, self.time_axis,
-                            donate=(right.K_dev == self.K_dev))
+                            donate=(right.K_dev == self.K_dev
+                                    and right.series_axis
+                                    == self.series_axis
+                                    and right.time_axis
+                                    == self.time_axis))
         pstack = align3(pstack, perm, ok, np.nan)
         vstack = align3(vstack, perm, ok, False)
 
@@ -626,6 +666,9 @@ class DistributedTSDF:
         r_mask_al = (align2(right.mask, perm, ok, False) if compact
                      else r_ts_al < packing.TS_REAL_MAX)
         has_seq = right.seq is not None
+        # stage donation applies only when the join outputs (left lane
+        # width) can alias the aligned right stacks (right lane width)
+        _donate_join = int(self.L) == int(right.L)
         if has_seq:
             # left rows ride the kernel-synthesized seq fill
             # (finfo.min in _merge_sides — above the -inf null-seq
@@ -636,12 +679,14 @@ class DistributedTSDF:
             if self.n_time > 1:
                 vals, found = _asof_a2a_seq(self.mesh, self.series_axis,
                                             self.time_axis, ml,
-                                            compact_left)(
+                                            compact_left,
+                                            donate=_donate_join)(
                     self.ts, self.mask, r_ts_al, r_seq_al, vstack, pstack
                 )
             else:
                 vals, found = _asof_local_seq(self.mesh, self.series_axis,
-                                              ml, compact_left)(
+                                              ml, compact_left,
+                                              donate=_donate_join)(
                     self.ts, self.mask, r_ts_al, r_seq_al, vstack, pstack
                 )
         elif self.n_time > 1:
@@ -651,13 +696,15 @@ class DistributedTSDF:
             # exactly, and switches back — no halo approximation
             vals, found = _asof_a2a(self.mesh, self.series_axis,
                                     self.time_axis, sort_kernels, ml,
-                                    compact, compact_left)(
+                                    compact, compact_left,
+                                    donate=_donate_join)(
                 self.ts, self.mask, r_ts_al, r_mask_al, vstack, pstack
             )
         else:
             vals, found = _asof_local(self.mesh, self.series_axis,
                                       sort_kernels, ml, compact,
-                                      compact_left)(
+                                      compact_left,
+                                      donate=_donate_join)(
                 self.ts, self.mask, r_ts_al, r_mask_al, vstack, pstack
             )
         audits = list(self.audits)
@@ -741,6 +788,15 @@ class DistributedTSDF:
                 freq=freq, func=func,
                 metricCols=tuple(metricCols) if metricCols else None))
         validateFuncExists(func)
+        if self.n_time > 1:
+            # time-sharded mesh: explicit whole-frame reshard + the
+            # series-local kernel + switch back (see withRangeStats —
+            # the mean aggregates are accumulation-sensitive, so the
+            # plan-placed reshard elimination requires the eager path
+            # to run the SAME series-local program)
+            local = reshard_frame(self, RESHARD_SERIES_LOCAL)
+            out = local.resample(freq, func, metricCols=metricCols)
+            return reshard_frame(out, RESHARD_TIME_SHARDED)
         step = freq_to_seconds(freq) * packing.NS_PER_S
         cols = metricCols or self.numeric_columns()
         fkey = {floor: 0, ceiling: 1, average: 2, min_func: 3, max_func: 4}[
@@ -896,6 +952,17 @@ class DistributedTSDF:
                 f"Please select from one of the following fill options: "
                 f"['zero', 'null', 'bfill', 'ffill', 'linear']: got {method}"
             )
+        if self.n_time > 1:
+            # the result is a NEW dense series-local frame even on a
+            # time-sharded mesh — reshard the inputs once (explicit
+            # program, same as the planner's reshard node), no switch
+            # back; the linear-fill lerp is FMA-sensitive, so the
+            # series-local kernel must be the one program both eager
+            # and planned chains run
+            return reshard_frame(self, RESHARD_SERIES_LOCAL).interpolate(
+                freq=freq, func=func, method=method,
+                target_cols=target_cols,
+                show_interpolated=show_interpolated)
         if self.resampled:
             freq = freq or self._resample_freq
             if freq != self._resample_freq:
@@ -954,8 +1021,17 @@ class DistributedTSDF:
                     col_interp[i].astype(vals.dtype), grid_mask, int64=True
                 )
         # interpolated frames are dense series-local grids: the time
-        # axis (if any) was consumed by the regather inside the kernel
+        # axis (if any) was consumed by the regather inside the kernel,
+        # and on a time-sharded mesh the outputs are JOINTLY sharded
+        # over ('series', 'time') — record that as the frame's series
+        # axis so downstream stages (whose jits now declare explicit
+        # in_shardings) see the true layout instead of compiling an
+        # implicit reshard against a stale P(series, None) claim
+        out_series_axis = ((res.series_axis, res.time_axis)
+                           if res.time_axis is not None
+                           else res.series_axis)
         return self._with(ts=grid_ts, mask=grid_mask, cols=new_cols,
+                          series_axis=out_series_axis,
                           time_axis=None, resampled=True,
                           seq=None, seq_col="", resample_freq=freq)
 
@@ -1121,9 +1197,23 @@ class DistributedTSDF:
                 else "no plain device plane for the column")
             with plan.suspended():
                 host = self.collect().fourier_transform(timestep, valueCol)
-                return host.on_mesh(self.mesh,
-                                    series_axis=self.series_axis,
-                                    time_axis=self.time_axis)
+                s_ax, t_ax = self.series_axis, self.time_axis
+                if isinstance(s_ax, tuple):
+                    # joint series-LOCAL frames (reshard_frame /
+                    # interpolate output) re-pack onto the plain series
+                    # axis: from_tsdf packs fresh from the host, so
+                    # there is no layout to preserve — and it cannot
+                    # look a tuple axis up in mesh.shape
+                    s_ax, t_ax = s_ax[0], None
+                return host.on_mesh(self.mesh, series_axis=s_ax,
+                                    time_axis=t_ax)
+        if self.n_time > 1:
+            # explicit reshard sandwich (see withRangeStats): the
+            # Bluestein DFT's accumulations must run the same
+            # series-local program eager and planned
+            local = reshard_frame(self, RESHARD_SERIES_LOCAL)
+            out = local.fourier_transform(timestep, valueCol)
+            return reshard_frame(out, RESHARD_TIME_SHARDED)
         vc = matches[0]
         col = self.cols[vc]
         freq, ftr, fti = _fourier_fn(self.mesh, self.series_axis,
@@ -1546,37 +1636,20 @@ def _range_stats_local_packed(mesh, series_axis, window_secs,
         return stats, jax.lax.psum(clipped, series_axis)
 
     stats_spec = {k: sp3 for k in packing.RANGE_STATS}
+    # the [C, K, L] value stack is a fresh jnp.stack at every call site
+    # (withRangeStats packs frame columns per call) and each f32 stats
+    # plane matches its shape/dtype — donate it so the packed stats
+    # reuse the stack's HBM instead of doubling the stage's working
+    # set.  The bool validity stack has no bool-shaped output and the
+    # ts plane is frame-owned: neither is donatable.
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp3, sp3),
-                             out_specs=(stats_spec, P())))
-
-
-@functools.lru_cache(maxsize=256)
-def _range_stats_a2a_packed(mesh, series_axis, time_axis, window_secs,
-                            rowbounds=None, sort_kernels=False,
-                            engine="shifted"):
-    """Time-sharded twin of :func:`_range_stats_local_packed`
-    (series-local layout switch around the stats, like the former
-    per-column ``_range_stats_a2a`` it replaces): the all_to_all pair
-    moves the [C, K, L] stack in one collective each way."""
-    sp = _spec(mesh, series_axis, time_axis)
-    sp3 = _spec(mesh, series_axis, time_axis, ndim=3)
-    w = window_secs
-
-    def kernel(ts, xs, valids):
-        fwd = lambda a, ax: jax.lax.all_to_all(
-            a, time_axis, split_axis=ax, concat_axis=ax + 1, tiled=True)
-        rev3 = lambda a: jax.lax.all_to_all(
-            a, time_axis, split_axis=2, concat_axis=1, tiled=True)
-        ts = fwd(ts, 0)
-        xs, valids = fwd(xs, 1), fwd(valids, 1)
-        stats, clipped = _range_stats_block_packed(ts, xs, valids, w,
-                                                   rowbounds, engine)
-        clipped = jax.lax.psum(clipped, (series_axis, time_axis))
-        return {k: rev3(v) for k, v in stats.items()}, clipped
-
-    stats_spec = {k: sp3 for k in packing.RANGE_STATS}
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp3, sp3),
-                             out_specs=(stats_spec, P())))
+                             out_specs=(stats_spec, P())),
+                   in_shardings=(_ns(mesh, sp), _ns(mesh, sp3),
+                                 _ns(mesh, sp3)),
+                   out_shardings=({k: _ns(mesh, sp3)
+                                   for k in packing.RANGE_STATS},
+                                  _ns(mesh, P())),
+                   donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=256)
@@ -1590,8 +1663,13 @@ def _ema_local(mesh, series_axis, alpha, exact, window):
             return pk.ema_scan(x, valid, alpha)
         return rk.ema_compat(x, valid, window, alpha)
 
+    # no donation: the EMA's value operand is the frame-OWNED column
+    # plane (the result frame shares it via ``_with``), unlike the
+    # join/stats stages whose operands are per-call stacks
     return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp, sp),
-                             out_specs=sp))
+                             out_specs=sp),
+                   in_shardings=(_ns(mesh, sp), _ns(mesh, sp)),
+                   out_shardings=_ns(mesh, sp))
 
 
 def _compact_right_lanes(r_ts, r_mask, vstack, pstack):
@@ -1666,7 +1744,7 @@ def _asof_planes(l_ts, r_ts, r_valids, r_values, sort_kernels,
 
 @functools.lru_cache(maxsize=256)
 def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0,
-                compact=False, compact_left=False):
+                compact=False, compact_left=False, donate=True):
     sp2 = _spec(mesh, series_axis, None)
     sp3 = _spec(mesh, series_axis, None, ndim=3)
 
@@ -1683,14 +1761,27 @@ def _asof_local(mesh, series_axis, sort_kernels=False, max_lookback=0,
             vals, found = _uncompact_left(src, vals, found)
         return vals, found
 
+    # whole-chain donation: the aligned validity/plane stacks are
+    # per-call temporaries (built by asofJoin, already donated once
+    # through _align3_fn) whose shapes/dtypes exactly match the
+    # ``found``/``vals`` outputs — each join stage reuses its consumed
+    # stage-N-1 buffers instead of doubling the chain's working set
+    # (verified compiled-side by the donation-applied contract rule).
+    # ``donate=False`` when the left/right lane widths differ: the
+    # outputs are left-width [P, K, Ll] and XLA could never alias a
+    # [P, K, Lr] stack onto them (it would warn and keep both live).
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
-                             out_specs=(sp3, sp3)))
+                             out_specs=(sp3, sp3)),
+                   in_shardings=(_ns(mesh, sp2),) * 4
+                   + (_ns(mesh, sp3),) * 2,
+                   out_shardings=(_ns(mesh, sp3), _ns(mesh, sp3)),
+                   donate_argnums=(4, 5) if donate else ())
 
 
 @functools.lru_cache(maxsize=256)
 def _asof_local_seq(mesh, series_axis, max_lookback=0,
-                    compact_left=False):
+                    compact_left=False, donate=True):
     """AS-OF with sequence tie-break: the merge join is the only exact
     form (reference union-sort semantics, tsdf.py:117-121), so it runs
     on every backend.  (A resampled RIGHT frame never has a sequence
@@ -1714,12 +1805,16 @@ def _asof_local_seq(mesh, series_axis, max_lookback=0,
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
-                             out_specs=(sp3, sp3)))
+                             out_specs=(sp3, sp3)),
+                   in_shardings=(_ns(mesh, sp2),) * 4
+                   + (_ns(mesh, sp3),) * 2,
+                   out_shardings=(_ns(mesh, sp3), _ns(mesh, sp3)),
+                   donate_argnums=(4, 5) if donate else ())
 
 
 @functools.lru_cache(maxsize=256)
 def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0,
-                  compact_left=False):
+                  compact_left=False, donate=True):
     from tempo_tpu.ops import sortmerge as sm
 
     sp2 = _spec(mesh, series_axis, time_axis)
@@ -1745,12 +1840,17 @@ def _asof_a2a_seq(mesh, series_axis, time_axis, max_lookback=0,
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
-                             out_specs=(sp3, sp3)))
+                             out_specs=(sp3, sp3)),
+                   in_shardings=(_ns(mesh, sp2),) * 4
+                   + (_ns(mesh, sp3),) * 2,
+                   out_shardings=(_ns(mesh, sp3), _ns(mesh, sp3)),
+                   donate_argnums=(4, 5) if donate else ())
 
 
 @functools.lru_cache(maxsize=256)
 def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
-              max_lookback=0, compact=False, compact_left=False):
+              max_lookback=0, compact=False, compact_left=False,
+              donate=True):
     """Exact AS-OF join on a time-sharded mesh: switch both sides to a
     series-local layout (full rows per device, one ``all_to_all`` per
     array), join locally, switch the [n_cols, K, Ll] results back."""
@@ -1780,7 +1880,11 @@ def _asof_a2a(mesh, series_axis, time_axis, sort_kernels=False,
 
     return jax.jit(shard_map(kernel, mesh=mesh,
                              in_specs=(sp2, sp2, sp2, sp2, sp3, sp3),
-                             out_specs=(sp3, sp3)))
+                             out_specs=(sp3, sp3)),
+                   in_shardings=(_ns(mesh, sp2),) * 4
+                   + (_ns(mesh, sp3),) * 2,
+                   out_shardings=(_ns(mesh, sp3), _ns(mesh, sp3)),
+                   donate_argnums=(4, 5) if donate else ())
 
 
 @functools.lru_cache(maxsize=256)
@@ -1832,6 +1936,137 @@ def _to_series_local_fn(mesh, series_axis, time_axis, n_arrays):
         kernel, mesh=mesh, in_specs=(sp_in,) * n_arrays,
         out_specs=(sp_out,) * n_arrays,
     ))
+
+
+# ----------------------------------------------------------------------
+# Plan-placed resharding: the executor of the planner's first-class
+# ``reshard`` IR node (tempo_tpu/plan/optimizer.py)
+# ----------------------------------------------------------------------
+
+#: targets of :func:`reshard_frame`: ``series_local`` re-lays a
+#: time-sharded frame so every device owns whole series (K sharded
+#: jointly over ('series', 'time'), rows unsplit) — the layout every
+#: per-series kernel wants; ``time_sharded`` is the inverse.
+RESHARD_SERIES_LOCAL = "series_local"
+RESHARD_TIME_SHARDED = "time_sharded"
+
+
+def reshard_frame(d: "DistributedTSDF", target: str) -> "DistributedTSDF":
+    """Explicit whole-frame layout switch — ONE jitted shard_map
+    program moving every device plane with ``lax.all_to_all`` (the
+    reshard.py collectives, fused across the frame's planes), instead
+    of each downstream op paying its own per-op all_to_all pair.  The
+    global logical [K, L] arrays are bit-identical before and after
+    (the collective moves bytes, computes nothing), which is what lets
+    the plan optimizer place/eliminate these nodes without breaking
+    the planned==eager bitwise contract.  A no-op when the frame is
+    already in the target layout.
+
+    Deliberately WHOLE-frame: untouched columns cross the wire too.
+    A partial relayout (move only the consulted planes) would leave
+    the frame mixed-layout, breaking the uniform-sharding invariant
+    every stage's explicit ``in_shardings`` now declares; the
+    planner's dead-column pruning is the sanctioned way to shrink the
+    moved set (it drops dead columns BEFORE packing, so they never
+    reach the reshard)."""
+    if target == RESHARD_SERIES_LOCAL:
+        if d.time_axis is None:
+            return d
+        s_ax, t_ax = d.series_axis, d.time_axis
+        new_series, new_time = (s_ax, t_ax), None
+    elif target == RESHARD_TIME_SHARDED:
+        if d.time_axis is not None or not (
+                isinstance(d.series_axis, tuple)
+                and len(d.series_axis) == 2):
+            return d
+        s_ax, t_ax = d.series_axis
+        new_series, new_time = s_ax, t_ax
+    else:
+        raise ValueError(f"unknown reshard target {target!r}")
+    names = list(d.cols)
+    fn = _relayout_fn(d.mesh, s_ax, t_ax,
+                      forward=(target == RESHARD_SERIES_LOCAL),
+                      with_cols=bool(names), has_seq=d.seq is not None)
+    ops = [d.ts, d.mask]
+    if names:
+        ops.append(jnp.stack([d.cols[c].values for c in names]))
+        ops.append(jnp.stack([d.cols[c].valid for c in names]))
+    if d.seq is not None:
+        ops.append(d.seq)
+    outs = list(fn(*ops))
+    ts2, mask2 = outs[0], outs[1]
+    i = 2
+    new_cols = dict(d.cols)
+    if names:
+        vals2, valids2 = outs[2], outs[3]
+        i = 4
+        new_cols = {
+            c: dataclasses.replace(col, values=vals2[j], valid=valids2[j])
+            for j, (c, col) in enumerate(d.cols.items())
+        }
+    seq2 = outs[i] if d.seq is not None else None
+    return d._with(ts=ts2, mask=mask2, cols=new_cols, seq=seq2,
+                   series_axis=new_series, time_axis=new_time)
+
+
+def relayout_comm_bytes(K_dev: int, L: int, n_cols: int, n_shards: int,
+                        has_seq: bool = False) -> int:
+    """Modeled per-shard all_to_all bytes of one :func:`reshard_frame`
+    call: every plane's per-shard element count (K*L / total shards)
+    times its itemsize — int64 ts + bool mask + n_cols x (compute
+    dtype value + bool validity) [+ seq].  The explain() annotation
+    and the reshard.plan_node compiled contract both read this model;
+    ``profiling.comm_bytes_from_compiled`` is the measured side."""
+    val_itemsize = np.dtype(packing.compute_dtype()).itemsize
+    elems = (K_dev * L) // max(n_shards, 1)
+    per_elem = 8 + 1 + n_cols * (val_itemsize + 1)
+    if has_seq:
+        per_elem += val_itemsize
+    return int(elems * per_elem)
+
+
+@functools.lru_cache(maxsize=256)
+def _relayout_fn(mesh, series_axis, time_axis, forward=True,
+                 with_cols=True, has_seq=False):
+    """The jitted relayout program: P(series, time) <-> the joint
+    P((series, time), None) series-local layout, every plane in one
+    program (ts/mask [K, L]; value/validity stacks [C, K, L]; optional
+    seq plane).  No donation: the input and output PER-DEVICE buffer
+    shapes differ by construction (that is the point of a layout
+    switch), so XLA could never apply an alias."""
+    joint = (series_axis, time_axis)
+    if forward:
+        sp2_in, sp2_out = P(series_axis, time_axis), P(joint, None)
+        sp3_in = P(None, series_axis, time_axis)
+        sp3_out = P(None, joint, None)
+    else:
+        sp2_in, sp2_out = P(joint, None), P(series_axis, time_axis)
+        sp3_in = P(None, joint, None)
+        sp3_out = P(None, series_axis, time_axis)
+
+    def kernel(*ops):
+        if forward:
+            a2a = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 2,
+                concat_axis=a.ndim - 1, tiled=True)
+        else:
+            a2a = lambda a: jax.lax.all_to_all(
+                a, time_axis, split_axis=a.ndim - 1,
+                concat_axis=a.ndim - 2, tiled=True)
+        return tuple(a2a(a) for a in ops)
+
+    in_specs = [sp2_in, sp2_in]
+    out_specs = [sp2_out, sp2_out]
+    if with_cols:
+        in_specs += [sp3_in, sp3_in]
+        out_specs += [sp3_out, sp3_out]
+    if has_seq:
+        in_specs.append(sp2_in)
+        out_specs.append(sp2_out)
+    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=tuple(in_specs),
+                             out_specs=tuple(out_specs)),
+                   in_shardings=tuple(_ns(mesh, s) for s in in_specs),
+                   out_shardings=tuple(_ns(mesh, s) for s in out_specs))
 
 
 @functools.lru_cache(maxsize=8)
@@ -2006,24 +2241,16 @@ def _interp_fn(mesh, series_axis, time_axis, step_ns, G, mkey, n_cols,
     from tempo_tpu.ops import sortmerge as sm
 
     n_t = mesh.shape[time_axis] if time_axis else 1
+    # interpolate() reshards time-sharded frames through reshard_frame
+    # BEFORE building this kernel, so only series-local (or degenerate
+    # size-1 time axis) frames reach here
+    assert n_t == 1, "interpolate kernels are series-local by contract"
     sp2_in = _spec(mesh, series_axis, time_axis)
     sp3_in = _spec(mesh, series_axis, time_axis, 3)
-    if n_t > 1:
-        out_axes = (series_axis, time_axis)
-        sp2_out = P(out_axes, None)
-        sp3_out = P(None, out_axes, None)
-    else:
-        sp2_out = _spec(mesh, series_axis, None)
-        sp3_out = _spec(mesh, series_axis, None, 3)
+    sp2_out = _spec(mesh, series_axis, None)
+    sp3_out = _spec(mesh, series_axis, None, 3)
 
     def kernel(ts, head, vals, valids):
-        if n_t > 1:
-            # series-local full rows: each device takes K/(ns*nt) series
-            a2a = lambda a: jax.lax.all_to_all(
-                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
-                tiled=True)
-            ts, head, vals, valids = (a2a(a) for a in
-                                      (ts, head, vals, valids))
         step = jnp.int64(step_ns)
         dt = vals.dtype
 
@@ -2156,6 +2383,9 @@ def _fourier_fn(mesh, series_axis, time_axis, timestep):
     from tempo_tpu.ops import fft as fft_ops
 
     n_t = mesh.shape[time_axis] if time_axis else 1
+    # fourier_transform() reshards time-sharded frames through
+    # reshard_frame BEFORE building this kernel
+    assert n_t == 1, "fourier kernels are series-local by contract"
     sp2 = _spec(mesh, series_axis, time_axis)
 
     def local(vals, mask):
@@ -2183,19 +2413,7 @@ def _fourier_fn(mesh, series_axis, time_axis, timestep):
                 jnp.where(ok, re.astype(vals.dtype), nan),
                 jnp.where(ok, im.astype(vals.dtype), nan))
 
-    def kernel(vals, mask):
-        if n_t > 1:
-            a2a_in = lambda a: jax.lax.all_to_all(
-                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
-                tiled=True)
-            a2a_out = lambda a: jax.lax.all_to_all(
-                a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
-                tiled=True)
-            outs = local(a2a_in(vals), a2a_in(mask))
-            return tuple(a2a_out(o) for o in outs)
-        return local(vals, mask)
-
-    return jax.jit(shard_map(kernel, mesh=mesh, in_specs=(sp2, sp2),
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(sp2, sp2),
                              out_specs=(sp2, sp2, sp2)))
 
 
@@ -2207,6 +2425,9 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
     and switch back — the reference's groupBy shuffle as two ICI
     collectives (reshard.py pattern)."""
     n_t = mesh.shape[time_axis] if time_axis else 1
+    # resample() reshards time-sharded frames through reshard_frame
+    # BEFORE building this kernel (dist.resample)
+    assert n_t == 1, "resample kernels are series-local by contract"
     sp2 = _spec(mesh, series_axis, time_axis)
     sp3 = _spec(mesh, series_axis, time_axis, 3)
 
@@ -2255,21 +2476,6 @@ def _resample_fn(mesh, series_axis, time_axis, step_ns, fkey, n_cols,
         new_ts = jnp.where(mask, b, packing.TS_PAD)
         return new_ts, head, jnp.stack(outs), jnp.stack(oks)
 
-    def kernel(ts, mask, vals, valids):
-        if n_t > 1:
-            a2a_in = lambda a: jax.lax.all_to_all(
-                a, time_axis, split_axis=a.ndim - 2, concat_axis=a.ndim - 1,
-                tiled=True)
-            a2a_out = lambda a: jax.lax.all_to_all(
-                a, time_axis, split_axis=a.ndim - 1, concat_axis=a.ndim - 2,
-                tiled=True)
-            ts, mask, vals, valids = (a2a_in(a) for a in
-                                      (ts, mask, vals, valids))
-            new_ts, head, ov, ok = local(ts, mask, vals, valids)
-            return (a2a_out(new_ts), a2a_out(head), a2a_out(ov),
-                    a2a_out(ok))
-        return local(ts, mask, vals, valids)
-
-    return jax.jit(shard_map(kernel, mesh=mesh,
+    return jax.jit(shard_map(local, mesh=mesh,
                              in_specs=(sp2, sp2, sp3, sp3),
                              out_specs=(sp2, sp2, sp3, sp3)))

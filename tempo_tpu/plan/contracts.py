@@ -37,12 +37,19 @@ Registry coverage map (program -> production user):
 ``dist.align3`` /               the eager + executor-replayed mesh
 ``dist.asof_local`` /           asofJoin -> withRangeStats -> EMA chain
 ``dist.range_stats_local`` /    (dist.py shard_map factories; also the
-``dist.ema_local``              ``plan.mesh_chain`` sharding chain)
+``dist.ema_local``              ``plan.mesh_chain`` sharding chain —
+                                join/stats now DONATE their consumed
+                                stage-N-1 stacks, round 10)
 ``dist.range_stats_windowed``   the data-independent windowed fallback
 ``halo.range_stats`` /          the time-sharded halo kernels
 ``halo.asof`` / ``halo.ema``    (parallel/halo.py; dryrun audit twin)
 ``reshard.series_to_time`` /    the explicit all_to_all layout
 ``reshard.time_to_series``      switches (parallel/reshard.py)
+``reshard.plan_node``           the planner's first-class reshard node
+                                executor (dist.reshard_frame: the
+                                whole-frame series-local switch the
+                                eager time-sharded stats/resample/
+                                fourier/interpolate paths now share)
 ``engine.join_single`` /        the ``pick_join_engine`` /
 ``engine.join_bitonic`` /       ``pick_range_engine`` XLA engine forms
 ``engine.range_shifted`` /      (ops/sortmerge.py, ops/pallas_merge.py
@@ -406,6 +413,14 @@ def _build_mesh_chain():
     join = dist._asof_local(mesh, "series", sort_kernels=True)
     join_c = join.lower(a["ts"], a["valid"], a["ts"], a["valid"],
                         vstack, planes).compile()
+    # whole-chain donation (round 10): the join donates its consumed
+    # aligned stacks (python args 4/5; the unused l/r masks are
+    # dropped by jit, so the COMPILED parameter indices are 2/3) onto
+    # its equal-shaped found/vals outputs, and the packed stats donate
+    # the per-call [C, K, L] value stack (compiled index 1) onto a
+    # stats plane — each stage of the chain reuses the buffers of the
+    # stage it consumed.
+    join_contract = Contract(donate_argnums=(2, 3))
 
     stats = dist._range_stats_local_packed(
         mesh, "series", _WINDOW_SECS, CONTRACT_ROWBOUNDS, True,
@@ -414,6 +429,7 @@ def _build_mesh_chain():
     stats_c = stats.lower(a["ts"], xs, a["rvalids"]).compile()
     stats_contract = Contract(
         incidental={"all-reduce": xs.shape[0] * 8 * 4},
+        donate_argnums=(1,),
     )
 
     ema = dist._ema_local(mesh, "series", 0.2, True, 31)
@@ -421,7 +437,7 @@ def _build_mesh_chain():
 
     programs = [
         CompiledProgram("dist.align3", align_c, align_contract),
-        CompiledProgram("dist.asof_local", join_c, Contract()),
+        CompiledProgram("dist.asof_local", join_c, join_contract),
         CompiledProgram("dist.range_stats_local", stats_c,
                         stats_contract),
         CompiledProgram("dist.ema_local", ema_c, Contract()),
@@ -480,6 +496,7 @@ def _build_stats_windowed():
     compiled = fn.lower(a["ts"], a["rvals"], a["rvalids"]).compile()
     contract = Contract(
         incidental={"all-reduce": a["rvals"].shape[0] * 8 * 4},
+        donate_argnums=(1,),
     )
     return CompiledProgram("dist.range_stats_windowed", compiled,
                            contract)
@@ -614,6 +631,32 @@ def _build_reshard_t2s():
     shard_bytes = (x.shape[0] // (n_s * n_t)) * x.shape[1] * 4
     contract = Contract(collectives={"all-to-all": shard_bytes})
     return CompiledProgram("reshard.time_to_series", compiled, contract)
+
+
+@register("reshard.plan_node", requires_devices=CONTRACT_SERIES)
+def _build_reshard_plan_node():
+    """The planner's first-class ``reshard`` node executor
+    (dist.reshard_frame / dist._relayout_fn): the whole-frame
+    series-local layout switch as ONE program — ts + mask + the
+    [C, K, L] value/validity stacks each ride one ``lax.all_to_all``
+    — modeled byte-exactly by ``dist.relayout_comm_bytes`` (the same
+    model explain() renders on placed reshard nodes and the
+    --only-mesh-scaling bench asserts).  No donation by construction:
+    a layout switch changes every per-device buffer shape, so no
+    input/output alias can exist."""
+    from tempo_tpu import dist
+
+    mesh = _grid_mesh()
+    a = _mesh_arrays(mesh, time_axis="time")
+    fn = dist._relayout_fn(mesh, "series", "time", forward=True,
+                           with_cols=True, has_seq=False)
+    compiled = fn.lower(a["ts"], a["valid"], a["rvals"],
+                        a["rvalids"]).compile()
+    K, L = a["ts"].shape
+    model = dist.relayout_comm_bytes(K, L, a["rvals"].shape[0],
+                                     CONTRACT_SERIES, has_seq=False)
+    contract = Contract(collectives={"all-to-all": model})
+    return CompiledProgram("reshard.plan_node", compiled, contract)
 
 
 @register("engine.join_single")

@@ -27,6 +27,12 @@ logger = logging.getLogger(__name__)
 
 def execute(root: ir.Node):
     key = ir.state_key(root)
+    if key is not None:
+        # the reshard-placement mode changes the OPTIMIZED plan without
+        # touching the logical signature — fold it into the cache key
+        # so flipping TEMPO_TPU_RESHARD_PLACEMENT never replays a plan
+        # placed under the other mode
+        key = key + (optimizer.reshard_mode(),)
     exe = cache.CACHE.lookup(key)
     if exe is None:
         t0 = time.perf_counter()
@@ -89,6 +95,13 @@ def _eval_op(node: ir.Node, ins: List):
 
     op = node.op
     p = node.param
+    if op == "reshard":
+        # the optimizer's first-class layout switch (plan-placed
+        # resharding): one explicit all_to_all program over the whole
+        # frame instead of per-op pairs inside every downstream stage
+        from tempo_tpu import dist as dist_mod
+
+        return dist_mod.reshard_frame(ins[0], p("target"))
     if op == "on_mesh":
         return ins[0].on_mesh(
             node.objs.get("mesh"), time_axis=p("time_axis"),

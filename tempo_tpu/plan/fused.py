@@ -290,7 +290,18 @@ def _fused_program(mesh, series_axis: str, stats_srcs: Tuple,
             ok[None, :, None], jnp.take(vstack, clip2, axis=1), False)
         return sharded(l_ts, lvals, lvalids, r_ts_al, vstack, pstack)
 
-    return jax.jit(fn, donate_argnums=DONATE_ARGNUMS)
+    # explicit stage shardings: operands arrive exactly as the frames
+    # hold them (series-sharded planes, replicated K-sized alignment
+    # metadata) and outputs leave pinned to the frame layout — a
+    # mis-laid operand raises instead of compiling an implicit reshard
+    ns = lambda s: jax.sharding.NamedSharding(mesh, s)
+    repl = ns(jax.sharding.PartitionSpec())
+    return jax.jit(
+        fn,
+        in_shardings=(ns(sp2), ns(sp3), ns(sp3), ns(sp2), ns(sp3),
+                      ns(sp3), repl, repl),
+        out_shardings=(ns(sp3), ns(sp3), ns(sp4), repl, ns(sp2)),
+        donate_argnums=DONATE_ARGNUMS)
 
 
 def compiled_cost(dl, dr, node: ir.Node):

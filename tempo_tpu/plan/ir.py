@@ -156,7 +156,11 @@ def _frame_state(frame) -> tuple:
     if isinstance(frame, DistributedTSDF):
         return ("dist", _mesh_state(frame.mesh), frame.K_dev, frame.L,
                 tuple(frame.cols), tuple(frame.host_cols),
-                frame.resampled, frame.seq_col)
+                frame.resampled, frame.seq_col,
+                # the packed layout: a series-LOCAL (jointly sharded)
+                # frame compiles different stage programs than a
+                # time-sharded one of the same shapes
+                frame.series_axis, frame.time_axis)
     df = frame.df
     return ("host", len(df), tuple(df.columns),
             tuple(str(t) for t in df.dtypes),
@@ -212,7 +216,7 @@ def output_columns(node: Node) -> Optional[List[str]]:
     cols = output_columns(node.inputs[0])
     if cols is None:
         return None
-    if node.op == "on_mesh":
+    if node.op in ("on_mesh", "reshard"):
         return cols
     if node.op == "select":
         sel = node.param("cols", ())
@@ -266,6 +270,6 @@ def consumed_columns(node: Node) -> Optional[List[str]]:
         return list(pick) if pick else None
     if node.op == "fourier":
         return [node.param("valueCol")]
-    if node.op in ("collect", "count", "on_mesh"):
+    if node.op in ("collect", "count", "on_mesh", "reshard"):
         return []
     return None

@@ -47,9 +47,27 @@ def optimize(root: ir.Node) -> ir.Node:
     root = _fuse_resample_ema(root)
     root = _fuse_mesh_chain(root)
     _hoist_engines(root)
+    root = _place_reshards(root)
     _prune_columns(root)
     _mark_barriers(root)
     return root
+
+
+def reshard_mode() -> str:
+    """``TEMPO_TPU_RESHARD_PLACEMENT`` — how the planner places layout
+    switches on time-sharded mesh chains: ``auto`` (default) inserts
+    explicit reshard nodes around maximal series-local-preferring op
+    runs, sinking/eliminating redundant switches; ``explicit`` reshards
+    around every such op individually (never eliminates — the
+    debugging view); ``declarative`` places no plan nodes and keeps
+    each op's internal all_to_all pair (XLA plans the collectives).
+    Part of the executable-cache key (executor.py): flipping the knob
+    never replays a plan placed under the other mode."""
+    from tempo_tpu import config
+
+    mode = (config.get("TEMPO_TPU_RESHARD_PLACEMENT") or "auto")
+    mode = mode.strip().lower()
+    return mode if mode in ("auto", "declarative", "explicit") else "auto"
 
 
 def _copy(root: ir.Node) -> ir.Node:
@@ -322,6 +340,189 @@ def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
 
 
 # ----------------------------------------------------------------------
+# Pass 2b: plan-placed resharding on time-sharded mesh chains
+# ----------------------------------------------------------------------
+
+#: ops whose shard-local kernels want series-local FULL rows — on a
+#: time-sharded mesh the eager methods bound each one with an explicit
+#: ``dist.reshard_frame`` switch pair (the join keeps its in-program
+#: ``_asof_a2a`` collectives: its math is float-accumulation-free and
+#: therefore layout-robust bitwise).  Their
+#: series-local twins are bitwise-identical (the kernels are batched
+#: over the lead axis and never couple rows), so the planner may run
+#: any RUN of them inside one series-local region bounded by two
+#: explicit ``reshard`` nodes: the interior all_to_all pairs are
+#: ELIMINATED (producer and consumer shardings already agree), and a
+#: pending reshard-back SINKS through further members of the set.
+_SERIES_LOCAL_OPS = ("asof_join", "range_stats", "resample", "fourier",
+                     "interpolate")
+
+#: ops a pending reshard-back may NOT sink past: their time-sharded
+#: and series-local executions differ in f32 association — EMA's
+#: cross-shard carry stitch (parallel/halo.py) vs the plain local scan
+#: bracket the same recurrence differently — so moving the layout
+#: boundary across them would break the bitwise planned==eager
+#: contract.  The reshard-back is placed immediately above them.
+_RESHARD_SINK_BLOCKERS = ("ema",)
+
+
+def _device_plane_count(node: ir.Node) -> Optional[int]:
+    """Best-effort device value-plane count of a node's result frame
+    (feeds the reshard nodes' modeled comm bytes in ``explain()``);
+    None when not statically derivable."""
+    if node.op == "dist_source":
+        return len(node.payload.cols)
+    if node.op == "on_mesh" and node.inputs \
+            and node.inputs[0].op == "source":
+        t = node.inputs[0].payload
+        return len([c for c in t.df.columns
+                    if c not in {t.ts_col, *t.partitionCols,
+                                 t.sequence_col or ""}])
+    if not node.inputs:
+        return None
+    base = _device_plane_count(node.inputs[0])
+    if base is None:
+        return None
+    if node.op == "reshard":
+        return base
+    if node.op == "asof_join":
+        right = _device_plane_count(node.inputs[1])
+        if right is None:
+            return None
+        return base + right + 3          # + the joined-ts chunk planes
+    if node.op == "range_stats":
+        pick = node.param("colsToSummarize")
+        import tempo_tpu.packing as packing
+
+        n_sum = len(pick) if pick else base
+        return base + len(packing.RANGE_STATS) * n_sum
+    if node.op == "ema":
+        return base + 1
+    if node.op in ("resample",):
+        pick = node.param("metricCols")
+        return len(pick) if pick else base
+    return None
+
+
+def _reshard_node(child: ir.Node, target: str) -> ir.Node:
+    node = ir.Node("reshard", params=dict(target=target), inputs=(child,))
+    node.ann["reshard"] = "placed"
+    planes = _device_plane_count(child)
+    src = next(iter(child.sources()), None)
+    if planes is not None and src is not None \
+            and src.op == "dist_source":
+        from tempo_tpu import dist
+
+        p = src.payload
+        node.ann["comm_bytes_model"] = dist.relayout_comm_bytes(
+            p.K_dev, p.L, planes,
+            p.n_series_shards * max(p.n_time, 1),
+            has_seq=p.seq is not None)
+    elif planes is not None and src is not None and src.op == "source":
+        mesh_node = child
+        while mesh_node.op != "on_mesh" and mesh_node.inputs:
+            mesh_node = mesh_node.inputs[0]
+        mesh = mesh_node.objs.get("mesh") if mesh_node.op == "on_mesh" \
+            else None
+        if mesh is not None:
+            from tempo_tpu import dist
+
+            K_dev, L, n_s, n_t = dist._mesh_packed_geometry(
+                src.payload.layout, mesh,
+                mesh_node.param("series_axis", "series"),
+                mesh_node.param("time_axis"))
+            node.ann["comm_bytes_model"] = dist.relayout_comm_bytes(
+                K_dev, L, planes, n_s * n_t,
+                has_seq=bool(src.payload.sequence_col))
+    return node
+
+
+def _place_reshards(root: ir.Node) -> ir.Node:
+    """Insert explicit ``reshard`` plan nodes on time-sharded mesh
+    chains (see :data:`_SERIES_LOCAL_OPS`): one switch to the
+    series-local layout at the head of each maximal series-local run,
+    one switch back where a sink-blocked op (or ``explicit`` mode)
+    requires the time-sharded layout again; the trailing switch is
+    eliminated outright when the consumer is ``collect``/``count``
+    (materialisation reads any layout).  ``declarative`` mode is a
+    no-op: every op keeps its internal all_to_all pair."""
+    mode = reshard_mode()
+    if mode == "declarative":
+        return root
+
+    layout: Dict[int, str] = {}        # id(node) -> "time" | "joint"
+
+    def fn(n: ir.Node) -> ir.Node:
+        if n.op == "dist_source":
+            p = n.payload
+            if p.time_axis is not None:
+                layout[id(n)] = "time"
+            elif isinstance(p.series_axis, tuple):
+                layout[id(n)] = "joint"
+            return n
+        if n.op == "on_mesh":
+            if n.param("time_axis") is not None:
+                layout[id(n)] = "time"
+            return n
+        if not n.inputs:
+            return n
+        in_layout = layout.get(id(n.inputs[0]))
+        if in_layout is None:
+            return n
+        series_local = n.op in _SERIES_LOCAL_OPS
+        if n.op == "range_stats" \
+                and n.param("strategy", "exact") != "exact":
+            # halo-strategy stats are DEFINED by the time-sharded
+            # layout (windows truncate at the halo, with an audit):
+            # resharding them series-local would silently compute the
+            # exact form instead — treat them as a boundary so the
+            # reshard-back lands above and eager/planned run the same
+            # halo program
+            series_local = False
+        if series_local:
+            if in_layout == "time":
+                r = _reshard_node(n.inputs[0], "series_local")
+                layout[id(r)] = "joint"
+                n.inputs = (r,) + n.inputs[1:]
+            else:
+                n.ann["reshard_eliminated"] = (
+                    "producer already series-local — shardings agree, "
+                    "the op's all_to_all pair is elided")
+            if n.op == "interpolate":
+                # interpolate's result is a NEW dense series-local
+                # frame in eager too (dist.py): nothing downstream
+                # ever reshards it back
+                return n
+            out = n
+            layout[id(out)] = "joint"
+            if mode == "explicit":
+                out = _reshard_node(n, "time_sharded")
+                layout[id(out)] = "time"
+            return out
+        if in_layout == "joint":
+            if n.op in ("collect", "count"):
+                n.ann["reshard_eliminated"] = (
+                    "trailing reshard elided — collect() materialises "
+                    "from any layout")
+                layout[id(n)] = "joint"
+                return n
+            r = _reshard_node(n.inputs[0], "time_sharded")
+            layout[id(r)] = "time"
+            n.inputs = (r,) + n.inputs[1:]
+            if n.op in _RESHARD_SINK_BLOCKERS:
+                n.ann["reshard_note"] = (
+                    "reshard-back not sunk past EMA: the time-sharded "
+                    "carry stitch and the series-local scan differ in "
+                    "f32 association (bitwise contract)")
+            layout[id(n)] = "time"
+            return n
+        layout[id(n)] = in_layout
+        return n
+
+    return _rewrite(root, fn)
+
+
+# ----------------------------------------------------------------------
 # Pass 3: dead-column pruning before packing
 # ----------------------------------------------------------------------
 
@@ -334,7 +535,8 @@ def _required_inputs(node: ir.Node, wanted: Wanted):
     n_in = len(node.inputs)
     if node.op == "count":
         return [frozenset()] * n_in
-    if node.op in ("collect", "on_mesh", "source", "dist_source"):
+    if node.op in ("collect", "on_mesh", "source", "dist_source",
+                   "reshard"):
         return [wanted] * n_in
     if node.op == "select":
         sel = node.param("cols", ())

@@ -36,8 +36,18 @@ def _node_line(node: ir.Node) -> str:
         axes = dict(p.mesh.shape)
         return (f"source[mesh {axes}] packed=[{p.K_dev}, {p.L}] "
                 f"cols={list(p.cols)}")
+    if node.op == "reshard":
+        line = f"reshard[{node.param('target')}]"
+        model = node.ann.get("comm_bytes_model")
+        line += ("  <- PLACED: explicit all_to_all layout switch"
+                 + (f", ~{model} B/shard modeled comm" if model else ""))
+        return line
     line = f"{node.op}({_param_str(node)})"
     notes = []
+    if "reshard_eliminated" in node.ann:
+        notes.append(f"reshard ELIMINATED: {node.ann['reshard_eliminated']}")
+    if "reshard_note" in node.ann:
+        notes.append(node.ann["reshard_note"])
     if "join_engine" in node.ann:
         est = node.ann.get("merged_lanes_est")
         notes.append(f"engine[join]={node.ann['join_engine']}"

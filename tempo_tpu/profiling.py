@@ -236,6 +236,37 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "f16": 2, "bf16": 2, "s64": 8,
                 "s8": 1, "u8": 1, "pred": 1}
 
 
+def _collective_instructions(text: str):
+    """The collective instructions of an optimized-HLO dump, yielded as
+    ``(kind, op, rhs)`` per instruction line — the ONE parser behind
+    both :func:`comm_bytes_from_compiled` and
+    :func:`collective_counts_from_compiled` (a second copy of the
+    which-line-is-a-collective logic would silently skew one audit
+    when the other is taught a new op kind).
+
+    e.g.  ``%all-to-all.1 = f32[4,16]{1,0} all-to-all(...)``
+          ``ROOT %cp = (f32[2,4]{...}, u32[]) collective-permute(...)``
+    Async decompositions count at the '-done' op (its result IS the
+    received data; the '-start' result is a bundle whose tuple would
+    double-count the operand)."""
+    import re
+
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = re.match(r"^(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$", stripped)
+        if not m:
+            continue
+        rhs = m.group(1)
+        for k in _COLLECTIVE_OPS:
+            for suffix in ("", "-done"):
+                if re.search(rf"\b{k}{suffix}\(", rhs):
+                    yield k, k + suffix, rhs
+                    break
+            else:
+                continue
+            break
+
+
 def comm_bytes_from_compiled(compiled,
                              text: Optional[str] = None) -> Dict[str, int]:
     """Per-kind ICI/DCN communication bytes of a compiled program, read
@@ -249,28 +280,8 @@ def comm_bytes_from_compiled(compiled,
     if text is None:
         text = compiled.as_text()
     out: Dict[str, int] = {}
-    # e.g.  %all-to-all.1 = f32[4,16]{1,0} all-to-all(...)
-    #       ROOT %cp = (f32[2,4]{...}, u32[]) collective-permute(...)
-    # Async decompositions count at the '-done' op (its result IS the
-    # received data; the '-start' result is a bundle whose tuple would
-    # double-count the operand).
     shape_re = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-    for line in text.splitlines():
-        stripped = line.strip()
-        m = re.match(r"^(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*)$", stripped)
-        if not m:
-            continue
-        rhs = m.group(1)
-        kind = op = None
-        for k in _COLLECTIVE_OPS:
-            for suffix in ("", "-done"):
-                if re.search(rf"\b{k}{suffix}\(", rhs):
-                    kind, op = k, k + suffix
-                    break
-            if kind:
-                break
-        if kind is None:
-            continue
+    for kind, op, rhs in _collective_instructions(text):
         # result type is everything before the op name: one shape, or a
         # tuple of shapes
         type_part = rhs.split(op + "(")[0]
@@ -284,6 +295,23 @@ def comm_bytes_from_compiled(compiled,
                     n *= int(d)
             nbytes += n * _DTYPE_BYTES[dt]
         out[kind] = out.get(kind, 0) + nbytes
+    return out
+
+
+def collective_counts_from_compiled(compiled,
+                                    text: Optional[str] = None
+                                    ) -> Dict[str, int]:
+    """Per-kind collective INSTRUCTION counts of a compiled program
+    (same :func:`_collective_instructions` parser as
+    :func:`comm_bytes_from_compiled`, counting ops instead of result
+    bytes).  The dryrun's per-stage reshard report reads the
+    ``all-to-all`` entry: each layout switch is one all_to_all
+    instruction per plane group."""
+    if text is None:
+        text = compiled.as_text()
+    out: Dict[str, int] = {}
+    for kind, _, _ in _collective_instructions(text):
+        out[kind] = out.get(kind, 0) + 1
     return out
 
 

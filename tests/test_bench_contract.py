@@ -43,7 +43,7 @@ def test_bench_smoke_prints_one_json_line():
         "2b_range_stats_dense_50hz", "6_seq_tiebreak_asof",
         "7_frame_e2e_pipeline", "8_chunked_205k_k128",
         "9_chunked_1m_single", "10_planned_chain",
-        "11_serving_ticks_per_sec",
+        "11_serving_ticks_per_sec", "12_mesh_scaling_top",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -79,6 +79,19 @@ def test_bench_smoke_prints_one_json_line():
     assert sv.get("p50_ms") is not None and sv.get("p99_ms") is not None
     assert sv.get("zero_builds_steady_state") is True
     assert "bitwise" in sv.get("value_audit", "")
+    # config 12 (round 10): the mesh-scaling sweep must have measured
+    # every device count of its (smoke-clipped) ladder, each point with
+    # the in-bench planned==eager bitwise audit and the per-stage comm
+    # audit performed
+    ms = rec.get("mesh_scaling") or {}
+    per = ms.get("per_device_count") or {}
+    assert per, ms
+    for n in ms.get("device_counts", []):
+        point = per.get(str(n)) or {}
+        assert point.get("rows_per_sec", 0) > 0, (n, point)
+        assert "bitwise" in point.get("value_audit", ""), (n, point)
+        assert "COLLECTIVE_TOLERANCE" in point.get("comm_audit", "")
+    assert ms.get("scaling_vs_1dev"), ms
     # NB: no hbm_frac assertion here — the 819 GB/s bound is a physical
     # invariant of the v5e only; a cache-resident CPU smoke run can
     # legitimately exceed it (bench.py gates its own check on backend)

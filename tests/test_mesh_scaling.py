@@ -1,0 +1,510 @@
+"""Real multi-chip scaling of the planned/fused mesh chain (ISSUE 10).
+
+Four contracts:
+
+* **planned == eager bitwise across the device sweep** — config 7's
+  frame-level chain (``on_mesh().asofJoin().withRangeStats().EMA()``)
+  at 1/2/4/8 virtual devices, across the seq-tie / skipNulls /
+  maxLookback variants.  This is also the named mesh-identity gate in
+  tools/run_checks.sh.
+* **plan-placed resharding** — on a time-sharded mesh the optimizer
+  inserts explicit ``reshard`` nodes around maximal series-local op
+  runs, ELIMINATES the interior switches (producer/consumer shardings
+  agree), SINKS the reshard-back through further series-local ops, and
+  refuses to sink past EMA (whose carry-stitch and local-scan forms
+  differ in f32 association) — all without breaking bit-identity.
+* **whole-chain donation** — each stage's consumed stage-N-1 stacks
+  are donated (input_output_alias in the compiled executable) and the
+  chain never reuses a stale buffer: frames still referencing their
+  planes survive the chain bit-intact, and repeated runs agree.
+* **stage-sharding handoff** — stage N's compiled out-sharding equals
+  stage N+1's in-sharding, and no stage's compiled HLO contains a
+  collective kind beyond its declared inventory (zero implicit
+  resharding between chained programs).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tempo_tpu  # noqa: F401  (jax config side effects)
+import jax
+
+from tempo_tpu import TSDF, dist, profiling
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import fused as plan_fused
+from tempo_tpu.plan import ir, optimizer
+
+K, L = 8, 40
+WINDOW = 10
+
+
+def make_frames(seed=0, nulls=False, seq=False, rows=L):
+    rng = np.random.default_rng(seed)
+    secs = np.cumsum(rng.integers(1, 3, size=(K, rows)).astype(np.int64),
+                     axis=-1)
+    syms = np.repeat([f"s{i}" for i in range(K)], rows)
+    df_l = pd.DataFrame({"sym": syms, "event_ts": secs.ravel(),
+                         "x": rng.standard_normal(K * rows)})
+    r_secs = np.cumsum(rng.integers(1, 3, size=(K, rows)).astype(np.int64),
+                       axis=-1)
+    v0 = rng.standard_normal(K * rows)
+    if nulls:
+        v0[rng.random(K * rows) < 0.15] = np.nan
+    df_r = pd.DataFrame({"sym": syms, "event_ts": r_secs.ravel(),
+                         "v0": v0, "v1": rng.standard_normal(K * rows)})
+    seq_col = None
+    if seq:
+        df_r["seq"] = rng.integers(0, 5, size=K * rows)
+        seq_col = "seq"
+    return (TSDF(df_l, "event_ts", ["sym"]),
+            TSDF(df_r, "event_ts", ["sym"], sequence_col=seq_col))
+
+
+@pytest.fixture
+def plan_toggle(monkeypatch):
+    """(set_planning) toggle + cache hygiene around each test."""
+    plan_cache.CACHE.clear()
+
+    def set_planning(on: bool):
+        if on:
+            monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+        else:
+            monkeypatch.delenv("TEMPO_TPU_PLAN", raising=False)
+
+    yield set_planning
+    plan_cache.CACHE.clear()
+
+
+def _series_mesh(n):
+    return make_mesh({"series": n}, devices=jax.devices()[:n])
+
+
+def _grid_mesh():
+    return make_mesh({"series": 4, "time": 2})
+
+
+# ----------------------------------------------------------------------
+# planned == eager bitwise across the 1 -> 8 device sweep (config 7)
+# ----------------------------------------------------------------------
+
+VARIANTS = {
+    "seq": dict(data=dict(nulls=True, seq=True), join=dict()),
+    "skipnulls": dict(data=dict(nulls=True), join=dict(skipNulls=False)),
+    "lookback": dict(data=dict(nulls=True), join=dict(maxLookback=3)),
+}
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4, 8])
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_config7_chain_bitwise_across_device_sweep(plan_toggle, n_dev,
+                                                   variant):
+    spec = VARIANTS[variant]
+    lt, rt = make_frames(seed=5, **spec["data"])
+
+    def fn():
+        dl = lt.on_mesh(_series_mesh(n_dev))
+        dr = rt.on_mesh(_series_mesh(n_dev))
+        return (dl.asofJoin(dr, **spec["join"])
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW)
+                .EMA("x", exact=True)
+                .collect().df)
+
+    plan_toggle(False)
+    eager = fn()
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    planned = fn()
+    pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+# ----------------------------------------------------------------------
+# plan-placed resharding on time-sharded chains
+# ----------------------------------------------------------------------
+
+def _optimized(lazy_frame):
+    root = ir.Node("collect", inputs=(lazy_frame.plan,))
+    return optimizer.optimize(root)
+
+
+def _reshard_nodes(root):
+    return [n for n in root.walk() if n.op == "reshard"]
+
+
+def test_reshard_eliminated_when_shardings_agree(plan_toggle):
+    """join -> stats on a time mesh: one reshard INTO the series-local
+    region; the stats op's switch and the trailing switch before
+    collect are both eliminated."""
+    lt, rt = make_frames(seed=2)
+    plan_toggle(True)
+    lazy = (lt.on_mesh(_grid_mesh(), time_axis="time")
+            .asofJoin(rt.on_mesh(_grid_mesh(), time_axis="time"))
+            .withRangeStats(colsToSummarize=["x"],
+                            rangeBackWindowSecs=WINDOW))
+    opt = _optimized(lazy)
+    placed = _reshard_nodes(opt)
+    assert len(placed) == 1
+    assert placed[0].param("target") == "series_local"
+    stats = [n for n in opt.walk() if n.op == "range_stats"][0]
+    assert "shardings agree" in stats.ann["reshard_eliminated"]
+    collect = [n for n in opt.walk() if n.op == "collect"][0]
+    assert "reshard_eliminated" in collect.ann
+
+
+def test_reshard_sink_blocked_by_ema_stays_bitwise(plan_toggle):
+    """join -> stats -> EMA -> stats2: the reshard-back may NOT sink
+    past EMA (carry-stitch vs local-scan f32 association), so the
+    optimized plan carries THREE placed reshards — and the chain is
+    still bit-identical to eager."""
+    lt, rt = make_frames(seed=3)
+
+    def fn():
+        dl = lt.on_mesh(_grid_mesh(), time_axis="time")
+        dr = rt.on_mesh(_grid_mesh(), time_axis="time")
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW)
+                .EMA("x", exact=True)
+                .withRangeStats(colsToSummarize=["EMA_x"],
+                                rangeBackWindowSecs=WINDOW))
+
+    plan_toggle(True)
+    opt = _optimized(fn())
+    assert len(_reshard_nodes(opt)) == 3
+    ema = [n for n in opt.walk() if n.op == "ema"][0]
+    assert "not sunk past EMA" in ema.ann["reshard_note"]
+
+    plan_toggle(False)
+    eager = fn().collect().df
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    planned = fn().collect().df
+    pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+def test_reshard_sinks_through_series_local_ops(plan_toggle):
+    """join -> stats -> resample: resample is itself series-local, so
+    the pending reshard-back sinks through it and the whole chain runs
+    in ONE series-local region (a single placed reshard)."""
+    lt, rt = make_frames(seed=4)
+
+    def fn():
+        dl = lt.on_mesh(_grid_mesh(), time_axis="time")
+        dr = rt.on_mesh(_grid_mesh(), time_axis="time")
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW)
+                .resample("1 minute", "mean", metricCols=["x"]))
+
+    plan_toggle(True)
+    opt = _optimized(fn())
+    placed = _reshard_nodes(opt)
+    assert len(placed) == 1
+    rs = [n for n in opt.walk() if n.op == "resample"][0]
+    assert "reshard_eliminated" in rs.ann
+
+    plan_toggle(False)
+    eager = fn().collect().df
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    planned = fn().collect().df
+    pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+def test_halo_strategy_stats_never_resharded(plan_toggle):
+    """strategy='halo' stats are DEFINED by the time-sharded layout
+    (windows truncate at the halo): the reshard pass must treat them
+    as a boundary, not a series-local member — planned and eager must
+    both run the halo program, truncation and audit included."""
+    lt, rt = make_frames(seed=21)
+
+    def fn():
+        dl = lt.on_mesh(_grid_mesh(), time_axis="time")
+        dr = rt.on_mesh(_grid_mesh(), time_axis="time")
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW,
+                                strategy="halo"))
+
+    plan_toggle(True)
+    opt = _optimized(fn())
+    stats = [n for n in opt.walk() if n.op == "range_stats"][0]
+    assert "reshard_eliminated" not in stats.ann
+    # the join's region closes with a reshard-back ABOVE the halo stats
+    assert stats.inputs[0].op == "reshard"
+    assert stats.inputs[0].param("target") == "time_sharded"
+
+    plan_toggle(False)
+    eager = fn().collect().df
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    planned = fn().collect().df
+    pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+@pytest.mark.parametrize("mode,n_expected", [("explicit", 4),
+                                             ("declarative", 0)])
+def test_reshard_placement_modes(plan_toggle, monkeypatch, mode,
+                                 n_expected):
+    """TEMPO_TPU_RESHARD_PLACEMENT=explicit reshards around every
+    series-local op (no elimination); declarative places no plan nodes
+    (each op keeps its internal collective pair).  Both bit-identical
+    to eager."""
+    monkeypatch.setenv("TEMPO_TPU_RESHARD_PLACEMENT", mode)
+    lt, rt = make_frames(seed=6)
+
+    def fn():
+        dl = lt.on_mesh(_grid_mesh(), time_axis="time")
+        dr = rt.on_mesh(_grid_mesh(), time_axis="time")
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW))
+
+    plan_toggle(True)
+    opt = _optimized(fn())
+    assert len(_reshard_nodes(opt)) == n_expected
+
+    plan_toggle(False)
+    eager = fn().collect().df
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    planned = fn().collect().df
+    pd.testing.assert_frame_equal(eager, planned, check_exact=True)
+
+
+def test_reshard_frame_roundtrip_bit_identical():
+    """The reshard node's executor: a series_local switch re-lays every
+    plane onto the joint ('series', 'time') axis without changing one
+    bit of the logical arrays; the inverse restores the original
+    layout."""
+    lt, rt = make_frames(seed=7)
+    d = lt.on_mesh(_grid_mesh(), time_axis="time")
+    before = d.collect().df
+
+    local = dist.reshard_frame(d, dist.RESHARD_SERIES_LOCAL)
+    assert local.series_axis == ("series", "time")
+    assert local.time_axis is None
+    assert local.n_series_shards == 8
+    spec = tuple(local.ts.sharding.spec)
+    assert spec and spec[0] == ("series", "time")
+    pd.testing.assert_frame_equal(before, local.collect().df,
+                                  check_exact=True)
+
+    back = dist.reshard_frame(local, dist.RESHARD_TIME_SHARDED)
+    assert back.series_axis == "series" and back.time_axis == "time"
+    pd.testing.assert_frame_equal(before, back.collect().df,
+                                  check_exact=True)
+    # no-ops: already in the target layout
+    assert dist.reshard_frame(local, dist.RESHARD_SERIES_LOCAL) is local
+    assert dist.reshard_frame(back, dist.RESHARD_TIME_SHARDED) is back
+
+
+def test_fourier_fallback_on_joint_resampled_frame():
+    """A joint series-local frame (interpolate output on a time mesh)
+    taking fourier's resampled host-fallback must re-pack onto the
+    plain series axis — from_tsdf cannot look a tuple axis up in
+    mesh.shape (round-10 review regression)."""
+    lt, _ = make_frames(seed=22)
+    d = lt.on_mesh(_grid_mesh(), time_axis="time")
+    g = d.interpolate(freq="30 seconds", func="mean", method="linear",
+                      target_cols=["x"])
+    assert isinstance(g.series_axis, tuple)
+    out = g.fourier_transform(1.0, "x")
+    df = out.collect().df
+    assert {"freq", "ft_real", "ft_imag"} <= set(df.columns)
+    # the fallback IS collect + host fourier + re-pack: exact match
+    ref = g.collect().fourier_transform(1.0, "x").df
+    pd.testing.assert_frame_equal(df, ref, check_exact=True)
+
+
+def test_reshard_comm_model_matches_compiled():
+    """relayout_comm_bytes == the all-to-all bytes in the relayout
+    program's compiled HLO (the model explain() renders and the
+    reshard.plan_node contract declares)."""
+    lt, rt = make_frames(seed=8)
+    d = lt.on_mesh(_grid_mesh(), time_axis="time")
+    fn = dist._relayout_fn(d.mesh, "series", "time", forward=True,
+                           with_cols=True, has_seq=False)
+    import jax.numpy as jnp
+
+    xs = jnp.stack([d.cols[c].values for c in d.cols])
+    vs = jnp.stack([d.cols[c].valid for c in d.cols])
+    compiled = fn.lower(d.ts, d.mask, xs, vs).compile()
+    measured = profiling.comm_bytes_from_compiled(compiled)
+    model = dist.relayout_comm_bytes(d.K_dev, d.L, len(d.cols),
+                                     d.n_series_shards * d.n_time,
+                                     has_seq=False)
+    assert measured.get("all-to-all") == model, (measured, model)
+
+
+def test_explain_renders_placed_and_eliminated_reshards(plan_toggle):
+    lt, rt = make_frames(seed=9)
+    plan_toggle(True)
+    lazy = (lt.on_mesh(_grid_mesh(), time_axis="time")
+            .asofJoin(rt.on_mesh(_grid_mesh(), time_axis="time"))
+            .withRangeStats(colsToSummarize=["x"],
+                            rangeBackWindowSecs=WINDOW)
+            .EMA("x", exact=True))
+    text = lazy.explain()
+    assert "reshard[series_local]" in text
+    assert "PLACED: explicit all_to_all layout switch" in text
+    assert "B/shard modeled comm" in text
+    assert "reshard ELIMINATED" in text
+    assert "not sunk past EMA" in text
+
+
+# ----------------------------------------------------------------------
+# whole-chain donation
+# ----------------------------------------------------------------------
+
+def test_chain_donation_applied_in_compiled_stages():
+    """The join donates its aligned stacks and the packed stats donate
+    their value stack: input_output_alias entries in the compiled
+    executables (the donation-applied contract's runtime twin)."""
+    lt, rt = make_frames(seed=10)
+    mesh = _series_mesh(8)
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+    import jax.numpy as jnp
+
+    rvals = jnp.stack([dr.cols[c].values for c in dr.cols])
+    rvalids = jnp.stack([dr.cols[c].valid for c in dr.cols])
+    planes, vstack = plan_fused._right_stacks(dr.ts, dr.mask, rvals,
+                                              rvalids)
+    from tempo_tpu.ops.sortmerge import use_sort_kernels
+
+    join_c = dist._asof_local(mesh, "series",
+                              sort_kernels=use_sort_kernels()) \
+        .lower(dl.ts, dl.mask, dr.ts, dr.mask, vstack, planes).compile()
+    assert profiling.donated_params_from_compiled(join_c) == {2, 3}
+
+    engine, rowbounds, sk = dl._range_engine_choice(float(WINDOW))
+    stats_c = dist._range_stats_local_packed(
+        mesh, "series", float(WINDOW), rowbounds, sk, engine) \
+        .lower(dl.ts, rvals, rvalids).compile()
+    assert profiling.donated_params_from_compiled(stats_c) == {1}
+
+
+def test_donation_no_stale_buffer_reuse(plan_toggle):
+    """Donation must never invalidate a frame-owned buffer: the right
+    frame's columns survive the chain bit-intact, and repeated runs
+    (eager and planned-cache-hit) agree bitwise."""
+    lt, rt = make_frames(seed=11, nulls=True)
+    mesh = _series_mesh(8)
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+
+    def chain():
+        return (dl.asofJoin(dr)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=WINDOW)
+                .EMA("x", exact=True)
+                .collect().df)
+
+    plan_toggle(False)
+    right_before = dr.collect().df
+    first = chain()
+    second = chain()
+    pd.testing.assert_frame_equal(first, second, check_exact=True)
+    # the donated stacks were per-call copies: the right frame's own
+    # planes must be untouched
+    pd.testing.assert_frame_equal(right_before, dr.collect().df,
+                                  check_exact=True)
+
+    plan_toggle(True)
+    plan_cache.CACHE.clear()
+    p1 = chain()
+    p2 = chain()     # cache hit replays the same executable
+    pd.testing.assert_frame_equal(first, p1, check_exact=True)
+    pd.testing.assert_frame_equal(p1, p2, check_exact=True)
+
+
+def test_join_donation_skipped_on_width_mismatch():
+    """Different left/right lane widths: the join outputs are
+    left-width, XLA could not alias — asofJoin must request NO donation
+    (a dropped donation would warn and silently keep both buffers)."""
+    import warnings
+
+    lt, _ = make_frames(seed=12)
+    _, rt = make_frames(seed=13, rows=2 * L)
+    mesh = _series_mesh(4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        out = lt.on_mesh(mesh).asofJoin(rt.on_mesh(mesh)).collect().df
+    assert len(out) == K * L
+
+
+# ----------------------------------------------------------------------
+# stage-sharding handoff + collective inventory
+# ----------------------------------------------------------------------
+
+def _flat_specs(shardings):
+    leaves = jax.tree_util.tree_leaves(shardings)
+    return [tuple(s.spec) if hasattr(s, "spec") else None
+            for s in leaves]
+
+
+def _strip(spec):
+    spec = tuple(spec)
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+def test_stage_handoff_shardings_match_and_no_undeclared_collectives():
+    """Every boundary of the 4-stage chain hands off in-layout (the
+    compiled out-sharding of stage N equals stage N+1's in-sharding)
+    and no stage's compiled HLO carries a collective kind beyond its
+    declared inventory."""
+    lt, rt = make_frames(seed=14)
+    mesh = _series_mesh(8)
+    dl = lt.on_mesh(mesh)
+    dr = rt.on_mesh(mesh)
+    import jax.numpy as jnp
+
+    rvals = jnp.stack([dr.cols[c].values for c in dr.cols])
+    rvalids = jnp.stack([dr.cols[c].valid for c in dr.cols])
+    planes, vstack = plan_fused._right_stacks(dr.ts, dr.mask, rvals,
+                                              rvalids)
+    perm, ok = dist._key_perm(dl.layout.key_frame, dr.layout.key_frame,
+                              dl.partitionCols, dl.K_dev)
+    from tempo_tpu.ops.sortmerge import use_sort_kernels
+
+    sk = use_sort_kernels()
+    engine, rowbounds, _ = dl._range_engine_choice(float(WINDOW))
+    align_c = dist._align3_fn(mesh, "series", None, donate=True) \
+        .lower(planes, jnp.asarray(perm), jnp.asarray(ok),
+               float("nan")).compile()
+    join_c = dist._asof_local(mesh, "series", sort_kernels=sk) \
+        .lower(dl.ts, dl.mask, dr.ts, dr.mask, vstack, planes).compile()
+    stats_c = dist._range_stats_local_packed(
+        mesh, "series", float(WINDOW), rowbounds, sk, engine) \
+        .lower(dl.ts, rvals, rvalids).compile()
+    ema_c = dist._ema_local(mesh, "series", 0.2, True, 30) \
+        .lower(dl.cols["x"].values, dl.cols["x"].valid).compile()
+
+    # handoffs (flat indices mirror the plan.mesh_chain contract links;
+    # jit drops the join's unused mask args, so its 6 python operands
+    # compile to 4 inputs)
+    def ins(c):
+        s = c.input_shardings
+        return _flat_specs(s[0] if isinstance(s, tuple) else s)
+
+    outs = lambda c: _flat_specs(c.output_shardings)
+    assert _strip(outs(align_c)[0]) == _strip(ins(join_c)[3])
+    assert _strip(outs(join_c)[0]) == _strip(ins(stats_c)[1])
+    assert _strip(outs(join_c)[1]) == _strip(ins(stats_c)[2])
+    # a [K, L] stats plane (leading C axis sliced host-side) -> EMA
+    assert _strip(outs(stats_c)[0][1:]) == _strip(ins(ema_c)[0])
+
+    declared = {"align": ({"all-gather"}, align_c),
+                "join": (set(), join_c),
+                "stats": ({"all-reduce"}, stats_c),
+                "ema": (set(), ema_c)}
+    for name, (allowed, compiled) in declared.items():
+        kinds = set(profiling.collective_counts_from_compiled(compiled))
+        assert kinds <= allowed, (
+            f"stage {name}: undeclared collective kinds "
+            f"{kinds - allowed}")

@@ -13,6 +13,13 @@ python tools/analyze.py || exit $?
 echo "== compiled contracts (tools/analyze.py --compiled) =="
 JAX_PLATFORMS=cpu python tools/analyze.py --compiled || exit $?
 
+echo "== mesh identity (tests/test_mesh_scaling.py) =="
+# the planned==eager bitwise contract of the mesh chain across the
+# virtual 1->8 device sweep, plus reshard placement and the
+# stage-sharding/donation handoffs, surfaced as its own gate
+JAX_PLATFORMS=cpu python -m pytest tests/test_mesh_scaling.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== serving identity (tests/test_serve.py) =="
 # the streamed==batch bitwise contract, surfaced as its own gate (it
 # also runs inside tier-1 below; a fast fail here names the subsystem)
