@@ -1907,6 +1907,184 @@ def bench_mesh_scaling():
     }
 
 
+def _cost_flip_demo(left, right):
+    """The round-11 acceptance's cost-decided engine flip, run in-bench:
+    the SAME host AS-OF join executed under the default cost priors
+    (engine 'single') and under a measured override that collapses the
+    single-program rate (engine 'bracket'), with the outputs asserted
+    bitwise identical — all join engines are bit-identical, so the
+    cost model may flip WHICH one runs but never a result bit."""
+    import pandas as pd
+
+    from tempo_tpu import profiling, resilience
+    from tempo_tpu.plan import cost as plan_cost
+
+    limit = resilience.max_merged_lanes()
+    est = 2 * left.df.shape[0]       # well under the ceiling
+    pick_default = profiling.pick_join_engine(est, limit,
+                                              chunked_ok=False)
+    out_default = left.asofJoin(right, right_prefix="r").df
+    plan_cost.set_measured(join_single_rate=1e3)
+    try:
+        pick_flipped = profiling.pick_join_engine(est, limit,
+                                                  chunked_ok=False)
+        out_flipped = left.asofJoin(right, right_prefix="r").df
+    finally:
+        plan_cost.clear_measured()
+    assert pick_default == "single" and pick_flipped == "bracket", (
+        f"cost flip demo: expected single -> bracket, got "
+        f"{pick_default} -> {pick_flipped}")
+    pd.testing.assert_frame_equal(out_default, out_flipped,
+                                  check_exact=True)
+    return {
+        "decision": "pick_join_engine",
+        "default_inputs": pick_default,
+        "flipped_inputs": pick_flipped,
+        "flip": "set_measured(join_single_rate=1e3)",
+        "value_audit": "flipped == default bitwise "
+                       "(assert_frame_equal check_exact)",
+    }
+
+
+def bench_query_service(seed=13):
+    """Config 13 (--only-query-service): the multi-tenant query service
+    under concurrent Poisson load.
+
+    ``n_tenants`` client threads each submit a mixed stream of query
+    shapes (plain AS-OF join; join + range stats; range stats + EMA)
+    over SHARED source frames with exponential inter-arrival gaps,
+    against one :class:`tempo_tpu.service.QueryService`.  Hard in-bench
+    invariants:
+
+    * **zero recompiles at steady state** — after a 3-query warmup
+      (one per shape) the plan cache's builds counter must stay flat
+      across the whole measured phase (single-flight + signature
+      keying: every tenant's every query is a cache hit);
+    * **no cross-tenant starvation** — every tenant completes its full
+      query count; the max/min per-tenant completed ratio is asserted
+      under 1.5 (it is 1.0 when everything drains);
+    * **cost-decided, bitwise-safe** — the engine-flip demo
+      (:func:`_cost_flip_demo`) shows a pick flipping with the cost
+      inputs while the outputs stay bit-identical.
+    """
+    import queue as queue_mod  # noqa: F401  (backpressure surfaces Full)
+    import threading
+
+    import pandas as pd
+
+    from tempo_tpu import TSDF, profiling
+    from tempo_tpu.plan import cache as plan_cache
+    from tempo_tpu.service import QueryService, lazy_frame
+
+    rng = np.random.default_rng(seed)
+    n_tenants, n_queries = 8, 24
+    Ks, Ls = 8, 512
+    if os.environ.get("TEMPO_BENCH_SMOKE"):
+        n_tenants, n_queries, Ls = 4, 8, 128
+
+    def mk(cols):
+        secs = np.cumsum(rng.integers(1, 3, size=(Ks, Ls)), axis=-1)
+        data = {"sym": np.repeat(np.arange(Ks), Ls),
+                "event_ts": secs.ravel().astype(np.int64)}
+        for c in cols:
+            data[c] = rng.standard_normal(Ks * Ls)
+        return TSDF(pd.DataFrame(data), "event_ts", ["sym"])
+
+    left, right = mk(["x"]), mk(["bid", "ask"])
+    shapes = {
+        "join": lambda: lazy_frame(left).asofJoin(right),
+        "join_stats": lambda: (
+            lazy_frame(left).asofJoin(right)
+            .withRangeStats(colsToSummarize=["x"],
+                            rangeBackWindowSecs=WINDOW_SECS)),
+        "stats_ema": lambda: (
+            lazy_frame(left)
+            .withRangeStats(colsToSummarize=["x"],
+                            rangeBackWindowSecs=WINDOW_SECS)
+            .EMA("x", exact=True)),
+    }
+    shape_names = list(shapes)
+
+    plan_cache.CACHE.clear()
+    svc = QueryService(workers=4)
+    warm = {name: svc.submit("warmup", shapes[name]()).result(timeout=600)
+            for name in shape_names}
+    builds0 = profiling.plan_cache_stats()["builds"]
+
+    errs = []
+
+    def run_tenant(t_name, t_seed):
+        trng = np.random.default_rng(t_seed)
+        gaps = trng.exponential(scale=2e-3, size=n_queries)
+        tickets = []
+        try:
+            for i in range(n_queries):
+                time.sleep(float(gaps[i]))
+                name = shape_names[int(trng.integers(len(shape_names)))]
+                tickets.append(svc.submit(t_name, shapes[name]()))
+            for tk in tickets:
+                tk.result(timeout=600)
+        except Exception as e:  # noqa: BLE001 - surfaced via the assert
+            errs.append((t_name, repr(e)))
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=run_tenant,
+                                args=(f"tenant{i}", seed + 1 + i))
+               for i in range(n_tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    assert not errs, f"tenant threads failed: {errs}"
+
+    # steady-state identity: a fresh query per shape must equal its
+    # warmup twin bitwise (every tenant got these same cached answers)
+    for name in shape_names:
+        again = svc.submit("audit", shapes[name]()).result(timeout=600)
+        pd.testing.assert_frame_equal(warm[name].df, again.df,
+                                      check_exact=True)
+    st = svc.stats()
+    svc.close()
+    pc = st["plan_cache"]
+    assert pc["builds"] == builds0, (
+        f"query-service steady state recompiled: builds went "
+        f"{builds0} -> {pc['builds']} "
+        f"(by_signature={pc['by_signature']})")
+    tenants = {t: c for t, c in st["tenants"].items()
+               if t.startswith("tenant")}
+    assert len(tenants) == n_tenants
+    completed = [c["completed"] for c in tenants.values()]
+    assert all(c == n_queries for c in completed), tenants
+    ratio = max(completed) / min(completed)
+    assert ratio <= 1.5, f"starvation: completed spread {completed}"
+    hit_rate = pc["hits"] / max(1, pc["hits"] + pc["misses"])
+    return {
+        "qps": round(n_tenants * n_queries / wall, 1),
+        "n_tenants": n_tenants,
+        "queries_per_tenant": n_queries,
+        "query_shapes": shape_names,
+        "cache_hit_rate": round(hit_rate, 4),
+        "plan_cache": {k: pc[k] for k in
+                       ("hits", "misses", "builds", "evictions")},
+        "per_tenant_cache": pc["by_tenant"],
+        "zero_builds_steady_state": True,
+        "per_tenant": {t: {"completed": c["completed"],
+                           "p50_ms": c["p50_ms"],
+                           "p99_ms": c["p99_ms"]}
+                       for t, c in sorted(tenants.items())},
+        "starvation_ratio": round(ratio, 3),
+        "starvation_audit": (
+            f"all {n_tenants} tenants completed {n_queries}/"
+            f"{n_queries}; max/min completed ratio {ratio:.3f} "
+            f"(bound 1.5)"),
+        "cost_decided": _cost_flip_demo(left, right),
+        "value_audit": "steady-state answers == warmup twins bitwise "
+                       "(assert_frame_equal check_exact) across the "
+                       "shared cache; cost-flip audit bitwise",
+    }
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -2027,6 +2205,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-query-service" in sys.argv:
+        res = _attempt("query_service", bench_query_service)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-mesh-scaling-one" in sys.argv:
         n = int(sys.argv[sys.argv.index("--only-mesh-scaling-one") + 1])
         res = _attempt("mesh_scaling_one", lambda: bench_mesh_scaling_one(n))
@@ -2121,6 +2305,8 @@ def main():
                                     timeout=2400)
     serving = _config_subprocess("--only-serving", "serving",
                                  timeout=2400)
+    query_service = _config_subprocess("--only-query-service",
+                                       "query_service", timeout=2400)
     mesh_scaling = _config_subprocess("--only-mesh-scaling",
                                       "mesh_scaling", timeout=7200)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
@@ -2229,6 +2415,12 @@ def main():
                 if mesh_scaling and mesh_scaling.get("per_device_count")
                 and mesh_scaling.get("device_counts")
                 else None),
+            # completed queries/sec through the multi-tenant service
+            # under Poisson load (queue wait + plan-cache lookup +
+            # execution); the record below carries the per-tenant
+            # percentiles, cache counters and the starvation audit
+            "13_query_service_qps": (
+                round(query_service["qps"]) if query_service else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -2236,6 +2428,11 @@ def main():
         # the in-bench planned==eager bitwise audit (ROADMAP item 2)
         "mesh_scaling": mesh_scaling,
         "serving": serving,
+        # config 13: the multi-tenant query service — shared-cache
+        # hit-rate, the hard zero-recompiles-at-steady-state assert,
+        # per-tenant p50/p99, the starvation audit and the
+        # cost-decided (bitwise-safe) engine-flip record
+        "query_service": query_service,
         # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
         # within ~1.2x is the claim being measured
         "frame_e2e_vs_fused": (

@@ -153,6 +153,34 @@ KNOBS: Dict[str, Knob] = _knobs(
          "snapshot the serving StreamState every N acked events "
          "(CRC'd keep-last-K via checkpoint.save_state; 0 disables "
          "automatic snapshots — snapshot() stays available)"),
+    Knob("TEMPO_TPU_COST_MODEL", "bool", "1", "tempo_tpu/plan/cost",
+         "0 reverts engine picks, fusion and reshard placement to the "
+         "pure rule-based decisions; on (default) they are argmins "
+         "over estimated cost, with the legacy thresholds demoted to "
+         "feasibility priors"),
+    Knob("TEMPO_TPU_SERVICE_WORKERS", "int", "4",
+         "tempo_tpu/service/service",
+         "worker-thread count of the multi-tenant query service "
+         "(concurrent plan executions; clamped >= 1)"),
+    Knob("TEMPO_TPU_SERVICE_TENANT_QUOTA", "int", "64",
+         "tempo_tpu/service/service",
+         "per-tenant pending-query bound: a tenant at quota blocks in "
+         "submit() — the per-tenant backpressure signal (the bounded-"
+         "queue pattern of serve/executor.py, applied per tenant)"),
+    Knob("TEMPO_TPU_SERVICE_VMEM_BUDGET", "int", None,
+         "tempo_tpu/service/admission",
+         "per-query VMEM admission budget in bytes; unset = the "
+         "kernel planners' scoped budget (pallas_kernels._VMEM_BUDGET),"
+         " explicit 0 admits nothing. A query whose projected "
+         "worst-case per-step block exceeds it is REJECTED with "
+         "AdmissionError (it could never run)"),
+    Knob("TEMPO_TPU_SERVICE_HBM_BUDGET", "int", None,
+         "tempo_tpu/service/admission",
+         "total HBM admission budget in bytes (default 2 GiB; "
+         "explicit 0 admits nothing): a query whose projected "
+         "footprint exceeds the whole budget is REJECTED; one that "
+         "merely exceeds the currently-free share is QUEUED until "
+         "running queries release theirs"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

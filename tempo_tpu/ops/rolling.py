@@ -98,9 +98,22 @@ def pick_range_engine(n_elems: int, max_behind: int, max_ahead: int,
     forced = window_engine_override()
     if forced in ("shifted", "stream", "windowed"):
         return forced
-    if W <= shifted_row_budget(n_elems, pallas_small_ok):
+    fits_shifted = W <= shifted_row_budget(n_elems, pallas_small_ok)
+    fits_stream = stream_ok and W <= pw._stream_max_rows()
+    from tempo_tpu.plan import cost as plan_cost
+
+    if plan_cost.enabled():
+        # cost-decided, but over the BITWISE-SAFE candidate set only:
+        # the three engines differ in f32 rounding order, so the
+        # revalidation lattice above admits exactly one engine per
+        # shape and the argmin cannot drift from the rule pick — the
+        # cost numbers feed explain() and the bench record
+        # (plan/cost.py:decide_range_engine documents the contract)
+        return plan_cost.decide_range_engine(W, n_elems, fits_shifted,
+                                             fits_stream)
+    if fits_shifted:
         return "shifted"
-    if stream_ok and W <= pw._stream_max_rows():
+    if fits_stream:
         return "stream"
     return "windowed"
 

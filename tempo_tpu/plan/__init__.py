@@ -19,10 +19,18 @@ by hand.  This package is the missing layer:
   ``pick_range_engine`` hoisted so knob reads happen once), dead-column
   pruning before packing, and explicit host-materialisation barrier
   marking.
+* :mod:`~tempo_tpu.plan.cost` — the cost model behind those decisions
+  (round 11; ``TEMPO_TPU_COST_MODEL``): estimated-seconds argmins from
+  byte models × measured-rate priors, with the legacy thresholds
+  demoted to feasibility priors and the argmin restricted to
+  bitwise-equal candidates.  The multi-tenant query service
+  (``tempo_tpu/service/``) sits on top of this package.
 * :mod:`~tempo_tpu.plan.cache` — compiled executables keyed by
-  (optimized-plan signature, source shapes/dtypes, mesh) with an LRU
-  bound (``TEMPO_TPU_PLAN_CACHE_SIZE``) and hit/miss/evict counters
-  surfaced through :func:`tempo_tpu.profiling.plan_cache_stats`.
+  (optimized-plan signature, source shapes/dtypes, mesh, cost
+  fingerprint) with an LRU bound (``TEMPO_TPU_PLAN_CACHE_SIZE``),
+  single-flight builds, and hit/miss/evict counters (totals,
+  per-signature, per-tenant) surfaced through
+  :func:`tempo_tpu.profiling.plan_cache_stats`.
 * :mod:`~tempo_tpu.plan.render` — ``explain(cost=False)``: the logical
   and optimized plans, per-node engine choices and barriers, and (with
   ``cost=True``) XLA's post-compilation cost analysis — the analog of

@@ -9,6 +9,18 @@ re-pick, and (because the underlying program builders are themselves
 keyed caches) zero new XLA compiles.  Counters are surfaced through
 :func:`tempo_tpu.profiling.plan_cache_stats`.
 
+Round 11 made the cache a genuinely shared, multi-tenant structure:
+
+* **single-flight builds** — two tenants missing on the same key
+  build ONCE: the first miss claims the key and builds outside the
+  lock, later misses wait on its event and then hit the inserted
+  entry (a failed build releases the claim so a waiter retries as the
+  builder — a poisoned query must not wedge every tenant behind it);
+* **per-signature and per-tenant counters** — ``stats()`` breaks the
+  totals down by plan signature (``key[0]``) and by the tenant the
+  query service installs via :func:`tenant_scope`, so a steady-state
+  audit can pin WHICH query shape or client is recompiling.
+
 The LRU bound is ``TEMPO_TPU_PLAN_CACHE_SIZE`` (default 64; 0 disables
 caching entirely).  A shape or dtype change on any source frame is a
 different key — a miss by design, since the compiled programs are
@@ -18,10 +30,15 @@ shape-specialised.
 from __future__ import annotations
 
 import collections
+import contextlib
+import contextvars
 import threading
 from typing import Dict, Optional
 
 _DEFAULT_SIZE = 64
+
+_TENANT: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "tempo_tpu_plan_cache_tenant", default=None)
 
 
 def max_size() -> int:
@@ -30,36 +47,83 @@ def max_size() -> int:
     return config.get_int("TEMPO_TPU_PLAN_CACHE_SIZE", _DEFAULT_SIZE)
 
 
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Attribute cache traffic inside the block to ``tenant`` (the
+    query service wraps each query execution; contextvars make the
+    attribution per-thread, so concurrent tenants never mix)."""
+    token = _TENANT.set(tenant)
+    try:
+        yield
+    finally:
+        _TENANT.reset(token)
+
+
+def _signature_of(key: Optional[tuple]) -> str:
+    if isinstance(key, tuple) and key:
+        return str(key[0])
+    return "uncacheable"
+
+
 class PlanCache:
     """Thread-safe LRU of built executables + hit/miss/evict/build
-    counters."""
+    counters (totals, per-signature, per-tenant) and single-flight
+    ``get_or_build``."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._entries = collections.OrderedDict()
+        self._building: Dict[tuple, threading.Event] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.builds = 0          # executables constructed (cache misses
         #                          + uncacheable plans)
         self.uncacheable = 0     # runs that bypassed the cache entirely
+        self.by_signature: Dict[str, Dict[str, int]] = {}
+        self.by_tenant: Dict[str, Dict[str, int]] = {}
+
+    # -- counter plumbing (callers hold self._lock) ---------------------
+
+    def _bump(self, key: Optional[tuple], field: str) -> None:
+        sig = _signature_of(key)
+        self.by_signature.setdefault(
+            sig, {"hits": 0, "misses": 0, "builds": 0, "evictions": 0})
+        self.by_signature[sig][field] += 1
+        tenant = _TENANT.get()
+        if tenant is not None and field != "evictions":
+            self.by_tenant.setdefault(
+                tenant, {"hits": 0, "misses": 0, "builds": 0})
+            self.by_tenant[tenant][field] += 1
+
+    def _hit_locked(self, key: tuple):
+        """LRU-touch + hit bookkeeping for a present entry (caller
+        holds the lock) — the ONE hit path shared by :meth:`lookup`
+        and :meth:`get_or_build`, so the counters the zero-recompile
+        audits read cannot diverge between them."""
+        exe = self._entries.get(key)
+        if exe is None:
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        self._bump(key, "hits")
+        return exe
 
     def lookup(self, key: Optional[tuple]):
         with self._lock:
             if key is None:
                 self.uncacheable += 1
                 return None
-            exe = self._entries.get(key)
+            exe = self._hit_locked(key)
             if exe is None:
                 self.misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
+                self._bump(key, "misses")
             return exe
 
     def insert(self, key: Optional[tuple], exe) -> None:
         with self._lock:
             self.builds += 1
+            self._bump(key, "builds")
             if key is None:
                 return
             bound = max_size()
@@ -68,23 +132,58 @@ class PlanCache:
             self._entries[key] = exe
             self._entries.move_to_end(key)
             while len(self._entries) > bound:
-                self._entries.popitem(last=False)
+                evicted, _ = self._entries.popitem(last=False)
                 self.evictions += 1
+                self._bump(evicted, "evictions")
 
     def get_or_build(self, key: Optional[tuple], build):
         """Cached executable for ``key``, invoking ``build()`` (and
         recording the build) on a miss.  The lookup/insert pair every
         steady-state consumer wants — the serving engine's per-bucket
-        step programs go through here, so its zero-recompile claim is
-        checkable from the same counters as the planner's
-        (``profiling.plan_cache_stats``)."""
-        exe = self.lookup(key)
-        if exe is None:
+        step programs and the query service's per-signature executables
+        both go through here, so their zero-recompile claims are
+        checkable from the same counters
+        (``profiling.plan_cache_stats``).
+
+        SINGLE-FLIGHT: concurrent misses on one key serialize on a
+        per-key event — exactly one caller builds, the rest wait and
+        take the inserted entry as a (late) hit.  A build that raises
+        releases the claim before re-raising, so one waiter retries as
+        the new builder instead of every tenant inheriting the
+        failure."""
+        if key is None:
+            self.lookup(key)         # counts the uncacheable bypass
             exe = build()
             self.insert(key, exe)
-        return exe
+            return exe
+        while True:
+            claimed: Optional[threading.Event] = None
+            with self._lock:
+                exe = self._hit_locked(key)
+                if exe is not None:
+                    return exe
+                waiting = self._building.get(key)
+                if waiting is None:
+                    claimed = self._building[key] = threading.Event()
+                    self.misses += 1
+                    self._bump(key, "misses")
+            if claimed is None:
+                waiting.wait()
+                continue
+            try:
+                # insert() stays INSIDE the claim window: if it raises
+                # (e.g. a malformed cache-size env var), the claim must
+                # still release or every waiter on this key hangs
+                # forever in wait()
+                exe = build()
+                self.insert(key, exe)
+                return exe
+            finally:
+                with self._lock:
+                    self._building.pop(key, None)
+                claimed.set()
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, object]:
         with self._lock:
             return {
                 "size": len(self._entries),
@@ -94,6 +193,10 @@ class PlanCache:
                 "evictions": self.evictions,
                 "builds": self.builds,
                 "uncacheable": self.uncacheable,
+                "by_signature": {s: dict(c)
+                                 for s, c in self.by_signature.items()},
+                "by_tenant": {t: dict(c)
+                              for t, c in self.by_tenant.items()},
             }
 
     def clear(self) -> None:
@@ -101,6 +204,8 @@ class PlanCache:
             self._entries.clear()
             self.hits = self.misses = self.evictions = 0
             self.builds = self.uncacheable = 0
+            self.by_signature = {}
+            self.by_tenant = {}
 
 
 #: Process-wide executable cache.

@@ -58,6 +58,13 @@ Registry coverage map (program -> production user):
                                 steady-state push step
                                 (tempo_tpu/serve/state.py: AS-OF +
                                 EMA + window carries, donated)
+``service.dispatch_stats`` /    the query service's steady-state
+``service.dispatch_ema``        dispatch programs: the cached planner
+                                executables (plan/fused.py) at the
+                                service bench's two canonical mesh
+                                query shapes (stats-only and
+                                stats+EMA), donation + alignment
+                                collectives pinned
 ==============================  =======================================
 
 The Mosaic-lowered engines (lane-chunked join, streaming window
@@ -480,6 +487,54 @@ def _build_serve_step():
     compiled = fn.lower(*serve_state.push_avals(cfg, Lb)).compile()
     contract = Contract(donate_argnums=tuple(range(n_state)))
     return CompiledProgram("serve.step", compiled, contract)
+
+
+@register("service.dispatch", requires_devices=CONTRACT_SERIES)
+def _build_service_dispatch():
+    """The query service's steady-state dispatch programs
+    (tempo_tpu/service/): a cached query IS a planner executable, and
+    the service's canonical mesh queries dispatch the fused chain
+    program (plan/fused.py) at two shapes the existing
+    ``fused.asof_stats_ema`` entry does not pin — stats over ONE right
+    column without EMA, and stats over both right columns with EMA
+    riding a right plane.  Contracts: the right stacks stay donated
+    (the service's shared cache would otherwise double every
+    concurrent query's HBM), alignment all-gathers within the byte
+    model, no f64 creep, no host transfer mid-dispatch."""
+    from tempo_tpu.plan import fused
+
+    mesh = _series_mesh()
+    a = _mesh_arrays(mesh)
+    lvals = a["x"][None]
+    lvalids = a["valid"][None]
+    planes, vstack = fused._right_stacks(a["ts"], a["valid"],
+                                         a["rvals"], a["rvalids"])
+    shapes = (
+        ("service.dispatch_stats", (("r", 0),), None),
+        ("service.dispatch_ema", (("r", 0), ("r", 1)), ("r", 0)),
+    )
+    programs = []
+    for name, srcs, ema_src in shapes:
+        program = fused._fused_program(
+            mesh, "series", srcs, _WINDOW_SECS, CONTRACT_ROWBOUNDS,
+            "shifted", True, ema_src, 0.2, True, 31)
+        compiled = program.lower(a["ts"], lvals, lvalids, a["ts"],
+                                 planes, vstack, a["perm"],
+                                 a["ok"]).compile()
+        # both canonical shapes summarize RIGHT columns only, so jit
+        # drops the unused left value/validity stacks (python args
+        # 1/2) and the donated right stacks (fused.DONATE_ARGNUMS =
+        # python 4/5) land at COMPILED parameter indices 2/3 — the
+        # Link convention (see Contract.donate_argnums)
+        contract = Contract(
+            collectives={
+                "all-gather": _nbytes(a["ts"], planes, vstack),
+            },
+            incidental={"all-reduce": len(srcs) * 8 * 4},
+            donate_argnums=(2, 3),
+        )
+        programs.append(CompiledProgram(name, compiled, contract))
+    return programs, []
 
 
 @register("dist.range_stats_windowed", requires_devices=CONTRACT_SERIES)

@@ -26,17 +26,26 @@ logger = logging.getLogger(__name__)
 
 
 def execute(root: ir.Node):
+    from tempo_tpu.plan import cost
+
+    # snapshot the cost inputs ONCE: the key's fingerprint and the
+    # decisions optimize() bakes into the executable must come from
+    # the same inputs even if a concurrent set_measured() lands
+    # mid-build (cost.pinned below)
+    snap = cost.snapshot()
     key = ir.state_key(root)
     if key is not None:
-        # the reshard-placement mode changes the OPTIMIZED plan without
-        # touching the logical signature — fold it into the cache key
-        # so flipping TEMPO_TPU_RESHARD_PLACEMENT never replays a plan
-        # placed under the other mode
-        key = key + (optimizer.reshard_mode(),)
-    exe = cache.CACHE.lookup(key)
-    if exe is None:
+        # the reshard-placement mode and the active cost-model inputs
+        # both change the OPTIMIZED plan without touching the logical
+        # signature — fold them into the cache key so flipping
+        # TEMPO_TPU_RESHARD_PLACEMENT or a measured cost input never
+        # replays a plan decided under the other configuration
+        key = key + (optimizer.reshard_mode(), cost.fingerprint(snap))
+
+    def build():
         t0 = time.perf_counter()
-        exe = Executable(optimizer.optimize(root))
+        with cost.pinned(snap):
+            exe = Executable(optimizer.optimize(root))
         exe.build_seconds = time.perf_counter() - t0
         # run() binds the caller's payloads positionally, so the
         # build-time frames on the optimized copy are dead weight —
@@ -44,7 +53,11 @@ def execute(root: ir.Node):
         # full DataFrames/device buffers until eviction
         for s in exe.plan.sources():
             s.payload = None
-        cache.CACHE.insert(key, exe)
+        return exe
+
+    # single-flight under the shared cache: concurrent tenants missing
+    # on the same signature build once (plan/cache.py)
+    exe = cache.CACHE.get_or_build(key, build)
     return exe.run([n.payload for n in root.sources()])
 
 

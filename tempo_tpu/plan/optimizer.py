@@ -1,5 +1,15 @@
 """Optimizer passes over a recorded plan.
 
+Since round 11 the decisions below are **cost-based**
+(``tempo_tpu/plan/cost.py``, ``TEMPO_TPU_COST_MODEL``): fusion,
+engine hoisting and reshard placement are argmins over estimated cost
+with the legacy thresholds demoted to feasibility priors.  Every
+cost-decided plan stays bitwise-identical to its rule-based twin —
+the argmin only runs over bitwise-equal alternatives (all join
+engines; fused vs op-by-op; placed vs declarative resharding), and
+the range-engine candidate set is the round-5 revalidation singleton.
+Under the default priors every decision reproduces the old rules.
+
 Four passes, in order:
 
 1. **Fusion** — rewrite adjacent nodes onto the already-shipped fused
@@ -178,6 +188,31 @@ def _plain_numeric_mesh_source(node: ir.Node) -> bool:
     return False
 
 
+def _est_frame_bytes(node: ir.Node) -> int:
+    """Best-effort device byte estimate of a source-adjacent node's
+    packed planes (ts + value/validity per column) — the byte input of
+    the fusion cost decision; 0 when not derivable at plan time."""
+    try:
+        frame = _source_frame(node)
+        if frame is None:
+            return 0
+        lay = getattr(frame, "layout", None)
+        if lay is not None:                     # host TSDF
+            import numpy as np
+
+            from tempo_tpu import packing
+
+            K = lay.n_series
+            L = packing.pad_length(int(np.max(lay.lengths, initial=0)))
+            n_cols = max(1, len(frame.df.columns)
+                         - len(frame.partitionCols) - 1)
+            return K * L * (8 + 5 * n_cols)
+        return int(frame.K_dev) * int(frame.L) * (
+            8 + 5 * max(1, len(frame.cols)))    # DistributedTSDF
+    except Exception:  # pragma: no cover - estimate must never kill a plan
+        return 0
+
+
 def _fuse_mesh_chain(root: ir.Node) -> ir.Node:
     def fn(n: ir.Node) -> ir.Node:
         # the rewriter runs bottom-up: range_stats(asof_join) fuses
@@ -199,6 +234,20 @@ def _fuse_mesh_chain(root: ir.Node) -> ir.Node:
             fused.ann["rewrite"] = (
                 "asofJoin + withRangeStats + EMA chained into ONE "
                 "jitted program (plan/fused.py)")
+            if "fusion_cost" in fused.ann:
+                # re-cost at the TRUE op count: the folded EMA adds a
+                # dispatch + an HBM re-read to the op-by-op side while
+                # the fused side stays one program, so a 2-op verdict
+                # of "fuse" only strengthens — no re-gate needed (a
+                # 2-op decline already stopped the base rewrite; that
+                # conservatively misses chains only a 3-op costing
+                # would fuse, which is bitwise-safe either way)
+                from tempo_tpu.plan import cost as plan_cost
+
+                est = sum(_est_frame_bytes(c) for c in base.inputs)
+                _, costs3 = plan_cost.fusion_worthwhile(3, est)
+                fused.ann["fusion_cost"] = dict(costs3,
+                                                decision="fused")
             return fused
         if n.op != "range_stats" or not _mesh_side(n) or not n.inputs:
             return n
@@ -215,6 +264,20 @@ def _fuse_mesh_chain(root: ir.Node) -> ir.Node:
         if not (_plain_numeric_mesh_source(left)
                 and _plain_numeric_mesh_source(right)):
             return n
+        from tempo_tpu.plan import cost as plan_cost
+
+        fusion_costs = None
+        if plan_cost.enabled():
+            # cost-decided fusion: one program vs the op-by-op chain —
+            # both bitwise-identical (plan/fused.py pins the op
+            # boundaries), so the decision is free to flip with the
+            # cost inputs; the priors make fusion win (today's rule)
+            est = _est_frame_bytes(left) + _est_frame_bytes(right)
+            worthwhile, fusion_costs = plan_cost.fusion_worthwhile(2, est)
+            if not worthwhile:
+                n.ann["fusion_cost"] = dict(fusion_costs,
+                                            decision="op-by-op")
+                return n
         fused = ir.Node("fused_asof_stats_ema", params=dict(
             j_left_prefix=jn.param("left_prefix"),
             j_right_prefix=jn.param("right_prefix") or "right",
@@ -225,6 +288,9 @@ def _fuse_mesh_chain(root: ir.Node) -> ir.Node:
         fused.ann["rewrite"] = (
             "asofJoin + withRangeStats chained into ONE jitted "
             "program (plan/fused.py)")
+        if fusion_costs is not None:
+            fused.ann["fusion_cost"] = dict(fusion_costs,
+                                            decision="fused")
         return fused
 
     return _rewrite(root, fn)
@@ -281,6 +347,13 @@ def _hoist_engines(root: ir.Node) -> None:
                     n.ann["join_engine"] = engine
                     n.ann["merged_lanes_est"] = est
                     n.ann.setdefault("hints", {})["join_engine"] = engine
+                    from tempo_tpu.plan import cost as plan_cost
+
+                    if plan_cost.enabled():
+                        n.ann["cost"] = {
+                            k: v for k, v in plan_cost.join_costs(
+                                est, limit, True).items()
+                            if v is not None}
 
 
 def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
@@ -445,11 +518,70 @@ def _place_reshards(root: ir.Node) -> ir.Node:
     requires the time-sharded layout again; the trailing switch is
     eliminated outright when the consumer is ``collect``/``count``
     (materialisation reads any layout).  ``declarative`` mode is a
-    no-op: every op keeps its internal all_to_all pair."""
+    no-op: every op keeps its internal all_to_all pair.
+
+    In ``auto`` mode the placement is **cost-decided** (round 11):
+    the placed shape's modeled comm bytes + per-node dispatch cost is
+    compared against the internal all_to_all pairs the ops would run
+    declaratively, and the whole plan keeps whichever is cheaper —
+    both shapes are bitwise-identical (the round-10 elimination
+    contract), so the decision is free to flip with the cost inputs.
+    Under the default priors placement wins whenever it eliminates a
+    switch, which is today's rule."""
     mode = reshard_mode()
     if mode == "declarative":
         return root
+    from tempo_tpu.plan import cost as plan_cost
 
+    if mode == "auto" and plan_cost.enabled():
+        trial = _place_reshards_impl(_copy(root), mode)
+        stats = _reshard_stats(trial)
+        if stats["n_placed"] == 0:
+            return trial               # no time-sharded chain: nothing
+        #                                to decide, no annotation noise
+        place, costs = plan_cost.reshard_decision(
+            stats["n_placed"], stats["placed_bytes"],
+            stats["n_internal"], stats["internal_bytes"])
+        if not place:
+            root.ann["reshard_cost"] = dict(costs,
+                                            decision="declarative")
+            return root
+        trial.ann["reshard_cost"] = dict(costs, decision="placed")
+        return trial
+    return _place_reshards_impl(root, mode)
+
+
+def _reshard_stats(placed: ir.Node) -> Dict[str, object]:
+    """Switch counts and modeled bytes of a placed plan, feeding the
+    cost decision above.  Internal pairs are modeled as 2 switches of
+    the same frame geometry per series-local member (the eager
+    time-sharded ops bracket themselves with ``dist.reshard_frame``);
+    bytes fall back to None (count-only decision) when any placed node
+    lacks a comm model."""
+    n_placed = 0
+    placed_bytes: Optional[int] = 0
+    members = 0
+    for n in placed.walk():
+        if n.op == "reshard" and n.ann.get("reshard") == "placed":
+            n_placed += 1
+            b = n.ann.get("comm_bytes_model")
+            if b is None or placed_bytes is None:
+                placed_bytes = None
+            else:
+                placed_bytes += int(b)
+        elif n.op in _SERIES_LOCAL_OPS and (
+                "reshard_eliminated" in n.ann
+                or (n.inputs and n.inputs[0].op == "reshard")):
+            members += 1
+    n_internal = 2 * members
+    internal_bytes = None
+    if placed_bytes is not None and n_placed:
+        internal_bytes = n_internal * (placed_bytes // n_placed)
+    return {"n_placed": n_placed, "placed_bytes": placed_bytes,
+            "n_internal": n_internal, "internal_bytes": internal_bytes}
+
+
+def _place_reshards_impl(root: ir.Node, mode: str) -> ir.Node:
     layout: Dict[int, str] = {}        # id(node) -> "time" | "joint"
 
     def fn(n: ir.Node) -> ir.Node:

@@ -54,6 +54,15 @@ def _node_line(node: ir.Node) -> str:
                      + (f" (~{est} merged lanes)" if est else ""))
     if "range_engine" in node.ann:
         notes.append(f"engine[stats]={node.ann['range_engine']}")
+    if "cost" in node.ann:
+        notes.append("est cost: " + ", ".join(
+            f"{k}~{v * 1e6:.1f}us" for k, v in node.ann["cost"].items()))
+    if "fusion_cost" in node.ann:
+        fc = node.ann["fusion_cost"]
+        notes.append(
+            f"cost-decided fusion: {fc['decision']} "
+            f"(fused~{fc['fused_s'] * 1e6:.1f}us vs "
+            f"chain~{fc['chain_s'] * 1e6:.1f}us)")
     if "rewrite" in node.ann:
         notes.append(f"rewrite: {node.ann['rewrite']}")
     if "barrier" in node.ann:
@@ -81,6 +90,13 @@ def explain_text(root: ir.Node, cost: bool = False) -> str:
     barriers = [n.op for n in opt.walk() if "barrier" in n.ann]
     lines += ["", "barriers: " + (", ".join(barriers) if barriers
                                   else "none (chain stays on device)")]
+    rc = opt.ann.get("reshard_cost")
+    if rc:
+        lines += [f"reshard placement: cost-decided -> {rc['decision']} "
+                  f"(placed~{rc['placed_s'] * 1e6:.1f}us vs "
+                  f"declarative~{rc['declarative_s'] * 1e6:.1f}us, "
+                  f"{rc['n_placed']} placed vs "
+                  f"{rc['n_internal_switches']} internal switches)"]
     if cost:
         lines += ["", "== Compiled cost (XLA) =="]
         lines += _cost_lines(opt)

@@ -191,7 +191,14 @@ def pick_join_engine(est_lanes: int, limit: int,
     but only when the caller's freshly-probed bounds still admit it
     (a cached 'single' plan replayed past the compiler ceiling, or
     'chunked' on a backend where the streaming kernel is unavailable,
-    falls through and re-picks)."""
+    falls through and re-picks).
+
+    With the cost model on (``TEMPO_TPU_COST_MODEL``, default on —
+    tempo_tpu/plan/cost.py) the unforced decision is an argmin over
+    estimated engine cost with the thresholds above demoted to
+    feasibility priors; all three engines are bit-identical, so a
+    measured cost input flipping the pick never changes a result bit.
+    Under the default priors the argmin reproduces the rule exactly."""
     from tempo_tpu.plan import hints as plan_hints
 
     hinted = plan_hints.get("join_engine")
@@ -206,6 +213,10 @@ def pick_join_engine(est_lanes: int, limit: int,
         return "single"
     if forced is not None:
         return forced
+    from tempo_tpu.plan import cost as plan_cost
+
+    if plan_cost.enabled():
+        return plan_cost.decide_join_engine(est_lanes, limit, chunked_ok)
     if limit <= 0 or est_lanes <= limit:
         return "single"
     return "chunked" if chunked_ok else "bracket"
@@ -377,12 +388,15 @@ def host_transfers_from_compiled(compiled,
     return out
 
 
-def plan_cache_stats() -> Dict[str, int]:
+def plan_cache_stats() -> Dict[str, object]:
     """Hit/miss/evict/build counters of the lazy planner's executable
     cache (tempo_tpu/plan/cache.py; LRU bound
-    ``TEMPO_TPU_PLAN_CACHE_SIZE``).  The serving-loop health metric: a
-    steady-state query mix should be all hits — every miss re-runs the
-    optimizer and may compile."""
+    ``TEMPO_TPU_PLAN_CACHE_SIZE``), including the ``by_signature`` and
+    ``by_tenant`` breakdowns (round 11: the query service attributes
+    traffic per tenant via ``cache.tenant_scope``).  The serving-loop
+    health metric: a steady-state query mix should be all hits — every
+    miss re-runs the optimizer and may compile, and the breakdowns pin
+    WHICH query shape or client caused it."""
     from tempo_tpu.plan.cache import CACHE
 
     return CACHE.stats()

@@ -35,6 +35,20 @@ from tempo_tpu.serve import stream as stream_mod
 _CLOSE = object()
 
 
+def latency_percentiles(lats: List[float]) -> dict:
+    """p50/p99 (milliseconds) + count of a latency sample — the ONE
+    percentile reducer behind every queue-side latency report (this
+    executor's ``latency_stats`` and the query service's per-tenant
+    stats, tempo_tpu/service/service.py)."""
+    if not lats:
+        return {"count": 0, "p50_ms": None, "p99_ms": None}
+    s = sorted(lats)
+    pick = lambda q: s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+    return {"count": len(s),
+            "p50_ms": round(pick(0.50) * 1e3, 3),
+            "p99_ms": round(pick(0.99) * 1e3, 3)}
+
+
 class Ticket:
     """One submitted tick: a waitable handle for its per-row result."""
 
@@ -220,16 +234,6 @@ class MicroBatchExecutor:
         pooled: List[float] = []
         for kind, lats in self._latencies.items():
             pooled.extend(lats)
-            out[kind] = self._pcts(lats)
-        out["all"] = self._pcts(pooled)
+            out[kind] = latency_percentiles(lats)
+        out["all"] = latency_percentiles(pooled)
         return out
-
-    @staticmethod
-    def _pcts(lats: List[float]) -> dict:
-        if not lats:
-            return {"count": 0, "p50_ms": None, "p99_ms": None}
-        s = sorted(lats)
-        pick = lambda q: s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
-        return {"count": len(s),
-                "p50_ms": round(pick(0.50) * 1e3, 3),
-                "p99_ms": round(pick(0.99) * 1e3, 3)}

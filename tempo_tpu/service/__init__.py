@@ -1,0 +1,34 @@
+"""Multi-tenant query service over the cost-based planner.
+
+The reference library's deployment story is "many analysts fire
+time-series queries at one shared Spark engine"; this package is the
+rebuild's equivalent front door (ROADMAP item 1):
+
+* ``service/service.py`` — :class:`QueryService`: plan-signature-keyed
+  queries from N concurrent tenants against the SHARED executable
+  cache (single-flight builds, per-tenant counters), a fair scheduler
+  (per-tenant token accounting + per-tenant submit backpressure), and
+  graceful drain.
+* ``service/admission.py`` — admission control: the static analyzer's
+  VMEM folding applied at runtime projects each query's device
+  footprint; over-budget queries are rejected with the named
+  :class:`AdmissionError` (never queued forever), over-the-free-share
+  queries queue until running work releases budget.
+
+Plan decisions underneath (engine picks, fusion, reshard placement)
+are cost-based since round 11 (``tempo_tpu/plan/cost.py``): estimated
+cost decides, the legacy thresholds are demoted to feasibility priors,
+and every cost-decided plan stays bitwise-identical to its rule-based
+twin.
+"""
+
+from tempo_tpu.service.admission import (AdmissionController,
+                                         AdmissionError, Footprint,
+                                         project_footprint)
+from tempo_tpu.service.service import QueryService, QueryTicket, lazy_frame
+
+__all__ = [
+    "QueryService", "QueryTicket", "lazy_frame",
+    "AdmissionController", "AdmissionError", "Footprint",
+    "project_footprint",
+]

@@ -1,0 +1,347 @@
+"""Multi-tenant query service: N concurrent clients, one shared
+planner.
+
+``QueryService`` is the front door the ROADMAP's "many analysts, one
+engine" cohort needs: clients submit plan-signature-keyed queries
+(lazy chains — :func:`lazy_frame` wraps any eager frame without the
+``TEMPO_TPU_PLAN`` knob), a bounded worker pool executes them through
+the shared executable cache (``plan/cache.py`` — single-flight, so two
+tenants compiling the same signature build once), and two policies sit
+between submit and dispatch:
+
+* **admission control** (``service/admission.py``) — the static
+  analyzer's VMEM folding applied at runtime: a query whose projected
+  footprint could never fit the declared budgets is REJECTED with
+  :class:`~tempo_tpu.service.admission.AdmissionError` at submit; one
+  that merely exceeds the currently-free HBM share stays QUEUED and
+  dispatches when running queries release theirs.
+* **fair scheduling** — per-tenant token accounting over the
+  bounded-queue backpressure pattern of ``serve/executor.py``: each
+  dispatch charges the tenant a token, the scheduler always offers the
+  lowest-token tenant first, and a tenant at
+  ``TEMPO_TPU_SERVICE_TENANT_QUOTA`` pending queries blocks in
+  ``submit()`` instead of flooding the shared queue — no client can
+  starve the others by volume.
+
+A poisoned query (its execution raises) fails its own ticket and
+releases its budget; the workers live on.  ``stats()`` reports
+per-tenant submitted/completed/failed/rejected counts, p50/p99
+latency, the cache's per-tenant traffic, and the max/min
+completed-query ratio — the starvation audit the bench asserts.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as queue_mod
+import threading
+import time
+from typing import Dict, Optional
+
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import ir
+from tempo_tpu.service.admission import (AdmissionController,
+                                         Footprint, project_footprint)
+
+
+def lazy_frame(frame):
+    """Wrap an eager ``TSDF`` / ``DistributedTSDF`` into its lazy
+    recording wrapper WITHOUT the ``TEMPO_TPU_PLAN`` knob: service
+    clients chain ops on the result and submit it — the service is
+    always plan-driven, whatever the process-wide planning mode."""
+    from tempo_tpu.plan import lazy
+
+    return lazy.wrap(lazy._as_node(frame))
+
+
+class QueryTicket:
+    """One submitted query: a waitable handle for its result."""
+
+    __slots__ = ("tenant", "signature", "footprint", "t_submit",
+                 "t_start", "t_done", "_root", "_event", "_result",
+                 "_exc")
+
+    def __init__(self, tenant: str, root: ir.Node, signature: str,
+                 footprint: Footprint):
+        self.tenant = tenant
+        self.signature = signature
+        self.footprint = footprint
+        self.t_submit = time.perf_counter()
+        self.t_start: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._root = root
+        self._event = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+
+    def _finish(self, result=None, exc: Optional[BaseException] = None):
+        self._result, self._exc = result, exc
+        self.t_done = time.perf_counter()
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The query's result frame (blocks until dispatched and
+        executed); re-raises the query's own failure."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("query not executed yet")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.t_done is None:
+            return None
+        return self.t_done - self.t_submit
+
+
+class QueryService:
+    """See module docstring."""
+
+    #: per-tenant latency samples kept for the percentile report (a
+    #: sliding window, not a lifetime log)
+    _LATENCY_WINDOW = 4096
+
+    def __init__(self, workers: Optional[int] = None,
+                 tenant_quota: Optional[int] = None,
+                 hbm_budget: Optional[int] = None,
+                 vmem_budget: Optional[int] = None,
+                 reserve_after_s: float = 5.0):
+        from tempo_tpu import config
+
+        if workers is None:
+            workers = config.get_int("TEMPO_TPU_SERVICE_WORKERS", 4)
+        if tenant_quota is None:
+            tenant_quota = config.get_int(
+                "TEMPO_TPU_SERVICE_TENANT_QUOTA", 64)
+        self.tenant_quota = max(1, int(tenant_quota))
+        #: budget reservation threshold: once a queued-but-unfitting
+        #: query has waited this long, the scheduler stops handing the
+        #: freed HBM share to smaller queries until the starved one
+        #: fits — without it, a sustained small-query stream could keep
+        #: ``hbm_in_use`` high forever and a large admitted query would
+        #: never dispatch (admission only rejects what can NEVER fit)
+        self.reserve_after_s = float(reserve_after_s)
+        self.admission = AdmissionController(hbm_budget, vmem_budget)
+        self._cond = threading.Condition()
+        self._queues: Dict[str, collections.deque] = {}
+        self._tokens: Dict[str, int] = {}       # dispatches charged
+        self._counts: Dict[str, Dict[str, int]] = {}
+        self._latencies: Dict[str, "collections.deque"] = {}
+        self._closed = False
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"tempo-query-service-{i}")
+            for i in range(max(1, int(workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- client side ---------------------------------------------------
+
+    def _count(self, tenant: str, field: str, by: int = 1) -> None:
+        c = self._counts.setdefault(tenant, {
+            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0})
+        c[field] += by
+
+    @staticmethod
+    def _as_root(query) -> ir.Node:
+        from tempo_tpu.plan import lazy
+
+        if isinstance(query, ir.Node):
+            return query
+        if isinstance(query, lazy.LazyDistributedTSDF):
+            # mesh chains materialise through their collect barrier,
+            # exactly like the lazy terminal does
+            return ir.Node("collect", inputs=(query.plan,))
+        if isinstance(query, lazy._LazyBase):
+            return query.plan
+        raise TypeError(
+            f"submit() takes a lazy chain (service.lazy_frame(frame)"
+            f".op()...) or a plan node, got {type(query).__name__}")
+
+    def submit(self, tenant: str, query,
+               timeout: Optional[float] = None) -> QueryTicket:
+        """Enqueue one query for ``tenant``.  Raises
+        :class:`AdmissionError` when the projected footprint could
+        never fit the budgets; blocks while the tenant is at quota
+        (per-tenant backpressure — ``queue.Full`` after ``timeout``)."""
+        root = self._as_root(query)
+        footprint = project_footprint(root)
+        sig = ir.signature(root)
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("query service is closed")
+            try:
+                self.admission.check(footprint)
+            except Exception:
+                self._count(tenant, "submitted")
+                self._count(tenant, "rejected")
+                raise
+            q = self._queues.setdefault(tenant, collections.deque())
+            if tenant not in self._tokens:
+                # new (or returning) tenants join at the FLOOR of the
+                # live token counts, not 0: starting from zero would
+                # hand a newcomer absolute priority until it caught up
+                # with tenants that have been served for hours —
+                # starving them, the inverse of the fairness contract
+                self._tokens[tenant] = min(self._tokens.values(),
+                                           default=0)
+            # standard condition-variable shape: re-check the predicate
+            # after EVERY wake (a timed-out wait may still have had the
+            # queue drained just before the deadline — Full only when
+            # the quota is genuinely still exhausted past it)
+            while len(q) >= self.tenant_quota:
+                remaining = None if deadline is None else \
+                    deadline - time.perf_counter()
+                if remaining is not None and remaining <= 0:
+                    raise queue_mod.Full(
+                        f"tenant {tenant!r} is at its pending-query "
+                        f"quota ({self.tenant_quota})")
+                self._cond.wait(remaining)
+                if self._closed:
+                    raise RuntimeError("query service is closed")
+            ticket = QueryTicket(tenant, root, sig, footprint)
+            q.append(ticket)
+            self._count(tenant, "submitted")
+            self._cond.notify_all()
+        return ticket
+
+    # -- scheduler/worker side ------------------------------------------
+
+    def _dispatch_locked(self, tenant: str) -> QueryTicket:
+        ticket = self._queues[tenant].popleft()
+        if not self._queues[tenant]:
+            # prune drained queues so _pick's sort scans tenants with
+            # PENDING work, not every tenant ever seen (tokens/counts
+            # persist — they are per-tenant-cardinality, not per-query)
+            del self._queues[tenant]
+        self._tokens[tenant] = self._tokens.get(tenant, 0) + 1
+        self.admission.acquire(ticket.footprint)
+        return ticket
+
+    def _pick(self) -> Optional[QueryTicket]:
+        """Next dispatchable ticket under the scheduler lock: tenants
+        offered in token order (fewest dispatches first — the fairness
+        accounting), first whose head query fits the free HBM share.
+        None = nothing dispatchable right now.
+
+        **Budget reservation**: a head that does not fit is only
+        *transiently* blocked (admission rejected everything that can
+        NEVER fit), but a sustained stream of smaller queries could
+        re-consume every freed byte and block it forever.  Once the
+        oldest unfitting head has waited ``reserve_after_s``, nothing
+        else dispatches until it fits — running queries drain,
+        ``hbm_in_use`` falls, and at worst an empty budget admits it."""
+        tenants = sorted(
+            (t for t, q in self._queues.items() if q),
+            key=lambda t: (self._tokens.get(t, 0), t))
+        starved: Optional[tuple] = None
+        for t in tenants:
+            head = self._queues[t][0]
+            if not self.admission.fits_now(head.footprint):
+                if starved is None or head.t_submit < starved[1].t_submit:
+                    starved = (t, head)
+        if starved is not None and (
+                time.perf_counter() - starved[1].t_submit
+                >= self.reserve_after_s):
+            if self.admission.fits_now(starved[1].footprint):
+                return self._dispatch_locked(starved[0])
+            return None                      # budget reserved: drain
+        for t in tenants:
+            if self.admission.fits_now(self._queues[t][0].footprint):
+                return self._dispatch_locked(t)
+        return None
+
+    def _worker(self) -> None:
+        from tempo_tpu.plan import executor as plan_executor
+
+        while True:
+            with self._cond:
+                ticket = self._pick()
+                while ticket is None:
+                    if self._closed and not any(self._queues.values()):
+                        return
+                    # reservation is age-triggered: wake periodically
+                    # even without queue events so a starved head's
+                    # clock is re-read
+                    self._cond.wait(timeout=0.25)
+                    ticket = self._pick()
+                # a dispatch frees a quota slot: wake blocked
+                # submitters (completions notify elsewhere)
+                self._cond.notify_all()
+            ticket.t_start = time.perf_counter()
+            try:
+                with plan_cache.tenant_scope(ticket.tenant):
+                    result = plan_executor.execute(ticket._root)
+            except BaseException as e:  # noqa: BLE001 - delivered on the
+                ticket._finish(exc=e)   # ticket; the worker lives on
+                with self._cond:
+                    self.admission.release(ticket.footprint)
+                    self._count(ticket.tenant, "failed")
+                    self._cond.notify_all()
+                continue
+            ticket._finish(result=result)
+            with self._cond:
+                self.admission.release(ticket.footprint)
+                self._count(ticket.tenant, "completed")
+                # bounded sample: percentiles are over the most recent
+                # window, and a long-lived service does not grow a
+                # float per query served forever
+                self._latencies.setdefault(
+                    ticket.tenant,
+                    collections.deque(maxlen=self._LATENCY_WINDOW),
+                ).append(ticket.latency_s)
+                self._cond.notify_all()
+
+    # -- lifecycle / metrics --------------------------------------------
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Graceful drain: stop accepting, execute everything already
+        queued, stop the workers."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False
+
+    def stats(self) -> dict:
+        """Per-tenant counts + latency percentiles, the shared cache's
+        per-tenant traffic, budget occupancy, and the starvation audit
+        (max/min completed-query ratio across tenants that submitted)."""
+        from tempo_tpu import profiling
+        from tempo_tpu.serve.executor import latency_percentiles
+
+        with self._cond:
+            tenants = {
+                t: dict(c, **latency_percentiles(
+                    list(self._latencies.get(t, ()))))
+                for t, c in self._counts.items()
+            }
+            completed = [c["completed"] for c in self._counts.values()
+                         if c["submitted"] > 0]
+            ratio = None
+            if completed and min(completed) > 0:
+                ratio = round(max(completed) / min(completed), 3)
+            return {
+                "tenants": tenants,
+                "starvation_ratio": ratio,
+                "hbm_in_use": self.admission.hbm_in_use,
+                "hbm_budget": self.admission.hbm_budget,
+                "vmem_budget": self.admission.vmem_budget,
+                "plan_cache": profiling.plan_cache_stats(),
+            }

@@ -44,6 +44,7 @@ def test_bench_smoke_prints_one_json_line():
         "7_frame_e2e_pipeline", "8_chunked_205k_k128",
         "9_chunked_1m_single", "10_planned_chain",
         "11_serving_ticks_per_sec", "12_mesh_scaling_top",
+        "13_query_service_qps",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -79,6 +80,27 @@ def test_bench_smoke_prints_one_json_line():
     assert sv.get("p50_ms") is not None and sv.get("p99_ms") is not None
     assert sv.get("zero_builds_steady_state") is True
     assert "bitwise" in sv.get("value_audit", "")
+    # config 13 (round 11): the multi-tenant query service must have
+    # run >= 2 tenants of mixed shapes with the shared-cache hit-rate
+    # reported, the hard zero-recompiles-at-steady-state assert, the
+    # per-tenant percentiles + starvation audit, and the cost-decided
+    # engine flip proved bitwise-safe
+    qs = rec.get("query_service") or {}
+    assert qs.get("qps", 0) > 0, qs
+    assert qs.get("n_tenants", 0) >= 2
+    assert 0 < qs.get("cache_hit_rate", 0) <= 1
+    assert qs.get("zero_builds_steady_state") is True
+    assert qs.get("starvation_ratio") is not None \
+        and qs["starvation_ratio"] <= 1.5
+    per_tenant = qs.get("per_tenant") or {}
+    assert len(per_tenant) == qs["n_tenants"], per_tenant
+    for t, c in per_tenant.items():
+        assert c.get("completed", 0) == qs["queries_per_tenant"], (t, c)
+        assert c.get("p50_ms") is not None and c.get("p99_ms") is not None
+    cd = qs.get("cost_decided") or {}
+    assert cd.get("default_inputs") != cd.get("flipped_inputs"), cd
+    assert "bitwise" in cd.get("value_audit", "")
+    assert "bitwise" in qs.get("value_audit", "")
     # config 12 (round 10): the mesh-scaling sweep must have measured
     # every device count of its (smoke-clipped) ladder, each point with
     # the in-bench planned==eager bitwise audit and the per-stage comm

@@ -1,0 +1,226 @@
+"""The cost-based planning layer (tempo_tpu/plan/cost.py, round 11).
+
+Load-bearing guarantees:
+
+* under the DEFAULT priors every cost decision reproduces the old
+  rule-based pick exactly (no behavior change at HEAD);
+* flipping a cost input genuinely flips a decision (engine pick,
+  fusion, reshard placement) — and every flipped plan stays BITWISE
+  identical to its rule-based twin, because the argmin only runs over
+  bitwise-equal candidates;
+* the active cost inputs are part of the executable-cache key, so a
+  flip re-plans instead of replaying the stale decision;
+* ``TEMPO_TPU_COST_MODEL=0`` restores the pure rule-based path.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, profiling
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import cost, ir, optimizer
+
+
+@pytest.fixture(autouse=True)
+def _clean_cost_state():
+    cost.clear_measured()
+    plan_cache.CACHE.clear()
+    yield
+    cost.clear_measured()
+    plan_cache.CACHE.clear()
+
+
+def _frame(cols, K=4, L=64, seed=0):
+    rng = np.random.default_rng(seed)
+    secs = np.cumsum(rng.integers(1, 3, size=(K, L)), axis=-1)
+    data = {"sym": np.repeat(np.arange(K), L),
+            "event_ts": secs.ravel().astype(np.int64)}
+    for c in cols:
+        data[c] = rng.standard_normal(K * L)
+    return TSDF(pd.DataFrame(data), "event_ts", ["sym"])
+
+
+# ----------------------------------------------------------------------
+# default priors == the rules
+# ----------------------------------------------------------------------
+
+def test_default_join_pick_reproduces_rule_everywhere():
+    for lanes in (1, 100, 10_000, 196_608, 196_609, 10**7):
+        for limit in (196_608, 1024, 0):
+            for chunked_ok in (True, False):
+                rule = ("single" if (limit <= 0 or lanes <= limit)
+                        else ("chunked" if chunked_ok else "bracket"))
+                got = cost.decide_join_engine(lanes, limit, chunked_ok)
+                assert got == rule, (lanes, limit, chunked_ok)
+                # the public pick agrees too (no hints, no forced knob)
+                assert profiling.pick_join_engine(
+                    lanes, limit, chunked_ok) == rule
+
+
+def test_cost_model_off_restores_rule_path(monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_COST_MODEL", "0")
+    assert not cost.enabled()
+    assert profiling.pick_join_engine(100, 196_608, True) == "single"
+    assert cost.fingerprint() == ("cost-off",)
+    monkeypatch.setenv("TEMPO_TPU_COST_MODEL", "1")
+    assert cost.enabled()
+
+
+def test_range_engine_cost_pick_equals_rule(monkeypatch):
+    from tempo_tpu.ops import rolling as ops_rolling
+
+    cases = [(512, 4, 2), (4096, 200, 100), (1 << 20, 5000, 5000)]
+    picks_on = [ops_rolling.pick_range_engine(n, b, a, True, True)
+                for n, b, a in cases]
+    monkeypatch.setenv("TEMPO_TPU_COST_MODEL", "0")
+    picks_off = [ops_rolling.pick_range_engine(n, b, a, True, True)
+                 for n, b, a in cases]
+    assert picks_on == picks_off
+
+
+def test_set_measured_rejects_unknown_inputs():
+    with pytest.raises(KeyError, match="unknown cost input"):
+        cost.set_measured(not_a_real_input=1.0)
+
+
+def test_fingerprint_tracks_measured_inputs():
+    fp0 = cost.fingerprint()
+    cost.set_measured(join_single_rate=123.0)
+    assert cost.fingerprint() != fp0
+    cost.clear_measured()
+    assert cost.fingerprint() == fp0
+
+
+# ----------------------------------------------------------------------
+# engine flip: cost-decided, bitwise-identical
+# ----------------------------------------------------------------------
+
+def test_join_engine_flip_is_bitwise_identical():
+    left = _frame(["x"], seed=1)
+    right = _frame(["bid", "ask"], seed=2)
+    limit = 196_608
+    assert profiling.pick_join_engine(100, limit, False) == "single"
+    out_single = left.asofJoin(right, right_prefix="r").df
+    cost.set_measured(join_single_rate=1e3)   # single-program rate collapses
+    assert profiling.pick_join_engine(100, limit, False) == "bracket"
+    out_bracket = left.asofJoin(right, right_prefix="r").df
+    pd.testing.assert_frame_equal(out_single, out_bracket,
+                                  check_exact=True)
+
+
+def test_forced_knob_beats_cost_model(monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_JOIN_ENGINE", "bracket")
+    cost.set_measured(host_bracket_rate=1e-3)  # cost says never bracket
+    assert profiling.pick_join_engine(100, 196_608, True) == "bracket"
+
+
+# ----------------------------------------------------------------------
+# fusion: cost-decided, bitwise-identical
+# ----------------------------------------------------------------------
+
+def _mesh_chain_nodes(monkeypatch):
+    from tempo_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    left = _frame(["x"], seed=3)
+    right = _frame(["v"], seed=4)
+    mesh = make_mesh({"series": 2})
+    chain = (left.on_mesh(mesh).asofJoin(right.on_mesh(mesh))
+             .withRangeStats(colsToSummarize=["x"],
+                             rangeBackWindowSecs=10))
+    return chain
+
+
+def test_fusion_cost_flip_bitwise(monkeypatch):
+    chain = _mesh_chain_nodes(monkeypatch)
+    root = ir.Node("collect", inputs=(chain.plan,))
+    opt_default = optimizer.optimize(root)
+    assert any(n.op == "fused_asof_stats_ema" for n in opt_default.walk())
+    out_fused = chain.collect().df
+
+    cost.set_measured(fused_overhead_s=10.0)
+    opt_flipped = optimizer.optimize(root)
+    assert not any(n.op == "fused_asof_stats_ema"
+                   for n in opt_flipped.walk())
+    flipped = [n for n in opt_flipped.walk()
+               if "fusion_cost" in n.ann]
+    assert flipped and flipped[0].ann["fusion_cost"]["decision"] \
+        == "op-by-op"
+    out_chain = chain.collect().df
+    pd.testing.assert_frame_equal(out_fused, out_chain, check_exact=True)
+
+
+def test_fusion_flip_replans_through_cache(monkeypatch):
+    """The cost fingerprint is part of the executable-cache key: the
+    flipped run above must be a fresh build, and flipping back must
+    HIT the original entry again."""
+    chain = _mesh_chain_nodes(monkeypatch)
+    chain.collect()
+    st = profiling.plan_cache_stats()
+    assert (st["builds"], st["hits"]) == (1, 0)
+    cost.set_measured(fused_overhead_s=10.0)
+    chain.collect()
+    st = profiling.plan_cache_stats()
+    assert st["builds"] == 2
+    cost.clear_measured()
+    chain.collect()
+    st = profiling.plan_cache_stats()
+    assert st["builds"] == 2 and st["hits"] == 1
+
+
+# ----------------------------------------------------------------------
+# reshard placement: cost-decided, bitwise-identical
+# ----------------------------------------------------------------------
+
+def _time_sharded_chain(monkeypatch):
+    from tempo_tpu.parallel import make_mesh
+
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    frame = _frame(["x"], K=4, L=64, seed=5)
+    mesh = make_mesh({"series": 2, "time": 2})
+    return (frame.on_mesh(mesh, time_axis="time")
+            .resample("30 seconds", "mean", metricCols=["x"]))
+
+
+def test_reshard_cost_flip_bitwise(monkeypatch):
+    chain = _time_sharded_chain(monkeypatch)
+    root = ir.Node("collect", inputs=(chain.plan,))
+    opt_placed = optimizer.optimize(root)
+    assert any(n.op == "reshard" for n in opt_placed.walk())
+    assert opt_placed.ann["reshard_cost"]["decision"] == "placed"
+    out_placed = chain.collect().df
+
+    cost.set_measured(reshard_dispatch_s=10.0)
+    opt_decl = optimizer.optimize(root)
+    assert not any(n.op == "reshard" for n in opt_decl.walk())
+    assert opt_decl.ann["reshard_cost"]["decision"] == "declarative"
+    out_decl = chain.collect().df
+    pd.testing.assert_frame_equal(out_placed, out_decl,
+                                  check_exact=True)
+
+
+def test_reshard_cost_silent_on_series_only_chains(monkeypatch):
+    """No time-sharded run -> nothing to decide: the optimized plan
+    carries no reshard_cost annotation noise."""
+    chain = _mesh_chain_nodes(monkeypatch)
+    opt = optimizer.optimize(ir.Node("collect", inputs=(chain.plan,)))
+    assert "reshard_cost" not in opt.ann
+
+
+# ----------------------------------------------------------------------
+# explain() renders the cost layer
+# ----------------------------------------------------------------------
+
+def test_explain_renders_cost_annotations(monkeypatch, capsys):
+    chain = _mesh_chain_nodes(monkeypatch)
+    text = chain.explain()
+    assert "est cost:" in text
+    assert "cost-decided fusion: fused" in text
+
+
+def test_explain_renders_reshard_cost_decision(monkeypatch):
+    chain = _time_sharded_chain(monkeypatch)
+    cost.set_measured(reshard_dispatch_s=10.0)
+    text = chain.explain()
+    assert "cost-decided -> declarative" in text

@@ -1,0 +1,413 @@
+"""The multi-tenant query service (tempo_tpu/service/, round 11):
+shared single-flight executable cache, admission control, fair
+scheduling, and failure isolation.
+"""
+
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, profiling
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import executor as plan_executor
+from tempo_tpu.service import (AdmissionError, QueryService, lazy_frame,
+                               project_footprint)
+from tempo_tpu.testing.faults import FaultInjector, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache():
+    plan_cache.CACHE.clear()
+    yield
+    plan_cache.CACHE.clear()
+
+
+def _frame(cols, K=4, L=64, seed=0):
+    rng = np.random.default_rng(seed)
+    secs = np.cumsum(rng.integers(1, 3, size=(K, L)), axis=-1)
+    data = {"sym": np.repeat(np.arange(K), L),
+            "event_ts": secs.ravel().astype(np.int64)}
+    for c in cols:
+        data[c] = rng.standard_normal(K * L)
+    return TSDF(pd.DataFrame(data), "event_ts", ["sym"])
+
+
+def _query(left, right):
+    return (lazy_frame(left).asofJoin(right)
+            .withRangeStats(colsToSummarize=["x"],
+                            rangeBackWindowSecs=10))
+
+
+# ----------------------------------------------------------------------
+# PlanCache: single-flight + per-signature / per-tenant counters
+# ----------------------------------------------------------------------
+
+def test_single_flight_builds_once_under_contention():
+    cache = plan_cache.PlanCache()
+    built = []
+    gate = threading.Event()
+
+    def build():
+        gate.wait(5)
+        time.sleep(0.02)                 # widen the race window
+        built.append(object())
+        return built[-1]
+
+    results = []
+
+    def worker():
+        results.append(cache.get_or_build(("sig", "k"), build))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    gate.set()
+    for t in threads:
+        t.join()
+    assert len(built) == 1
+    assert all(r is built[0] for r in results)
+    st = cache.stats()
+    assert st["builds"] == 1 and st["misses"] == 1
+    assert st["hits"] == 7
+
+
+def test_single_flight_failed_build_releases_the_claim():
+    cache = plan_cache.PlanCache()
+    calls = []
+
+    def flaky_build():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("poisoned build")
+        return "exe"
+
+    with pytest.raises(RuntimeError, match="poisoned build"):
+        cache.get_or_build(("sig",), flaky_build)
+    # the claim is released: the next caller retries as the builder
+    assert cache.get_or_build(("sig",), flaky_build) == "exe"
+    assert len(calls) == 2
+
+
+def test_insert_failure_releases_single_flight_claim(monkeypatch):
+    """insert() raising (malformed cache-size env var) must release
+    the build claim — otherwise every waiter on that key hangs."""
+    cache = plan_cache.PlanCache()
+    monkeypatch.setenv("TEMPO_TPU_PLAN_CACHE_SIZE", "not-a-number")
+    with pytest.raises(ValueError):
+        cache.get_or_build(("sig",), lambda: "exe")
+    monkeypatch.setenv("TEMPO_TPU_PLAN_CACHE_SIZE", "8")
+    assert cache.get_or_build(("sig",), lambda: "exe2") == "exe2"
+
+
+def test_per_signature_and_per_tenant_counters():
+    cache = plan_cache.PlanCache()
+    with plan_cache.tenant_scope("alice"):
+        cache.get_or_build(("sigA",), lambda: "a")
+        cache.get_or_build(("sigA",), lambda: "a")
+    with plan_cache.tenant_scope("bob"):
+        cache.get_or_build(("sigA",), lambda: "a")
+        cache.get_or_build(("sigB",), lambda: "b")
+    st = cache.stats()
+    assert st["by_signature"]["sigA"]["builds"] == 1
+    assert st["by_signature"]["sigA"]["hits"] == 2
+    assert st["by_signature"]["sigB"]["builds"] == 1
+    assert st["by_tenant"]["alice"] == {"hits": 1, "misses": 1,
+                                        "builds": 1}
+    assert st["by_tenant"]["bob"] == {"hits": 1, "misses": 1,
+                                      "builds": 1}
+
+
+def test_plan_cache_stats_exposes_breakdowns():
+    st = profiling.plan_cache_stats()
+    assert "by_signature" in st and "by_tenant" in st
+
+
+# ----------------------------------------------------------------------
+# QueryService basics
+# ----------------------------------------------------------------------
+
+def test_concurrent_tenants_share_one_build():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with QueryService(workers=4) as svc:
+        tickets = [svc.submit(f"t{i % 4}", _query(left, right))
+                   for i in range(12)]
+        results = [t.result(timeout=120) for t in tickets]
+        st = svc.stats()
+    pc = st["plan_cache"]
+    assert pc["builds"] == 1, pc
+    assert pc["hits"] == 11
+    assert st["starvation_ratio"] == 1.0
+    ref = results[0].df
+    for r in results[1:]:
+        pd.testing.assert_frame_equal(ref, r.df, check_exact=True)
+
+
+def test_submit_after_close_raises():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    svc = QueryService(workers=1)
+    svc.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit("t0", _query(left, right))
+
+
+def test_submit_rejects_non_lazy_queries():
+    svc = QueryService(workers=1)
+    try:
+        with pytest.raises(TypeError, match="lazy chain"):
+            svc.submit("t0", _frame(["x"]))
+    finally:
+        svc.close()
+
+
+# ----------------------------------------------------------------------
+# Admission control
+# ----------------------------------------------------------------------
+
+def test_footprint_projection_scales_with_shape():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    small = project_footprint(_query(left, right).plan)
+    big_l = _frame(["x"], L=512, seed=1)
+    big_r = _frame(["v"], L=512, seed=2)
+    big = project_footprint(_query(big_l, big_r).plan)
+    assert small.hbm_bytes > 0 and small.vmem_bytes > 0
+    assert big.hbm_bytes > small.hbm_bytes
+    assert big.vmem_bytes >= small.vmem_bytes
+
+
+def test_over_vmem_query_is_rejected_named_not_queued():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with QueryService(workers=1, vmem_budget=64) as svc:
+        t0 = time.perf_counter()
+        with pytest.raises(AdmissionError, match="VMEM"):
+            svc.submit("t0", _query(left, right))
+        assert time.perf_counter() - t0 < 5      # immediate, not queued
+        st = svc.stats()
+    assert st["tenants"]["t0"]["rejected"] == 1
+    assert st["tenants"]["t0"]["completed"] == 0
+
+
+def test_over_total_hbm_query_is_rejected():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with QueryService(workers=1, hbm_budget=128) as svc:
+        with pytest.raises(AdmissionError, match="TOTAL"):
+            svc.submit("t0", _query(left, right))
+
+
+def test_queued_query_runs_after_budget_frees():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    fp = project_footprint(_query(left, right).plan)
+    # budget admits exactly ONE query at a time; three must still all
+    # complete, serialized by admission (release -> re-check)
+    with QueryService(workers=2,
+                      hbm_budget=int(fp.hbm_bytes * 1.5)) as svc:
+        tickets = [svc.submit("t0", _query(left, right))
+                   for _ in range(3)]
+        results = [t.result(timeout=120) for t in tickets]
+        st = svc.stats()
+    assert st["tenants"]["t0"]["completed"] == 3
+    assert st["hbm_in_use"] == 0
+    ref = results[0].df
+    for r in results[1:]:
+        pd.testing.assert_frame_equal(ref, r.df, check_exact=True)
+
+
+# ----------------------------------------------------------------------
+# Fairness + backpressure
+# ----------------------------------------------------------------------
+
+def _blocked_executor(monkeypatch):
+    """Patch plan execution to wait on a gate — lets tests stack the
+    queues deterministically before any dispatch completes."""
+    gate = threading.Event()
+    original = plan_executor.execute
+
+    def gated(root):
+        gate.wait(30)
+        return original(root)
+
+    monkeypatch.setattr(plan_executor, "execute", gated)
+    return gate
+
+
+def test_tenant_quota_backpressure(monkeypatch):
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    gate = _blocked_executor(monkeypatch)
+    svc = QueryService(workers=1, tenant_quota=2)
+    try:
+        t1 = svc.submit("t0", _query(left, right))
+        # wait until the worker has POPPED t1 and sits blocked inside
+        # execution — from here the queue can only grow
+        deadline = time.perf_counter() + 10
+        while t1.t_start is None:
+            assert time.perf_counter() < deadline, "worker never started"
+            time.sleep(0.005)
+        tickets = [t1,
+                   svc.submit("t0", _query(left, right)),
+                   svc.submit("t0", _query(left, right))]  # at quota
+        with pytest.raises(queue_mod.Full, match="quota"):
+            svc.submit("t0", _query(left, right), timeout=0.05)
+        gate.set()
+        for t in tickets:
+            t.result(timeout=120)
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_explicit_zero_budget_admits_nothing():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with QueryService(workers=1, hbm_budget=0) as svc:
+        with pytest.raises(AdmissionError):
+            svc.submit("t0", _query(left, right))
+
+
+def test_new_tenant_joins_at_token_floor(monkeypatch):
+    """A tenant first seen after hours of service must NOT get
+    absolute priority until token parity: newcomers join at the floor
+    of the live token counts, so dispatch interleaves instead of
+    draining the newcomer's whole backlog first."""
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    gate = threading.Event()
+    gate.set()
+    original = plan_executor.execute
+
+    def gated(root):
+        gate.wait(30)
+        return original(root)
+
+    monkeypatch.setattr(plan_executor, "execute", gated)
+    svc = QueryService(workers=1)
+    try:
+        for _ in range(4):                    # veteran earns 4 tokens
+            svc.submit("vet", _query(left, right)).result(timeout=120)
+        gate.clear()                          # block the worker…
+        hold = svc.submit("vet", _query(left, right))
+        deadline = time.perf_counter() + 10
+        while hold.t_start is None:           # …mid-dispatch
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)
+        new = [svc.submit("newbie", _query(left, right))
+               for _ in range(3)]
+        vet = [svc.submit("vet", _query(left, right))
+               for _ in range(3)]
+        gate.set()
+        for t in new + vet + [hold]:
+            t.result(timeout=120)
+        # floor join: newbie starts at vet's token count, so vet's
+        # queued work interleaves — its first follow-up starts before
+        # newbie's backlog fully drains (tokens from 0 would run all
+        # three newbie queries first)
+        assert min(t.t_start for t in vet) < max(t.t_start for t in new)
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_starved_large_query_reserves_budget(monkeypatch):
+    """A large admitted query must not be starved by smaller queries
+    re-consuming every freed HBM byte: past ``reserve_after_s`` the
+    scheduler reserves — nothing smaller dispatches until the starved
+    head fits."""
+    small_l, small_r = _frame(["x"], L=64, seed=1), _frame(["v"], L=64,
+                                                           seed=2)
+    big_l, big_r = _frame(["x"], L=256, seed=3), _frame(["v"], L=256,
+                                                        seed=4)
+    fp_small = project_footprint(_query(small_l, small_r).plan)
+    fp_big = project_footprint(_query(big_l, big_r).plan)
+    assert fp_big.hbm_bytes > fp_small.hbm_bytes
+    gate = _blocked_executor(monkeypatch)
+    # budget: big alone fits; big + small does not; small + small does
+    budget = fp_big.hbm_bytes + fp_small.hbm_bytes // 2
+    svc = QueryService(workers=2, hbm_budget=budget, reserve_after_s=0.0)
+    try:
+        s1 = svc.submit("flood", _query(small_l, small_r))
+        deadline = time.perf_counter() + 10
+        while s1.t_start is None:         # worker holds fp_small
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)
+        big = svc.submit("big", _query(big_l, big_r))     # cannot fit
+        s2 = svc.submit("flood", _query(small_l, small_r))  # would fit
+        time.sleep(0.3)
+        # reservation active: s2 fits the free share but must NOT run
+        # ahead of the starved big query
+        assert s2.t_start is None and big.t_start is None
+        gate.set()
+        big.result(timeout=120)
+        s2.result(timeout=120)
+        assert big.t_start < s2.t_start
+    finally:
+        gate.set()
+        svc.close()
+
+
+def test_fair_scheduler_interleaves_tenants(monkeypatch):
+    """A flooding tenant must not starve a light one: with the worker
+    gated, 'heavy' enqueues 5 queries before 'light' enqueues 1 — the
+    token accounting dispatches light's query second, not sixth."""
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    gate = _blocked_executor(monkeypatch)
+    svc = QueryService(workers=1, tenant_quota=16)
+    try:
+        heavy = [svc.submit("heavy", _query(left, right))
+                 for _ in range(5)]
+        light = svc.submit("light", _query(left, right))
+        gate.set()
+        for t in heavy + [light]:
+            t.result(timeout=120)
+        starts = sorted(t.t_start for t in heavy)
+        # light started before heavy's 3rd dispatch (fair interleave,
+        # not FIFO behind the flood)
+        assert light.t_start < starts[2], (light.t_start, starts)
+        st = svc.stats()
+    finally:
+        gate.set()
+        svc.close()
+    assert st["tenants"]["light"]["completed"] == 1
+    assert st["tenants"]["heavy"]["completed"] == 5
+
+
+# ----------------------------------------------------------------------
+# Failure isolation (chaos)
+# ----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_poisoned_query_fails_its_ticket_not_the_scheduler():
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with QueryService(workers=2) as svc:
+        with FaultInjector() as fi:
+            fi.flaky(plan_executor, "execute", failures=1)
+            poisoned = svc.submit("evil", _query(left, right))
+            with pytest.raises(InjectedFault):
+                poisoned.result(timeout=120)
+            # the scheduler survives: later queries (any tenant) run
+            ok = svc.submit("good", _query(left, right))
+            assert isinstance(ok.result(timeout=120), object)
+        st = svc.stats()
+    assert st["tenants"]["evil"]["failed"] == 1
+    assert st["tenants"]["good"]["completed"] == 1
+    assert st["hbm_in_use"] == 0         # the poisoned query released
+
+
+@pytest.mark.chaos
+def test_poisoned_build_does_not_wedge_single_flight_waiters():
+    """Two tenants race the same signature; the first build dies.  The
+    waiter must retry as the builder and succeed — nobody hangs."""
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    with FaultInjector() as fi:
+        fi.flaky(plan_executor.Executable, "run", failures=1)
+        with QueryService(workers=2) as svc:
+            tickets = [svc.submit(f"t{i}", _query(left, right))
+                       for i in range(4)]
+            outcomes = []
+            for t in tickets:
+                try:
+                    t.result(timeout=120)
+                    outcomes.append("ok")
+                except InjectedFault:
+                    outcomes.append("fault")
+    assert outcomes.count("fault") == 1
+    assert outcomes.count("ok") == 3

@@ -26,6 +26,14 @@ echo "== serving identity (tests/test_serve.py) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
 
+echo "== query service (tests/test_service.py + tests/test_cost.py) =="
+# the multi-tenant service's single-flight/admission/fairness contracts
+# and the cost model's default-priors==rules + bitwise-flip contracts,
+# surfaced as their own gate before tier-1
+JAX_PLATFORMS=cpu python -m pytest tests/test_service.py tests/test_cost.py \
+    -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
