@@ -106,8 +106,9 @@ def pick_range_engine(n_elems: int, max_behind: int, max_ahead: int,
         # cost-decided, but over the BITWISE-SAFE candidate set only:
         # the three engines differ in f32 rounding order, so the
         # revalidation lattice above admits exactly one engine per
-        # shape and the argmin cannot drift from the rule pick — the
-        # cost numbers feed explain() and the bench record
+        # shape and a cost argmin cannot drift from the rule pick —
+        # the cost numbers surface in explain() via the plan-time
+        # hoist, not on this per-call path
         # (plan/cost.py:decide_range_engine documents the contract)
         return plan_cost.decide_range_engine(W, n_elems, fits_shifted,
                                              fits_stream)

@@ -242,7 +242,9 @@ def decide_join_engine(est_lanes: int, limit: int, chunked_ok: bool) -> str:
 def range_costs(W: int, n_elems: int) -> Dict[str, float]:
     """Estimated seconds per range-stats engine over ``n_elems`` rows
     with a (max_behind + max_ahead) row extent of ``W`` — the numbers
-    ``explain()`` renders next to the hoisted engine choice.  Models:
+    the plan-time hoist (``optimizer._hoist_engines``) attaches to
+    range_stats nodes for ``explain()`` to render next to the engine
+    choice (host chains with derivable rowbounds).  Models:
     shifted/stream cross HBM once (roofline-minimal) but re-touch the
     VMEM-resident slab once per window row at
     ``vmem_pass_rate_multiple`` × the stream rate (stream pays one
@@ -269,18 +271,21 @@ def decide_range_engine(W: int, n_elems: int, fits_shifted: bool,
     """Cheapest *bitwise-safe* range engine.  The three engines differ
     in f32 rounding order (MIGRATION v0.7), so the candidate set is the
     revalidation lattice's singleton — shifted iff it fits, else stream
-    iff it fits, else windowed — and the argmin can never flip the
-    engine away from the rule-based pick (the bitwise contract wins
-    over the cost model by design; the costs still feed ``explain()``
-    and the bench record)."""
+    iff it fits, else windowed — and a cost argmin over one candidate
+    can never flip the engine away from the rule-based pick (the
+    bitwise contract wins over the cost model by design).  The
+    :func:`range_costs` estimates are therefore NOT computed on this
+    per-call path; they surface once per plan via the optimizer's
+    engine hoist, which annotates the node for ``explain()``.  ``W``
+    and ``n_elems`` stay in the signature as the decision's cost-model
+    inputs — a future bitwise-equal engine pair would argmin over
+    them."""
+    del W, n_elems                       # singleton candidate set
     if fits_shifted:
-        safe = ("shifted",)
-    elif fits_stream:
-        safe = ("stream",)
-    else:
-        safe = ("windowed",)
-    costs = range_costs(W, n_elems)
-    return min(safe, key=lambda e: costs[e])
+        return "shifted"
+    if fits_stream:
+        return "stream"
+    return "windowed"
 
 
 # ----------------------------------------------------------------------

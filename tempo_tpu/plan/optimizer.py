@@ -188,6 +188,19 @@ def _plain_numeric_mesh_source(node: ir.Node) -> bool:
     return False
 
 
+def _host_value_cols(t) -> list:
+    """Plane-backed value columns of a host TSDF — everything except
+    ts, partitions, and the sequence column.  THE one column filter
+    behind every host plane count: ``_device_plane_count``'s
+    on_mesh(source) branch, ``_est_frame_bytes``'s fusion byte input,
+    and the query service's runtime admission projection
+    (``service/admission.py``) all call it, so the three models cannot
+    drift column-accounting again."""
+    return [c for c in t.df.columns
+            if c not in {t.ts_col, *t.partitionCols,
+                         t.sequence_col or ""}]
+
+
 def _est_frame_bytes(node: ir.Node) -> int:
     """Best-effort device byte estimate of a source-adjacent node's
     packed planes (ts + value/validity per column) — the byte input of
@@ -204,8 +217,7 @@ def _est_frame_bytes(node: ir.Node) -> int:
 
             K = lay.n_series
             L = packing.pad_length(int(np.max(lay.lengths, initial=0)))
-            n_cols = max(1, len(frame.df.columns)
-                         - len(frame.partitionCols) - 1)
+            n_cols = max(1, len(_host_value_cols(frame)))
             return K * L * (8 + 5 * n_cols)
         return int(frame.K_dev) * int(frame.L) * (
             8 + 5 * max(1, len(frame.cols)))    # DistributedTSDF
@@ -318,10 +330,12 @@ def _hoist_engines(root: ir.Node) -> None:
         if n.op in ("range_stats", "fused_asof_stats_ema"):
             w = n.param("s_window" if n.op == "fused_asof_stats_ema"
                         else "rangeBackWindowSecs", 1000)
-            engine = _plan_range_engine(n, float(w))
+            engine, rcosts = _plan_range_engine(n, float(w))
             if engine is not None:
                 n.ann["range_engine"] = engine
                 n.ann.setdefault("hints", {})["range_engine"] = engine
+                if rcosts is not None:
+                    n.ann["cost"] = rcosts
         if n.op in ("asof_join", "fused_asof_stats_ema"):
             sides = [(_source_frame(c)) for c in n.inputs[:2]]
             if all(s is not None for s in sides):
@@ -356,17 +370,21 @@ def _hoist_engines(root: ir.Node) -> None:
                             if v is not None}
 
 
-def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
-    """The engine the stats op will pick over this node's input chain,
-    computed once at plan time — the SAME decision function the eager
-    paths run per call (rolling.plan_range_engine for host frames,
-    dist's shared shard pick for mesh frames), so replaying the hint
-    can never change which kernel a planned chain runs.  None when the
-    shard shape is not derivable at plan time (e.g. stats after an
-    op that reshapes) — the executor then picks at run time, exactly
-    like eager."""
+def _plan_range_engine(node: ir.Node, w: float):
+    """``(engine, costs)`` the stats op will pick over this node's
+    input chain, computed once at plan time — the SAME decision
+    function the eager paths run per call (rolling.plan_range_engine
+    for host frames, dist's shared shard pick for mesh frames), so
+    replaying the hint can never change which kernel a planned chain
+    runs.  ``costs`` is the per-engine estimate dict explain() renders
+    next to the choice (host chains with derivable rowbounds, cost
+    model on; None otherwise — the mesh picks are per-shard and
+    annotate the engine only).  ``(None, None)`` when the shard shape
+    is not derivable at plan time (e.g. stats after an op that
+    reshapes) — the executor then picks at run time, exactly like
+    eager."""
     if not node.inputs:
-        return None
+        return None, None
     child = node.inputs[0]
     try:
         if _mesh_side(child):
@@ -374,7 +392,7 @@ def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
 
             if child.op == "dist_source":
                 engine, _, _ = child.payload._range_engine_choice(w)
-                return engine
+                return engine, None
             # mesh chains pick on the LEFT frame's packed geometry; a
             # join keeps it, so walk past source-preserving ops to an
             # on_mesh(source) whose geometry is derivable pre-packing
@@ -392,11 +410,11 @@ def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
                 engine, _, _ = dist.plan_range_engine_choice(
                     t.layout, mesh, cur.param("series_axis", "series"),
                     cur.param("time_axis"), w)
-                return engine
-            return None
+                return engine, None
+            return None, None
         src = _source_frame(child)
         if src is None:
-            return None
+            return None, None
         from tempo_tpu import rolling as frame_rolling
 
         # the column count enters the host pick (C*K shard elements),
@@ -404,12 +422,21 @@ def _plan_range_engine(node: ir.Node, w: float) -> Optional[str]:
         pick = node.param("colsToSummarize")
         cols = list(pick) if pick else src.summarizable_columns()
         if not cols:
-            return None
-        engine = frame_rolling.plan_range_engine(src, cols, w)[0]
-        return engine
+            return None, None
+        engine, rb, ts_long, _ = frame_rolling.plan_range_engine(
+            src, cols, w)
+        costs = None
+        if rb is not None and ts_long is not None:
+            from tempo_tpu.plan import cost as plan_cost
+
+            if plan_cost.enabled():
+                K, L = ts_long.shape
+                costs = plan_cost.range_costs(
+                    int(rb[0]) + int(rb[1]), K * L)
+        return engine, costs
     except Exception as e:  # pragma: no cover - probe must never kill a plan
         logger.debug("plan: range-engine hoist skipped (%s)", e)
-        return None
+        return None, None
 
 
 # ----------------------------------------------------------------------
@@ -445,12 +472,15 @@ def _device_plane_count(node: ir.Node) -> Optional[int]:
     None when not statically derivable."""
     if node.op == "dist_source":
         return len(node.payload.cols)
+    if node.op == "source":
+        # bare host frame (pre-mesh): the same value planes it packs —
+        # a derivable LEAF, so downstream op nodes of pure host chains
+        # derive their counts too (runtime admission projects whole
+        # host chains through this model, not just mesh chains)
+        return len(_host_value_cols(node.payload))
     if node.op == "on_mesh" and node.inputs \
             and node.inputs[0].op == "source":
-        t = node.inputs[0].payload
-        return len([c for c in t.df.columns
-                    if c not in {t.ts_col, *t.partitionCols,
-                                 t.sequence_col or ""}])
+        return len(_host_value_cols(node.inputs[0].payload))
     if not node.inputs:
         return None
     base = _device_plane_count(node.inputs[0])

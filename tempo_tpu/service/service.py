@@ -58,8 +58,8 @@ class QueryTicket:
     """One submitted query: a waitable handle for its result."""
 
     __slots__ = ("tenant", "signature", "footprint", "t_submit",
-                 "t_start", "t_done", "_root", "_event", "_result",
-                 "_exc")
+                 "t_blocked", "t_start", "t_done", "_root", "_event",
+                 "_result", "_exc")
 
     def __init__(self, tenant: str, root: ir.Node, signature: str,
                  footprint: Footprint):
@@ -67,6 +67,11 @@ class QueryTicket:
         self.signature = signature
         self.footprint = footprint
         self.t_submit = time.perf_counter()
+        #: when this query, AT THE HEAD of its tenant's queue, first
+        #: failed ``fits_now()`` — the budget-reservation clock (time
+        #: spent behind the tenant's own earlier queries is not
+        #: starvation and must not trigger a service-wide reserve)
+        self.t_blocked: Optional[float] = None
         self.t_start: Optional[float] = None
         self.t_done: Optional[float] = None
         self._root = root
@@ -118,8 +123,8 @@ class QueryService:
             tenant_quota = config.get_int(
                 "TEMPO_TPU_SERVICE_TENANT_QUOTA", 64)
         self.tenant_quota = max(1, int(tenant_quota))
-        #: budget reservation threshold: once a queued-but-unfitting
-        #: query has waited this long, the scheduler stops handing the
+        #: budget reservation threshold: once a head-of-queue query has
+        #: sat unfitting this long, the scheduler stops handing the
         #: freed HBM share to smaller queries until the starved one
         #: fits — without it, a sustained small-query stream could keep
         #: ``hbm_in_use`` high forever and a large admitted query would
@@ -206,6 +211,13 @@ class QueryService:
                 self._cond.wait(remaining)
                 if self._closed:
                     raise RuntimeError("query service is closed")
+                # the scheduler PRUNES a deque it drains
+                # (_dispatch_locked), so the reference captured above
+                # may be orphaned by now — re-resolve the live deque
+                # before re-checking the predicate, or the append below
+                # would land in a deque _pick never scans and silently
+                # lose the query
+                q = self._queues.setdefault(tenant, q)
             ticket = QueryTicket(tenant, root, sig, footprint)
             q.append(ticket)
             self._count(tenant, "submitted")
@@ -219,7 +231,10 @@ class QueryService:
         if not self._queues[tenant]:
             # prune drained queues so _pick's sort scans tenants with
             # PENDING work, not every tenant ever seen (tokens/counts
-            # persist — they are per-tenant-cardinality, not per-query)
+            # persist — they are per-tenant-cardinality, not per-query).
+            # Safe against submitters blocked at quota: they re-resolve
+            # the live deque after every wake (see submit()), so a
+            # pruned reference is never appended into
             del self._queues[tenant]
         self._tokens[tenant] = self._tokens.get(tenant, 0) + 1
         self.admission.acquire(ticket.footprint)
@@ -237,7 +252,13 @@ class QueryService:
         re-consume every freed byte and block it forever.  Once the
         oldest unfitting head has waited ``reserve_after_s``, nothing
         else dispatches until it fits — running queries drain,
-        ``hbm_in_use`` falls, and at worst an empty budget admits it."""
+        ``hbm_in_use`` falls, and at worst an empty budget admits it.
+        The clock starts when the query FIRST fails ``fits_now()`` as
+        its tenant's head (``t_blocked``), not at submit: time queued
+        behind the same tenant's earlier queries is ordinary waiting,
+        and triggering off it would stall the whole service for a query
+        that was never budget-starved."""
+        now = time.perf_counter()
         tenants = sorted(
             (t for t, q in self._queues.items() if q),
             key=lambda t: (self._tokens.get(t, 0), t))
@@ -245,11 +266,13 @@ class QueryService:
         for t in tenants:
             head = self._queues[t][0]
             if not self.admission.fits_now(head.footprint):
-                if starved is None or head.t_submit < starved[1].t_submit:
+                if head.t_blocked is None:
+                    head.t_blocked = now
+                if starved is None \
+                        or head.t_blocked < starved[1].t_blocked:
                     starved = (t, head)
         if starved is not None and (
-                time.perf_counter() - starved[1].t_submit
-                >= self.reserve_after_s):
+                now - starved[1].t_blocked >= self.reserve_after_s):
             if self.admission.fits_now(starved[1].footprint):
                 return self._dispatch_locked(starved[0])
             return None                      # budget reserved: drain
@@ -268,9 +291,12 @@ class QueryService:
                     if self._closed and not any(self._queues.values()):
                         return
                     # reservation is age-triggered: wake periodically
-                    # even without queue events so a starved head's
-                    # clock is re-read
-                    self._cond.wait(timeout=0.25)
+                    # while queries are PENDING so a starved head's
+                    # clock is re-read; an idle service sleeps until a
+                    # submit/close notifies instead of spinning
+                    self._cond.wait(
+                        timeout=0.25 if any(self._queues.values())
+                        else None)
                     ticket = self._pick()
                 # a dispatch frees a quota slot: wake blocked
                 # submitters (completions notify elsewhere)
@@ -303,14 +329,18 @@ class QueryService:
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Graceful drain: stop accepting, execute everything already
-        queued, stop the workers."""
+        queued, stop the workers.  ``timeout`` bounds the WHOLE drain —
+        one shared deadline across the worker joins, not per worker."""
         with self._cond:
             if self._closed:
                 return
             self._closed = True
             self._cond.notify_all()
+        deadline = None if timeout is None else \
+            time.perf_counter() + timeout
         for t in self._threads:
-            t.join(timeout)
+            t.join(None if deadline is None else
+                   max(0.0, deadline - time.perf_counter()))
 
     def __enter__(self) -> "QueryService":
         return self

@@ -224,3 +224,47 @@ def test_explain_renders_reshard_cost_decision(monkeypatch):
     cost.set_measured(reshard_dispatch_s=10.0)
     text = chain.explain()
     assert "cost-decided -> declarative" in text
+
+
+def test_explain_renders_range_engine_costs_on_host_chains(monkeypatch):
+    """The hoisted range-engine choice carries its per-engine cost
+    estimates (cost.range_costs) into explain() — the numbers exist in
+    the rendered plan, not just in a computed-and-discarded dict."""
+    # rowbounds (the cost model's W input) derive only on the
+    # sort-kernel path; force it on so the CPU test sees the TPU shape
+    monkeypatch.setenv("TEMPO_TPU_SORT_KERNELS", "1")
+    frame = _frame(["x"])
+    from tempo_tpu.plan import lazy
+
+    chain = lazy.wrap(lazy._as_node(frame)).withRangeStats(
+        colsToSummarize=["x"], rangeBackWindowSecs=10)
+    text = chain.explain()
+    assert "engine[stats]=" in text
+    assert "est cost:" in text
+    for eng in ("shifted", "stream", "windowed"):
+        assert eng in text
+
+
+def test_host_value_column_filter_is_shared():
+    """One column filter behind every host plane count: the fusion byte
+    estimate, the reshard plane model, and runtime admission all see
+    the same value columns — ts, partitions and the sequence column
+    excluded everywhere."""
+    rng = np.random.default_rng(0)
+    K, L = 4, 64
+    secs = np.cumsum(rng.integers(1, 3, size=(K, L)), axis=-1)
+    df = pd.DataFrame({
+        "sym": np.repeat(np.arange(K), L),
+        "event_ts": secs.ravel().astype(np.int64),
+        "seq": np.arange(K * L),
+        "x": rng.standard_normal(K * L),
+        "y": rng.standard_normal(K * L),
+    })
+    t = TSDF(df, "event_ts", ["sym"], sequence_col="seq")
+    assert sorted(optimizer._host_value_cols(t)) == ["x", "y"]
+    src = ir.Node("source", payload=t)
+    node = ir.Node("on_mesh", inputs=(src,))
+    assert optimizer._device_plane_count(node) == 2
+    # the bare host leaf derives too (runtime admission projects whole
+    # host chains through this model, not just mesh chains)
+    assert optimizer._device_plane_count(src) == 2

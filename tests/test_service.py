@@ -178,6 +178,33 @@ def test_footprint_projection_scales_with_shape():
     assert big.vmem_bytes >= small.vmem_bytes
 
 
+def test_host_frame_footprint_counts_real_columns():
+    """A bare host frame's HBM projection must scale with its actual
+    value-column count, not the 2-plane fallback — a wide frame
+    projected at 2 planes lets admission over-admit."""
+    from tempo_tpu import packing
+
+    wide = _frame([f"c{i}" for i in range(12)], seed=1)
+    narrow = _frame(["x"], seed=1)
+    fp_wide = project_footprint(lazy_frame(wide).plan)
+    fp_narrow = project_footprint(lazy_frame(narrow).plan)
+    assert fp_wide.hbm_bytes > fp_narrow.hbm_bytes
+    L = packing.pad_length(64)
+    # ts i64 + (value f32 + validity bool) per value column
+    assert fp_narrow.hbm_bytes == 4 * L * (8 + 5 * 1)
+    assert fp_wide.hbm_bytes == 4 * L * (8 + 5 * 12)
+    # intermediates derive from the same model: an op node over the
+    # wide host source projects its real plane count, not the 2-plane
+    # fallback (the source leaf makes the whole chain derivable)
+    from tempo_tpu.plan import optimizer
+
+    stats_node = (lazy_frame(wide)
+                  .withRangeStats(colsToSummarize=["c0"],
+                                  rangeBackWindowSecs=10).plan)
+    assert optimizer._device_plane_count(stats_node) is not None
+    assert optimizer._device_plane_count(stats_node) > 12
+
+
 def test_over_vmem_query_is_rejected_named_not_queued():
     left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
     with QueryService(workers=1, vmem_budget=64) as svc:
@@ -256,6 +283,117 @@ def test_tenant_quota_backpressure(monkeypatch):
     finally:
         gate.set()
         svc.close()
+
+
+def test_quota_blocked_submitter_survives_queue_drain(monkeypatch):
+    """A submitter blocked at quota must append into the LIVE deque
+    after waking: if the scheduler pruned the tenant's drained deque
+    while the submitter slept, the woken append would land in an
+    orphaned deque the picker never scans — a silently lost query whose
+    ticket blocks forever."""
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    gate = _blocked_executor(monkeypatch)
+    svc = QueryService(workers=1, tenant_quota=1)
+    try:
+        t1 = svc.submit("t0", _query(left, right))
+        deadline = time.perf_counter() + 10
+        while t1.t_start is None:        # t1 popped; queue is empty
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)
+        t2 = svc.submit("t0", _query(left, right))   # queue at quota
+        slot = []
+
+        def blocked_submit():
+            slot.append(svc.submit("t0", _query(left, right)))
+
+        th = threading.Thread(target=blocked_submit)
+        th.start()
+        time.sleep(0.2)                  # t3's submitter is in wait()
+        assert not slot                  # …still blocked at quota
+        gate.set()                       # t1 completes; t2 dispatches,
+        th.join(30)                      # draining the deque; t3 wakes
+        assert not th.is_alive()
+        assert slot, "blocked submitter never returned"
+        for t in (t1, t2, slot[0]):
+            t.result(timeout=60)
+        st = svc.stats()
+    finally:
+        gate.set()
+        svc.close()
+    assert st["tenants"]["t0"]["completed"] == 3
+
+
+def test_reservation_clock_starts_at_head_not_at_submit(monkeypatch):
+    """A query that aged behind its OWN tenant's earlier queries must
+    not freeze service-wide dispatch the instant it reaches the head:
+    the reservation clock starts when it first fails ``fits_now()`` as
+    head, not at submit."""
+    small_l, small_r = _frame(["x"], L=64, seed=1), _frame(["v"], L=64,
+                                                           seed=2)
+    big_l, big_r = _frame(["x"], L=256, seed=3), _frame(["v"], L=256,
+                                                        seed=4)
+    fp_small = project_footprint(_query(small_l, small_r).plan)
+    fp_big = project_footprint(_query(big_l, big_r).plan)
+    # geometry: big alone fits; big + one small does not; two smalls do
+    budget = fp_big.hbm_bytes + fp_small.hbm_bytes // 2
+    assert 2 * fp_small.hbm_bytes <= budget
+    sem = threading.Semaphore(0)
+    original = plan_executor.execute
+
+    def gated(root):
+        assert sem.acquire(timeout=60)
+        return original(root)
+
+    monkeypatch.setattr(plan_executor, "execute", gated)
+    svc = QueryService(workers=2, hbm_budget=budget, reserve_after_s=2.0)
+    try:
+        s1 = svc.submit("busy", _query(small_l, small_r))
+        s2 = svc.submit("busy", _query(small_l, small_r))
+        deadline = time.perf_counter() + 10
+        while s1.t_start is None or s2.t_start is None:
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)
+        # big queues behind nothing dispatchable and AGES past
+        # reserve_after_s before any picker ever sees it as a
+        # failing head
+        big = svc.submit("busy", _query(big_l, big_r))
+        time.sleep(2.5)
+        sem.release()                    # one small drains its budget
+        deadline = time.perf_counter() + 10
+        while not (s1.done() or s2.done()):
+            assert time.perf_counter() < deadline
+            time.sleep(0.005)
+        # big's head-check now fails fits_now with t_submit 2.5 s old:
+        # a submit-based clock would reserve instantly and freeze this
+        # fitting query; the head-based clock dispatches it promptly
+        other = svc.submit("other", _query(small_l, small_r))
+        deadline = time.perf_counter() + 1.5    # well under 2.0 s
+        while other.t_start is None:
+            assert time.perf_counter() < deadline, \
+                "fitting query frozen by a never-head-starved reservation"
+            time.sleep(0.005)
+        sem.release(8)                   # drain everything
+        for t in (s1, s2, big, other):
+            t.result(timeout=120)
+    finally:
+        sem.release(16)
+        svc.close()
+
+
+def test_close_timeout_is_a_shared_deadline(monkeypatch):
+    """close(timeout) bounds the WHOLE drain: with W gated workers the
+    call must return in ~timeout, not W x timeout."""
+    left, right = _frame(["x"], seed=1), _frame(["v"], seed=2)
+    gate = _blocked_executor(monkeypatch)
+    svc = QueryService(workers=4)
+    tickets = [svc.submit("t0", _query(left, right)) for _ in range(4)]
+    t0 = time.perf_counter()
+    svc.close(timeout=1.0)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 2.5, elapsed        # per-worker joins would be ~4 s
+    gate.set()
+    for t in tickets:                    # daemon workers still drain
+        t.result(timeout=120)
 
 
 def test_explicit_zero_budget_admits_nothing():
