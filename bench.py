@@ -1685,6 +1685,224 @@ def bench_serving(seed=11):
     }
 
 
+def bench_fleet_serving(seed=14):
+    """Config 14: fleet-scale serving through the cohort engine
+    (``--only-fleet-serving``).
+
+    >= 10k single-series streams in ONE process, every one driven under
+    a Poisson arrival load through the :class:`CohortExecutor`: each
+    coalesced micro-batch becomes ONE cohort dispatch (a scatter into
+    the ``[S, K, Lb]`` batch + one cached step program over the whole
+    ``[S, ...]`` state block), so aggregate throughput is bounded by
+    the program, not by per-stream dispatch count.  Reported alongside
+    a PR 8 per-instance baseline measured in the same process — the
+    same tick mix through independent ``StreamingTSDF`` instances, one
+    tiny dispatch per push (the pre-cohort architecture) — with the
+    >= 20x aggregate target asserted hard in full mode.
+
+    In-bench invariants, asserted hard:
+
+    * **zero-recompile steady state** — after warmup, the measured
+      phase builds nothing (``plan_cache_stats()`` builds counter);
+    * **sampled streamed == batch** — for >= 64 sampled streams, every
+      measured emission (join values/found/idx, stats planes, EMA) is
+      compared bitwise against the batch operators over that stream's
+      concatenated history.
+    """
+    from tempo_tpu import profiling
+    from tempo_tpu.ops import rolling as ops_rolling
+    from tempo_tpu.serve import (CohortExecutor, StreamCohort,
+                                 StreamingTSDF)
+    from tempo_tpu.serve import state as serve_state
+
+    smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+    S = 512 if smoke else 10240
+    n_warm = 400 if smoke else 4000
+    n_meas = 2000 if smoke else 40000
+    ml = 32
+    wsecs, rows_bound, alpha = 10.0, 8, 0.2
+    cols = ("px",)
+    C = len(cols)
+
+    rng = np.random.default_rng(seed)
+    cohort = StreamCohort(cols, window_secs=wsecs,
+                          window_rows_bound=rows_bound,
+                          ema_alpha=alpha, max_lookback=ml, slots=S)
+    members = [cohort.add_stream(f"u{i}", ["ticks"]) for i in range(S)]
+    ex = CohortExecutor(cohort, batch_rows=32, queue_depth=64,
+                        coalesce_s=0.004)
+    cohort.warmup(32)
+
+    n = n_warm + n_meas
+    # Poisson arrivals on a global logical clock (exponential gaps,
+    # strictly increasing => per-stream merged order holds); the first
+    # S ticks deal one per stream so EVERY stream is driven, the rest
+    # land on random streams
+    gaps = rng.exponential(scale=4e7, size=n).astype(np.int64) + 1
+    ts = np.cumsum(gaps) + np.int64(10**9)
+    stream_of = np.concatenate([
+        rng.permutation(S),
+        rng.integers(0, S, max(0, n - S))])[:n]
+    is_left = rng.random(n) < 0.25
+    is_left[:S] = False                  # the dealt tick is a data push
+    vals = rng.standard_normal(n).astype(np.float32)
+    vals[rng.random(n) < 0.05] = np.nan  # NaN runs
+    chunk_len = 2048
+
+    def feed(i0, i1):
+        # bulk chunks in arrival order (kinds mixed; the executor's
+        # member-order-preserving split re-batches per side)
+        tickets = []
+        for c0 in range(i0, i1, chunk_len):
+            tickets.extend(ex.submit_many([
+                ("left", members[stream_of[q]], "ticks", int(ts[q]),
+                 None, None)
+                if is_left[q] else
+                ("right", members[stream_of[q]], "ticks", int(ts[q]),
+                 {"px": vals[q]}, None)
+                for q in range(c0, min(i1, c0 + chunk_len))]))
+        return tickets
+
+    for t in feed(0, n_warm):
+        t.result(timeout=300)
+    builds0 = profiling.plan_cache_stats()["builds"]
+    t0 = time.perf_counter()
+    tickets = feed(n_warm, n)
+    measured = [t.result(timeout=600) for t in tickets]
+    wall = time.perf_counter() - t0
+    ex.close()
+    stats = profiling.plan_cache_stats()
+    assert stats["builds"] == builds0, (
+        f"fleet steady state recompiled: builds went "
+        f"{builds0} -> {stats['builds']} ({stats})")
+    assert cohort.clipped == 0, (
+        f"{cohort.clipped} rows exceeded the declared window row "
+        f"bound — widen window_rows_bound")
+    driven = len(set(stream_of.tolist()))
+    assert driven >= S, f"only {driven} of {S} streams driven"
+    agg_rate = n_meas / wall
+
+    # ---- PR 8 per-instance baseline: the SAME fleet as independent
+    # StreamingTSDF instances — one Python object, one executable set,
+    # one tiny dispatch per push (the architecture this config exists
+    # to beat) — measured live at fleet scale, not assumed.  Median of
+    # three windows bounds scheduler noise.
+    base_streams = [StreamingTSDF(["ticks"], cols, window_secs=wsecs,
+                                  window_rows_bound=rows_bound,
+                                  ema_alpha=alpha, max_lookback=ml)
+                    for _ in range(S)]
+    base_streams[0].warmup(1)      # executables are shared via the
+    #                                plan cache; one build covers all
+    n_base = 300 if smoke else 500
+    base_rates = []
+    bi = 0
+    for _ in range(3):
+        tb0 = time.perf_counter()
+        for _ in range(n_base):
+            s = base_streams[stream_of[bi % n]]
+            t_i = np.int64(10**9) * (bi + 1)
+            if bi % 4 == 3:
+                s.push_left(["ticks"], [t_i + 1])
+            else:
+                s.push(["ticks"], [t_i],
+                       {"px": np.float32([vals[bi % n]])})
+            bi += 1
+        base_rates.append(n_base / (time.perf_counter() - tb0))
+    base_rate = sorted(base_rates)[1]
+    ratio = agg_rate / base_rate
+    if not smoke:
+        assert ratio >= 20, (
+            f"aggregate {agg_rate:.0f} ticks/s is only {ratio:.1f}x "
+            f"the per-instance baseline {base_rate:.0f} ticks/s "
+            f"(target >= 20x)")
+
+    # ---- sampled identity: streamed emissions == batch operators
+    # over each sampled stream's concatenated history
+    audit_streams = sorted(set(
+        rng.choice(S, size=min(64, S), replace=False).tolist()))
+    all_results = [None] * n_warm + measured
+    checked = 0
+    for sidx in audit_streams:
+        idxs = [i for i in range(n) if stream_of[i] == sidx]
+        r_idx = [i for i in idxs if not is_left[i]]
+        l_idx = [i for i in idxs if is_left[i]]
+        if r_idx:
+            r_ts = np.array([ts[i] for i in r_idx], np.int64)[None]
+            r_vals = np.array([vals[i] for i in r_idx],
+                              np.float32)[None, None]
+        else:       # pad row: the join still needs a right side
+            r_ts = np.full((1, 1), TS_PAD, np.int64)
+            r_vals = np.full((1, 1, 1), np.nan, np.float32)
+        r_valids = ~np.isnan(r_vals)
+        wstats, _ = serve_state.window_stats_batch(
+            r_ts, r_vals, r_valids, serve_state.window_ns(wsecs),
+            rows_bound)
+        wstats = {k: np.asarray(v) for k, v in wstats.items()}
+        w_ema, _ = ops_rolling.ema_scan(
+            jnp.asarray(r_vals), jnp.asarray(r_valids),
+            np.float32(alpha))
+        w_ema = np.asarray(w_ema)
+        if l_idx:
+            l_ts = np.array([ts[i] for i in l_idx], np.int64)[None]
+            wv, wf, wi = (np.asarray(a) for a in sm.asof_merge_values(
+                jnp.asarray(l_ts), jnp.asarray(r_ts),
+                jnp.asarray(r_valids), jnp.asarray(r_vals),
+                skip_nulls=True, max_lookback=ml))
+        jr = jl = 0
+        for i in idxs:
+            res = all_results[i]
+            if is_left[i]:
+                j = jl; jl += 1
+            else:
+                j = jr; jr += 1
+            if res is None:
+                continue
+            if is_left[i]:
+                got_f = bool(res["px_found"])
+                assert got_f == bool(wf[0, 0, j]), (sidx, j, "found")
+                if got_f:
+                    assert np.float32(res["px"]).tobytes() == \
+                        np.float32(wv[0, 0, j]).tobytes(), (sidx, j)
+                assert int(res["right_row_idx"]) == int(wi[0, j])
+            else:
+                assert np.float32(res["px_ema"]).tobytes() == \
+                    np.float32(w_ema[0, 0, j]).tobytes(), (sidx, j,
+                                                           "ema")
+                for skey in ("mean", "stddev", "count"):
+                    assert np.float32(res[f"px_{skey}"]).tobytes() == \
+                        np.float32(wstats[skey][0, 0, j]).tobytes(), \
+                        (sidx, j, skey)
+            checked += 1
+
+    lat = ex.latency_stats()
+    return {
+        "aggregate_ticks_per_sec": round(agg_rate, 1),
+        "n_streams": S,
+        "streams_driven": driven,
+        "n_ticks": n_meas,
+        "p50_ms": lat["all"]["p50_ms"],
+        "p99_ms": lat["all"]["p99_ms"],
+        "latency": lat,
+        "dispatches": ex.batches,
+        "bucket_hist": {str(k): v for k, v in
+                        sorted(ex.bucket_hist.items())},
+        "plan_cache": {k: stats[k] for k in
+                       ("hits", "misses", "builds", "evictions")},
+        "zero_builds_steady_state": True,
+        "per_instance_baseline": {
+            "ticks_per_sec": round(base_rate, 1),
+            "n_streams": S,
+            "n_ticks": 3 * n_base,
+        },
+        "aggregate_vs_per_instance": round(ratio, 1),
+        "audit_streams": len(audit_streams),
+        "value_audit": f"sampled streamed == batch bitwise over "
+                       f"{len(audit_streams)} streams ({checked} "
+                       f"measured-phase ticks checked; join "
+                       f"vals/found/idx, mean/stddev/count, EMA)",
+    }
+
+
 def _mesh_scaling_frames(n_dev, seed=11):
     """Config-7-shaped frames for the mesh sweep: K series over the
     frame API, same data at every device count so rates compare."""
@@ -2205,6 +2423,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-fleet-serving" in sys.argv:
+        res = _attempt("fleet_serving", bench_fleet_serving)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-query-service" in sys.argv:
         res = _attempt("query_service", bench_query_service)
         if res is None:
@@ -2305,6 +2529,8 @@ def main():
                                     timeout=2400)
     serving = _config_subprocess("--only-serving", "serving",
                                  timeout=2400)
+    fleet_serving = _config_subprocess("--only-fleet-serving",
+                                       "fleet_serving", timeout=2400)
     query_service = _config_subprocess("--only-query-service",
                                        "query_service", timeout=2400)
     mesh_scaling = _config_subprocess("--only-mesh-scaling",
@@ -2421,6 +2647,13 @@ def main():
             # percentiles, cache counters and the starvation audit
             "13_query_service_qps": (
                 round(query_service["qps"]) if query_service else None),
+            # aggregate ticks/sec over >= 10k streams multiplexed
+            # through ONE cohort step program per dispatch (the record
+            # below carries the per-instance baseline and the >= 20x
+            # aggregate ratio the config asserts)
+            "14_fleet_serving_ticks_per_sec": (
+                round(fleet_serving["aggregate_ticks_per_sec"])
+                if fleet_serving else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -2428,6 +2661,10 @@ def main():
         # the in-bench planned==eager bitwise audit (ROADMAP item 2)
         "mesh_scaling": mesh_scaling,
         "serving": serving,
+        # config 14: the fleet-scale cohort engine — >= 10k streams in
+        # one process, aggregate vs the PR 8 per-instance baseline,
+        # zero-recompile steady state, sampled bitwise audit
+        "fleet_serving": fleet_serving,
         # config 13: the multi-tenant query service — shared-cache
         # hit-rate, the hard zero-recompiles-at-steady-state assert,
         # per-tenant p50/p99, the starvation audit and the

@@ -324,6 +324,13 @@ def load(path: str, mesh=None, series_axis: str = "series",
             f"tempo_tpu.serve.StreamingTSDF.resume",
             kind=FailureKind.PERMANENT,
         )
+    if man["kind"] == "cohort_state":
+        raise CheckpointError(
+            f"{path!r} holds a serving cohort snapshot, not a frame: "
+            f"restore it with load_state(kind='cohort_state') or "
+            f"tempo_tpu.serve.StreamCohort.resume",
+            kind=FailureKind.PERMANENT,
+        )
     if man["kind"] == "host":
         return _load_host(path, man)
     if mesh is None:
@@ -360,13 +367,16 @@ def _npz_checksums(man: dict, npz_name: str) -> Optional[Dict[str, int]]:
 # ----------------------------------------------------------------------
 
 def save_state(arrays: Dict[str, np.ndarray], path: str,
-               meta: Optional[dict] = None) -> None:
+               meta: Optional[dict] = None,
+               kind: str = "stream_state") -> None:
     """Atomic, CRC'd snapshot of a flat ``name -> array`` dict — the
-    durability primitive behind ``StreamingTSDF.snapshot``.  Same
-    guarantees as :func:`save`: the directory appears fully written or
-    not at all (three-step swap, ``.bak`` fallback), every array CRC-32
-    is recorded in the manifest and verified on load, and snapshots
-    written under a ``step_NNNNN`` family compose with
+    durability primitive behind ``StreamingTSDF.snapshot`` (kind
+    ``"stream_state"``, the default) and ``StreamCohort.snapshot``
+    (kind ``"cohort_state"``: ONE artifact for the whole cohort).
+    Same guarantees as :func:`save`: the directory appears fully
+    written or not at all (three-step swap, ``.bak`` fallback), every
+    array CRC-32 is recorded in the manifest and verified on load, and
+    snapshots written under a ``step_NNNNN`` family compose with
     :func:`list_steps` / :func:`latest` / :func:`prune` (keep-last-K).
     ``meta`` rides in the manifest (JSON-serializable only).
     Single-process: serving streams are single-writer by contract."""
@@ -379,7 +389,7 @@ def save_state(arrays: Dict[str, np.ndarray], path: str,
         host = {k: np.asarray(v) for k, v in arrays.items()}
         sums = _savez(os.path.join(tmp, "state.npz"), host)
         man = {
-            "kind": "stream_state",
+            "kind": str(kind),
             "array_checksums": {"state.npz": sums},
             "meta": meta or {},
         }
@@ -395,22 +405,28 @@ def save_state(arrays: Dict[str, np.ndarray], path: str,
         raise
 
 
-def load_state(path: str, verify: bool = True):
+def load_state(path: str, verify: bool = True,
+               kind: str = "stream_state"):
     """Restore a :func:`save_state` snapshot: ``(arrays dict, meta)``.
-    ``verify=True`` checks every array against the manifest CRCs and
-    raises :class:`CheckpointError` naming the corrupt array; stale
-    ``.tmp`` residue is cleaned and a crash mid-swap falls back to
-    ``.bak`` exactly like :func:`load`."""
+    ``kind`` names the expected snapshot family (``"stream_state"`` /
+    ``"cohort_state"``) — a mismatch raises by name so a cohort resume
+    can never silently swallow a single-stream snapshot (or vice
+    versa).  ``verify=True`` checks every array against the manifest
+    CRCs and raises :class:`CheckpointError` naming the corrupt array;
+    stale ``.tmp`` residue is cleaned and a crash mid-swap falls back
+    to ``.bak`` exactly like :func:`load`."""
     _clean_stale_tmp(path)
     if not os.path.exists(os.path.join(path, "manifest.json")) \
             and os.path.exists(os.path.join(path + ".bak",
                                             "manifest.json")):
         path = path + ".bak"
     man = _manifest(path)
-    if man["kind"] != "stream_state":
+    if man["kind"] != kind:
         raise CheckpointError(
             f"{path!r} is a {man['kind']!r} checkpoint, not a "
-            f"StreamState snapshot: restore frames with checkpoint.load")
+            f"{kind!r} snapshot: restore frames with checkpoint.load, "
+            f"single streams with load_state(kind='stream_state'), "
+            f"cohorts with load_state(kind='cohort_state')")
     arrs = _load_npz(os.path.join(path, "state.npz"),
                      _npz_checksums(man, "state.npz"), verify=verify)
     return dict(arrs), man.get("meta") or {}

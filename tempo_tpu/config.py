@@ -153,6 +153,18 @@ KNOBS: Dict[str, Knob] = _knobs(
          "snapshot the serving StreamState every N acked events "
          "(CRC'd keep-last-K via checkpoint.save_state; 0 disables "
          "automatic snapshots — snapshot() stays available)"),
+    Knob("TEMPO_TPU_SERVE_COHORT_SLOTS", "int", "1024",
+         "tempo_tpu/serve/cohort",
+         "initial stream-slot capacity of each cohort shape-bucket "
+         "group (grown by doubling when full; rounded up to the "
+         "mesh's stream-axis size on sharded cohorts — a capacity "
+         "change recompiles, so size it to the expected fleet)"),
+    Knob("TEMPO_TPU_SERVE_COHORT_CKPT_EVERY", "int", "0",
+         "tempo_tpu/serve/cohort",
+         "snapshot the whole cohort (ONE kind=\"cohort_state\" "
+         "artifact, per-stream acked cursors in the manifest) every N "
+         "total acked events; 0 disables automatic snapshots — "
+         "StreamCohort.snapshot() stays available"),
     Knob("TEMPO_TPU_COST_MODEL", "bool", "1", "tempo_tpu/plan/cost",
          "0 reverts engine picks, fusion and reshard placement to the "
          "pure rule-based decisions; on (default) they are argmins "

@@ -107,6 +107,32 @@ def _ns(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def stream_mesh(n_devices: Optional[int] = None,
+                stream_axis: str = "streams") -> Mesh:
+    """A 1-D mesh whose single axis is the cohort STREAM axis — the
+    fleet-serving layout (serve/cohort.py): scale-out is
+    stream-parallel, so the whole device budget goes to one axis and
+    every cohort state array shards its leading [S] dim across it."""
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else int(n_devices)
+    return Mesh(np.asarray(devs[:n]).reshape(n), (stream_axis,))
+
+
+def stream_shardings(mesh: Mesh, stream_axis: str, tree):
+    """Same-structure tree of ``NamedSharding(mesh, P(stream_axis))``
+    for every leaf of ``tree`` (avals or arrays): axis 0 — the cohort
+    stream axis — sharded, everything else replicated per shard.  The
+    cohort step programs jit with this as BOTH ``in_shardings`` and
+    ``out_shardings`` (:func:`serve.state.cohort_push_jitted`), the
+    PR 10 pre-partitioned handoff: the compiled loop's output layout
+    is its own input layout, so the steady state never implies a
+    reshard and the compiled HLO carries zero collectives
+    (``profiling.collective_counts_from_compiled`` — asserted by the
+    ``serve.cohort_push`` compiled contract and the fleet bench)."""
+    sh = _ns(mesh, P(stream_axis))
+    return jax.tree_util.tree_map(lambda _: sh, tree)
+
+
 class DistributedTSDF:
     """A TSDF whose packed cache is a sharded ``jax.Array`` on a device
     mesh and whose ops run distributed (SURVEY.md §2.3)."""

@@ -47,6 +47,24 @@ def max_size() -> int:
     return config.get_int("TEMPO_TPU_PLAN_CACHE_SIZE", _DEFAULT_SIZE)
 
 
+def device_key(mesh=None) -> tuple:
+    """Hashable device-placement component of an executable cache key.
+
+    Compiled executables are pinned to concrete devices: the same
+    program lowered for a different backend — or sharded over a
+    different mesh — is a DIFFERENT executable, and replaying a cached
+    one would either crash or silently run with stale placement.  Every
+    serving-engine key (per-stream step programs, cohort step programs)
+    folds this in; ``mesh=None`` is the single-device form."""
+    import jax
+
+    if mesh is None:
+        return (jax.default_backend(), None)
+    return (jax.default_backend(),
+            tuple(sorted(mesh.shape.items())),
+            tuple(d.id for d in mesh.devices.flat))
+
+
 @contextlib.contextmanager
 def tenant_scope(tenant: Optional[str]):
     """Attribute cache traffic inside the block to ``tenant`` (the

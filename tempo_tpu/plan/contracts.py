@@ -58,6 +58,15 @@ Registry coverage map (program -> production user):
                                 steady-state push step
                                 (tempo_tpu/serve/state.py: AS-OF +
                                 EMA + window carries, donated)
+``serve.cohort_push`` /         the fleet-serving cohort engine's
+``serve.cohort_query``          mesh-sharded step programs
+                                (serve/cohort.py: [S, ...] stream-axis
+                                state, whole-state donation, ZERO
+                                collectives — stream-parallel by
+                                construction) + the ``serve.cohort_
+                                loop`` chain pinning that the step's
+                                out-shardings ARE its own (and the
+                                query's) in-shardings
 ``service.dispatch_stats`` /    the query service's steady-state
 ``service.dispatch_ema``        dispatch programs: the cached planner
                                 executables (plan/fused.py) at the
@@ -487,6 +496,64 @@ def _build_serve_step():
     compiled = fn.lower(*serve_state.push_avals(cfg, Lb)).compile()
     contract = Contract(donate_argnums=tuple(range(n_state)))
     return CompiledProgram("serve.step", compiled, contract)
+
+
+@register("serve.cohort_step", requires_devices=CONTRACT_SERIES)
+def _build_cohort_step():
+    """The fleet-serving cohort engine's mesh-sharded step programs
+    (serve/cohort.py): ONE push and ONE query program for S streams
+    sharing a shape bucket, the [S, ...] stream axis sharded across the
+    mesh.  Contracts: every retired cohort state buffer donated (a
+    dropped donation doubles FLEET HBM per tick), no f64 creep, no
+    host transfers, and — the fleet-scaling claim itself — ZERO
+    collectives: nothing in the step mixes streams, so an empty
+    collective inventory is the declared model and ANY collective in
+    the compiled HLO fails as unmodeled.  The ``serve.cohort_loop``
+    chain declares the steady-state wiring: the push step's state
+    out-shardings are its own in-shardings (the pre-partitioned pjit
+    handoff) and feed the query step's carry inputs — jit drops the
+    query's two unused lock planes under skipNulls, so the query-side
+    indices are COMPILED parameter positions."""
+    import jax
+
+    from tempo_tpu import dist
+    from tempo_tpu.serve import state as serve_state
+
+    S = 2 * CONTRACT_SERIES
+    cfg = serve_state.StreamConfig(
+        n_series=4, n_cols=2, skip_nulls=True, max_lookback=16,
+        window_ns=serve_state.window_ns(_WINDOW_SECS), rows_bound=8,
+        ema_alpha=0.2)
+    Lb = 8
+    mesh = dist.stream_mesh(CONTRACT_SERIES)
+    push_fn, n_state = serve_state.cohort_push_jitted(cfg, S, Lb, mesh)
+    push_c = push_fn.lower(
+        *serve_state.cohort_push_avals(cfg, S, Lb)).compile()
+    query_fn = serve_state.cohort_query_jitted(cfg, S, Lb, mesh)
+    query_c = query_fn.lower(
+        *serve_state.cohort_query_avals(cfg, S, Lb)).compile()
+    # the query reads 7 of its 9 python operands (skipNulls drops
+    # lock_val/lock_valid), so python arg 7 (the donated n_merged
+    # carry) lands at COMPILED parameter index 5
+    programs = [
+        CompiledProgram("serve.cohort_push", push_c,
+                        Contract(donate_argnums=tuple(range(n_state)))),
+        CompiledProgram("serve.cohort_query", query_c,
+                        Contract(donate_argnums=(5,))),
+    ]
+    # flat output order of the push step: the state tuple's n_state
+    # leaves precede the emission dict, so state i is out_idx i; the
+    # query's compiled inputs are the 7 used operands in python order
+    links = [Link("serve.cohort_push", i, "serve.cohort_push", i)
+             for i in range(n_state)]
+    links += [
+        Link("serve.cohort_push", out_i, "serve.cohort_query", in_i)
+        for out_i, in_i in
+        # last_val, last_src, lock_src, last_ridx, r_count, n_merged
+        ((0, 0), (1, 1), (4, 2), (5, 3), (6, 4), (7, 5))
+    ]
+    chain = Chain("serve.cohort_loop", tuple(links))
+    return programs, [chain]
 
 
 @register("service.dispatch", requires_devices=CONTRACT_SERIES)

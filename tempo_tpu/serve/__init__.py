@@ -7,18 +7,26 @@ state (``serve/state.py`` — the chunked merge kernel's cross-chunk
 scratch lifted into jitted-function carries), a streaming frame
 (``serve/stream.py`` — ``push`` / ``push_left`` emitting results for
 exactly the new rows, bitwise-equal to the batch operators over the
-concatenated history), a shape-bucketing background executor
-(``serve/executor.py`` — bounded queue, backpressure, p50/p99 latency
-stamps, zero-recompile steady state through the planner's executable
-cache), and crash-resume via CRC'd StreamState snapshots
-(``tempo_tpu/checkpoint.py:save_state`` / ``StreamingTSDF.resume``).
+concatenated history), the fleet-scale cohort engine
+(``serve/cohort.py`` — thousands of streams as ONE ``[S, ...]`` state
+block per shape bucket, stepped by one AOT program, stream axis
+shardable over the mesh with zero per-push collectives), shape-
+bucketing background executors (``serve/executor.py`` — bounded queue,
+backpressure, per-ticket p50/p99 latency over a bounded window,
+zero-recompile steady state through the planner's executable cache),
+and crash-resume via CRC'd snapshots
+(``tempo_tpu/checkpoint.py:save_state`` — per-stream
+``StreamingTSDF.resume``, whole-cohort ``StreamCohort.resume``).
 """
 
-from tempo_tpu.serve.executor import MicroBatchExecutor, Ticket
+from tempo_tpu.serve.cohort import CohortMember, StreamCohort, row_bucket
+from tempo_tpu.serve.executor import (CohortExecutor, MicroBatchExecutor,
+                                      Ticket)
 from tempo_tpu.serve.state import StreamConfig, init_state, window_stats_batch
 from tempo_tpu.serve.stream import LateTickError, StreamingTSDF
 
 __all__ = [
-    "StreamingTSDF", "MicroBatchExecutor", "Ticket", "LateTickError",
+    "StreamingTSDF", "StreamCohort", "CohortMember", "row_bucket",
+    "MicroBatchExecutor", "CohortExecutor", "Ticket", "LateTickError",
     "StreamConfig", "init_state", "window_stats_batch",
 ]

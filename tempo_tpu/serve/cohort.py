@@ -1,0 +1,931 @@
+"""``StreamCohort``: fleet-scale serving — thousands of streams, ONE
+step program.
+
+``StreamingTSDF`` (serve/stream.py) is one stream per instance with its
+own step executables: N streams means N Python objects and N tiny
+dispatches, so aggregate throughput is dispatch-bound long before the
+hardware is busy.  All incremental state is already explicit device
+arrays (serve/state.py), so the batching dimension is free — stack it:
+
+* **cohort state** — every carry array gains a leading ``[S]`` stream
+  axis (``state.cohort_state_init``), one block per *shape bucket*:
+  streams whose padded series-row count lands on the same power of two
+  (:func:`row_bucket` — the executor's pow2 bucketing promoted to
+  cohort membership) share one ``[S, ...]`` state block and ONE
+  AOT-compiled push/query program (``state.cohort_push_jitted`` — the
+  per-stream step under ``jax.vmap``, so each stream's slice of the
+  cohort result is **bitwise** the single-stream program's output).
+* **scatter admission** — a dispatch takes ticks from any number of
+  member streams, validates each member against its own watermark rows
+  of the cohort's ``[S, K]`` watermark planes (the same
+  ``stream.admit_batch`` rule as the single-stream engine), and
+  scatters the admitted ticks into one padded ``[S, K, Lb]`` batch.
+  Idle slots ride along as masked no-op rows — the step leaves their
+  state bit-identical — so per-push work is one scatter plus one
+  executable call regardless of how many streams ticked.
+* **per-stream isolation** — a late tick rejects only its own member's
+  rows: that member's sub-batch is zeroed out of the dispatch (its
+  tickets get the :class:`~tempo_tpu.serve.stream.LateTickError`), the
+  rest of the cohort steps normally, and the rejected member's state
+  and watermarks stay untouched (commit-after-success per member).
+* **mesh scale-out** — with a ``mesh``, the ``[S]`` axis is sharded
+  across devices via explicit ``in_shardings``/``out_shardings``
+  (``dist.stream_shardings``) with whole-state donation: no op in the
+  step mixes streams, so the compiled HLO carries **zero per-push
+  collectives** (asserted by the ``serve.cohort_push`` compiled
+  contract and the ``--only-fleet-serving`` bench) and scale-out is
+  embarrassingly stream-parallel.
+* **durability** — ``snapshot()`` writes ONE CRC'd artifact for the
+  whole cohort (``checkpoint.save_state(kind="cohort_state")``);
+  :meth:`StreamCohort.resume` restores it and reports per-stream
+  ``acked`` so only each stream's unacknowledged tail replays.
+
+Semantics are the single-stream engine's, exactly: results are bitwise
+equal to S independent ``StreamingTSDF`` instances fed the same
+per-stream events at any push interleaving (tests/test_cohort.py pins
+the matrix), per-stream watermarks and ``maxLookback`` expiry
+included.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tempo_tpu import checkpoint as ckpt
+from tempo_tpu import config, resilience
+from tempo_tpu.packing import TS_PAD
+from tempo_tpu.serve import state as sst
+from tempo_tpu.serve import stream as stream_mod
+from tempo_tpu.serve.stream import LateTickError, _SIDE_LEFT, _SIDE_RIGHT
+
+#: per-state-array position of the SERIES axis (without the leading
+#: stream axis); everything not listed keeps it last.  Used by slot
+#: reset and bucket migration, which copy/clear series-row prefixes.
+_K_AXIS = {"ring_ts": -2, "ring_x": -2, "ring_valid": -2}
+
+
+def row_bucket(n: int) -> int:
+    """Cohort membership: padded series-row count of a stream — next
+    power of two, floor 1.  Streams sharing a bucket share one state
+    block and one step program; a stream that outgrows its bucket
+    migrates to the next one (:meth:`CohortMember.add_series`)."""
+    if n < 1:
+        raise ValueError("a stream needs at least one series")
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def _k_slice(arr_ndim: int, name: str, k: int) -> tuple:
+    """Indexer selecting the first ``k`` series rows of a PER-SLOT
+    state array (no stream axis)."""
+    ax = _K_AXIS.get(name, -1) % arr_ndim
+    sl = [slice(None)] * arr_ndim
+    sl[ax] = slice(0, k)
+    return tuple(sl)
+
+
+class _Singles:
+    """Per-dispatch accumulator for single-tick members (the fleet
+    regime): plain python lists, turned into ONE set of index arrays
+    and ONE vectorized watermark check in ``_dispatch_group``."""
+
+    __slots__ = ("members", "idxs", "slots", "rows", "ts", "sqf",
+                 "planes")
+
+    def __init__(self, n_cols: int):
+        self.members: List[CohortMember] = []
+        self.idxs: List[int] = []
+        self.slots: List[int] = []
+        self.rows: List[int] = []
+        self.ts: List[int] = []
+        self.sqf: List[float] = []
+        self.planes: List[List[float]] = [[] for _ in range(n_cols)]
+
+
+class CohortMember:
+    """One stream of a cohort: the ``StreamingTSDF``-shaped handle
+    (``push`` / ``push_left`` with the same argument and emission
+    contract), backed by one slot of its bucket group's stacked state.
+    Single-writer like the standalone frame; route concurrent traffic
+    through :class:`~tempo_tpu.serve.executor.CohortExecutor`."""
+
+    def __init__(self, cohort: "StreamCohort", name: str,
+                 series: Sequence):
+        self.cohort = cohort
+        self.name = str(name)
+        self.series = list(series)
+        if len(set(self.series)) != len(self.series):
+            raise ValueError("duplicate series keys")
+        self._row = {s: k for k, s in enumerate(self.series)}
+        self.acked = 0
+        self._group: Optional["_Group"] = None
+        self.slot: Optional[int] = None
+
+    @property
+    def bucket(self) -> int:
+        """The member's current shape bucket (padded series rows)."""
+        return self._group.cfg.n_series
+
+    # -- the StreamingTSDF-shaped surface ------------------------------
+
+    def push(self, series_ids, ts, values: Dict[str, np.ndarray],
+             seq=None) -> Dict[str, np.ndarray]:
+        """Ingest right-side ticks for this stream (parallel arrays,
+        same contract as ``StreamingTSDF.push``) — dispatched as this
+        member's sub-batch of one cohort step."""
+        items = self._items(series_ids, ts, seq, values)
+        return self._collect(self.cohort.dispatch("right", items))
+
+    def push_left(self, series_ids, ts, seq=None) -> Dict[str, np.ndarray]:
+        """Answer AS-OF queries for new left rows (the
+        ``StreamingTSDF.push_left`` contract)."""
+        items = self._items(series_ids, ts, seq, None)
+        return self._collect(self.cohort.dispatch("left", items))
+
+    def _items(self, series_ids, ts, seq, values):
+        ts = np.atleast_1d(np.asarray(ts, np.int64))
+        series_ids = list(np.atleast_1d(np.asarray(series_ids, object)))
+        n = len(series_ids)
+        if len(ts) != n:
+            raise ValueError(
+                f"series_ids and ts are parallel arrays: got {n} "
+                f"series ids but {len(ts)} timestamps")
+        if seq is not None and len(np.atleast_1d(seq)) != n:
+            raise ValueError(
+                f"seq must align with series_ids: "
+                f"{len(np.atleast_1d(seq))} != {n}")
+        seqa = (np.full(n, None, object) if seq is None
+                else list(np.atleast_1d(np.asarray(seq, object))))
+        if values is None:
+            return [(self, series_ids[i], int(ts[i]), seqa[i], None)
+                    for i in range(n)]
+        rows = []
+        for i in range(n):
+            row = {}
+            for col, v in values.items():
+                v = np.atleast_1d(np.asarray(v, np.float32))
+                if len(v) != n:
+                    raise ValueError(
+                        f"values[{col!r}] must align with series_ids: "
+                        f"{len(v)} != {n}")
+                row[col] = v[i]
+            rows.append((self, series_ids[i], int(ts[i]), seqa[i], row))
+        return rows
+
+    @staticmethod
+    def _collect(results) -> Dict[str, np.ndarray]:
+        for r in results:
+            if isinstance(r, Exception):
+                raise r
+        if not results:
+            return {}
+        return {k: np.array([r[k] for r in results])
+                for k in results[0]}
+
+    # -- growth / introspection ----------------------------------------
+
+    def add_series(self, new_series: Sequence) -> None:
+        """Extend this stream's series set.  Within the current bucket
+        the new rows are already-fresh state; outgrowing it migrates
+        the stream to the next bucket's group (its carries copied
+        bit-for-bit, the new rows fresh) — cohort membership follows
+        the shape bucket, not the object."""
+        new_series = list(new_series)
+        dup = [s for s in new_series if s in self._row]
+        if dup or len(set(new_series)) != len(new_series):
+            raise ValueError(f"duplicate series keys: {dup or new_series}")
+        self.cohort._grow_member(self, new_series)
+
+    @property
+    def clipped(self) -> int:
+        """Rows of THIS stream whose true stats window exceeded the
+        declared row bound (truncated — the declared-bound audit)."""
+        if not self.cohort.cfg_has_window:
+            return 0
+        plane = np.asarray(self._group.state["clipped"])
+        return int(plane[self.slot, :len(self.series)].sum())
+
+
+class _Group:
+    """One shape bucket's stacked state: ``[S, ...]`` arrays for up to
+    ``capacity`` member slots, plus the watermark planes and the pinned
+    per-bucket executables."""
+
+    def __init__(self, cohort: "StreamCohort", bucket: int,
+                 capacity: int):
+        self.cohort = cohort
+        self.bucket = bucket
+        self.cfg = cohort._member_cfg(bucket)
+        self.capacity = capacity
+        self.state = sst.cohort_state_init(self.cfg, capacity)
+        self._slot_init = sst.init_state(self.cfg)
+        self.wm_ts = np.full((capacity, bucket), sst._FAR_PAST, np.int64)
+        self.wm_seq = np.full((capacity, bucket), -np.inf, np.float64)
+        self.wm_side = np.zeros((capacity, bucket), np.int8)
+        self.members: List[Optional[CohortMember]] = [None] * capacity
+        self._free = list(range(capacity - 1, -1, -1))
+        # per-group strong refs to built executables, keyed (kind, Lb):
+        # the zero-recompile steady state of a live cohort must not
+        # hinge on the shared LRU surviving eviction pressure
+        self._exes: Dict[Tuple[str, int], object] = {}
+
+    def alloc(self, member: CohortMember) -> int:
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        self.members[slot] = member
+        member._group, member.slot = self, slot
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Free a slot and reset its state/watermark rows to fresh
+        init, so the slot is inert (masked no-op) until reused."""
+        self.members[slot] = None
+        self._host()
+        for name, arr in self.state.items():
+            arr[slot] = self._slot_init[name]
+        self.wm_ts[slot] = sst._FAR_PAST
+        self.wm_seq[slot] = -np.inf
+        self.wm_side[slot] = 0
+        self._free.append(slot)
+
+    def _grow(self) -> None:
+        """Double the slot capacity (stays a multiple of the mesh's
+        stream-axis size).  A capacity change is a new program shape —
+        admission-time, never steady-state — so the pinned executables
+        reset."""
+        add = self.capacity
+        self._host()
+        tail = sst.cohort_state_init(self.cfg, add)
+        self.state = {k: np.concatenate([self.state[k], tail[k]], axis=0)
+                      for k in self.state}
+        self.wm_ts = np.concatenate(
+            [self.wm_ts, np.full((add, self.bucket), sst._FAR_PAST,
+                                 np.int64)])
+        self.wm_seq = np.concatenate(
+            [self.wm_seq, np.full((add, self.bucket), -np.inf,
+                                  np.float64)])
+        self.wm_side = np.concatenate(
+            [self.wm_side, np.zeros((add, self.bucket), np.int8)])
+        self.members.extend([None] * add)
+        self._free.extend(range(self.capacity + add - 1,
+                                self.capacity - 1, -1))
+        self.capacity += add
+        self._exes = {}
+
+    def _host(self) -> None:
+        """Materialize the state block on host (numpy, writable) for
+        slot-level surgery (alloc-reset, growth, migration,
+        snapshot)."""
+        out = {}
+        for k, v in self.state.items():
+            a = np.asarray(v)
+            if not a.flags.writeable:   # device arrays view read-only
+                a = np.array(a)
+            out[k] = a
+        self.state = out
+
+    def executable(self, kind: str, Lb: int):
+        exe = self._exes.get((kind, Lb))
+        if exe is None:
+            build = (sst.cohort_push_executable if kind == "push"
+                     else sst.cohort_query_executable)
+            exe = build(self.cfg, self.capacity, Lb,
+                        self.cohort.mesh, self.cohort.stream_axis)
+            self._exes[(kind, Lb)] = exe
+        return exe
+
+    def n_members(self) -> int:
+        return sum(m is not None for m in self.members)
+
+
+class StreamCohort:
+    """See module docstring.  Shared shape config (``value_cols``,
+    ``skip_nulls``, ``max_lookback``, window, ``ema_alpha``) fixes the
+    operator set for every member; ``add_stream`` admits streams with
+    arbitrary series sets, grouped by shape bucket.  ``mesh`` (with
+    ``stream_axis``) shards every bucket's stream axis across devices;
+    slot capacities are rounded up to the axis size.  ``slots`` is the
+    initial per-bucket slot capacity (default
+    ``TEMPO_TPU_SERVE_COHORT_SLOTS``); groups grow by doubling."""
+
+    def __init__(self, value_cols: Sequence[str], *,
+                 skip_nulls: bool = True, max_lookback: int = 0,
+                 window_secs=None, window_rows_bound: int = 64,
+                 ema_alpha=None, mesh=None, stream_axis: str = "streams",
+                 slots: Optional[int] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 ckpt_every: Optional[int] = None, keep_last: int = 3):
+        self.value_cols = [str(c) for c in value_cols]
+        self.skip_nulls = bool(skip_nulls)
+        self.max_lookback = int(max_lookback)
+        self.window_ns = (None if window_secs is None
+                          else sst.window_ns(window_secs))
+        self.rows_bound = int(window_rows_bound)
+        self.ema_alpha = (None if ema_alpha is None else float(ema_alpha))
+        self.mesh = mesh
+        self.stream_axis = str(stream_axis)
+        if slots is None:
+            slots = config.get_int("TEMPO_TPU_SERVE_COHORT_SLOTS", 1024)
+        self._slots = max(1, int(slots))
+        if mesh is not None:
+            n_axis = int(mesh.shape[self.stream_axis])
+            self._slots = -(-self._slots // n_axis) * n_axis
+        self._groups: Dict[int, _Group] = {}
+        self._members: Dict[str, CohortMember] = {}
+        self.acked_total = 0
+        self.dispatches = 0
+        self.checkpoint_dir = checkpoint_dir
+        self.keep_last = int(keep_last)
+        if ckpt_every is None:
+            ckpt_every = config.get_int(
+                "TEMPO_TPU_SERVE_COHORT_CKPT_EVERY", 0)
+        self.ckpt_every = int(ckpt_every or 0)
+        self._next_ckpt = self.ckpt_every or None
+        self._emit_cache: Dict[tuple, list] = {}
+
+    # -- membership ----------------------------------------------------
+
+    @property
+    def cfg_has_window(self) -> bool:
+        return self.window_ns is not None
+
+    def _member_cfg(self, bucket: int) -> sst.StreamConfig:
+        cfg = sst.StreamConfig(
+            n_series=bucket, n_cols=len(self.value_cols),
+            skip_nulls=self.skip_nulls, max_lookback=self.max_lookback,
+            window_ns=self.window_ns, rows_bound=self.rows_bound,
+            ema_alpha=self.ema_alpha)
+        return cfg
+
+    def _group(self, bucket: int) -> _Group:
+        g = self._groups.get(bucket)
+        if g is None:
+            g = self._groups[bucket] = _Group(self, bucket, self._slots)
+        return g
+
+    def add_stream(self, name: str, series: Sequence) -> CohortMember:
+        """Admit a stream: allocate a slot in its shape bucket's group
+        (creating/growing the group as needed) and return its handle."""
+        name = str(name)
+        if name in self._members:
+            raise ValueError(f"stream {name!r} already exists")
+        member = CohortMember(self, name, series)
+        self._group(row_bucket(len(member.series))).alloc(member)
+        self._members[name] = member
+        return member
+
+    def stream(self, name: str) -> CohortMember:
+        return self._members[str(name)]
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._members)
+
+    @property
+    def acked(self) -> Dict[str, int]:
+        """Per-stream acknowledged-event counts (the replay cursors a
+        resumed server restarts its event sources from)."""
+        return {name: m.acked for name, m in self._members.items()}
+
+    @property
+    def clipped(self) -> int:
+        if not self.cfg_has_window:
+            return 0
+        total = 0
+        for g in self._groups.values():
+            plane = np.asarray(g.state["clipped"])
+            for m in g.members:
+                if m is not None:
+                    total += int(plane[m.slot, :len(m.series)].sum())
+        return total
+
+    def _grow_member(self, member: CohortMember,
+                     new_series: Sequence) -> None:
+        new_k = len(member.series) + len(new_series)
+        old_g, old_slot = member._group, member.slot
+        target = row_bucket(new_k)
+        if target == old_g.bucket:
+            # in-bucket growth: the new rows are untouched init rows of
+            # the same slot — already bit-fresh, nothing to move
+            member.series.extend(new_series)
+            member._row = {s: k for k, s in enumerate(member.series)}
+            return
+        new_g = self._group(target)
+        slot = new_g.alloc(member)   # re-pins member._group/.slot
+        old_g._host()
+        new_g._host()
+        k_old = old_g.bucket
+        for name in new_g.state:
+            src = old_g.state[name][old_slot]
+            dst = new_g.state[name][slot]
+            dst[_k_slice(dst.ndim, name, k_old)] = \
+                src[_k_slice(src.ndim, name, k_old)]
+        new_g.wm_ts[slot, :k_old] = old_g.wm_ts[old_slot, :k_old]
+        new_g.wm_seq[slot, :k_old] = old_g.wm_seq[old_slot, :k_old]
+        new_g.wm_side[slot, :k_old] = old_g.wm_side[old_slot, :k_old]
+        old_g.release(old_slot)
+        member.series.extend(new_series)
+        member._row = {s: k for k, s in enumerate(member.series)}
+
+    # -- the cohort step -----------------------------------------------
+
+    def dispatch(self, side: str, items: List[tuple]) -> List[object]:
+        """Run ONE cohort step per touched bucket group over a tick
+        list ``[(member, series_key, ts, seq_or_None, values_or_None)]``
+        (arrival order; ``side`` 'right' = data pushes, 'left' = AS-OF
+        queries).  Returns a list parallel to ``items``: the per-tick
+        emission dict, or the exception that rejected that member's
+        sub-batch — **per-stream isolation**: a late tick (or bad
+        payload) zeroes only its own member's rows out of the step,
+        every other member's results and state are bit-identical to a
+        dispatch that never contained the offender."""
+        if side not in ("right", "left"):
+            raise ValueError(f"side must be 'right' or 'left', got "
+                             f"{side!r}")
+        side_i = _SIDE_RIGHT if side == "right" else _SIDE_LEFT
+        right = side_i == _SIDE_RIGHT
+        results: List[object] = [None] * len(items)
+        # first occurrence stored as a bare int (the fleet regime is
+        # one tick per member — no per-tick list allocation), demoted
+        # to an index list on a second tick from the same member
+        by_member: Dict[int, object] = {}
+        for i, it in enumerate(items):
+            key = id(it[0])
+            prev = by_member.get(key)
+            if prev is None:
+                by_member[key] = i
+            elif type(prev) is int:
+                by_member[key] = [prev, i]
+            else:
+                prev.append(i)
+
+        # per-member admission: validate payloads + watermark order,
+        # assign lanes; a failing member is recorded and EXCLUDED.
+        # Single-tick members take a deferred path: payloads validated
+        # here (python scalars), the watermark predicate evaluated
+        # VECTORIZED against the group's [S, K] planes inside
+        # _dispatch_group — per-member numpy work is the aggregate
+        # throughput bottleneck otherwise
+        groups: Dict[int, List] = {}
+        singles: Dict[int, "_Singles"] = {}
+        n_cols = len(self.value_cols)
+        for idxs in by_member.values():
+            if type(idxs) is int:
+                i = idxs
+                member, skey, ts, sq, vals = items[i]
+                if member.cohort is not self:
+                    raise ValueError(
+                        f"stream {member.name!r} belongs to a "
+                        f"different cohort")
+                try:
+                    k, ts, sqf, row = self._admit_tick(
+                        member, skey, ts, sq, vals, right)
+                except Exception as e:  # noqa: BLE001 - per tick
+                    results[i] = e
+                    continue
+                bucket = member._group.bucket
+                sg = singles.get(bucket)
+                if sg is None:
+                    sg = singles[bucket] = _Singles(n_cols)
+                sg.members.append(member)
+                sg.idxs.append(i)
+                sg.slots.append(member.slot)
+                sg.rows.append(k)
+                sg.ts.append(ts)
+                sg.sqf.append(sqf)
+                if row is not None:
+                    planes = sg.planes
+                    for c in range(n_cols):
+                        planes[c].append(row[c])
+                continue
+            member = items[idxs[0]][0]
+            if member.cohort is not self:
+                raise ValueError(
+                    f"stream {member.name!r} belongs to a different "
+                    f"cohort")
+            try:
+                rec = self._admit_member(member, items, idxs, side_i)
+            except Exception as e:  # noqa: BLE001 - delivered per tick
+                for i in idxs:
+                    results[i] = e
+                continue
+            groups.setdefault(member._group.bucket, []).append(
+                (member, idxs, rec))
+
+        for bucket in set(groups) | set(singles):
+            self._dispatch_group(self._groups[bucket], side_i,
+                                 groups.get(bucket, ()),
+                                 singles.get(bucket), results)
+        self.dispatches += 1
+        self._maybe_snapshot()
+        return results
+
+    def _admit_tick(self, member: CohortMember, skey, ts, sq, vals,
+                    right: bool):
+        """Scalar per-tick validation shared by the singles fast path
+        and the multi-tick ``_admit_member`` loop — ONE copy of the
+        series-row lookup, the NULLS-FIRST seq normalization (None and
+        ANY NaN, numpy scalars included, map to -inf — the
+        ``StreamingTSDF._seq_array`` rule; an un-normalized NaN would
+        poison the watermark and silently stop rejecting late ticks),
+        and the payload check.  Returns ``(k, ts, sqf, row)``."""
+        k = member._row.get(skey)
+        if k is None:
+            raise ValueError(
+                f"unknown series {skey!r} on stream {member.name!r}: "
+                f"a cohort stream's series set grows only through "
+                f"add_series")
+        ts = int(ts)
+        if sq is None:
+            sqf = -np.inf
+        else:
+            sqf = float(sq)
+            if sqf != sqf:               # NaN of any flavour
+                sqf = -np.inf            # NULLS FIRST
+        row = None
+        if right:
+            if vals is None:
+                raise ValueError(
+                    f"right tick on stream {member.name!r} has no "
+                    f"values")
+            # python float(): validates per member (a bad payload
+            # rejects only its own sub-batch); the f32 cast lands at
+            # the batch-array build, bit-equal to a per-tick
+            # np.float32() cast
+            row = [float(vals[col]) if col in vals else
+                   self._missing_col(member, col)
+                   for col in self.value_cols]
+        return k, ts, sqf, row
+
+    def _missing_col(self, member, col):
+        raise ValueError(
+            f"push on stream {member.name!r} is missing value column "
+            f"{col!r} (cohort columns: {self.value_cols})")
+
+    def _admit_member(self, member: CohortMember, items, idxs,
+                      side_i: int):
+        """Validate one member's sub-batch (payloads first, then the
+        merged-stream watermark rule — the same ordering predicate as
+        ``stream.admit_batch``, evaluated against this member's rows
+        of the group's watermark planes) — any failure rejects the
+        whole sub-batch atomically, exactly like a standalone
+        ``StreamingTSDF`` push.  Scalar-path implementation: the fleet
+        regime is thousands of members with a tick or two each per
+        dispatch, so per-member numpy allocation is the aggregate
+        bottleneck — everything here is python scalars and lists until
+        the group-level scatter."""
+        g, slot = member._group, member.slot
+        gw_ts, gw_seq, gw_side = g.wm_ts, g.wm_seq, g.wm_side
+        n_cols = len(self.value_cols)
+        right = side_i == _SIDE_RIGHT
+        rows, lanes, ts_l = [], [], []
+        planes = [[] for _ in range(n_cols)] if right else None
+        cand: Dict[int, tuple] = {}     # candidate watermark per row
+        lane_ct: Dict[int, int] = {}
+        for i in idxs:
+            _, skey, ts, sq, vals = items[i]
+            k, ts, sqf, row = self._admit_tick(member, skey, ts, sq,
+                                               vals, right)
+            key = (ts, sqf, side_i)
+            wm = cand.get(k)
+            if wm is None:
+                wm = (gw_ts[slot, k].item(), gw_seq[slot, k].item(),
+                      gw_side[slot, k].item())
+            if key < wm:
+                raise LateTickError(
+                    f"{member.name}/{member.series[k]!r}", ts, sqf,
+                    side_i, wm)
+            cand[k] = key
+            if right:
+                for c in range(n_cols):
+                    planes[c].append(row[c])
+            rows.append(k)
+            lane = lane_ct.get(k, 0)
+            lane_ct[k] = lane + 1
+            lanes.append(lane)
+            ts_l.append(ts)
+        return dict(rows=rows, lanes=lanes, lane_ct=lane_ct, wm=cand,
+                    ts=ts_l, planes=planes)
+
+    def _put(self, group: _Group, a):
+        if self.mesh is None:
+            return a
+        import jax
+
+        from tempo_tpu import dist
+
+        return jax.device_put(
+            a, dist.stream_shardings(self.mesh, self.stream_axis, a))
+
+    def _emit_fields(self, keys) -> List[Tuple[str, str, int]]:
+        """Flattened per-tick output fields ``(out_name, emit_key,
+        col_index)`` for an emission-key set, cached — dict keys are
+        rebuilt per tick, their NAMES are not."""
+        cache_key = tuple(keys)
+        fields = self._emit_cache.get(cache_key)
+        if fields is None:
+            fields = [(f"{col}_{key}", key, c)
+                      for key in cache_key
+                      for c, col in enumerate(self.value_cols)]
+            self._emit_cache[cache_key] = fields
+        return fields
+
+    def _dispatch_group(self, g: _Group, side_i: int, recs, sg, results):
+        """Scatter the admitted sub-batches into one ``[S, K, Lb]``
+        cohort batch, run the bucket's step program once, commit each
+        admitted member's watermarks, and fan the emissions back out
+        per tick.  Single-tick members (``sg``) are admitted here with
+        ONE vectorized watermark check; everything is one numpy
+        scatter in and one gather per emission plane out, so per-tick
+        python work is bounded by the result-dict build."""
+        S, K, C = g.capacity, g.bucket, len(self.value_cols)
+        max_rows = 1
+        n_total = 0
+        spans = []                     # (member, idxs, rec, pos0)
+        slots_l: List[int] = []
+        rows_l: List[int] = []
+        lanes_l: List[int] = []
+        ts_l: List[int] = []
+        for member, idxs, rec in recs:
+            m = max(rec["lane_ct"].values())
+            if m > max_rows:
+                max_rows = m
+            spans.append((member, idxs, rec, n_total))
+            n_total += len(idxs)
+            slot = member.slot
+            slots_l.extend([slot] * len(rec["rows"]))
+            rows_l.extend(rec["rows"])
+            lanes_l.extend(rec["lanes"])
+            ts_l.extend(rec["ts"])
+        sl = np.asarray(slots_l, np.int64)
+        rw = np.asarray(rows_l, np.int64)
+        ln = np.asarray(lanes_l, np.int64)
+        tsv = np.asarray(ts_l, np.int64)
+
+        # ---- singles: ONE vectorized admission over the [S, K]
+        # watermark planes (key < wm, lexicographic on (ts, seq, side))
+        s_members, s_idxs = [], []
+        s_sl = s_rw = s_ts = s_sq = None
+        s_planes = None
+        if sg is not None and sg.idxs:
+            s_sl = np.asarray(sg.slots, np.int64)
+            s_rw = np.asarray(sg.rows, np.int64)
+            s_ts = np.asarray(sg.ts, np.int64)
+            s_sq = np.asarray(sg.sqf, np.float64)
+            s_members, s_idxs = sg.members, sg.idxs
+            wts = g.wm_ts[s_sl, s_rw]
+            wsq = g.wm_seq[s_sl, s_rw]
+            wsd = g.wm_side[s_sl, s_rw]
+            late = (s_ts < wts) | (
+                (s_ts == wts) & ((s_sq < wsq) |
+                                 ((s_sq == wsq) & (side_i < wsd))))
+            if side_i == _SIDE_RIGHT:
+                s_planes = [np.asarray(p, np.float32)
+                            for p in sg.planes]
+            if late.any():
+                for j in np.nonzero(late)[0]:
+                    m = s_members[j]
+                    results[s_idxs[j]] = LateTickError(
+                        f"{m.name}/{m.series[int(s_rw[j])]!r}",
+                        int(s_ts[j]), float(s_sq[j]), side_i,
+                        (int(wts[j]), float(wsq[j]), int(wsd[j])))
+                keep = np.nonzero(~late)[0]
+                s_members = [s_members[j] for j in keep]
+                s_idxs = [s_idxs[j] for j in keep]
+                s_sl, s_rw = s_sl[keep], s_rw[keep]
+                s_ts, s_sq = s_ts[keep], s_sq[keep]
+                if s_planes is not None:
+                    s_planes = [p[keep] for p in s_planes]
+            if len(s_idxs):
+                sl = np.concatenate([sl, s_sl])
+                rw = np.concatenate([rw, s_rw])
+                ln = np.concatenate([ln, np.zeros(len(s_idxs),
+                                                  np.int64)])
+                tsv = np.concatenate([tsv, s_ts])
+        if not len(sl):          # every member of this bucket rejected
+            return
+        Lb = stream_mod._bucket(max_rows)
+        counts = np.zeros((S, K), np.int64)
+        for member, _, rec, _ in spans:
+            slot = member.slot
+            for k, c in rec["lane_ct"].items():
+                counts[slot, k] = c
+        if len(s_idxs):
+            counts[s_sl, s_rw] = 1
+
+        if side_i == _SIDE_RIGHT:
+            ts_p = np.full((S, K, Lb), TS_PAD, np.int64)
+            xs = np.full((S, C, K, Lb), np.nan, np.float32)
+            mask = np.zeros((S, K, Lb), bool)
+            ts_p[sl, rw, ln] = tsv
+            mask[sl, rw, ln] = True
+            for c in range(C):
+                col = [v for _, _, rec, _ in spans
+                       for v in rec["planes"][c]]
+                colv = np.asarray(col, np.float32)
+                if len(s_idxs):
+                    colv = np.concatenate([colv, s_planes[c]])
+                xs[sl, c, rw, ln] = colv
+            exe = g.executable("push", Lb)
+            args = [self._put(g, v) for v in g.state.values()]
+            new_state, emits = exe(*args, self._put(g, ts_p),
+                                   self._put(g, xs), self._put(g, mask),
+                                   self._put(g, counts))
+            g.state = dict(zip(g.cfg.state_names(), new_state))
+            # one gather per emission plane: [N, C] per key, then one
+            # bounded dict build per tick
+            fields = self._emit_fields(emits.keys())
+            gathered = {key: np.asarray(plane)[sl, :, rw, ln]
+                        for key, plane in emits.items()}
+            flat = [(name, gathered[key][:, c])
+                    for name, key, c in fields]
+            for member, idxs, rec, pos0 in spans:
+                self._commit(member, rec, len(idxs))
+                for j, i in enumerate(idxs):
+                    p = pos0 + j
+                    results[i] = {name: arr[p] for name, arr in flat}
+            for j, i in enumerate(s_idxs):
+                p = n_total + j
+                results[i] = {name: arr[p] for name, arr in flat}
+        else:
+            exe = g.executable("query", Lb)
+            args = [self._put(g, g.state[n]) for n in sst._QUERY_STATE]
+            new_n_merged, (vals, found, idx) = exe(*args,
+                                                   self._put(g, counts))
+            g.state["n_merged"] = new_n_merged
+            v_g = np.asarray(vals)[sl, :, rw, ln]      # [N, C]
+            f_g = np.asarray(found)[sl, :, rw, ln]
+            i_g = np.asarray(idx)[sl, rw, ln]
+            flat = [(col, v_g[:, c])
+                    for c, col in enumerate(self.value_cols)]
+            flat += [(f"{col}_found", f_g[:, c])
+                     for c, col in enumerate(self.value_cols)]
+            for member, idxs, rec, pos0 in spans:
+                self._commit(member, rec, len(idxs))
+                for j, i in enumerate(idxs):
+                    p = pos0 + j
+                    out = {name: arr[p] for name, arr in flat}
+                    out["right_row_idx"] = i_g[p]
+                    results[i] = out
+            for j, i in enumerate(s_idxs):
+                p = n_total + j
+                out = {name: arr[p] for name, arr in flat}
+                out["right_row_idx"] = i_g[p]
+                results[i] = out
+
+        # singles commit: vectorized watermark advance + acked
+        if len(s_idxs):
+            g.wm_ts[s_sl, s_rw] = s_ts
+            g.wm_seq[s_sl, s_rw] = s_sq
+            g.wm_side[s_sl, s_rw] = side_i
+            for m in s_members:
+                m.acked += 1
+            self.acked_total += len(s_idxs)
+
+    def _commit(self, member: CohortMember, rec, n_ticks: int) -> None:
+        g, slot = member._group, member.slot
+        wm_ts, wm_seq, wm_side = g.wm_ts, g.wm_seq, g.wm_side
+        for k, (t, sq, sd) in rec["wm"].items():
+            wm_ts[slot, k] = t
+            wm_seq[slot, k] = sq
+            wm_side[slot, k] = sd
+        member.acked += n_ticks
+        self.acked_total += n_ticks
+
+    # -- warmup --------------------------------------------------------
+
+    def warmup(self, max_rows: int) -> int:
+        """Pre-build every bucket group's push/query executables for
+        the padded-batch ladder up to ``max_rows`` — a fresh process
+        reaches the zero-recompile steady state before traffic."""
+        shapes = []
+        b = stream_mod._bucket(1)
+        while True:
+            shapes.append(b)
+            if b >= max_rows:
+                break
+            b *= 2
+        for g in self._groups.values():
+            for Lb in shapes:
+                g.executable("push", Lb)
+                g.executable("query", Lb)
+        return len(shapes) * len(self._groups)
+
+    # -- durability ----------------------------------------------------
+
+    def _config_meta(self) -> dict:
+        return {
+            "value_cols": self.value_cols,
+            "skip_nulls": self.skip_nulls,
+            "max_lookback": self.max_lookback,
+            "window_ns": self.window_ns,
+            "rows_bound": self.rows_bound,
+            "ema_alpha": self.ema_alpha,
+        }
+
+    def snapshot(self) -> str:
+        """ONE CRC'd atomic artifact for the whole cohort
+        (kind="cohort_state"): every bucket group's stacked state +
+        watermark planes, plus per-member slot assignments and acked
+        counts in the manifest.  Step number = total events acked."""
+        if not self.checkpoint_dir:
+            raise ValueError("StreamCohort has no checkpoint_dir")
+        arrays = {}
+        groups_meta = []
+        for bucket in sorted(self._groups):
+            g = self._groups[bucket]
+            g._host()
+            for name, arr in g.state.items():
+                arrays[f"g{bucket}.{name}"] = arr
+            arrays[f"g{bucket}.wm_ts"] = g.wm_ts
+            arrays[f"g{bucket}.wm_seq"] = g.wm_seq
+            arrays[f"g{bucket}.wm_side"] = g.wm_side
+            groups_meta.append({"bucket": bucket,
+                                "capacity": g.capacity})
+        members_meta = [
+            {"name": m.name, "bucket": m._group.bucket, "slot": m.slot,
+             "series": list(m.series), "acked": m.acked}
+            for m in self._members.values()]
+        meta = {"cohort_config": self._config_meta(),
+                "groups": groups_meta, "members": members_meta,
+                "acked_total": self.acked_total}
+        path = os.path.join(self.checkpoint_dir,
+                            f"step_{self.acked_total:010d}")
+        resilience.retrying(resilience.DEFAULT_IO_POLICY,
+                            label="cohort-snapshot")(ckpt.save_state)(
+            arrays, path, meta, kind="cohort_state")
+        ckpt.prune(self.checkpoint_dir, keep_last=self.keep_last)
+        return path
+
+    def _maybe_snapshot(self) -> None:
+        if self._next_ckpt is not None and self.checkpoint_dir \
+                and self.acked_total >= self._next_ckpt:
+            self.snapshot()
+            self._next_ckpt = self.acked_total + self.ckpt_every
+
+    @classmethod
+    def resume(cls, checkpoint_dir: str, verify: bool = True,
+               mesh=None, stream_axis: str = "streams",
+               **overrides) -> "StreamCohort":
+        """Restore the newest intact cohort snapshot.  The returned
+        cohort's per-stream ``acked`` dict tells the caller where each
+        stream's event source restarts — replay every stream's tail
+        after its own cursor and the output is byte-identical to a run
+        that never died."""
+        path = ckpt.latest(checkpoint_dir, verify=verify)
+        if path is None:
+            raise ckpt.CheckpointError(
+                f"no intact cohort snapshot under {checkpoint_dir!r}")
+        arrays, meta = ckpt.load_state(path, verify=verify,
+                                       kind="cohort_state")
+        scfg = meta["cohort_config"]
+        cohort = cls(
+            scfg["value_cols"], skip_nulls=scfg["skip_nulls"],
+            max_lookback=scfg["max_lookback"], window_secs=None,
+            window_rows_bound=scfg["rows_bound"],
+            ema_alpha=scfg["ema_alpha"], mesh=mesh,
+            stream_axis=stream_axis,
+            checkpoint_dir=overrides.pop("checkpoint_dir",
+                                         checkpoint_dir),
+            **overrides)
+        # reconstruct the exact folded integer width (window_secs
+        # would re-floor; the snapshot already holds the int)
+        cohort.window_ns = scfg["window_ns"]
+        for gm in meta["groups"]:
+            bucket, cap = int(gm["bucket"]), int(gm["capacity"])
+            if mesh is not None:
+                n_axis = int(mesh.shape[stream_axis])
+                if cap % n_axis:
+                    raise ckpt.CheckpointError(
+                        f"cohort snapshot group bucket={bucket} has "
+                        f"capacity {cap}, not divisible by the mesh's "
+                        f"{stream_axis!r} axis ({n_axis}): resume onto "
+                        f"a mesh whose stream axis divides it")
+            g = _Group(cohort, bucket, cap)
+            for name in g.state:
+                g.state[name] = np.ascontiguousarray(
+                    arrays[f"g{bucket}.{name}"])
+            g.wm_ts = np.asarray(arrays[f"g{bucket}.wm_ts"], np.int64)
+            g.wm_seq = np.asarray(arrays[f"g{bucket}.wm_seq"],
+                                  np.float64)
+            g.wm_side = np.asarray(arrays[f"g{bucket}.wm_side"], np.int8)
+            cohort._groups[bucket] = g
+        for mm in meta["members"]:
+            member = CohortMember(cohort, mm["name"], mm["series"])
+            g = cohort._groups[int(mm["bucket"])]
+            slot = int(mm["slot"])
+            g.members[slot] = member
+            g._free.remove(slot)
+            member._group, member.slot = g, slot
+            member.acked = int(mm["acked"])
+            cohort._members[member.name] = member
+        cohort.acked_total = int(meta["acked_total"])
+        if cohort.ckpt_every:
+            cohort._next_ckpt = cohort.acked_total + cohort.ckpt_every
+        return cohort

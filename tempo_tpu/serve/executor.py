@@ -22,6 +22,7 @@ exits.  A batch failure is delivered on each affected ticket's
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
 import time
@@ -34,8 +35,15 @@ from tempo_tpu.serve import stream as stream_mod
 
 _CLOSE = object()
 
+#: bounded percentile-sample window shared by every queue-side latency
+#: report: this executor's per-side samples, the cohort executor's, and
+#: the query service's per-tenant deques (service/service.py) all keep
+#: the most recent window, so a long-lived server never grows a float
+#: per tick served forever.
+LATENCY_WINDOW = 4096
 
-def latency_percentiles(lats: List[float]) -> dict:
+
+def latency_percentiles(lats) -> dict:
     """p50/p99 (milliseconds) + count of a latency sample — the ONE
     percentile reducer behind every queue-side latency report (this
     executor's ``latency_stats`` and the query service's per-tenant
@@ -49,37 +57,75 @@ def latency_percentiles(lats: List[float]) -> dict:
             "p99_ms": round(pick(0.99) * 1e3, 3)}
 
 
+class _ChunkGate:
+    """Shared completion gate for a ``submit_many`` chunk: ONE lock
+    for the whole chunk.  A per-ticket ``threading.Event`` costs ~10us
+    to allocate on this image — at fleet rates that alone caps the
+    feeder below the dispatch side.  Tickets flip their ``_done``
+    flag; the worker rings the gate once per processed batch; waiters
+    re-check their own flag (a chunk split across batches wakes some
+    waiters early — they just wait again)."""
+
+    __slots__ = ("cv",)
+
+    def __init__(self):
+        self.cv = threading.Condition()
+
+    def ring(self):
+        with self.cv:
+            self.cv.notify_all()
+
+    def wait_for(self, ticket: "Ticket",
+                 timeout: Optional[float]) -> bool:
+        with self.cv:
+            return self.cv.wait_for(lambda: ticket._done, timeout)
+
+
 class Ticket:
-    """One submitted tick: a waitable handle for its per-row result."""
+    """One submitted tick: a waitable handle for its per-row result.
+    ``member`` is the cohort stream handle on
+    :class:`CohortExecutor` tickets, ``None`` on single-stream ones."""
 
-    __slots__ = ("kind", "series", "ts", "seq", "values", "t_submit",
-                 "t_done", "_event", "_result", "_exc")
+    __slots__ = ("kind", "series", "ts", "seq", "values", "member",
+                 "t_submit", "t_done", "_event", "_gate", "_done",
+                 "_result", "_exc")
 
-    def __init__(self, kind, series, ts, seq, values):
+    def __init__(self, kind, series, ts, seq, values, member=None,
+                 t_submit=None, gate: Optional[_ChunkGate] = None):
         self.kind = kind
         self.series = series
         self.ts = ts
         self.seq = seq
         self.values = values
-        self.t_submit = time.perf_counter()
+        self.member = member
+        self.t_submit = (time.perf_counter() if t_submit is None
+                         else t_submit)
         self.t_done = None
-        self._event = threading.Event()
+        self._gate = gate
+        self._event = None if gate is not None else threading.Event()
+        self._done = False
         self._result = None
         self._exc = None
 
     def _finish(self, result=None, exc=None):
         self._result, self._exc = result, exc
         self.t_done = time.perf_counter()
-        self._event.set()
+        self._done = True
+        if self._event is not None:
+            self._event.set()
+        # gate tickets are woken by the worker's per-batch ring()
 
     def done(self) -> bool:
-        return self._event.is_set()
+        return self._done
 
     def result(self, timeout: Optional[float] = None):
         """Per-row emission dict for this tick (blocks until its
         micro-batch completes); re-raises the batch's failure."""
-        if not self._event.wait(timeout):
-            raise TimeoutError("tick not processed yet")
+        if not self._done:
+            ok = (self._event.wait(timeout) if self._event is not None
+                  else self._gate.wait_for(self, timeout))
+            if not ok:
+                raise TimeoutError("tick not processed yet")
         if self._exc is not None:
             raise self._exc
         return self._result
@@ -96,8 +142,13 @@ class MicroBatchExecutor:
     traffic must go through it (``StreamingTSDF`` itself is
     single-writer)."""
 
+    #: upper bound on a coalesced run before the worker stops waiting
+    #: for more ticks and dispatches what it has
+    _COALESCE_MAX = 8192
+
     def __init__(self, stream, queue_depth: Optional[int] = None,
-                 batch_rows: Optional[int] = None):
+                 batch_rows: Optional[int] = None,
+                 coalesce_s: float = 0.0):
         if queue_depth is None:
             queue_depth = config.get_int("TEMPO_TPU_SERVE_QUEUE_DEPTH",
                                          1024)
@@ -105,9 +156,21 @@ class MicroBatchExecutor:
             batch_rows = config.get_int("TEMPO_TPU_SERVE_BATCH_ROWS", 64)
         self.stream = stream
         self.batch_rows = max(1, int(batch_rows))
+        # micro-batch coalescing window: after the first tick of a
+        # run, wait up to this long for more before dispatching.  A
+        # dispatch has a real fixed cost (for a cohort, stepping the
+        # whole [S, ...] state block); under load, paying it for a
+        # handful of ticks caps aggregate throughput — the window
+        # trades bounded extra latency for amortization.  0 (the
+        # single-stream default) preserves drain-what's-queued
+        self.coalesce_s = max(0.0, float(coalesce_s))
         self._q: "queue.Queue" = queue.Queue(maxsize=int(queue_depth))
-        self._latencies: Dict[str, List[float]] = {"right": [],
-                                                   "left": []}
+        # bounded per-side sample windows: percentiles are over the
+        # most recent LATENCY_WINDOW ticks, per ticket (submit ->
+        # completion), never per dispatch
+        self._latencies: Dict[str, collections.deque] = {
+            "right": collections.deque(maxlen=LATENCY_WINDOW),
+            "left": collections.deque(maxlen=LATENCY_WINDOW)}
         self.batches = 0
         self.ticks = 0
         self.bucket_hist: Dict[int, int] = {}
@@ -156,37 +219,68 @@ class MicroBatchExecutor:
 
     # -- worker side ---------------------------------------------------
 
+    @staticmethod
+    def _extend(group: List[Ticket], item) -> None:
+        """Fold one queue entry into the run — a bare ticket or a
+        ``submit_many`` chunk (list of tickets)."""
+        if type(item) is list:
+            group.extend(item)
+        else:
+            group.append(item)
+
     def _run(self):
         closing = False
         while not closing:
             item = self._q.get()
             if item is _CLOSE:
                 break
-            group = [item]
-            while True:
-                try:
-                    nxt = self._q.get_nowait()
-                except queue.Empty:
-                    break
-                if nxt is _CLOSE:
-                    closing = True
-                    break
-                group.append(nxt)
+            group: List[Ticket] = []
+            self._extend(group, item)
+            if self.coalesce_s > 0.0:
+                deadline = time.monotonic() + self.coalesce_s
+                while len(group) < self._COALESCE_MAX:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        break
+                    try:
+                        nxt = self._q.get(timeout=rem)
+                    except queue.Empty:
+                        break
+                    if nxt is _CLOSE:
+                        closing = True
+                        break
+                    self._extend(group, nxt)
+            if not closing:
+                while True:
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is _CLOSE:
+                        closing = True
+                        break
+                    self._extend(group, nxt)
             for batch in self._split(group):
                 self._process(batch)
 
+    @staticmethod
+    def _series_key(t: Ticket):
+        return t.series
+
     def _split(self, group: List[Ticket]):
         """Side-homogeneous runs in arrival order, cut when any series
-        reaches the per-batch row cap."""
+        (per stream, on cohort executors) reaches the per-batch row
+        cap."""
         batch: List[Ticket] = []
         counts: Dict[object, int] = {}
         for t in group:
+            key = self._series_key(t)
             if batch and (t.kind != batch[0].kind
-                          or counts.get(t.series, 0) >= self.batch_rows):
+                          or counts.get(key, 0) >= self.batch_rows):
                 yield batch
                 batch, counts = [], {}
             batch.append(t)
-            counts[t.series] = counts.get(t.series, 0) + 1
+            counts[key] = counts.get(key, 0) + 1
         if batch:
             yield batch
 
@@ -237,3 +331,142 @@ class MicroBatchExecutor:
             out[kind] = latency_percentiles(lats)
         out["all"] = latency_percentiles(pooled)
         return out
+
+
+class CohortExecutor(MicroBatchExecutor):
+    """The fleet-serving front door: one executor, N member streams,
+    ONE cohort dispatch per micro-batch.
+
+    Same bounded-queue/backpressure/drain machinery as
+    :class:`MicroBatchExecutor`, but tickets name a
+    :class:`~tempo_tpu.serve.cohort.CohortMember` and a coalesced run
+    becomes one :meth:`~tempo_tpu.serve.cohort.StreamCohort.dispatch`
+    regardless of how many streams it spans — aggregate throughput is
+    bounded by the step program, not by per-stream dispatch count.
+    Accounting is **per ticket**: latency is each tick's own
+    submit → completion interval (a 10k-stream dispatch contributes 10k
+    samples, not one) over the bounded ``LATENCY_WINDOW``, and a
+    rejected member's tickets fail individually while the rest of the
+    dispatch completes (the cohort's per-stream isolation, surfaced
+    per ticket)."""
+
+    def __init__(self, cohort, queue_depth: Optional[int] = None,
+                 batch_rows: Optional[int] = None,
+                 coalesce_s: float = 0.002):
+        super().__init__(cohort, queue_depth=queue_depth,
+                         batch_rows=batch_rows, coalesce_s=coalesce_s)
+        self.cohort = cohort
+
+    def submit(self, member, kind: str, series, ts, values=None,
+               seq=None, timeout: Optional[float] = None) -> Ticket:
+        """Enqueue one tick for ``member`` (``kind`` 'right' = data,
+        'left' = query); blocks on a full queue (backpressure)."""
+        if kind not in ("right", "left"):
+            raise ValueError(f"kind must be 'right' or 'left', got "
+                             f"{kind!r}")
+        t = Ticket(kind, series, ts, seq, values, member=member)
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("executor is closed")
+            self._q.put(t, block=True, timeout=timeout)
+        return t
+
+    def submit_many(self, ticks,
+                    timeout: Optional[float] = None) -> List[Ticket]:
+        """Bulk enqueue: ``ticks`` is ``[(kind, member, series, ts,
+        values, seq)]`` in arrival order (``values`` None for
+        queries; kinds may mix — the worker's member-order-preserving
+        split sorts it out).  ONE queue entry and one shared submit
+        stamp for the whole chunk — the fleet feeder's path: at
+        10k-stream rates, per-tick ``submit()`` overhead (a lock round
+        and a queue put per tick) costs more than the whole
+        dispatch-side share.  Results, failures and latency stay per
+        ticket; a chunk counts as one entry toward the queue bound."""
+        t0 = time.perf_counter()
+        gate = _ChunkGate()
+        chunk = []
+        for kind, member, series, ts, values, seq in ticks:
+            if kind not in ("right", "left"):
+                raise ValueError(f"kind must be 'right' or 'left', "
+                                 f"got {kind!r}")
+            chunk.append(Ticket(kind, series, ts, seq, values,
+                                member=member, t_submit=t0, gate=gate))
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("executor is closed")
+            self._q.put(chunk, block=True, timeout=timeout)
+        return chunk
+
+    @staticmethod
+    def _series_key(t: Ticket):
+        return (id(t.member), t.series)
+
+    def _split(self, group: List[Ticket]):
+        """Cohort-aware micro-batching: member streams are independent
+        merged streams, so ticks of DIFFERENT members may legally
+        reorder around each other — only each member's own order is a
+        contract.  Each tick lands in the earliest side-matching batch
+        at or after its member's last batch (capped at ``batch_rows``
+        rows per (member, series)), so a side-alternating tick mix
+        collapses to ~one batch per side instead of a dispatch per
+        side flip (which would pay the whole-cohort step cost for a
+        handful of ticks).  Yields ``(tickets, max_rows)``."""
+        batches: List[list] = []      # [kind, tickets, counts, max]
+        last_idx: Dict[int, int] = {}
+        cap = self.batch_rows
+        for t in group:
+            mid = id(t.member)
+            key = (mid, t.series)
+            placed = -1
+            for bi in range(last_idx.get(mid, 0), len(batches)):
+                b = batches[bi]
+                if b[0] == t.kind and b[2].get(key, 0) < cap:
+                    placed = bi
+                    break
+            if placed < 0:
+                batches.append([t.kind, [t], {key: 1}, 1])
+                placed = len(batches) - 1
+            else:
+                b = batches[placed]
+                b[1].append(t)
+                c = b[2].get(key, 0) + 1
+                b[2][key] = c
+                if c > b[3]:
+                    b[3] = c
+            last_idx[mid] = placed
+        for b in batches:
+            yield b[1], b[3]
+
+    @staticmethod
+    def _ring(batch):
+        gates = {t._gate for t in batch}
+        gates.discard(None)
+        for gate in gates:
+            gate.ring()
+
+    def _process(self, batch):
+        batch, max_rows = batch
+        kind = batch[0].kind
+        try:
+            items = [(t.member, t.series, t.ts, t.seq, t.values)
+                     for t in batch]
+            results = self.cohort.dispatch(kind, items)
+        except Exception as e:       # dispatch-level failure: delivered
+            for t in batch:          # per ticket, worker lives on
+                t._finish(exc=e)
+            self._ring(batch)
+            return
+        self.batches += 1
+        lats = self._latencies[kind]
+        ok = 0
+        for t, r in zip(batch, results):
+            if isinstance(r, Exception):
+                t._finish(exc=r)
+                continue
+            t._finish(result=r)
+            ok += 1
+            lats.append(t.t_done - t.t_submit)
+        self.ticks += ok
+        self._ring(batch)
+        b = stream_mod._bucket(max_rows)
+        self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1

@@ -404,7 +404,9 @@ def query_avals(cfg: StreamConfig, Lb: int):
 
 
 def _cache_key(kind: str, cfg: StreamConfig, Lb: int):
-    return ("serve", kind, cfg.key(), Lb, jax.default_backend())
+    from tempo_tpu.plan.cache import device_key
+
+    return ("serve", kind, cfg.key(), Lb, device_key())
 
 
 def push_executable(cfg: StreamConfig, Lb: int):
@@ -429,3 +431,109 @@ def query_executable(cfg: StreamConfig, Lb: int):
             *query_avals(cfg, Lb)).compile()
 
     return CACHE.get_or_build(_cache_key("query", cfg, Lb), build)
+
+
+# ----------------------------------------------------------------------
+# Cohort step programs: ONE program for S streams sharing a shape
+# bucket (serve/cohort.py).  The cohort step is jax.vmap of the
+# per-stream step over a leading [S] stream axis — the identical op
+# sequence per stream (elementwise/batched ops, per-row gathers, the
+# sequential EMA scan), so each stream's slice of the cohort result is
+# bitwise the single-stream program's output.  No op in the step mixes
+# streams (or series), which is also why the mesh-sharded variant
+# compiles with ZERO collectives: sharding the S axis splits a batch of
+# independent per-stream programs across devices, nothing more.
+# ----------------------------------------------------------------------
+
+def cohort_state_init(cfg: StreamConfig, S: int) -> Dict[str, np.ndarray]:
+    """Fresh [S, ...] cohort carry: S stacked :func:`init_state`s."""
+    base = init_state(cfg)
+    return {k: np.broadcast_to(v, (S,) + v.shape).copy()
+            for k, v in base.items()}
+
+
+def cohort_push_avals(cfg: StreamConfig, S: int, Lb: int):
+    return tuple(_abstract((S,) + a.shape, a.dtype)
+                 for a in push_avals(cfg, Lb))
+
+
+def cohort_query_avals(cfg: StreamConfig, S: int, Lb: int):
+    return tuple(_abstract((S,) + a.shape, a.dtype)
+                 for a in query_avals(cfg, Lb))
+
+
+def _cohort_shardings(fn, avals, mesh, stream_axis: str):
+    """Explicit in/out shardings placing the leading stream axis of
+    EVERY operand and result on ``mesh``'s ``stream_axis`` (built
+    through :func:`tempo_tpu.dist.stream_shardings` — the PR 10
+    pre-partitioned-handoff idiom: the step's out_shardings ARE the
+    next step's in_shardings, so the steady-state loop never implies
+    a reshard)."""
+    from tempo_tpu import dist
+
+    in_sh = dist.stream_shardings(mesh, stream_axis, tuple(avals))
+    out_sh = dist.stream_shardings(mesh, stream_axis,
+                                   jax.eval_shape(fn, *avals))
+    return in_sh, out_sh
+
+
+def cohort_push_jitted(cfg: StreamConfig, S: int, Lb: int, mesh=None,
+                       stream_axis: str = "streams"):
+    """``(jitted cohort push step, n_state)``: the vmapped per-stream
+    step with every retired [S, ...] state buffer donated.  With a
+    ``mesh``, the jit carries explicit stream-axis in/out shardings."""
+    n_state = len(cfg.state_names())
+    fn = jax.vmap(_push_fn(cfg, Lb))
+    donate = tuple(range(n_state))
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=donate), n_state
+    in_sh, out_sh = _cohort_shardings(fn, cohort_push_avals(cfg, S, Lb),
+                                      mesh, stream_axis)
+    return jax.jit(fn, donate_argnums=donate, in_shardings=in_sh,
+                   out_shardings=out_sh), n_state
+
+
+def cohort_query_jitted(cfg: StreamConfig, S: int, Lb: int, mesh=None,
+                        stream_axis: str = "streams"):
+    fn = jax.vmap(_query_fn(cfg, Lb))
+    if mesh is None:
+        return jax.jit(fn, donate_argnums=(7,))
+    in_sh, out_sh = _cohort_shardings(
+        fn, cohort_query_avals(cfg, S, Lb), mesh, stream_axis)
+    return jax.jit(fn, donate_argnums=(7,), in_shardings=in_sh,
+                   out_shardings=out_sh)
+
+
+def _cohort_cache_key(kind: str, cfg: StreamConfig, S: int, Lb: int,
+                      mesh):
+    from tempo_tpu.plan.cache import device_key
+
+    return ("serve", kind, cfg.key(), S, Lb, device_key(mesh))
+
+
+def cohort_push_executable(cfg: StreamConfig, S: int, Lb: int,
+                           mesh=None, stream_axis: str = "streams"):
+    """AOT-compiled cohort push program for one (shape bucket, S,
+    padded-batch bucket), through the planner's executable cache —
+    the cohort steady state shares the zero-recompile counters
+    (``profiling.plan_cache_stats``) with every other program."""
+    from tempo_tpu.plan.cache import CACHE
+
+    def build():
+        fn, _ = cohort_push_jitted(cfg, S, Lb, mesh, stream_axis)
+        return fn.lower(*cohort_push_avals(cfg, S, Lb)).compile()
+
+    return CACHE.get_or_build(
+        _cohort_cache_key("cohort_push", cfg, S, Lb, mesh), build)
+
+
+def cohort_query_executable(cfg: StreamConfig, S: int, Lb: int,
+                            mesh=None, stream_axis: str = "streams"):
+    from tempo_tpu.plan.cache import CACHE
+
+    def build():
+        fn = cohort_query_jitted(cfg, S, Lb, mesh, stream_axis)
+        return fn.lower(*cohort_query_avals(cfg, S, Lb)).compile()
+
+    return CACHE.get_or_build(
+        _cohort_cache_key("cohort_query", cfg, S, Lb, mesh), build)

@@ -70,6 +70,40 @@ def _bucket(n: int) -> int:
     return b
 
 
+def admit_batch(series_names, wm_ts, wm_seq, wm_side, rows, ts, seq,
+                side: int, n_series: int):
+    """Validate one side-homogeneous batch against per-series
+    merged-stream watermark PLANES and assign in-batch lanes.
+
+    The ordering core shared by the single-stream frame
+    (:meth:`StreamingTSDF._admit`) and the cohort engine
+    (serve/cohort.py, which holds [S, K] watermark planes and admits
+    each member against its own slot's rows) — one admission rule, so
+    the two engines cannot drift on what "late" means.
+
+    Returns ``(lanes, counts, (wm_ts', wm_seq', wm_side'))`` with the
+    ADVANCED watermark copies; callers install them only after the
+    step program succeeds (commit-after-success), so any failed batch
+    leaves the stream untouched.  Raises :class:`LateTickError` naming
+    the offending series on the first violation."""
+    n = len(rows)
+    lanes = np.zeros(n, np.int64)
+    counts = np.zeros(n_series, np.int64)
+    wm_ts = wm_ts.copy()
+    wm_seq = wm_seq.copy()
+    wm_side = wm_side.copy()
+    for i in range(n):
+        k = rows[i]
+        key = (ts[i], seq[i], side)
+        wm = (wm_ts[k], wm_seq[k], int(wm_side[k]))
+        if key < wm:
+            raise LateTickError(series_names[k], ts[i], seq[i], side, wm)
+        wm_ts[k], wm_seq[k], wm_side[k] = ts[i], seq[i], side
+        lanes[i] = counts[k]
+        counts[k] += 1
+    return lanes, counts, (wm_ts, wm_seq, wm_side)
+
+
 class StreamingTSDF:
     """See module docstring.  ``series`` fixes the lane rows for the
     stream's lifetime; ``value_cols`` the metric columns.  Operators
@@ -127,26 +161,12 @@ class StreamingTSDF:
         step program succeeded, so ANY failed batch (late tick, bad
         payload, executable error) leaves the stream untouched and the
         corrected batch replays cleanly."""
-        n = len(rows)
-        lanes = np.zeros(n, np.int64)
-        counts = np.zeros(self.cfg.n_series, np.int64)
-        wm_ts = self._wm_ts.copy()
-        wm_seq = self._wm_seq.copy()
-        wm_side = self._wm_side.copy()
-        for i in range(n):
-            k = rows[i]
-            key = (ts[i], seq[i], side)
-            wm = (wm_ts[k], wm_seq[k], int(wm_side[k]))
-            if key < wm:
-                raise LateTickError(self.series[k], ts[i], seq[i],
-                                    side, wm)
-            wm_ts[k], wm_seq[k], wm_side[k] = ts[i], seq[i], side
-            lanes[i] = counts[k]
-            counts[k] += 1
+        lanes, counts, wm_new = admit_batch(
+            self.series, self._wm_ts, self._wm_seq, self._wm_side,
+            rows, ts, seq, side, self.cfg.n_series)
 
         def commit():
-            self._wm_ts, self._wm_seq, self._wm_side = \
-                wm_ts, wm_seq, wm_side
+            self._wm_ts, self._wm_seq, self._wm_side = wm_new
 
         return lanes, counts, commit
 

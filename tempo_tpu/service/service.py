@@ -40,6 +40,7 @@ from typing import Dict, Optional
 
 from tempo_tpu.plan import cache as plan_cache
 from tempo_tpu.plan import ir
+from tempo_tpu.serve.executor import LATENCY_WINDOW
 from tempo_tpu.service.admission import (AdmissionController,
                                          Footprint, project_footprint)
 
@@ -107,8 +108,10 @@ class QueryService:
     """See module docstring."""
 
     #: per-tenant latency samples kept for the percentile report (a
-    #: sliding window, not a lifetime log)
-    _LATENCY_WINDOW = 4096
+    #: sliding window, not a lifetime log) — the serving executors'
+    #: shared bound (serve/executor.py:LATENCY_WINDOW), so every
+    #: queue-side percentile in the system is over the same window
+    _LATENCY_WINDOW = LATENCY_WINDOW
 
     def __init__(self, workers: Optional[int] = None,
                  tenant_quota: Optional[int] = None,

@@ -44,7 +44,7 @@ def test_bench_smoke_prints_one_json_line():
         "7_frame_e2e_pipeline", "8_chunked_205k_k128",
         "9_chunked_1m_single", "10_planned_chain",
         "11_serving_ticks_per_sec", "12_mesh_scaling_top",
-        "13_query_service_qps",
+        "13_query_service_qps", "14_fleet_serving_ticks_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -80,6 +80,23 @@ def test_bench_smoke_prints_one_json_line():
     assert sv.get("p50_ms") is not None and sv.get("p99_ms") is not None
     assert sv.get("zero_builds_steady_state") is True
     assert "bitwise" in sv.get("value_audit", "")
+    # config 14 (round 12): the fleet-scale cohort engine must have
+    # driven EVERY stream through the cohort executor with per-ticket
+    # percentiles, the zero-recompile steady state asserted, the
+    # sampled (>= 64 streams) bitwise streamed==batch audit performed,
+    # and the per-instance baseline measured in-process (the >= 20x
+    # aggregate ratio is asserted hard by the full-mode config itself;
+    # smoke just proves the machinery)
+    fs = rec.get("fleet_serving") or {}
+    assert fs.get("aggregate_ticks_per_sec", 0) > 0, fs
+    assert fs.get("streams_driven", 0) >= fs.get("n_streams", 1), fs
+    assert fs.get("p50_ms") is not None and fs.get("p99_ms") is not None
+    assert fs.get("zero_builds_steady_state") is True
+    assert fs.get("audit_streams", 0) >= 64
+    assert "bitwise" in fs.get("value_audit", "")
+    base = fs.get("per_instance_baseline") or {}
+    assert base.get("ticks_per_sec", 0) > 0, fs
+    assert fs.get("aggregate_vs_per_instance", 0) > 0
     # config 13 (round 11): the multi-tenant query service must have
     # run >= 2 tenants of mixed shapes with the shared-cache hit-rate
     # reported, the hard zero-recompiles-at-steady-state assert, the

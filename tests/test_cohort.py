@@ -1,0 +1,570 @@
+"""Fleet-scale cohort serving: cohort-vs-independent-streams bitwise
+identity.
+
+The contract under test: a :class:`StreamCohort` of S member streams —
+ONE ``[S, ...]`` state block per shape bucket, ONE step program per
+dispatch — emits, for every member and any interleaving of member
+sub-batches across cohort dispatches, exactly the bits S independent
+``StreamingTSDF`` instances emit for the same per-stream events
+(which test_serve.py in turn pins against the batch operators).  Plus:
+per-stream late-tick isolation inside one dispatch, shape-bucket
+membership migration, the mesh-sharded variant's zero-per-push-
+collectives + whole-state-donation contract, the cohort executor's
+per-ticket accounting, and chaos kill/resume from ONE cohort_state
+artifact with per-stream acked cursors and a byte-identical tail.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from tempo_tpu import checkpoint, dist, profiling
+from tempo_tpu.serve import (CohortExecutor, LateTickError, StreamCohort,
+                             StreamingTSDF, row_bucket)
+from tempo_tpu.serve import state as sst
+from tempo_tpu.serve import executor as serve_executor
+from tempo_tpu.testing import faults
+from tests.test_serve import COLS, C, _gen_events
+
+ML = 7
+WINDOW = dict(window_secs=9.0, window_rows_bound=16, ema_alpha=0.2)
+
+
+def _mk_pair(S, *, skip_nulls=True, ml=ML, seed=0, slots=None,
+             mesh=None, k_of=lambda s: 1 + s % 3, **kw):
+    """A cohort of S streams + S independent StreamingTSDF twins with
+    identical per-stream configs (series counts vary per stream, so
+    several shape buckets coexist)."""
+    cohort = StreamCohort(COLS, skip_nulls=skip_nulls, max_lookback=ml,
+                          slots=slots or max(2, S), mesh=mesh, **WINDOW,
+                          **kw)
+    members, twins = [], []
+    for s in range(S):
+        series = [f"m{s}s{k}" for k in range(k_of(s))]
+        members.append(cohort.add_stream(f"m{s}", series))
+        twins.append(StreamingTSDF(series, COLS, skip_nulls=skip_nulls,
+                                   max_lookback=ml, **WINDOW))
+    return cohort, members, twins
+
+
+def _member_events(rng, K, n, seq):
+    """Per-member event list in valid merged order, remapped to the
+    member's local series indices (test_serve's generator: ties, NaN
+    runs, optional seq keys)."""
+    return _gen_events(rng, K, n, tie_heavy=True, seq=seq)
+
+
+def _run_of(events, pos):
+    """Next side-homogeneous run of a member's event list."""
+    if pos >= len(events):
+        return None, pos
+    side = events[pos][1]
+    run = []
+    while pos < len(events) and events[pos][1] == side and len(run) < 5:
+        run.append(events[pos])
+        pos += 1
+    return (side, run), pos
+
+
+def _assert_tick_equal(got, want, label):
+    for key in want:
+        a, b = np.asarray(got[key]), np.asarray(want[key])
+        assert a.dtype == b.dtype and a.tobytes() == b.tobytes(), \
+            (label, key, got[key], want[key])
+
+
+def _feed_interleaved(cohort, members, twins, evsets, rng):
+    """Feed every member's events through shared cohort dispatches —
+    per round, each member contributes its next side-homogeneous run,
+    runs from MANY members ride one dispatch — and compare every tick
+    against the member's independent twin fed the same run as its own
+    push.  Returns the number of cross-member dispatches."""
+    pos = [0] * len(members)
+    n_mixed = 0
+    while any(pos[s] < len(evsets[s]) for s in range(len(members))):
+        rounds = {"right": [], "left": []}
+        for s in rng.permutation(len(members)):
+            nxt, pos[s] = _run_of(evsets[s], pos[s])
+            if nxt is not None:
+                rounds[nxt[0]].append((s, nxt[1]))
+        for side in ("right", "left"):
+            if not rounds[side]:
+                continue
+            items, spans = [], []
+            for s, run in rounds[side]:
+                m = members[s]
+                start = len(items)
+                for (k, _, ts, sq, vals) in run:
+                    items.append((
+                        m, m.series[k], ts, sq,
+                        {c: vals[ci] for ci, c in enumerate(COLS)}
+                        if side == "right" else None))
+                spans.append((s, run, start, len(items)))
+            if len(rounds[side]) > 1:
+                n_mixed += 1
+            res = cohort.dispatch(side, items)
+            assert not any(isinstance(r, Exception) for r in res), res
+            for s, run, lo, hi in spans:
+                ks = [twins[s].series[e[0]] for e in run]
+                ts = [e[2] for e in run]
+                sq = [e[3] for e in run]
+                sq = None if all(x is None for x in sq) else \
+                    [np.nan if x is None else x for x in sq]
+                if side == "right":
+                    vals = {c: np.array([e[4][ci] for e in run],
+                                        np.float32)
+                            for ci, c in enumerate(COLS)}
+                    want = twins[s].push(ks, ts, vals, seq=sq)
+                else:
+                    want = twins[s].push_left(ks, ts, seq=sq)
+                for j, i in enumerate(range(lo, hi)):
+                    _assert_tick_equal(
+                        res[i], {k: v[j] for k, v in want.items()},
+                        (s, side, j))
+    return n_mixed
+
+
+def _run_matrix(S, *, seq, skip_nulls, ml, seed, n=40):
+    rng = np.random.default_rng(seed)
+    cohort, members, twins = _mk_pair(S, skip_nulls=skip_nulls, ml=ml,
+                                      seed=seed)
+    evsets = [_member_events(rng, len(m.series), n, seq)
+              for m in members]
+    n_mixed = _feed_interleaved(cohort, members, twins, evsets, rng)
+    if S > 1:
+        assert n_mixed > 0, "no dispatch actually mixed members"
+    for s in range(S):
+        assert members[s].clipped == twins[s].clipped, s
+        assert members[s].acked == twins[s].acked, s
+    assert cohort.acked_total == sum(t.acked for t in twins)
+
+
+# ----------------------------------------------------------------------
+# The randomized cohort-vs-independent identity matrix
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("S", [1, 7])
+@pytest.mark.parametrize("seq,skip_nulls,ml", [
+    (False, True, 0), (True, True, ML), (True, False, ML)])
+def test_identity_matrix(S, seq, skip_nulls, ml):
+    """S streams, mixed series counts (several shape buckets), seq
+    ties, NaN runs, maxLookback expiry, interleaved push order across
+    shared dispatches: every member's bits == its independent twin."""
+    _run_matrix(S, seq=seq, skip_nulls=skip_nulls, ml=ml,
+                seed=2000 + 17 * S + 2 * seq + skip_nulls + ml)
+
+
+def test_identity_many_streams():
+    """S=64: one dispatch spans dozens of streams; still bitwise."""
+    _run_matrix(64, seq=False, skip_nulls=True, ml=5, seed=64, n=8)
+
+
+# ----------------------------------------------------------------------
+# Per-stream isolation inside one dispatch
+# ----------------------------------------------------------------------
+
+def test_late_tick_isolation_in_one_dispatch():
+    """Stream i's late tick rejects ONLY stream i's sub-batch: stream
+    j's rows in the same dispatch emit exactly what they would have
+    without the offender, and stream i's state/watermarks are
+    untouched (its corrected batch replays cleanly)."""
+    cohort, (mi, mj), (ti, tj) = _mk_pair(2, k_of=lambda s: 2)
+    for m, t in ((mi, ti), (mj, tj)):
+        got = m.push([m.series[0]], [5 * 10**9],
+                     {"px": np.float32([1.0]), "qty": np.float32([2.0])})
+        want = t.push([t.series[0]], [5 * 10**9],
+                      {"px": np.float32([1.0]), "qty": np.float32([2.0])})
+        _assert_tick_equal({k: v[0] for k, v in got.items()},
+                           {k: v[0] for k, v in want.items()}, "warm")
+    vals = lambda x: {"px": np.float32(x), "qty": np.float32(x + 1)}
+    items = [(mi, mi.series[0], 10**9, None, vals(3.0)),    # late
+             (mj, mj.series[0], 9 * 10**9, None, vals(4.0)),
+             (mi, mi.series[1], 9 * 10**9, None, vals(5.0))]  # same
+    res = cohort.dispatch("right", items)                     # member:
+    assert isinstance(res[0], LateTickError)                  # atomic
+    assert isinstance(res[2], LateTickError)
+    assert not isinstance(res[1], Exception)
+    want = tj.push([tj.series[0]], [9 * 10**9],
+                   {"px": np.float32([4.0]), "qty": np.float32([5.0])})
+    _assert_tick_equal(res[1], {k: v[0] for k, v in want.items()},
+                       "isolated")
+    # the rejected member replays the CORRECTED batch cleanly and
+    # stays bitwise on its twin (state + watermarks never moved)
+    got = mi.push([mi.series[1]], [9 * 10**9],
+                  {"px": np.float32([5.0]), "qty": np.float32([6.0])})
+    want = ti.push([ti.series[1]], [9 * 10**9],
+                   {"px": np.float32([5.0]), "qty": np.float32([6.0])})
+    _assert_tick_equal({k: v[0] for k, v in got.items()},
+                       {k: v[0] for k, v in want.items()}, "replay")
+    assert mi.acked == ti.acked
+
+
+def test_nan_seq_normalizes_nulls_first_any_flavour():
+    """A NaN seq of ANY dtype (np.float32/np.float64/python float)
+    normalizes to -inf (NULLS FIRST) — an un-normalized NaN would
+    poison the watermark and silently stop rejecting late ticks."""
+    cohort, (m,), _ = _mk_pair(1, k_of=lambda s: 1)
+    v = {"px": np.float32(1), "qty": np.float32(1)}
+    for bad_nan in (np.float32(np.nan), np.float64(np.nan), float("nan")):
+        res = cohort.dispatch(
+            "right", [(m, m.series[0], 10**9, bad_nan, v)])
+        assert not isinstance(res[0], Exception), res[0]
+        # the watermark must hold (ts, -inf, right): a same-ts tick
+        # with a REAL seq is fine, a same-ts NaN-seq right repeat is
+        # fine (== watermark), but an earlier ts is late
+        res = cohort.dispatch(
+            "right", [(m, m.series[0], 10**9 - 1, None, v)])
+        assert isinstance(res[0], LateTickError), (bad_nan, res[0])
+        # multi-tick path takes the same normalization
+        res = cohort.dispatch("right", [
+            (m, m.series[0], 2 * 10**9, bad_nan, v),
+            (m, m.series[0], 10**9, None, v)])      # late inside batch
+        assert isinstance(res[0], LateTickError)
+
+
+def test_unknown_series_rejects_only_its_member():
+    cohort, (mi, mj), (_, tj) = _mk_pair(2, k_of=lambda s: 1)
+    items = [(mi, "nope", 10**9, None,
+              {"px": np.float32(1), "qty": np.float32(1)}),
+             (mj, mj.series[0], 10**9, None,
+              {"px": np.float32(2), "qty": np.float32(3)})]
+    res = cohort.dispatch("right", items)
+    assert isinstance(res[0], ValueError)
+    assert "nope" in str(res[0])
+    want = tj.push([tj.series[0]], [10**9],
+                   {"px": np.float32([2]), "qty": np.float32([3])})
+    _assert_tick_equal(res[1], {k: v[0] for k, v in want.items()},
+                       "unknown-series")
+
+
+# ----------------------------------------------------------------------
+# Shape-bucket membership migration
+# ----------------------------------------------------------------------
+
+def test_row_bucket_ladder():
+    assert [row_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9)] == \
+        [1, 2, 4, 4, 8, 8, 16]
+    with pytest.raises(ValueError):
+        row_bucket(0)
+
+
+def test_membership_migration_preserves_carries():
+    """A stream outgrowing its row bucket migrates to the next one:
+    existing series' carries copy bit-for-bit (continued pushes stay
+    on its twin's bits), new series behave as fresh streams, and the
+    old slot is released."""
+    cohort, (m,), (twin,) = _mk_pair(1, k_of=lambda s: 2)
+    rng = np.random.default_rng(3)
+    evs = [e for e in _member_events(rng, 2, 30, False)
+           if e[1] == "right"]
+    for k, _, ts, sq, vals in evs:
+        got = m.push([m.series[k]], [ts],
+                     {c: np.float32([vals[ci]])
+                      for ci, c in enumerate(COLS)})
+        want = twin.push([twin.series[k]], [ts],
+                         {c: np.float32([vals[ci]])
+                          for ci, c in enumerate(COLS)})
+        _assert_tick_equal({k2: v[0] for k2, v in got.items()},
+                           {k2: v[0] for k2, v in want.items()}, "pre")
+    old_group = m._group
+    old_slot = m.slot
+    assert m.bucket == 2
+    m.add_series(["extra0", "extra1"])          # 4 series -> bucket 4
+    assert m.bucket == 4
+    assert old_group.members[old_slot] is None  # slot released
+    # a fresh twin for the NEW series: per-series independence makes
+    # it the exact oracle for rows that started at migration time
+    fresh = StreamingTSDF(["extra0", "extra1"], COLS, max_lookback=ML,
+                          **WINDOW)
+    t0 = max(e[2] for e in evs) + 10**9
+    for i in range(6):
+        ts = t0 + i * 10**9
+        v = {c: np.float32([float(i + ci)])
+             for ci, c in enumerate(COLS)}
+        got_old = m.push([m.series[0]], [ts], v)
+        want_old = twin.push([twin.series[0]], [ts], v)
+        _assert_tick_equal({k: x[0] for k, x in got_old.items()},
+                           {k: x[0] for k, x in want_old.items()},
+                           "migrated-old")
+        got_new = m.push(["extra0"], [ts], v)
+        want_new = fresh.push(["extra0"], [ts], v)
+        _assert_tick_equal({k: x[0] for k, x in got_new.items()},
+                           {k: x[0] for k, x in want_new.items()},
+                           "migrated-new")
+    q_got = m.push_left([m.series[1]], [t0 + 10**10])
+    q_want = twin.push_left([twin.series[1]], [t0 + 10**10])
+    _assert_tick_equal({k: x[0] for k, x in q_got.items()},
+                       {k: x[0] for k, x in q_want.items()},
+                       "migrated-query")
+
+
+def test_in_bucket_series_growth_needs_no_migration():
+    cohort, (m,), _ = _mk_pair(1, k_of=lambda s: 3)   # bucket 4
+    g = m._group
+    m.add_series(["x"])                               # 4 fits
+    assert m._group is g and m.bucket == 4
+    out = m.push(["x"], [10**9], {"px": np.float32([1.0]),
+                                  "qty": np.float32([2.0])})
+    assert np.float32(out["px_ema"][0]) == np.float32(0.2 * 1.0)
+
+
+# ----------------------------------------------------------------------
+# Mesh sharding: zero per-push collectives, whole-state donation
+# ----------------------------------------------------------------------
+
+def test_sharded_cohort_compiled_contract():
+    """The fleet-scaling mechanism, asserted on the artifact: the
+    mesh-sharded cohort step's compiled HLO contains ZERO collectives
+    and aliases every retired state buffer (whole-state donation)."""
+    mesh = dist.stream_mesh()
+    S = 2 * len(jax.devices())
+    cfg = sst.StreamConfig(n_series=2, n_cols=C, skip_nulls=True,
+                           max_lookback=4,
+                           window_ns=sst.window_ns(9.0), rows_bound=4,
+                           ema_alpha=0.2)
+    fn, n_state = sst.cohort_push_jitted(cfg, S, 8, mesh)
+    compiled = fn.lower(*sst.cohort_push_avals(cfg, S, 8)).compile()
+    assert profiling.collective_counts_from_compiled(compiled) == {}
+    donated = profiling.donated_params_from_compiled(compiled)
+    assert set(range(n_state)) <= donated
+    qfn = sst.cohort_query_jitted(cfg, S, 8, mesh)
+    qcompiled = qfn.lower(*sst.cohort_query_avals(cfg, S, 8)).compile()
+    assert profiling.collective_counts_from_compiled(qcompiled) == {}
+
+
+def test_sharded_cohort_bitwise_and_capacity_rounding():
+    """A sharded cohort emits the unsharded bits, and slot capacity
+    rounds up to the stream-axis size."""
+    mesh = dist.stream_mesh()
+    n_dev = len(jax.devices())
+    cohort, members, twins = _mk_pair(3, mesh=mesh, slots=2,
+                                      k_of=lambda s: 2, seed=5)
+    assert all(g.capacity % n_dev == 0
+               for g in cohort._groups.values())
+    rng = np.random.default_rng(5)
+    evsets = [_member_events(rng, 2, 16, False) for _ in members]
+    _feed_interleaved(cohort, members, twins, evsets, rng)
+
+
+# ----------------------------------------------------------------------
+# Cohort executor: per-ticket accounting
+# ----------------------------------------------------------------------
+
+def test_cohort_executor_identity_and_per_ticket_latency():
+    cohort, members, twins = _mk_pair(4, k_of=lambda s: 1)
+    with CohortExecutor(cohort, batch_rows=8) as ex:
+        tickets = []
+        for t in range(24):
+            s = t % 4
+            tickets.append((s, t, ex.submit(
+                members[s], "right", members[s].series[0],
+                (t + 1) * 10**9, {"px": np.float32(t),
+                                  "qty": np.float32(t + 1)})))
+        for s, t, tk in tickets:
+            got = tk.result(timeout=60)
+            want = twins[s].push(
+                [twins[s].series[0]], [(t + 1) * 10**9],
+                {"px": np.float32([t]), "qty": np.float32([t + 1])})
+            _assert_tick_equal(got, {k: v[0] for k, v in want.items()},
+                               (s, t))
+            assert tk.latency_s is not None and tk.latency_s >= 0
+        # queries ride the same executor
+        qt = ex.submit(members[0], "left", members[0].series[0],
+                       10**12)
+        want = twins[0].push_left([twins[0].series[0]], [10**12])
+        _assert_tick_equal(qt.result(timeout=60),
+                           {k: v[0] for k, v in want.items()}, "query")
+        st = ex.latency_stats()
+        # per TICKET, not per dispatch: every tick contributed a sample
+        assert st["right"]["count"] == 24
+        assert st["left"]["count"] == 1
+        assert st["right"]["p50_ms"] is not None
+
+
+def test_cohort_executor_late_tick_fails_only_its_ticket():
+    cohort, members, twins = _mk_pair(2, k_of=lambda s: 1)
+    with CohortExecutor(cohort) as ex:
+        ok0 = ex.submit(members[0], "right", members[0].series[0],
+                        5 * 10**9, {"px": np.float32(1),
+                                    "qty": np.float32(1)})
+        ok0.result(timeout=60)
+        bad = ex.submit(members[0], "right", members[0].series[0],
+                        10**9, {"px": np.float32(2),
+                                "qty": np.float32(2)})
+        ok1 = ex.submit(members[1], "right", members[1].series[0],
+                        9 * 10**9, {"px": np.float32(3),
+                                    "qty": np.float32(4)})
+        with pytest.raises(LateTickError):
+            bad.result(timeout=60)
+        want = twins[1].push([twins[1].series[0]], [9 * 10**9],
+                             {"px": np.float32([3]),
+                              "qty": np.float32([4])})
+        _assert_tick_equal(ok1.result(timeout=60),
+                           {k: v[0] for k, v in want.items()},
+                           "survivor")
+
+
+def test_latency_windows_are_bounded():
+    """The percentile samples are sliding windows (PR 11's reducer
+    bound), shared by both executors and the query service."""
+    from tempo_tpu.service.service import QueryService
+
+    cohort, _, _ = _mk_pair(1, k_of=lambda s: 1)
+    for ex_cls, arg in ((CohortExecutor, cohort),
+                        (serve_executor.MicroBatchExecutor,
+                         StreamingTSDF(["a"], COLS))):
+        ex = ex_cls(arg)
+        try:
+            for d in ex._latencies.values():
+                assert d.maxlen == serve_executor.LATENCY_WINDOW
+        finally:
+            ex.close()
+    assert QueryService._LATENCY_WINDOW == serve_executor.LATENCY_WINDOW
+
+
+# ----------------------------------------------------------------------
+# Durability: ONE artifact for the whole cohort
+# ----------------------------------------------------------------------
+
+def _push_events(target, events, name_of):
+    outs = []
+    for k, side, ts, sq, vals in events:
+        if side != "right":
+            continue
+        outs.append(target.push(
+            [name_of(k)], [ts],
+            {c: np.float32([vals[ci]]) for ci, c in enumerate(COLS)}))
+    return outs
+
+
+def test_cohort_snapshot_resume_roundtrip(tmp_path):
+    parent = str(tmp_path / "cohort_ckpt")
+    cohort, members, twins = _mk_pair(3, k_of=lambda s: 1 + s,
+                                      checkpoint_dir=parent,
+                                      ckpt_every=6)
+    rng = np.random.default_rng(11)
+    evsets = [_member_events(rng, len(m.series), 20, False)
+              for m in members]
+    _feed_interleaved(cohort, members, twins, evsets, rng)
+    cohort.snapshot()
+    steps = checkpoint.list_steps(parent)
+    assert steps, "auto-snapshots never fired"
+    r = StreamCohort.resume(parent)
+    # per-stream acked cursors reported on resume
+    assert r.acked == cohort.acked
+    assert r.n_streams == 3
+    m0, t0 = r.stream("m0"), twins[0]
+    ts = 10**14
+    got = m0.push([m0.series[0]], [ts], {"px": np.float32([1.5]),
+                                         "qty": np.float32([2.5])})
+    want = t0.push([t0.series[0]], [ts], {"px": np.float32([1.5]),
+                                          "qty": np.float32([2.5])})
+    _assert_tick_equal({k: v[0] for k, v in got.items()},
+                       {k: v[0] for k, v in want.items()}, "resumed")
+    # kind check: a cohort dir is not a single-stream snapshot, and
+    # checkpoint.load() redirects by name instead of falling through
+    # to the distributed-frame path
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="cohort_state"):
+        checkpoint.load_state(steps[0][1])
+    with pytest.raises(checkpoint.CheckpointError,
+                       match="StreamCohort.resume"):
+        checkpoint.load(steps[0][1])
+    with pytest.raises(checkpoint.CheckpointError):
+        StreamingTSDF.resume(parent)
+
+
+@pytest.mark.chaos
+def test_cohort_kill_mid_push_resume_byte_identical(tmp_path):
+    """The acceptance scenario at cohort grain: FaultInjector kills
+    the process mid-cohort-push; resume restores the newest intact
+    cohort artifact, per-stream acked tells each event source where
+    to restart, and the replayed tails are byte-identical to a run
+    that never died."""
+    rng = np.random.default_rng(13)
+    S = 3
+    evsets = [[e for e in _member_events(rng, 2, 40, False)
+               if e[1] == "right"] for _ in range(S)]
+
+    def run(cohort, members, skip=None):
+        outs = [[] for _ in range(S)]
+        pos = skip or [0] * S
+        done = [pos[s] >= len(evsets[s]) for s in range(S)]
+        i = 0
+        while not all(done):
+            s = i % S
+            i += 1
+            if pos[s] >= len(evsets[s]):
+                done[s] = True
+                continue
+            k, _, ts, _, vals = evsets[s][pos[s]]
+            pos[s] += 1
+            outs[s].append(members[s].push(
+                [members[s].series[k]], [ts],
+                {c: np.float32([vals[ci]])
+                 for ci, c in enumerate(COLS)}))
+        return outs
+
+    def mk(dir_=None, every=0):
+        cohort = StreamCohort(COLS, max_lookback=ML, **WINDOW,
+                              checkpoint_dir=dir_, ckpt_every=every,
+                              slots=4)
+        return cohort, [cohort.add_stream(f"m{s}",
+                                          [f"m{s}s0", f"m{s}s1"])
+                        for s in range(S)]
+
+    golden_cohort, golden_members = mk()
+    golden = run(golden_cohort, golden_members)
+
+    parent = str(tmp_path / "ck")
+    cohort, members = mk(parent, every=9)
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(StreamCohort, "dispatch", call_no=25)
+        with pytest.raises(faults.SimulatedKill):
+            run(cohort, members)
+    assert any(r.action == "kill" for r in fi.records)
+
+    r = StreamCohort.resume(parent)
+    acked = r.acked
+    total = sum(acked.values())
+    assert 0 < total < sum(len(e) for e in evsets)
+    tails = run(r, [r.stream(f"m{s}") for s in range(S)],
+                skip=[acked[f"m{s}"] for s in range(S)])
+    for s in range(S):
+        want_tail = golden[s][acked[f"m{s}"]:]
+        assert len(tails[s]) == len(want_tail)
+        for got, want in zip(tails[s], want_tail):
+            assert set(got) == set(want)
+            for key in want:
+                assert np.asarray(got[key]).tobytes() == \
+                    np.asarray(want[key]).tobytes(), (s, key)
+
+
+# ----------------------------------------------------------------------
+# Registry / misc
+# ----------------------------------------------------------------------
+
+def test_cohort_contract_registered():
+    from tempo_tpu.plan import contracts
+
+    assert "serve.cohort_step" in contracts.names()
+
+
+def test_zero_recompile_steady_state_across_streams():
+    """After warmup, pushes from ANY member of the bucket reuse the
+    one cached cohort program: the plan-cache builds counter stays
+    flat (the fleet bench asserts this under load)."""
+    cohort, members, _ = _mk_pair(4, k_of=lambda s: 1)
+    cohort.warmup(8)
+    builds0 = profiling.plan_cache_stats()["builds"]
+    for t in range(8):
+        s = t % 4
+        members[s].push([members[s].series[0]], [(t + 1) * 10**9],
+                        {"px": np.float32([t]),
+                         "qty": np.float32([t + 1])})
+        members[s].push_left([members[s].series[0]],
+                             [(t + 1) * 10**9 + 1])
+    assert profiling.plan_cache_stats()["builds"] == builds0

@@ -26,6 +26,13 @@ echo "== serving identity (tests/test_serve.py) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
 
+echo "== cohort identity (tests/test_cohort.py) =="
+# the fleet engine's cohort==independent-streams bitwise contract,
+# late-tick isolation, bucket migration, sharded zero-collectives +
+# donation, and cohort snapshot/resume — surfaced before tier-1
+JAX_PLATFORMS=cpu python -m pytest tests/test_cohort.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== query service (tests/test_service.py + tests/test_cost.py) =="
 # the multi-tenant service's single-flight/admission/fairness contracts
 # and the cost model's default-priors==rules + bitwise-flip contracts,
