@@ -2303,6 +2303,48 @@ def bench_query_service(seed=13):
     }
 
 
+def bench_chaos_serving(seed=15):
+    """Config 15 (--only-chaos-serving): the fault-domain chaos
+    campaign against live serving + query planes
+    (:mod:`tempo_tpu.testing.chaos`).
+
+    A cohort behind a :class:`CohortExecutor` (differential snapshots
+    on) and a :class:`QueryService` are driven through scripted
+    FaultInjector schedules under Poisson load — flaky dispatches,
+    a plane-level fault (supervised drain restart), latency injection
+    against a short deadline, a poison-pill member/signature quarantined
+    and recovered through a half-open probe, and a ``SimulatedKill``
+    followed by ``CohortExecutor.resume`` + unacked-tail replay.
+    Asserted HARD inside the campaign (a violation nulls the config,
+    which the bench contract test treats as failure):
+
+    * no ticket ever hangs — every submit resolves with a result or a
+      named error (DeadlineExceeded / QuarantinedError / Cancelled /
+      ShutdownError / the injected fault);
+    * recovery (resume + warmup) completes inside the declared bound;
+    * the post-recovery steady state builds ZERO new executables;
+    * every stream's full emission history — replayed tail included —
+      is bitwise identical to an uninjected twin cohort;
+    * differential snapshots are measurably cheaper than fulls once a
+      shape bucket goes quiet (dirty-bucket byte economics).
+    """
+    import shutil
+    import tempfile
+
+    from tempo_tpu.testing import chaos
+
+    smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+    n_streams, events_per_stream = (12, 24) if smoke else (48, 80)
+    d = tempfile.mkdtemp(prefix="tempo_chaos_")
+    try:
+        rep = chaos.run_campaign(
+            d, n_streams=n_streams, events_per_stream=events_per_stream,
+            seed=seed, recovery_bound_s=60.0)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return rep
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -2435,6 +2477,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-chaos-serving" in sys.argv:
+        res = _attempt("chaos_serving", bench_chaos_serving)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-mesh-scaling-one" in sys.argv:
         n = int(sys.argv[sys.argv.index("--only-mesh-scaling-one") + 1])
         res = _attempt("mesh_scaling_one", lambda: bench_mesh_scaling_one(n))
@@ -2533,6 +2581,8 @@ def main():
                                        "fleet_serving", timeout=2400)
     query_service = _config_subprocess("--only-query-service",
                                        "query_service", timeout=2400)
+    chaos_serving = _config_subprocess("--only-chaos-serving",
+                                       "chaos_serving", timeout=2400)
     mesh_scaling = _config_subprocess("--only-mesh-scaling",
                                       "mesh_scaling", timeout=7200)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
@@ -2654,6 +2704,14 @@ def main():
             "14_fleet_serving_ticks_per_sec": (
                 round(fleet_serving["aggregate_ticks_per_sec"])
                 if fleet_serving else None),
+            # successful ticks/sec sustained WHILE the chaos campaign
+            # injects kill/flaky/delay faults (retries, quarantine,
+            # plane death + resume included in the wall clock); the
+            # record below carries the outcome/injection counts,
+            # recovery time and the bitwise tail audit
+            "15_chaos_serving_ticks_per_sec": (
+                round(chaos_serving["ticks_per_sec"])
+                if chaos_serving else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -2670,6 +2728,12 @@ def main():
         # per-tenant p50/p99, the starvation audit and the
         # cost-decided (bitwise-safe) engine-flip record
         "query_service": query_service,
+        # config 15: the fault-domain chaos campaign — no hung
+        # tickets, bounded recovery, zero recompiles after recovery,
+        # bitwise tails vs the uninjected twin, diff-vs-full snapshot
+        # byte economics, and the query plane's quarantine/deadline/
+        # cancel/supervision gauntlet
+        "chaos_serving": chaos_serving,
         # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
         # within ~1.2x is the claim being measured
         "frame_e2e_vs_fused": (

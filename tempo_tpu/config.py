@@ -193,6 +193,44 @@ KNOBS: Dict[str, Knob] = _knobs(
          "footprint exceeds the whole budget is REJECTED; one that "
          "merely exceeds the currently-free share is QUEUED until "
          "running queries release theirs"),
+    Knob("TEMPO_TPU_SERVE_DEADLINE_S", "float", None,
+         "tempo_tpu/serve/executor",
+         "default end-to-end deadline (seconds) for serving tickets: "
+         "a tick still queued when its budget dies fails fast with a "
+         "stage-named DeadlineExceeded instead of waiting forever; "
+         "unset/0 = no default deadline (per-submit deadlines stay "
+         "available)"),
+    Knob("TEMPO_TPU_SERVICE_DEADLINE_S", "float", None,
+         "tempo_tpu/service/service",
+         "default end-to-end deadline (seconds) for submitted "
+         "queries, carried through quota wait, admission wait and "
+         "dispatch; unset/0 = no default deadline"),
+    Knob("TEMPO_TPU_BREAKER_THRESHOLD", "int", "3",
+         "tempo_tpu/resilience",
+         "consecutive failures of one key (plan signature / stream "
+         "member) that OPEN its circuit breaker: further work on the "
+         "key fails fast with QuarantinedError instead of burning "
+         "retry budgets"),
+    Knob("TEMPO_TPU_BREAKER_COOLDOWN_S", "float", "5.0",
+         "tempo_tpu/resilience",
+         "quarantine cooldown: after this many seconds an open "
+         "circuit admits ONE half-open probe — success closes it, "
+         "failure re-opens it for another cooldown"),
+    Knob("TEMPO_TPU_SERVE_DONATE", "bool", None, "tempo_tpu/serve/state",
+         "force (1) / forbid (0) donation of the serve/cohort step "
+         "programs' retired state buffers; unset = backend-automatic: "
+         "ON for accelerators (in-place steady state, pinned by the "
+         "serve.step/serve.cohort_step compiled contracts), OFF on "
+         "XLA:CPU where the virtual multi-device host platform "
+         "corrupts donated serve buffers (use-after-free: garbage "
+         "emissions / heap aborts observed on jaxlib 0.4.36)"),
+    Knob("TEMPO_TPU_SERVE_COHORT_DIFF", "bool", "0",
+         "tempo_tpu/serve/cohort",
+         "1 makes automatic cohort snapshots differential: only "
+         "bucket groups dirty since the previous snapshot are "
+         "written, chained to the last full artifact by CRC'd "
+         "manifests (resume walks the chain; bytes per snapshot "
+         "scale with dirty state, not fleet size)"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads
@@ -234,6 +272,14 @@ def get_int(name: str, default: Optional[int] = None) -> Optional[int]:
     if val is None or not val.strip():
         return default
     return int(val)
+
+
+def get_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    """Float knob (seconds budgets etc.); unset or empty → ``default``."""
+    val = get(name)
+    if val is None or not val.strip():
+        return default
+    return float(val)
 
 
 def env_external(name: str, default: Optional[str] = None) -> Optional[str]:

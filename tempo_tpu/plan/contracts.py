@@ -494,7 +494,12 @@ def _build_serve_step():
     Lb = 8
     fn, n_state = serve_state.push_jitted(cfg, Lb)
     compiled = fn.lower(*serve_state.push_avals(cfg, Lb)).compile()
-    contract = Contract(donate_argnums=tuple(range(n_state)))
+    # donation is backend-gated (serve_state.donate_serve_steps: off on
+    # XLA:CPU where the virtual-device host platform corrupts donated
+    # serve buffers); the contract pins whatever the builder declared
+    donate = (tuple(range(n_state))
+              if serve_state.donate_serve_steps() else ())
+    contract = Contract(donate_argnums=donate)
     return CompiledProgram("serve.step", compiled, contract)
 
 
@@ -534,12 +539,16 @@ def _build_cohort_step():
         *serve_state.cohort_query_avals(cfg, S, Lb)).compile()
     # the query reads 7 of its 9 python operands (skipNulls drops
     # lock_val/lock_valid), so python arg 7 (the donated n_merged
-    # carry) lands at COMPILED parameter index 5
+    # carry) lands at COMPILED parameter index 5.  Donation is
+    # backend-gated (serve_state.donate_serve_steps: off on XLA:CPU)
+    donating = serve_state.donate_serve_steps()
     programs = [
         CompiledProgram("serve.cohort_push", push_c,
-                        Contract(donate_argnums=tuple(range(n_state)))),
+                        Contract(donate_argnums=(
+                            tuple(range(n_state)) if donating else ()))),
         CompiledProgram("serve.cohort_query", query_c,
-                        Contract(donate_argnums=(5,))),
+                        Contract(donate_argnums=(
+                            (5,) if donating else ()))),
     ]
     # flat output order of the push step: the state tuple's n_state
     # leaves precede the emission dict, so state i is out_idx i; the

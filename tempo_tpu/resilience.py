@@ -23,6 +23,17 @@ supply itself:
   with periodic checkpoints and, on restart, resumes from the newest
   *intact* checkpoint (corrupt ones are detected by checksum and
   skipped), recomputing only the steps after it.
+* **Fault-domain primitives** — the serving executors (``serve/``) and
+  the query service (``service/``) build their availability story from
+  the pieces here: :class:`Deadline` (one wall-clock budget carried
+  submit -> queue -> admission -> dispatch, dying with a *stage-named*
+  :class:`DeadlineExceeded`), :class:`Cancelled` /
+  :class:`ShutdownError` (a ticket always resolves — cancelled work
+  never reaches a worker, a closed/dead plane fails its backlog by
+  name instead of hanging callers), and :class:`CircuitBreaker` /
+  :class:`QuarantinedError` (per-key quarantine of repeat offenders
+  with half-open probes, so one poison pill cannot burn every retry
+  budget).
 
 Fault-injection coverage for all three lives in
 :mod:`tempo_tpu.testing.faults` and the ``chaos``-marked test suite.
@@ -38,6 +49,7 @@ import logging
 import os
 import random
 import re
+import threading
 import time
 import zipfile
 from typing import Callable, FrozenSet, Optional, Sequence
@@ -74,9 +86,212 @@ class CheckpointError(ValueError):
 
 
 class DeadlineExceeded(TimeoutError):
-    """A retry loop ran out of wall-clock budget (RetryPolicy.deadline_s)."""
+    """A wall-clock budget died: a retry loop ran past
+    ``RetryPolicy.deadline_s``, or a serving/query ticket's
+    :class:`Deadline` expired at a named plane stage (``stage`` says
+    which one — queue wait, admission, dispatch...)."""
 
     failure_kind = FailureKind.DEADLINE
+
+    def __init__(self, message: str, stage: Optional[str] = None):
+        super().__init__(message)
+        self.stage = stage
+
+
+class Cancelled(RuntimeError):
+    """A ticket was cancelled before a worker processed it.  Cancelled
+    work releases its quota/queue slot and never reaches a worker; the
+    caller's ``result()`` re-raises this by name.  Deliberate — never
+    retried."""
+
+    failure_kind = FailureKind.PERMANENT
+
+
+class ShutdownError(RuntimeError):
+    """The plane (executor / query service) shut down — or died — with
+    this ticket still outstanding.  Every pending ticket is failed with
+    this named error instead of hanging its caller forever on
+    ``result()``."""
+
+    failure_kind = FailureKind.PERMANENT
+
+
+class QuarantinedError(RuntimeError):
+    """Work was refused because its circuit breaker is OPEN: the same
+    key (plan signature / stream member) failed
+    ``TEMPO_TPU_BREAKER_THRESHOLD`` consecutive times and is
+    quarantined until a half-open probe (one admission after
+    ``TEMPO_TPU_BREAKER_COOLDOWN_S``) succeeds.  Fail-fast by design:
+    a poison pill must not burn every retry budget in the plane."""
+
+    failure_kind = FailureKind.PERMANENT
+
+    def __init__(self, message: str, key=None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.key = key
+        self.retry_after_s = retry_after_s
+
+
+# ----------------------------------------------------------------------
+# End-to-end deadlines
+# ----------------------------------------------------------------------
+
+class Deadline:
+    """A wall-clock budget carried end to end through the serving and
+    query planes: created at ``submit``, checked by name at every stage
+    the ticket crosses (queue wait, admission wait, build, dispatch) so
+    the caller learns *where* the budget died, not just that it did.
+
+    Monotonic-clock based; ``None`` budgets are represented by the
+    absence of a Deadline (``Deadline.after(None) is None``), so hot
+    paths pay nothing when deadlines are off."""
+
+    __slots__ = ("budget_s", "expires_at", "_clock")
+
+    def __init__(self, budget_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.budget_s = float(budget_s)
+        self._clock = clock
+        self.expires_at = clock() + self.budget_s
+
+    @classmethod
+    def after(cls, budget_s, clock: Callable[[], float] = time.monotonic
+              ) -> "Optional[Deadline]":
+        """``None``/non-positive = no deadline; a :class:`Deadline`
+        passes through unchanged (so call sites can take either)."""
+        if budget_s is None:
+            return None
+        if isinstance(budget_s, Deadline):
+            return budget_s
+        if budget_s <= 0:
+            return None
+        return cls(budget_s, clock=clock)
+
+    def remaining(self) -> float:
+        return self.expires_at - self._clock()
+
+    def expired(self) -> bool:
+        return self._clock() >= self.expires_at
+
+    def check(self, stage: str) -> None:
+        """Raise :class:`DeadlineExceeded` naming ``stage`` when the
+        budget is gone."""
+        rem = self.remaining()
+        if rem <= 0:
+            raise DeadlineExceeded(
+                f"deadline exceeded at stage {stage!r}: the "
+                f"{self.budget_s:.3f}s budget ran out "
+                f"{-rem:.3f}s ago", stage=stage)
+
+    def __repr__(self) -> str:
+        return (f"Deadline(budget_s={self.budget_s:.3f}, "
+                f"remaining={self.remaining():.3f})")
+
+
+# ----------------------------------------------------------------------
+# Circuit breaker (per-key quarantine with half-open probes)
+# ----------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-key failure quarantine for the serving/query planes.
+
+    Keys are whatever identifies a repeat offender — a plan signature
+    in the query service, a stream-member name in the cohort executor.
+    ``threshold`` consecutive failures OPEN the circuit for that key:
+    :meth:`allow` then raises :class:`QuarantinedError` immediately
+    (fail-fast — the poison pill stops burning worker time and retry
+    budgets).  After ``cooldown_s`` the circuit goes HALF-OPEN: exactly
+    one probe is admitted; its success closes the circuit (counters
+    reset), its failure re-opens it for another cooldown.  Thread-safe;
+    the planes call it from submit paths and worker threads."""
+
+    def __init__(self, threshold: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        from tempo_tpu import config
+
+        if threshold is None:
+            threshold = config.get_int("TEMPO_TPU_BREAKER_THRESHOLD", 3)
+        if cooldown_s is None:
+            cooldown_s = config.get_float(
+                "TEMPO_TPU_BREAKER_COOLDOWN_S", 5.0)
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> [consecutive_failures, opened_at | None, probing]
+        self._st = {}
+        self.quarantined_total = 0
+        self.trips = 0
+
+    def state(self, key) -> str:
+        """``"closed"`` / ``"open"`` / ``"half-open"`` for ``key``."""
+        with self._lock:
+            st = self._st.get(key)
+            if st is None or st[1] is None:
+                return "closed"
+            if st[2] or self._clock() - st[1] >= self.cooldown_s:
+                return "half-open"
+            return "open"
+
+    def allow(self, key, label: str = "work") -> None:
+        """Admit or refuse ``key``.  Raises :class:`QuarantinedError`
+        while the circuit is open (and while a half-open probe is
+        already in flight); admits the single probe once the cooldown
+        has elapsed."""
+        with self._lock:
+            st = self._st.get(key)
+            if st is None or st[1] is None:
+                return
+            elapsed = self._clock() - st[1]
+            if not st[2] and elapsed >= self.cooldown_s:
+                st[2] = True        # this caller IS the half-open probe
+                return
+            self.quarantined_total += 1
+            wait = max(0.0, self.cooldown_s - elapsed)
+            raise QuarantinedError(
+                f"{label} {key!r} is quarantined: {st[0]} consecutive "
+                f"failures opened its circuit breaker"
+                + (f"; half-open probe already in flight" if st[2]
+                   else f"; next half-open probe in {wait:.2f}s"),
+                key=key, retry_after_s=wait)
+
+    def record(self, key, ok: bool) -> None:
+        """Record one outcome for ``key`` (success closes a half-open
+        circuit and resets counters; failure counts toward the
+        threshold / re-opens a probing circuit)."""
+        with self._lock:
+            st = self._st.setdefault(key, [0, None, False])
+            if ok:
+                if st[0] or st[1] is not None:
+                    self._st[key] = [0, None, False]
+                return
+            st[0] += 1
+            if st[1] is not None or st[0] >= self.threshold:
+                if st[1] is None:
+                    self.trips += 1
+                st[1] = self._clock()   # (re)open; probe slot resets
+                st[2] = False
+
+    def abandon(self, key) -> None:
+        """The in-flight half-open probe for ``key`` will never report
+        an outcome (cancelled / deadline-dead before dispatch): free
+        the probe slot so the next :meth:`allow` can probe again —
+        without this a vanished probe would quarantine the key
+        forever.  No-op when ``key`` is not probing."""
+        with self._lock:
+            st = self._st.get(key)
+            if st is not None and st[1] is not None and st[2]:
+                st[2] = False
+
+    def stats(self) -> dict:
+        with self._lock:
+            open_keys = [k for k, st in self._st.items()
+                         if st[1] is not None]
+            return {"open": sorted(map(str, open_keys)),
+                    "trips": self.trips,
+                    "quarantined_total": self.quarantined_total}
 
 
 # errnos that indicate a transient environment problem, not a bug

@@ -19,6 +19,8 @@ and crash-resume via CRC'd snapshots
 ``StreamingTSDF.resume``, whole-cohort ``StreamCohort.resume``).
 """
 
+from tempo_tpu.resilience import (Cancelled, Deadline, DeadlineExceeded,
+                                  QuarantinedError, ShutdownError)
 from tempo_tpu.serve.cohort import CohortMember, StreamCohort, row_bucket
 from tempo_tpu.serve.executor import (CohortExecutor, MicroBatchExecutor,
                                       Ticket)
@@ -29,4 +31,8 @@ __all__ = [
     "StreamingTSDF", "StreamCohort", "CohortMember", "row_bucket",
     "MicroBatchExecutor", "CohortExecutor", "Ticket", "LateTickError",
     "StreamConfig", "init_state", "window_stats_batch",
+    # the fault-domain vocabulary (defined in tempo_tpu.resilience,
+    # re-exported here because serving callers meet them on tickets)
+    "Deadline", "DeadlineExceeded", "Cancelled", "ShutdownError",
+    "QuarantinedError",
 ]

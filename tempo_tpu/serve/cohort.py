@@ -49,7 +49,9 @@ included.
 
 from __future__ import annotations
 
+import logging
 import os
+import shutil
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -60,6 +62,8 @@ from tempo_tpu.packing import TS_PAD
 from tempo_tpu.serve import state as sst
 from tempo_tpu.serve import stream as stream_mod
 from tempo_tpu.serve.stream import LateTickError, _SIDE_LEFT, _SIDE_RIGHT
+
+logger = logging.getLogger(__name__)
 
 #: per-state-array position of the SERIES axis (without the leading
 #: stream axis); everything not listed keeps it last.  Used by slot
@@ -240,11 +244,13 @@ class _Group:
         slot = self._free.pop()
         self.members[slot] = member
         member._group, member.slot = self, slot
+        self.cohort._dirty.add(self.bucket)
         return slot
 
     def release(self, slot: int) -> None:
         """Free a slot and reset its state/watermark rows to fresh
         init, so the slot is inert (masked no-op) until reused."""
+        self.cohort._dirty.add(self.bucket)
         self.members[slot] = None
         self._host()
         for name, arr in self.state.items():
@@ -277,6 +283,7 @@ class _Group:
                                 self.capacity - 1, -1))
         self.capacity += add
         self._exes = {}
+        self.cohort._dirty.add(self.bucket)
 
     def _host(self) -> None:
         """Materialize the state block on host (numpy, writable) for
@@ -312,7 +319,11 @@ class StreamCohort:
     ``stream_axis``) shards every bucket's stream axis across devices;
     slot capacities are rounded up to the axis size.  ``slots`` is the
     initial per-bucket slot capacity (default
-    ``TEMPO_TPU_SERVE_COHORT_SLOTS``); groups grow by doubling."""
+    ``TEMPO_TPU_SERVE_COHORT_SLOTS``); groups grow by doubling.
+    ``diff_snapshots`` (default ``TEMPO_TPU_SERVE_COHORT_DIFF``) makes
+    automatic snapshots differential — only dirty bucket groups,
+    chained to the last full artifact by CRC'd manifests — with every
+    ``full_every``-th automatic snapshot full."""
 
     def __init__(self, value_cols: Sequence[str], *,
                  skip_nulls: bool = True, max_lookback: int = 0,
@@ -320,7 +331,9 @@ class StreamCohort:
                  ema_alpha=None, mesh=None, stream_axis: str = "streams",
                  slots: Optional[int] = None,
                  checkpoint_dir: Optional[str] = None,
-                 ckpt_every: Optional[int] = None, keep_last: int = 3):
+                 ckpt_every: Optional[int] = None, keep_last: int = 3,
+                 diff_snapshots: Optional[bool] = None,
+                 full_every: int = 16):
         self.value_cols = [str(c) for c in value_cols]
         self.skip_nulls = bool(skip_nulls)
         self.max_lookback = int(max_lookback)
@@ -348,6 +361,18 @@ class StreamCohort:
         self.ckpt_every = int(ckpt_every or 0)
         self._next_ckpt = self.ckpt_every or None
         self._emit_cache: Dict[tuple, list] = {}
+        # -- incremental failover state: buckets whose stacked state /
+        # watermarks / capacity changed since the previous snapshot
+        # (ANY kind), the chain anchors, and the auto-snapshot policy
+        if diff_snapshots is None:
+            diff_snapshots = config.get_bool(
+                "TEMPO_TPU_SERVE_COHORT_DIFF", False)
+        self.diff_snapshots = bool(diff_snapshots)
+        self.full_every = max(1, int(full_every))
+        self._dirty: set = set()
+        self._last_snapshot: Optional[str] = None
+        self._last_full: Optional[str] = None
+        self._diffs_since_full = 0
 
     # -- membership ----------------------------------------------------
 
@@ -367,6 +392,7 @@ class StreamCohort:
         g = self._groups.get(bucket)
         if g is None:
             g = self._groups[bucket] = _Group(self, bucket, self._slots)
+            self._dirty.add(bucket)
         return g
 
     def add_stream(self, name: str, series: Sequence) -> CohortMember:
@@ -412,9 +438,12 @@ class StreamCohort:
         target = row_bucket(new_k)
         if target == old_g.bucket:
             # in-bucket growth: the new rows are untouched init rows of
-            # the same slot — already bit-fresh, nothing to move
+            # the same slot — already bit-fresh, nothing to move; the
+            # SERIES SET changed though, and it rides snapshot
+            # manifests, so the bucket is snapshot-dirty
             member.series.extend(new_series)
             member._row = {s: k for k, s in enumerate(member.series)}
+            self._dirty.add(old_g.bucket)
             return
         new_g = self._group(target)
         slot = new_g.alloc(member)   # re-pins member._group/.slot
@@ -522,6 +551,7 @@ class StreamCohort:
             self._dispatch_group(self._groups[bucket], side_i,
                                  groups.get(bucket, ()),
                                  singles.get(bucket), results)
+            self._dirty.add(bucket)
         self.dispatches += 1
         self._maybe_snapshot()
         return results
@@ -829,16 +859,12 @@ class StreamCohort:
             "ema_alpha": self.ema_alpha,
         }
 
-    def snapshot(self) -> str:
-        """ONE CRC'd atomic artifact for the whole cohort
-        (kind="cohort_state"): every bucket group's stacked state +
-        watermark planes, plus per-member slot assignments and acked
-        counts in the manifest.  Step number = total events acked."""
-        if not self.checkpoint_dir:
-            raise ValueError("StreamCohort has no checkpoint_dir")
+    def _snapshot_arrays(self, buckets) -> Tuple[dict, list]:
+        """``(arrays, groups_meta)`` for the given bucket set: every
+        state plane + the watermark planes, prefixed ``g<bucket>.``."""
         arrays = {}
         groups_meta = []
-        for bucket in sorted(self._groups):
+        for bucket in sorted(buckets):
             g = self._groups[bucket]
             g._host()
             for name, arr in g.state.items():
@@ -848,6 +874,43 @@ class StreamCohort:
             arrays[f"g{bucket}.wm_side"] = g.wm_side
             groups_meta.append({"bucket": bucket,
                                 "capacity": g.capacity})
+        return arrays, groups_meta
+
+    def snapshot(self, differential: bool = False) -> str:
+        """CRC'd atomic cohort artifact (kind="cohort_state"), step
+        number = total events acked.
+
+        ``differential=False`` (default): every bucket group's stacked
+        state + watermark planes — the standalone artifact.
+
+        ``differential=True``: ONLY the bucket groups dirty since the
+        previous snapshot (any kind), chained to it by the
+        predecessor's manifest CRC-32 recorded in this manifest — so
+        fleet-scale checkpoint cost is O(changed state), and a broken
+        link is detected at resume, never silently skipped.  Member
+        slot assignments and acked cursors (small) ride every
+        manifest, so membership is exact at each link.  Falls back to
+        a full snapshot when there is no predecessor in this process.
+        Retention keeps every link of the last ``keep_last`` full
+        snapshots' chains."""
+        if not self.checkpoint_dir:
+            raise ValueError("StreamCohort has no checkpoint_dir")
+        if self._last_snapshot is not None and os.path.basename(
+                self._last_snapshot) == f"step_{self.acked_total:010d}":
+            if not self._dirty:
+                # nothing acked AND nothing structurally dirty
+                # (membership/capacity changes mark their bucket):
+                # the artifact on disk is already exact
+                return self._last_snapshot
+            # same step number but changed state: the artifact must be
+            # REWRITTEN in place — as a standalone full (a diff would
+            # record its predecessor's manifest CRC and then replace
+            # that very predecessor, breaking its own chain link)
+            differential = False
+        differential = differential and self._last_snapshot is not None
+        buckets = (sorted(b for b in self._dirty if b in self._groups)
+                   if differential else sorted(self._groups))
+        arrays, groups_meta = self._snapshot_arrays(buckets)
         members_meta = [
             {"name": m.name, "bucket": m._group.bucket, "slot": m.slot,
              "series": list(m.series), "acked": m.acked}
@@ -855,36 +918,190 @@ class StreamCohort:
         meta = {"cohort_config": self._config_meta(),
                 "groups": groups_meta, "members": members_meta,
                 "acked_total": self.acked_total}
+        if differential:
+            prev = self._last_snapshot
+            meta["snapshot"] = {
+                "mode": "differential",
+                "prev": os.path.basename(prev),
+                "prev_manifest_crc": ckpt._file_crc(
+                    os.path.join(self._resolved_dir(prev),
+                                 "manifest.json")),
+                "base": os.path.basename(self._last_full),
+            }
+        else:
+            meta["snapshot"] = {"mode": "full"}
         path = os.path.join(self.checkpoint_dir,
                             f"step_{self.acked_total:010d}")
         resilience.retrying(resilience.DEFAULT_IO_POLICY,
                             label="cohort-snapshot")(ckpt.save_state)(
             arrays, path, meta, kind="cohort_state")
-        ckpt.prune(self.checkpoint_dir, keep_last=self.keep_last)
+        self._last_snapshot = path
+        if differential:
+            self._diffs_since_full += 1
+        else:
+            self._last_full = path
+            self._diffs_since_full = 0
+        self._dirty.clear()
+        self._prune_chain()
         return path
+
+    @staticmethod
+    def _resolved_dir(path: str) -> str:
+        """The directory a load would actually read: ``path``, or its
+        ``.bak`` survivor after a crash mid-swap (load_state's rule)."""
+        if not os.path.exists(os.path.join(path, "manifest.json")) \
+                and os.path.exists(os.path.join(path + ".bak",
+                                                "manifest.json")):
+            return path + ".bak"
+        return path
+
+    @staticmethod
+    def _snapshot_mode(path: str) -> dict:
+        man = ckpt._manifest(path)
+        return (man.get("meta") or {}).get("snapshot") \
+            or {"mode": "full"}
+
+    def _prune_chain(self) -> None:
+        """Chain-aware retention: keep the last ``keep_last`` FULL
+        snapshots and every differential link newer than the oldest
+        kept full — a plain keep-last-K would sever a live chain from
+        its base.  Pre-chain snapshots (no ``snapshot`` meta) count as
+        full, so all-full histories degrade to exactly the old
+        keep-last-K behaviour."""
+        steps = ckpt.list_steps(self.checkpoint_dir)   # newest first
+        fulls = 0
+        cut = None
+        for step, path in steps:
+            try:
+                mode = self._snapshot_mode(
+                    self._resolved_dir(path))["mode"]
+            except ckpt.CheckpointError:
+                continue            # unreadable: neither full nor kept
+            if mode != "differential":
+                fulls += 1
+                if fulls >= max(1, self.keep_last):
+                    cut = step
+                    break
+        if cut is None:
+            return
+        for step, path in steps:
+            if step < cut:
+                logger.info("pruning old cohort snapshot %s "
+                            "(keep_last=%d fulls)", path, self.keep_last)
+                shutil.rmtree(path, ignore_errors=True)
+                shutil.rmtree(path + ".bak", ignore_errors=True)
 
     def _maybe_snapshot(self) -> None:
         if self._next_ckpt is not None and self.checkpoint_dir \
                 and self.acked_total >= self._next_ckpt:
-            self.snapshot()
+            diff = (self.diff_snapshots
+                    and self._last_snapshot is not None
+                    and self._diffs_since_full < self.full_every - 1)
+            self.snapshot(differential=diff)
             self._next_ckpt = self.acked_total + self.ckpt_every
+
+    # -- failover ------------------------------------------------------
+
+    @classmethod
+    def _resolve_chain(cls, checkpoint_dir: str, verify: bool = True):
+        """Newest intact snapshot chain under ``checkpoint_dir``, as
+        ``[(arrays, meta), ...]`` base-full first.  A differential head
+        is walked back link by link — each link's recorded predecessor
+        manifest CRC must match the predecessor on disk — down to its
+        full base; ANY broken/corrupt/missing link disqualifies the
+        whole head and the next-older candidate is tried (the
+        fall-back-to-older discipline of ``checkpoint.latest``)."""
+        candidates = ckpt.list_steps(checkpoint_dir)
+        last_err: Optional[str] = None
+        for _, head in candidates:
+            entries = []
+            path = head
+            try:
+                while True:
+                    resolved = cls._resolved_dir(path)
+                    ckpt.verify_checkpoint(resolved,
+                                           verify_arrays=verify)
+                    arrays, meta = ckpt.load_state(
+                        resolved, verify=verify, kind="cohort_state")
+                    snap = meta.get("snapshot") or {"mode": "full"}
+                    entries.append((arrays, meta))
+                    if snap["mode"] != "differential":
+                        return list(reversed(entries))
+                    prev = os.path.join(checkpoint_dir, snap["prev"])
+                    prev_resolved = cls._resolved_dir(prev)
+                    got = ckpt._file_crc(
+                        os.path.join(prev_resolved, "manifest.json"))
+                    if got != int(snap["prev_manifest_crc"]):
+                        raise ckpt.CheckpointError(
+                            f"differential chain broken at "
+                            f"{path!r}: predecessor {snap['prev']!r} "
+                            f"manifest crc32 {got} != recorded "
+                            f"{snap['prev_manifest_crc']}")
+                    path = prev
+            except (ckpt.CheckpointError, OSError) as e:
+                last_err = f"{head}: {e}"
+                logger.warning(
+                    "cohort snapshot chain headed at %s unusable (%s); "
+                    "trying an older head", head, e)
+        raise ckpt.CheckpointError(
+            f"no intact cohort snapshot chain under "
+            f"{checkpoint_dir!r}"
+            + (f" (last failure: {last_err})" if last_err else ""))
+
+    def _install_link(self, arrays: dict, meta: dict, mesh,
+                      stream_axis: str) -> None:
+        """Apply one chain link: replace/create every bucket group it
+        carries (full arrays per carried bucket), then rebuild the
+        whole membership from its manifest (membership is exact at
+        every link)."""
+        for gm in meta["groups"]:
+            bucket, cap = int(gm["bucket"]), int(gm["capacity"])
+            if mesh is not None:
+                n_axis = int(mesh.shape[stream_axis])
+                if cap % n_axis:
+                    raise ckpt.CheckpointError(
+                        f"cohort snapshot group bucket={bucket} has "
+                        f"capacity {cap}, not divisible by the mesh's "
+                        f"{stream_axis!r} axis ({n_axis}): resume onto "
+                        f"a mesh whose stream axis divides it")
+            g = _Group(self, bucket, cap)
+            for name in g.state:
+                g.state[name] = np.ascontiguousarray(
+                    arrays[f"g{bucket}.{name}"])
+            g.wm_ts = np.asarray(arrays[f"g{bucket}.wm_ts"], np.int64)
+            g.wm_seq = np.asarray(arrays[f"g{bucket}.wm_seq"],
+                                  np.float64)
+            g.wm_side = np.asarray(arrays[f"g{bucket}.wm_side"], np.int8)
+            self._groups[bucket] = g
+        self._members.clear()
+        for g in self._groups.values():
+            g.members = [None] * g.capacity
+        for mm in meta["members"]:
+            member = CohortMember(self, mm["name"], mm["series"])
+            g = self._groups[int(mm["bucket"])]
+            slot = int(mm["slot"])
+            g.members[slot] = member
+            member._group, member.slot = g, slot
+            member.acked = int(mm["acked"])
+            self._members[member.name] = member
+        for g in self._groups.values():
+            g._free = [i for i in range(g.capacity - 1, -1, -1)
+                       if g.members[i] is None]
+        self.acked_total = int(meta["acked_total"])
 
     @classmethod
     def resume(cls, checkpoint_dir: str, verify: bool = True,
                mesh=None, stream_axis: str = "streams",
                **overrides) -> "StreamCohort":
-        """Restore the newest intact cohort snapshot.  The returned
-        cohort's per-stream ``acked`` dict tells the caller where each
-        stream's event source restarts — replay every stream's tail
-        after its own cursor and the output is byte-identical to a run
-        that never died."""
-        path = ckpt.latest(checkpoint_dir, verify=verify)
-        if path is None:
-            raise ckpt.CheckpointError(
-                f"no intact cohort snapshot under {checkpoint_dir!r}")
-        arrays, meta = ckpt.load_state(path, verify=verify,
-                                       kind="cohort_state")
-        scfg = meta["cohort_config"]
+        """Restore the newest intact cohort snapshot — a standalone
+        full artifact, or a differential chain replayed base-first
+        (each link CRC-verified against its predecessor).  The
+        returned cohort's per-stream ``acked`` dict tells the caller
+        where each stream's event source restarts — replay every
+        stream's tail after its own cursor and the output is
+        byte-identical to a run that never died."""
+        chain = cls._resolve_chain(checkpoint_dir, verify=verify)
+        scfg = chain[-1][1]["cohort_config"]
         cohort = cls(
             scfg["value_cols"], skip_nulls=scfg["skip_nulls"],
             max_lookback=scfg["max_lookback"], window_secs=None,
@@ -897,35 +1114,18 @@ class StreamCohort:
         # reconstruct the exact folded integer width (window_secs
         # would re-floor; the snapshot already holds the int)
         cohort.window_ns = scfg["window_ns"]
-        for gm in meta["groups"]:
-            bucket, cap = int(gm["bucket"]), int(gm["capacity"])
-            if mesh is not None:
-                n_axis = int(mesh.shape[stream_axis])
-                if cap % n_axis:
-                    raise ckpt.CheckpointError(
-                        f"cohort snapshot group bucket={bucket} has "
-                        f"capacity {cap}, not divisible by the mesh's "
-                        f"{stream_axis!r} axis ({n_axis}): resume onto "
-                        f"a mesh whose stream axis divides it")
-            g = _Group(cohort, bucket, cap)
-            for name in g.state:
-                g.state[name] = np.ascontiguousarray(
-                    arrays[f"g{bucket}.{name}"])
-            g.wm_ts = np.asarray(arrays[f"g{bucket}.wm_ts"], np.int64)
-            g.wm_seq = np.asarray(arrays[f"g{bucket}.wm_seq"],
-                                  np.float64)
-            g.wm_side = np.asarray(arrays[f"g{bucket}.wm_side"], np.int8)
-            cohort._groups[bucket] = g
-        for mm in meta["members"]:
-            member = CohortMember(cohort, mm["name"], mm["series"])
-            g = cohort._groups[int(mm["bucket"])]
-            slot = int(mm["slot"])
-            g.members[slot] = member
-            g._free.remove(slot)
-            member._group, member.slot = g, slot
-            member.acked = int(mm["acked"])
-            cohort._members[member.name] = member
-        cohort.acked_total = int(meta["acked_total"])
+        for arrays, meta in chain:
+            cohort._install_link(arrays, meta, mesh, stream_axis)
+        # the resumed process continues the SAME chain: its first
+        # differential snapshot links to the restored head
+        head = os.path.join(checkpoint_dir,
+                            f"step_{cohort.acked_total:010d}")
+        base_meta = chain[0][1]
+        cohort._last_snapshot = head
+        cohort._last_full = os.path.join(
+            checkpoint_dir, f"step_{int(base_meta['acked_total']):010d}")
+        cohort._diffs_since_full = len(chain) - 1
+        cohort._dirty.clear()
         if cohort.ckpt_every:
             cohort._next_ckpt = cohort.acked_total + cohort.ckpt_every
         return cohort

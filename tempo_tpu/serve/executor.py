@@ -18,11 +18,38 @@ wait included — the number a caller actually experiences);
 gracefully: everything already submitted completes, then the worker
 exits.  A batch failure is delivered on each affected ticket's
 ``result()``, never swallowed.
+
+**The fault domain** (resilience.py primitives):
+
+* *deadlines* — a :class:`~tempo_tpu.resilience.Deadline` rides each
+  ticket from ``submit`` (``deadline=`` seconds, default
+  ``TEMPO_TPU_SERVE_DEADLINE_S``); a tick whose budget dies while it
+  is still queued fails fast with a stage-named ``DeadlineExceeded``
+  and never reaches a dispatch (once dispatched, its state change is
+  real, so its result is always delivered).
+* *cancellation* — ``Ticket.cancel()`` resolves the ticket with
+  :class:`~tempo_tpu.resilience.Cancelled`; the worker drops it on
+  sight, so cancelled work never reaches the stream.
+* *supervision* — the drain thread runs under a supervisor: an
+  unexpected exception escaping the worker loop fails the in-flight
+  tickets, restarts the drain (``restarts`` counts them), and the
+  plane lives on; a ``BaseException`` (``SimulatedKill`` — modelled
+  process death) marks the plane dead, fails every outstanding ticket
+  with :class:`~tempo_tpu.resilience.ShutdownError` and closes it.
+* *quarantine* — :class:`CohortExecutor` carries a per-stream-member
+  :class:`~tempo_tpu.resilience.CircuitBreaker`: a member failing
+  repeatedly is quarantined (its tickets fail fast with
+  ``QuarantinedError``) until a half-open probe succeeds.
+* *shutdown* — ``close(timeout)`` shares ONE deadline across the
+  drain; whatever is still pending when it expires (or when the
+  worker is dead) is failed with ``ShutdownError`` — a ticket NEVER
+  hangs its caller.
 """
 
 from __future__ import annotations
 
 import collections
+import logging
 import queue
 import threading
 import time
@@ -31,7 +58,12 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from tempo_tpu import config
+from tempo_tpu.resilience import (Cancelled, CircuitBreaker, Deadline,
+                                  DeadlineExceeded, QuarantinedError,
+                                  ShutdownError)
 from tempo_tpu.serve import stream as stream_mod
+
+logger = logging.getLogger(__name__)
 
 _CLOSE = object()
 
@@ -87,33 +119,53 @@ class Ticket:
     :class:`CohortExecutor` tickets, ``None`` on single-stream ones."""
 
     __slots__ = ("kind", "series", "ts", "seq", "values", "member",
-                 "t_submit", "t_done", "_event", "_gate", "_done",
-                 "_result", "_exc")
+                 "deadline", "t_submit", "t_done", "_event", "_gate",
+                 "_done", "_cancelled", "_result", "_exc")
 
     def __init__(self, kind, series, ts, seq, values, member=None,
-                 t_submit=None, gate: Optional[_ChunkGate] = None):
+                 t_submit=None, gate: Optional[_ChunkGate] = None,
+                 deadline: Optional[Deadline] = None):
         self.kind = kind
         self.series = series
         self.ts = ts
         self.seq = seq
         self.values = values
         self.member = member
+        self.deadline = deadline
         self.t_submit = (time.perf_counter() if t_submit is None
                          else t_submit)
         self.t_done = None
         self._gate = gate
         self._event = None if gate is not None else threading.Event()
         self._done = False
+        self._cancelled = False
         self._result = None
         self._exc = None
 
     def _finish(self, result=None, exc=None):
+        if self._done:      # first outcome wins: a shutdown sweep and
+            return          # a still-draining worker may race here
         self._result, self._exc = result, exc
         self.t_done = time.perf_counter()
         self._done = True
         if self._event is not None:
             self._event.set()
         # gate tickets are woken by the worker's per-batch ring()
+
+    def cancel(self) -> bool:
+        """Request cancellation (best-effort, asynchronous): the WORKER
+        resolves the ticket with :class:`Cancelled` when it reaches it
+        still queued — cancelled work never reaches a dispatch.  A tick
+        already inside a dispatch cannot be un-run: its real outcome is
+        delivered (resolving it Cancelled while the state change lands
+        would make an at-least-once feeder double-apply the event).
+        Returns ``True`` when the request was registered before the
+        ticket resolved; the caller learns the actual outcome from
+        ``result()``."""
+        if self._done:
+            return False
+        self._cancelled = True
+        return not self._done
 
     def done(self) -> bool:
         return self._done
@@ -174,41 +226,126 @@ class MicroBatchExecutor:
         self.batches = 0
         self.ticks = 0
         self.bucket_hist: Dict[int, int] = {}
+        #: default per-ticket deadline budget (seconds); None = none
+        self.deadline_s = config.get_float("TEMPO_TPU_SERVE_DEADLINE_S")
+        #: drain-thread restarts performed by the supervisor
+        self.restarts = 0
+        #: tickets failed with a stage-named DeadlineExceeded
+        self.deadline_failures = 0
+        #: the BaseException that killed the plane, when it is dead
+        self.fatal: Optional[BaseException] = None
+        self._inflight: List[Ticket] = []
         self._closed = False
         # serializes the closed-check+enqueue against close(): without
         # it a tick can land BEHIND the close sentinel and hang its
         # result() forever
         self._submit_lock = threading.Lock()
-        self._thread = threading.Thread(target=self._run, daemon=True,
+        self._thread = threading.Thread(target=self._supervise,
+                                        daemon=True,
                                         name="tempo-serve-executor")
         self._thread.start()
 
     # -- producer side -------------------------------------------------
 
+    def _deadline(self, deadline) -> Optional[Deadline]:
+        """Per-submit override (seconds or a Deadline) over the
+        executor default (``TEMPO_TPU_SERVE_DEADLINE_S``)."""
+        if deadline is None:
+            deadline = self.deadline_s
+        return Deadline.after(deadline)
+
     def submit(self, kind: str, series, ts, values=None, seq=None,
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None, deadline=None) -> Ticket:
         """Enqueue one tick (``kind`` 'right' = data, 'left' = query).
         Blocks while the queue is full (backpressure); a ``timeout``
-        surfaces ``queue.Full`` instead of waiting forever."""
+        surfaces ``queue.Full`` instead of waiting forever.
+        ``deadline`` (seconds, or a :class:`Deadline`) bounds the
+        tick's WHOLE trip: expiry during the backpressure wait or in
+        the queue fails it with a stage-named ``DeadlineExceeded``."""
         if kind not in ("right", "left"):
             raise ValueError(f"kind must be 'right' or 'left', got "
                              f"{kind!r}")
-        t = Ticket(kind, series, ts, seq, values)
+        dl = self._deadline(deadline)
+        t = Ticket(kind, series, ts, seq, values, deadline=dl)
+        self._put(t, timeout, dl)
+        return t
+
+    def _put(self, item, timeout: Optional[float],
+             dl: Optional[Deadline]) -> None:
+        """Closed-checked enqueue; a deadline bounds the backpressure
+        wait (stage 'submit backpressure') under the caller timeout."""
+        if dl is not None:
+            dl.check("submit backpressure")
+            rem = dl.remaining()
+            timeout = rem if timeout is None else min(timeout, rem)
         with self._submit_lock:
             if self._closed:
-                raise RuntimeError("executor is closed")
-            self._q.put(t, block=True, timeout=timeout)
-        return t
+                raise ShutdownError("executor is closed")
+            try:
+                self._q.put(item, block=True, timeout=timeout)
+            except queue.Full:
+                if dl is not None and dl.expired():
+                    raise DeadlineExceeded(
+                        f"deadline exceeded at stage 'submit "
+                        f"backpressure': queue still full after the "
+                        f"{dl.budget_s:.3f}s budget",
+                        stage="submit backpressure") from None
+                raise
 
     def close(self, timeout: Optional[float] = None):
         """Graceful drain: stop accepting, process everything already
-        queued, stop the worker."""
+        queued, stop the worker.  ``timeout`` bounds the WHOLE drain
+        (one shared deadline, the ``QueryService.close`` discipline);
+        tickets still pending when it expires — or when the worker is
+        dead — are failed with :class:`ShutdownError`, never left to
+        hang their callers."""
         with self._submit_lock:
-            if self._closed:
-                return
-            self._closed = True
+            if not self._closed:
+                self._closed = True
+                self._q.put(_CLOSE)
+        # idempotent: a second close (e.g. __exit__ after an explicit
+        # close) joins the SAME drain within its own timeout — it must
+        # never steal queued tickets from a worker that is still
+        # draining them gracefully
+        dl = Deadline.after(timeout)
+        self._thread.join(timeout if dl is None else
+                          max(0.0, dl.remaining()))
+        if self._thread.is_alive() or self.fatal is not None \
+                or not self._q.empty():
+            cause = (f" (plane died: {self.fatal})"
+                     if self.fatal is not None else
+                     " (drain deadline expired)"
+                     if self._thread.is_alive() else "")
+            self._fail_pending(ShutdownError(
+                f"executor closed with this tick still pending{cause}"))
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        """Resolve every ticket the worker will never process: the
+        queue backlog and the not-yet-finished in-flight group.  A
+        still-alive worker finds a fresh close sentinel so it exits at
+        its next queue read instead of blocking forever."""
+        drained = False
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            drained = True
+            if item is _CLOSE:
+                continue
+            group: List[Ticket] = []
+            self._extend(group, item)
+            for t in group:
+                t._finish(exc=exc)
+                self._on_dropped(t)     # free an abandoned breaker probe
+            self._ring(group)
+        for t in list(self._inflight):
+            if not t._done:
+                t._finish(exc=exc)
+                self._on_dropped(t)
+        self._ring(self._inflight)
+        if drained and self._thread.is_alive():
             self._q.put(_CLOSE)
-        self._thread.join(timeout)
 
     def __enter__(self):
         return self
@@ -227,6 +364,84 @@ class MicroBatchExecutor:
             group.extend(item)
         else:
             group.append(item)
+
+    @staticmethod
+    def _ring(batch):
+        gates = {t._gate for t in batch}
+        gates.discard(None)
+        for gate in gates:
+            gate.ring()
+
+    def _supervise(self):
+        """The drain thread's supervisor: an unexpected ``Exception``
+        escaping the worker loop (poisoned work already fails inside
+        its own batch — this catches plane-level faults) fails the
+        in-flight group, restarts the drain, and the executor keeps
+        serving.  A ``BaseException`` (``SimulatedKill`` — modelled
+        process death, real interpreter teardown) is NOT survivable:
+        the plane closes itself, every outstanding ticket resolves
+        with :class:`ShutdownError`, and the thread exits."""
+        while True:
+            try:
+                self._run()
+                return                        # clean close
+            except Exception as e:  # noqa: BLE001 - supervised restart
+                for t in list(self._inflight):
+                    t._finish(exc=e)
+                self._ring(self._inflight)
+                self._inflight = []
+                self.restarts += 1
+                logger.warning(
+                    "serve executor worker died (%s: %s); supervisor "
+                    "restart #%d", type(e).__name__, e, self.restarts)
+            except BaseException as e:        # the plane is dead
+                self.fatal = e
+                with self._submit_lock:
+                    self._closed = True
+                self._fail_pending(ShutdownError(
+                    f"executor plane died ({type(e).__name__}: {e}); "
+                    f"tick was never processed"))
+                logger.error("serve executor plane died: %s", e)
+                return
+
+    def _admit_live(self, group: List[Ticket]) -> List[Ticket]:
+        """Drop tickets that must never reach a dispatch: cancelled
+        ones (resolved HERE with :class:`Cancelled` — the worker is
+        the single decision point, so a cancellation can never race a
+        dispatch's state change) and those whose deadline died in the
+        queue — failed with a stage-named ``DeadlineExceeded``.
+        Deadlines are only enforced BEFORE dispatch: once the step
+        program ran, the state change is real and the result is
+        always delivered."""
+        live: List[Ticket] = []
+        woke: List[Ticket] = []
+        for t in group:
+            if t._done:
+                continue
+            if t._cancelled:
+                t._finish(exc=Cancelled(
+                    f"tick ({t.kind!r}, series {t.series!r}, ts "
+                    f"{t.ts}) cancelled before dispatch"))
+                self._on_dropped(t)
+                woke.append(t)
+                continue
+            if t.deadline is not None and t.deadline.expired():
+                t._finish(exc=DeadlineExceeded(
+                    f"deadline exceeded at stage 'serve queue': tick "
+                    f"({t.kind!r}, series {t.series!r}, ts {t.ts}) "
+                    f"spent its {t.deadline.budget_s:.3f}s budget "
+                    f"waiting for dispatch", stage="serve queue"))
+                self.deadline_failures += 1
+                self._on_dropped(t)
+                woke.append(t)
+                continue
+            live.append(t)
+        self._ring(woke)
+        return live
+
+    def _on_dropped(self, t: Ticket) -> None:
+        """Hook: a ticket resolved before reaching a dispatch (deadline
+        death).  CohortExecutor frees an abandoned breaker probe."""
 
     def _run(self):
         closing = False
@@ -260,8 +475,14 @@ class MicroBatchExecutor:
                         closing = True
                         break
                     self._extend(group, nxt)
+            group = self._admit_live(group)
+            # visible to the supervisor/shutdown sweep: anything not
+            # finished when this group dies mid-processing gets failed
+            # instead of hanging its caller
+            self._inflight = group
             for batch in self._split(group):
                 self._process(batch)
+            self._inflight = []
 
     @staticmethod
     def _series_key(t: Ticket):
@@ -352,27 +573,58 @@ class CohortExecutor(MicroBatchExecutor):
 
     def __init__(self, cohort, queue_depth: Optional[int] = None,
                  batch_rows: Optional[int] = None,
-                 coalesce_s: float = 0.002):
+                 coalesce_s: float = 0.002,
+                 breaker: Optional[CircuitBreaker] = None):
         super().__init__(cohort, queue_depth=queue_depth,
                          batch_rows=batch_rows, coalesce_s=coalesce_s)
         self.cohort = cohort
+        #: per-stream-member circuit breaker: a member whose ticks keep
+        #: failing is quarantined (fail-fast QuarantinedError tickets)
+        #: until a half-open probe succeeds — one poisoned feed cannot
+        #: burn the whole plane's retry budget
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+
+    def _quarantined(self, member, kind, series, ts, seq, values,
+                     t_submit=None, gate=None) -> Optional[Ticket]:
+        """A pre-resolved QuarantinedError ticket when ``member`` is
+        quarantined (it never enters the queue); None when admitted."""
+        try:
+            self.breaker.allow(member.name, label="stream member")
+        except QuarantinedError as e:
+            t = Ticket(kind, series, ts, seq, values, member=member,
+                       t_submit=t_submit, gate=gate)
+            t._finish(exc=e)
+            return t
+        return None
 
     def submit(self, member, kind: str, series, ts, values=None,
-               seq=None, timeout: Optional[float] = None) -> Ticket:
+               seq=None, timeout: Optional[float] = None,
+               deadline=None) -> Ticket:
         """Enqueue one tick for ``member`` (``kind`` 'right' = data,
-        'left' = query); blocks on a full queue (backpressure)."""
+        'left' = query); blocks on a full queue (backpressure).
+        ``deadline`` as on :meth:`MicroBatchExecutor.submit`; a
+        quarantined member's ticket resolves immediately with
+        ``QuarantinedError`` and never reaches the queue."""
         if kind not in ("right", "left"):
             raise ValueError(f"kind must be 'right' or 'left', got "
                              f"{kind!r}")
-        t = Ticket(kind, series, ts, seq, values, member=member)
-        with self._submit_lock:
-            if self._closed:
-                raise RuntimeError("executor is closed")
-            self._q.put(t, block=True, timeout=timeout)
+        bad = self._quarantined(member, kind, series, ts, seq, values)
+        if bad is not None:
+            return bad
+        dl = self._deadline(deadline)
+        t = Ticket(kind, series, ts, seq, values, member=member,
+                   deadline=dl)
+        try:
+            self._put(t, timeout, dl)
+        except BaseException:
+            # the failed enqueue may have been the member's half-open
+            # probe: free the slot or the member quarantines forever
+            self.breaker.abandon(member.name)
+            raise
         return t
 
-    def submit_many(self, ticks,
-                    timeout: Optional[float] = None) -> List[Ticket]:
+    def submit_many(self, ticks, timeout: Optional[float] = None,
+                    deadline=None) -> List[Ticket]:
         """Bulk enqueue: ``ticks`` is ``[(kind, member, series, ts,
         values, seq)]`` in arrival order (``values`` None for
         queries; kinds may mix — the worker's member-order-preserving
@@ -381,21 +633,37 @@ class CohortExecutor(MicroBatchExecutor):
         10k-stream rates, per-tick ``submit()`` overhead (a lock round
         and a queue put per tick) costs more than the whole
         dispatch-side share.  Results, failures and latency stay per
-        ticket; a chunk counts as one entry toward the queue bound."""
+        ticket; a chunk counts as one entry toward the queue bound.
+        One shared ``deadline`` covers the chunk; quarantined members'
+        tickets resolve immediately with ``QuarantinedError`` while
+        the rest of the chunk proceeds."""
         t0 = time.perf_counter()
         gate = _ChunkGate()
-        chunk = []
+        dl = self._deadline(deadline)
+        chunk, out = [], []
         for kind, member, series, ts, values, seq in ticks:
             if kind not in ("right", "left"):
                 raise ValueError(f"kind must be 'right' or 'left', "
                                  f"got {kind!r}")
-            chunk.append(Ticket(kind, series, ts, seq, values,
-                                member=member, t_submit=t0, gate=gate))
-        with self._submit_lock:
-            if self._closed:
-                raise RuntimeError("executor is closed")
-            self._q.put(chunk, block=True, timeout=timeout)
-        return chunk
+            bad = self._quarantined(member, kind, series, ts, seq,
+                                    values, t_submit=t0)
+            if bad is not None:
+                out.append(bad)
+                continue
+            t = Ticket(kind, series, ts, seq, values, member=member,
+                       t_submit=t0, gate=gate, deadline=dl)
+            chunk.append(t)
+            out.append(t)
+        if chunk:
+            try:
+                self._put(chunk, timeout, dl)
+            except BaseException:
+                # any of the chunk's members may have been probing;
+                # abandon() is a no-op for the rest
+                for t in chunk:
+                    self.breaker.abandon(t.member.name)
+                raise
+        return out
 
     @staticmethod
     def _series_key(t: Ticket):
@@ -437,12 +705,12 @@ class CohortExecutor(MicroBatchExecutor):
         for b in batches:
             yield b[1], b[3]
 
-    @staticmethod
-    def _ring(batch):
-        gates = {t._gate for t in batch}
-        gates.discard(None)
-        for gate in gates:
-            gate.ring()
+    def _on_dropped(self, t: Ticket) -> None:
+        # a deadline-dead ticket may have been the member's half-open
+        # probe; free the probe slot so the member is not quarantined
+        # forever by an outcome that will never arrive
+        if t.member is not None:
+            self.breaker.abandon(t.member.name)
 
     def _process(self, batch):
         batch, max_rows = batch
@@ -454,6 +722,7 @@ class CohortExecutor(MicroBatchExecutor):
         except Exception as e:       # dispatch-level failure: delivered
             for t in batch:          # per ticket, worker lives on
                 t._finish(exc=e)
+                self.breaker.record(t.member.name, ok=False)
             self._ring(batch)
             return
         self.batches += 1
@@ -462,11 +731,39 @@ class CohortExecutor(MicroBatchExecutor):
         for t, r in zip(batch, results):
             if isinstance(r, Exception):
                 t._finish(exc=r)
+                self.breaker.record(t.member.name, ok=False)
                 continue
             t._finish(result=r)
+            self.breaker.record(t.member.name, ok=True)
             ok += 1
             lats.append(t.t_done - t.t_submit)
         self.ticks += ok
         self._ring(batch)
         b = stream_mod._bucket(max_rows)
         self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1
+
+    # -- failover ------------------------------------------------------
+
+    @classmethod
+    def resume(cls, checkpoint_dir: str, *, verify: bool = True,
+               mesh=None, stream_axis: str = "streams",
+               queue_depth: Optional[int] = None,
+               batch_rows: Optional[int] = None,
+               coalesce_s: float = 0.002,
+               breaker: Optional[CircuitBreaker] = None,
+               **overrides) -> "CohortExecutor":
+        """Failover in one call: restore the newest intact cohort
+        snapshot (full or differential chain —
+        :meth:`~tempo_tpu.serve.cohort.StreamCohort.resume`) and stand
+        a fresh executor over it.  The resumed cohort's per-stream
+        ``acked`` cursors tell each event source where to restart;
+        replay the unacked tails through :meth:`submit_many` and the
+        emissions are byte-identical to a plane that never died."""
+        from tempo_tpu.serve.cohort import StreamCohort
+
+        cohort = StreamCohort.resume(checkpoint_dir, verify=verify,
+                                     mesh=mesh, stream_axis=stream_axis,
+                                     **overrides)
+        return cls(cohort, queue_depth=queue_depth,
+                   batch_rows=batch_rows, coalesce_s=coalesce_s,
+                   breaker=breaker)

@@ -55,6 +55,35 @@ from tempo_tpu.packing import TS_PAD
 _FAR_PAST = np.int64(-(1 << 62))
 
 
+def donate_serve_steps() -> bool:
+    """Whether the serve/cohort step programs donate their retired
+    state buffers.
+
+    On accelerator backends donation is the whole point: the steady
+    state updates in place and a dropped donation doubles serving HBM
+    per tick (the ``serve.step`` / ``serve.cohort_step`` compiled
+    contracts pin it).  On XLA:**CPU** donation is disabled: host
+    buffers are cheap, AND the virtual multi-device host platform
+    (``--xla_force_host_platform_device_count``, the test/dryrun
+    topology) exhibits use-after-free corruption when donated serve
+    steps run in a process that has also executed stream-axis-sharded
+    programs — observed as garbage emissions, glibc heap aborts and
+    segfaults (jaxlib 0.4.36; minimal trigger pinned by the chaos
+    suite's provenance notes).  ``TEMPO_TPU_SERVE_DONATE`` overrides
+    both directions (1 forces donation on CPU, 0 disables it
+    everywhere); unset = backend-automatic."""
+    from tempo_tpu import config
+
+    val = config.get("TEMPO_TPU_SERVE_DONATE")
+    if val is not None and val.strip() != "":
+        return val.strip().lower() not in ("0", "false", "no", "off")
+    return jax.default_backend() != "cpu"
+
+
+def _serve_donate(argnums: Tuple[int, ...]) -> Tuple[int, ...]:
+    return argnums if donate_serve_steps() else ()
+
+
 def window_ns(window_secs) -> int:
     """Window width in integer nanoseconds.  Membership ``ts >= t - w``
     over int64-ns keys equals ``ts >= t - floor(w_ns)`` (the
@@ -383,7 +412,7 @@ def push_jitted(cfg: StreamConfig, Lb: int):
     compiled contract)."""
     n_state = len(cfg.state_names())
     fn = jax.jit(_push_fn(cfg, Lb),
-                 donate_argnums=tuple(range(n_state)))
+                 donate_argnums=_serve_donate(tuple(range(n_state))))
     return fn, n_state
 
 
@@ -393,7 +422,8 @@ _QUERY_STATE = ("last_val", "last_src", "lock_val", "lock_valid",
 
 def query_jitted(cfg: StreamConfig, Lb: int):
     # only n_merged is retired by a query
-    return jax.jit(_query_fn(cfg, Lb), donate_argnums=(7,))
+    return jax.jit(_query_fn(cfg, Lb),
+                   donate_argnums=_serve_donate((7,)))
 
 
 def query_avals(cfg: StreamConfig, Lb: int):
@@ -484,7 +514,7 @@ def cohort_push_jitted(cfg: StreamConfig, S: int, Lb: int, mesh=None,
     ``mesh``, the jit carries explicit stream-axis in/out shardings."""
     n_state = len(cfg.state_names())
     fn = jax.vmap(_push_fn(cfg, Lb))
-    donate = tuple(range(n_state))
+    donate = _serve_donate(tuple(range(n_state)))
     if mesh is None:
         return jax.jit(fn, donate_argnums=donate), n_state
     in_sh, out_sh = _cohort_shardings(fn, cohort_push_avals(cfg, S, Lb),
@@ -496,11 +526,12 @@ def cohort_push_jitted(cfg: StreamConfig, S: int, Lb: int, mesh=None,
 def cohort_query_jitted(cfg: StreamConfig, S: int, Lb: int, mesh=None,
                         stream_axis: str = "streams"):
     fn = jax.vmap(_query_fn(cfg, Lb))
+    donate = _serve_donate((7,))
     if mesh is None:
-        return jax.jit(fn, donate_argnums=(7,))
+        return jax.jit(fn, donate_argnums=donate)
     in_sh, out_sh = _cohort_shardings(
         fn, cohort_query_avals(cfg, S, Lb), mesh, stream_axis)
-    return jax.jit(fn, donate_argnums=(7,), in_shardings=in_sh,
+    return jax.jit(fn, donate_argnums=donate, in_shardings=in_sh,
                    out_shardings=out_sh)
 
 

@@ -22,6 +22,8 @@ and every cost-decided plan stays bitwise-identical to its rule-based
 twin.
 """
 
+from tempo_tpu.resilience import (Cancelled, Deadline, DeadlineExceeded,
+                                  QuarantinedError, ShutdownError)
 from tempo_tpu.service.admission import (AdmissionController,
                                          AdmissionError, Footprint,
                                          project_footprint)
@@ -31,4 +33,8 @@ __all__ = [
     "QueryService", "QueryTicket", "lazy_frame",
     "AdmissionController", "AdmissionError", "Footprint",
     "project_footprint",
+    # fault-domain vocabulary (tempo_tpu.resilience), re-exported:
+    # service callers meet these on submit() and tickets
+    "Deadline", "DeadlineExceeded", "Cancelled", "ShutdownError",
+    "QuarantinedError",
 ]

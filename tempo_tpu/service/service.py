@@ -28,11 +28,34 @@ releases its budget; the workers live on.  ``stats()`` reports
 per-tenant submitted/completed/failed/rejected counts, p50/p99
 latency, the cache's per-tenant traffic, and the max/min
 completed-query ratio — the starvation audit the bench asserts.
+
+**The fault domain** (resilience.py primitives):
+
+* *deadlines* — ``submit(..., deadline_s=...)`` (default
+  ``TEMPO_TPU_SERVICE_DEADLINE_S``) carries ONE
+  :class:`~tempo_tpu.resilience.Deadline` through the tenant-quota
+  wait, the admission queue and dispatch; whichever stage the budget
+  dies at raises/fails with a stage-named ``DeadlineExceeded``.
+* *cancellation* — ``QueryTicket.cancel()`` removes a still-queued
+  query, frees its quota slot, and resolves the ticket with
+  :class:`~tempo_tpu.resilience.Cancelled`; it never reaches a worker
+  and never acquires budget.
+* *quarantine* — a per-plan-signature
+  :class:`~tempo_tpu.resilience.CircuitBreaker`: a signature failing
+  ``TEMPO_TPU_BREAKER_THRESHOLD`` consecutive times is refused at
+  submit with ``QuarantinedError`` until a half-open probe (after
+  ``TEMPO_TPU_BREAKER_COOLDOWN_S``) succeeds — a poison-pill query
+  cannot burn every worker's time forever.
+* *supervision* — worker threads run under a supervisor: an exception
+  escaping the scheduler loop (not a query's own failure — those are
+  already per-ticket) logs, counts on ``restarts`` and restarts the
+  worker, so the plane survives its own bugs and injected faults.
 """
 
 from __future__ import annotations
 
 import collections
+import logging
 import queue as queue_mod
 import threading
 import time
@@ -40,9 +63,13 @@ from typing import Dict, Optional
 
 from tempo_tpu.plan import cache as plan_cache
 from tempo_tpu.plan import ir
+from tempo_tpu.resilience import (Cancelled, CircuitBreaker, Deadline,
+                                  DeadlineExceeded)
 from tempo_tpu.serve.executor import LATENCY_WINDOW
 from tempo_tpu.service.admission import (AdmissionController,
                                          Footprint, project_footprint)
+
+logger = logging.getLogger(__name__)
 
 
 def lazy_frame(frame):
@@ -58,15 +85,18 @@ def lazy_frame(frame):
 class QueryTicket:
     """One submitted query: a waitable handle for its result."""
 
-    __slots__ = ("tenant", "signature", "footprint", "t_submit",
-                 "t_blocked", "t_start", "t_done", "_root", "_event",
-                 "_result", "_exc")
+    __slots__ = ("tenant", "signature", "footprint", "deadline",
+                 "_service", "t_submit", "t_blocked", "t_start",
+                 "t_done", "_root", "_event", "_result", "_exc")
 
     def __init__(self, tenant: str, root: ir.Node, signature: str,
-                 footprint: Footprint):
+                 footprint: Footprint,
+                 deadline: Optional[Deadline] = None, service=None):
         self.tenant = tenant
         self.signature = signature
         self.footprint = footprint
+        self.deadline = deadline
+        self._service = service
         self.t_submit = time.perf_counter()
         #: when this query, AT THE HEAD of its tenant's queue, first
         #: failed ``fits_now()`` — the budget-reservation clock (time
@@ -87,6 +117,16 @@ class QueryTicket:
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def cancel(self) -> bool:
+        """Cancel this query if it is still queued: it is removed from
+        its tenant's queue (freeing the quota slot), never reaches a
+        worker, never acquires budget, and ``result()`` raises
+        :class:`~tempo_tpu.resilience.Cancelled`.  Returns ``False``
+        once the query has been dispatched or resolved."""
+        if self._service is None:
+            return False
+        return self._service._cancel(self)
 
     def result(self, timeout: Optional[float] = None):
         """The query's result frame (blocks until dispatched and
@@ -117,7 +157,9 @@ class QueryService:
                  tenant_quota: Optional[int] = None,
                  hbm_budget: Optional[int] = None,
                  vmem_budget: Optional[int] = None,
-                 reserve_after_s: float = 5.0):
+                 reserve_after_s: float = 5.0,
+                 deadline_s: Optional[float] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         from tempo_tpu import config
 
         if workers is None:
@@ -125,6 +167,17 @@ class QueryService:
         if tenant_quota is None:
             tenant_quota = config.get_int(
                 "TEMPO_TPU_SERVICE_TENANT_QUOTA", 64)
+        if deadline_s is None:
+            deadline_s = config.get_float("TEMPO_TPU_SERVICE_DEADLINE_S")
+        #: default end-to-end budget for submitted queries (None = no
+        #: deadline unless the submit passes one)
+        self.deadline_s = deadline_s
+        #: per-plan-signature circuit breaker: repeat-failing
+        #: signatures are refused at submit with QuarantinedError
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        #: supervised worker restarts (an exception escaping the
+        #: scheduler loop, NOT a query's own failure)
+        self.restarts = 0
         self.tenant_quota = max(1, int(tenant_quota))
         #: budget reservation threshold: once a head-of-queue query has
         #: sat unfitting this long, the scheduler stops handing the
@@ -134,6 +187,9 @@ class QueryService:
         #: never dispatch (admission only rejects what can NEVER fit)
         self.reserve_after_s = float(reserve_after_s)
         self.admission = AdmissionController(hbm_budget, vmem_budget)
+        #: per-worker-thread picked-but-unaccounted ticket (supervisor
+        #: fails + releases it if the loop dies mid-query)
+        self._running: Dict[int, QueryTicket] = {}
         self._cond = threading.Condition()
         self._queues: Dict[str, collections.deque] = {}
         self._tokens: Dict[str, int] = {}       # dispatches charged
@@ -152,7 +208,8 @@ class QueryService:
 
     def _count(self, tenant: str, field: str, by: int = 1) -> None:
         c = self._counts.setdefault(tenant, {
-            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0})
+            "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
+            "cancelled": 0, "quarantined": 0})
         c[field] += by
 
     @staticmethod
@@ -172,14 +229,25 @@ class QueryService:
             f".op()...) or a plan node, got {type(query).__name__}")
 
     def submit(self, tenant: str, query,
-               timeout: Optional[float] = None) -> QueryTicket:
+               timeout: Optional[float] = None,
+               deadline_s=None) -> QueryTicket:
         """Enqueue one query for ``tenant``.  Raises
         :class:`AdmissionError` when the projected footprint could
-        never fit the budgets; blocks while the tenant is at quota
-        (per-tenant backpressure — ``queue.Full`` after ``timeout``)."""
+        never fit the budgets, and
+        :class:`~tempo_tpu.resilience.QuarantinedError` when the plan
+        signature's circuit breaker is open (repeat poison pill —
+        fail-fast until a half-open probe succeeds); blocks while the
+        tenant is at quota (per-tenant backpressure — ``queue.Full``
+        after ``timeout``).  ``deadline_s`` (seconds or a
+        :class:`Deadline`; default ``TEMPO_TPU_SERVICE_DEADLINE_S``)
+        is carried end to end: expiry during the quota wait raises —
+        and later, in the admission queue or at dispatch, fails the
+        ticket — with a stage-named ``DeadlineExceeded``."""
         root = self._as_root(query)
         footprint = project_footprint(root)
         sig = ir.signature(root)
+        dl = Deadline.after(self.deadline_s if deadline_s is None
+                            else deadline_s)
         deadline = None if timeout is None else \
             time.perf_counter() + timeout
         with self._cond:
@@ -191,41 +259,93 @@ class QueryService:
                 self._count(tenant, "submitted")
                 self._count(tenant, "rejected")
                 raise
-            q = self._queues.setdefault(tenant, collections.deque())
-            if tenant not in self._tokens:
-                # new (or returning) tenants join at the FLOOR of the
-                # live token counts, not 0: starting from zero would
-                # hand a newcomer absolute priority until it caught up
-                # with tenants that have been served for hours —
-                # starving them, the inverse of the fairness contract
-                self._tokens[tenant] = min(self._tokens.values(),
-                                           default=0)
-            # standard condition-variable shape: re-check the predicate
-            # after EVERY wake (a timed-out wait may still have had the
-            # queue drained just before the deadline — Full only when
-            # the quota is genuinely still exhausted past it)
-            while len(q) >= self.tenant_quota:
-                remaining = None if deadline is None else \
-                    deadline - time.perf_counter()
-                if remaining is not None and remaining <= 0:
-                    raise queue_mod.Full(
-                        f"tenant {tenant!r} is at its pending-query "
-                        f"quota ({self.tenant_quota})")
-                self._cond.wait(remaining)
-                if self._closed:
-                    raise RuntimeError("query service is closed")
-                # the scheduler PRUNES a deque it drains
-                # (_dispatch_locked), so the reference captured above
-                # may be orphaned by now — re-resolve the live deque
-                # before re-checking the predicate, or the append below
-                # would land in a deque _pick never scans and silently
-                # lose the query
-                q = self._queues.setdefault(tenant, q)
-            ticket = QueryTicket(tenant, root, sig, footprint)
-            q.append(ticket)
-            self._count(tenant, "submitted")
-            self._cond.notify_all()
+            try:
+                self.breaker.allow(sig, label="plan signature")
+            except Exception:
+                self._count(tenant, "submitted")
+                self._count(tenant, "quarantined")
+                raise
+            try:
+                ticket = self._enqueue_locked(tenant, root, sig,
+                                              footprint, dl, deadline)
+            except BaseException:
+                # this admission may have been the signature's
+                # half-open probe; a failed ENQUEUE (quota Full,
+                # deadline, close) reports no outcome — free the probe
+                # slot or the signature quarantines forever
+                self.breaker.abandon(sig)
+                raise
         return ticket
+
+    def _enqueue_locked(self, tenant, root, sig, footprint, dl,
+                        deadline) -> QueryTicket:
+        """The quota-wait + append half of submit (under the
+        scheduler condition)."""
+        q = self._queues.setdefault(tenant, collections.deque())
+        if tenant not in self._tokens:
+            # new (or returning) tenants join at the FLOOR of the
+            # live token counts, not 0: starting from zero would
+            # hand a newcomer absolute priority until it caught up
+            # with tenants that have been served for hours —
+            # starving them, the inverse of the fairness contract
+            self._tokens[tenant] = min(self._tokens.values(),
+                                       default=0)
+        # standard condition-variable shape: re-check the predicate
+        # after EVERY wake (a timed-out wait may still have had the
+        # queue drained just before the deadline — Full only when
+        # the quota is genuinely still exhausted past it)
+        while len(q) >= self.tenant_quota:
+            if dl is not None:
+                # the end-to-end budget dies HERE by name, not as
+                # an anonymous queue.Full
+                dl.check("tenant quota")
+            remaining = None if deadline is None else \
+                deadline - time.perf_counter()
+            if dl is not None:
+                rem_dl = dl.remaining()
+                remaining = rem_dl if remaining is None \
+                    else min(remaining, rem_dl)
+            if remaining is not None and remaining <= 0:
+                raise queue_mod.Full(
+                    f"tenant {tenant!r} is at its pending-query "
+                    f"quota ({self.tenant_quota})")
+            self._cond.wait(remaining)
+            if self._closed:
+                raise RuntimeError("query service is closed")
+            # the scheduler PRUNES a deque it drains
+            # (_dispatch_locked), so the reference captured above
+            # may be orphaned by now — re-resolve the live deque
+            # before re-checking the predicate, or the append below
+            # would land in a deque _pick never scans and silently
+            # lose the query
+            q = self._queues.setdefault(tenant, q)
+        ticket = QueryTicket(tenant, root, sig, footprint,
+                             deadline=dl, service=self)
+        q.append(ticket)
+        self._count(tenant, "submitted")
+        self._cond.notify_all()
+        return ticket
+
+    def _cancel(self, ticket: QueryTicket) -> bool:
+        """Remove a still-queued ticket (QueryTicket.cancel's body):
+        frees its quota slot, resolves it with :class:`Cancelled`; a
+        dispatched/resolved ticket is not cancellable."""
+        with self._cond:
+            q = self._queues.get(ticket.tenant)
+            if ticket.done() or q is None or ticket not in q:
+                return False
+            q.remove(ticket)
+            if not q:
+                del self._queues[ticket.tenant]
+            ticket._finish(exc=Cancelled(
+                f"query {ticket.signature[:16]}... for tenant "
+                f"{ticket.tenant!r} cancelled before dispatch"))
+            self._count(ticket.tenant, "cancelled")
+            self._cond.notify_all()     # a quota slot freed
+        # a cancelled query reports no outcome: free a possible
+        # half-open probe slot for its signature
+        self.breaker.abandon(ticket.signature)
+        return True
 
     # -- scheduler/worker side ------------------------------------------
 
@@ -261,6 +381,7 @@ class QueryService:
         behind the same tenant's earlier queries is ordinary waiting,
         and triggering off it would stall the whole service for a query
         that was never budget-starved."""
+        self._expire_locked()
         now = time.perf_counter()
         tenants = sorted(
             (t for t, q in self._queues.items() if q),
@@ -284,7 +405,60 @@ class QueryService:
                 return self._dispatch_locked(t)
         return None
 
+    def _expire_locked(self) -> None:
+        """Fail every queued ticket whose deadline died waiting for
+        admission (stage-named) — under the scheduler lock.  Expired
+        work must resolve NOW, not when it happens to reach its
+        tenant's head."""
+        for tenant in list(self._queues):
+            q = self._queues[tenant]
+            dead = [t for t in q
+                    if t.deadline is not None and t.deadline.expired()]
+            if not dead:
+                continue
+            for t in dead:
+                q.remove(t)
+                t._finish(exc=DeadlineExceeded(
+                    f"deadline exceeded at stage 'admission queue': "
+                    f"query for tenant {tenant!r} spent its "
+                    f"{t.deadline.budget_s:.3f}s budget waiting for "
+                    f"budget/workers", stage="admission queue"))
+                self._count(tenant, "failed")
+                self.breaker.abandon(t.signature)   # vanished probe
+            if not q:
+                del self._queues[tenant]
+            self._cond.notify_all()     # quota slots freed
+
     def _worker(self) -> None:
+        """Supervised scheduler/executor loop: a query's own failure is
+        delivered on its ticket (the inner try); an exception escaping
+        the LOOP itself (scheduler bug, injected plane fault) restarts
+        the worker — the plane outlives it.  A ticket this worker had
+        already PICKED when the loop died is failed and its budget
+        released here (it would otherwise hang its caller and leak
+        admission capacity forever)."""
+        tid = threading.get_ident()
+        while True:
+            try:
+                self._worker_loop(tid)
+                return                       # clean close
+            except Exception as e:  # noqa: BLE001 - supervised restart
+                ticket = self._running.pop(tid, None)
+                if ticket is not None and not ticket.done():
+                    ticket._finish(exc=e)
+                    self.breaker.abandon(ticket.signature)
+                    with self._cond:
+                        self.admission.release(ticket.footprint)
+                        self._count(ticket.tenant, "failed")
+                with self._cond:
+                    self.restarts += 1
+                    n = self.restarts
+                    self._cond.notify_all()
+                logger.warning(
+                    "query-service worker died (%s: %s); supervisor "
+                    "restart #%d", type(e).__name__, e, n)
+
+    def _worker_loop(self, tid) -> None:
         from tempo_tpu.plan import executor as plan_executor
 
         while True:
@@ -295,8 +469,9 @@ class QueryService:
                         return
                     # reservation is age-triggered: wake periodically
                     # while queries are PENDING so a starved head's
-                    # clock is re-read; an idle service sleeps until a
-                    # submit/close notifies instead of spinning
+                    # clock is re-read (and deadlines expire by name);
+                    # an idle service sleeps until a submit/close
+                    # notifies instead of spinning
                     self._cond.wait(
                         timeout=0.25 if any(self._queues.values())
                         else None)
@@ -304,18 +479,40 @@ class QueryService:
                 # a dispatch frees a quota slot: wake blocked
                 # submitters (completions notify elsewhere)
                 self._cond.notify_all()
+            # visible to the supervisor: if this loop dies before the
+            # ticket is accounted, the restart fails it and releases
+            # its acquired budget instead of hanging its caller
+            self._running[tid] = ticket
+            if ticket.deadline is not None and ticket.deadline.expired():
+                # budget died between pick and dispatch: the budget IS
+                # acquired at pick — release it with the failure
+                ticket._finish(exc=DeadlineExceeded(
+                    f"deadline exceeded at stage 'dispatch': query for "
+                    f"tenant {ticket.tenant!r} ran out of its "
+                    f"{ticket.deadline.budget_s:.3f}s budget before "
+                    f"execution", stage="dispatch"))
+                with self._cond:
+                    self.admission.release(ticket.footprint)
+                    self._count(ticket.tenant, "failed")
+                    self._cond.notify_all()
+                self.breaker.abandon(ticket.signature)
+                self._running.pop(tid, None)
+                continue
             ticket.t_start = time.perf_counter()
             try:
                 with plan_cache.tenant_scope(ticket.tenant):
                     result = plan_executor.execute(ticket._root)
             except BaseException as e:  # noqa: BLE001 - delivered on the
                 ticket._finish(exc=e)   # ticket; the worker lives on
+                self.breaker.record(ticket.signature, ok=False)
                 with self._cond:
                     self.admission.release(ticket.footprint)
                     self._count(ticket.tenant, "failed")
                     self._cond.notify_all()
+                self._running.pop(tid, None)
                 continue
             ticket._finish(result=result)
+            self.breaker.record(ticket.signature, ok=True)
             with self._cond:
                 self.admission.release(ticket.footprint)
                 self._count(ticket.tenant, "completed")
@@ -327,13 +524,19 @@ class QueryService:
                     collections.deque(maxlen=self._LATENCY_WINDOW),
                 ).append(ticket.latency_s)
                 self._cond.notify_all()
+            self._running.pop(tid, None)
 
     # -- lifecycle / metrics --------------------------------------------
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Graceful drain: stop accepting, execute everything already
         queued, stop the workers.  ``timeout`` bounds the WHOLE drain —
-        one shared deadline across the worker joins, not per worker."""
+        one shared deadline across the worker joins, not per worker.
+        Queries still pending when it expires are failed with
+        :class:`~tempo_tpu.resilience.ShutdownError` — a ticket never
+        hangs its caller."""
+        from tempo_tpu.resilience import ShutdownError
+
         with self._cond:
             if self._closed:
                 return
@@ -344,6 +547,15 @@ class QueryService:
         for t in self._threads:
             t.join(None if deadline is None else
                    max(0.0, deadline - time.perf_counter()))
+        with self._cond:
+            for tenant in list(self._queues):
+                for ticket in self._queues.pop(tenant):
+                    ticket._finish(exc=ShutdownError(
+                        f"query service closed with this query "
+                        f"(tenant {tenant!r}) still pending"))
+                    self._count(tenant, "failed")
+                    self.breaker.abandon(ticket.signature)
+            self._cond.notify_all()
 
     def __enter__(self) -> "QueryService":
         return self
@@ -377,4 +589,6 @@ class QueryService:
                 "hbm_budget": self.admission.hbm_budget,
                 "vmem_budget": self.admission.vmem_budget,
                 "plan_cache": profiling.plan_cache_stats(),
+                "breaker": self.breaker.stats(),
+                "restarts": self.restarts,
             }

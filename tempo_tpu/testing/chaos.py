@@ -1,0 +1,583 @@
+"""Chaos campaign harness: drive the serving + query planes through
+kill / flaky / delay schedules under Poisson load and prove the
+fault-domain contracts on the way through.
+
+PR-1 chaos coverage (tests/test_chaos.py) exercises the BATCH side:
+``run_resumable`` chains killed mid-save.  This module is the serving
+side's equivalent — a scripted campaign against a live
+:class:`~tempo_tpu.serve.StreamCohort` behind a
+:class:`~tempo_tpu.serve.CohortExecutor`, and against a
+:class:`~tempo_tpu.service.QueryService`, asserting the four
+availability invariants the fault-domain runtime promises:
+
+* **no hung tickets** — every submit resolves with a result or a NAMED
+  error (``DeadlineExceeded`` / ``QuarantinedError`` / ``Cancelled`` /
+  ``ShutdownError`` / the injected fault), within a bounded wait;
+* **bounded recovery** — after a :class:`SimulatedKill` of the serving
+  plane, ``CohortExecutor.resume`` + warmup completes inside the
+  declared bound;
+* **zero recompiles after recovery** — the resumed plane's replay and
+  steady state build no new executables past its warmup;
+* **bitwise tails** — every stream's full emission history (including
+  the replayed unacked tail) is byte-identical to an UNINJECTED twin
+  cohort fed the same per-stream events.
+
+The campaign is deterministic: injections are call-counted
+(:class:`~tempo_tpu.testing.faults.FaultInjector`), latency injection
+drives the deadline plane against a *known* sleep instead of racing a
+wall clock, and the feeder keeps at most one in-flight event per
+stream so per-stream order survives retries (an event is re-submitted
+only until it is acked — the at-least-once feeder every replayable
+event source implements).
+
+Entry points: :func:`run_serving_campaign`,
+:func:`run_service_campaign`, and :func:`run_campaign` (both planes,
+one report — bench config 15's ``--only-chaos-serving`` body).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tempo_tpu import profiling
+from tempo_tpu.resilience import (Cancelled, CircuitBreaker,
+                                  DeadlineExceeded, QuarantinedError,
+                                  ShutdownError)
+from tempo_tpu.testing import faults
+
+#: per-ticket result() bound: anything still unresolved after this is a
+#: HUNG ticket and fails the campaign (the invariant, not a tuning)
+RESULT_TIMEOUT_S = 120.0
+
+
+def _du(path: str) -> int:
+    """Recursive byte size of one snapshot directory."""
+    total = 0
+    for root, _, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(root, f))
+    return total
+
+
+# ----------------------------------------------------------------------
+# Event schedules (Poisson load)
+# ----------------------------------------------------------------------
+
+#: trailing streams of a campaign cohort that live in a SECOND shape
+#: bucket (3 declared series -> bucket 4) and receive only a quarter
+#: of the traffic: once their events run dry, their bucket goes quiet
+#: and every later differential snapshot excludes it — the dirty-
+#: bucket economics the acceptance measures
+COLD_STREAMS = 2
+
+
+def make_events(rng, n_streams: int, events_per_stream: int,
+                left_frac: float = 0.2):
+    """Per-stream event lists under Poisson arrivals: exponential
+    inter-arrival gaps on a per-stream logical clock (strictly
+    increasing, so per-stream merged order holds by construction),
+    ~``left_frac`` AS-OF queries, NaN runs in the values.  ``[(kind,
+    ts, value_or_None)]`` per stream; every stream's first event is a
+    data push (a query against an empty carry is legal but dull).
+    The last :data:`COLD_STREAMS` streams get a quarter of the
+    traffic — they finish early and leave their shape bucket quiet."""
+    out = []
+    for s in range(n_streams):
+        n_ev = events_per_stream
+        if n_streams > COLD_STREAMS and s >= n_streams - COLD_STREAMS:
+            n_ev = max(2, events_per_stream // 4)
+        gaps = rng.exponential(scale=4e7, size=n_ev).astype(np.int64) + 1
+        ts = np.cumsum(gaps) + np.int64(10**9) * (s + 1)
+        kinds = rng.random(n_ev) < left_frac
+        kinds[0] = False
+        vals = rng.standard_normal(n_ev).astype(np.float32)
+        vals[rng.random(n_ev) < 0.05] = np.nan
+        out.append([("left" if kinds[i] else "right", int(ts[i]),
+                     None if kinds[i] else float(vals[i]))
+                    for i in range(n_ev)])
+    return out
+
+
+def _mk_cohort(n_streams: int, checkpoint_dir: Optional[str], ckpt_every,
+               diff_snapshots: bool):
+    from tempo_tpu.serve import StreamCohort
+
+    cohort = StreamCohort(
+        ("px",), window_secs=10.0, window_rows_bound=8, ema_alpha=0.2,
+        max_lookback=16, slots=n_streams, checkpoint_dir=checkpoint_dir,
+        ckpt_every=ckpt_every, diff_snapshots=diff_snapshots)
+    members = []
+    for s in range(n_streams):
+        cold = (n_streams > COLD_STREAMS
+                and s >= n_streams - COLD_STREAMS)
+        # cold streams declare 3 series (shape bucket 4) but only feed
+        # "s0": a second bucket group exists and goes quiet early
+        members.append(cohort.add_stream(
+            f"u{s}", ["s0", "s1", "s2"] if cold else ["s0"]))
+    return cohort, members
+
+
+def _golden_run(events) -> List[List[dict]]:
+    """The uninjected twin: a fresh cohort fed the same per-stream
+    events directly (no executor, no faults, no checkpoints) — the
+    byte-level oracle for every stream's full emission history."""
+    cohort, members = _mk_cohort(len(events), None, 0, False)
+    out: List[List[dict]] = [[] for _ in events]
+    pos = [0] * len(events)
+    live = list(range(len(events)))
+    while live:
+        nxt = []
+        for s in live:
+            kind, ts, val = events[s][pos[s]]
+            m = members[s]
+            if kind == "right":
+                r = m.push(["s0"], [ts], {"px": np.float32([val])})
+            else:
+                r = m.push_left(["s0"], [ts])
+            out[s].append({k: np.asarray(v[0]) for k, v in r.items()})
+            pos[s] += 1
+            if pos[s] < len(events[s]):
+                nxt.append(s)
+        live = nxt
+    return out
+
+
+# ----------------------------------------------------------------------
+# The serving-plane campaign
+# ----------------------------------------------------------------------
+
+class _Feeder:
+    """At-least-once, order-preserving feeder: one in-flight event per
+    stream, retried until acked; every outcome is categorized and every
+    ticket must resolve inside ``RESULT_TIMEOUT_S`` (a hang fails the
+    campaign on the spot)."""
+
+    def __init__(self, events, golden):
+        self.events = events
+        self.golden = golden
+        self.pos = [0] * len(events)
+        self.emissions: List[List[Optional[dict]]] = [
+            [None] * len(ev) for ev in events]
+        self.outcomes = {"ok": 0, "deadline": 0, "quarantined": 0,
+                         "shutdown": 0, "injected": 0, "retried": 0}
+        self.resolved = 0
+
+    def pending(self, s: int) -> bool:
+        return self.pos[s] < len(self.events[s])
+
+    def tick_of(self, s: int, members):
+        kind, ts, val = self.events[s][self.pos[s]]
+        return (kind, members[s], "s0", ts,
+                None if val is None else {"px": np.float32(val)}, None)
+
+    def settle(self, s_list, tickets) -> List[int]:
+        """Resolve one round's tickets; returns the streams whose event
+        must be RETRIED (everything else advanced or terminally
+        failed the campaign)."""
+        retry: List[int] = []
+        for s, t in zip(s_list, tickets):
+            try:
+                r = t.result(timeout=RESULT_TIMEOUT_S)
+            # NB: DeadlineExceeded IS a TimeoutError (and InjectedFault
+            # an OSError) — the named outcomes must be caught before
+            # the bare TimeoutError that means an actual HANG
+            except DeadlineExceeded:
+                self.outcomes["deadline"] += 1
+                retry.append(s)
+            except QuarantinedError:
+                self.outcomes["quarantined"] += 1
+                retry.append(s)
+            except ShutdownError:
+                self.outcomes["shutdown"] += 1
+                retry.append(s)
+            except faults.InjectedFault:
+                self.outcomes["injected"] += 1
+                retry.append(s)
+            except TimeoutError as e:
+                raise AssertionError(
+                    f"HUNG ticket for stream {s}: {e}") from e
+            else:
+                i = self.pos[s]
+                self.emissions[s][i] = {k: np.asarray(v)
+                                        for k, v in r.items()}
+                self.pos[s] += 1
+                self.outcomes["ok"] += 1
+            finally:
+                self.resolved += 1
+        self.outcomes["retried"] += len(retry)
+        return retry
+
+    def round(self, ex, members, streams=None, deadline=None) -> List[int]:
+        """Submit one pending event per (given) stream as ONE
+        submit_many chunk, settle, return retries."""
+        s_list = [s for s in (streams if streams is not None
+                              else range(len(self.events)))
+                  if self.pending(s)]
+        if not s_list:
+            return []
+        tickets = ex.submit_many([self.tick_of(s, members)
+                                  for s in s_list], deadline=deadline)
+        return self.settle(s_list, tickets)
+
+    def acked_total(self) -> int:
+        return sum(self.pos)
+
+    def audit_tails(self) -> int:
+        """Every stream's full emission history bitwise vs golden."""
+        checked = 0
+        for s, gold in enumerate(self.golden):
+            assert self.pos[s] == len(self.events[s]), (
+                f"stream {s} incomplete: {self.pos[s]} of "
+                f"{len(self.events[s])} events acked")
+            for i, want in enumerate(gold):
+                got = self.emissions[s][i]
+                assert got is not None, (s, i)
+                assert set(got) == set(want), (s, i)
+                for key in want:
+                    assert got[key].tobytes() == want[key].tobytes(), (
+                        f"stream {s} event {i} field {key!r}: "
+                        f"{got[key]} != {want[key]}")
+                checked += 1
+        return checked
+
+
+def run_serving_campaign(checkpoint_dir: str, *, n_streams: int = 12,
+                         events_per_stream: int = 24, seed: int = 17,
+                         ckpt_every: int = 40,
+                         recovery_bound_s: float = 60.0,
+                         delay_s: float = 0.5,
+                         delay_deadline_s: float = 0.12) -> dict:
+    """The serving-plane chaos campaign (see module docstring).
+
+    Phases: clean warm-up traffic -> flaky dispatches (retried) ->
+    a plane-level fault that kills the drain thread (supervised
+    restart) -> latency injection against a short deadline (stage-named
+    ``DeadlineExceeded``, nothing lost) -> a poison-pill member driven
+    into quarantine and recovered through a half-open probe ->
+    :class:`SimulatedKill` of a dispatch (plane death: every
+    outstanding ticket resolves with ``ShutdownError``) ->
+    ``CohortExecutor.resume`` from the differential snapshot chain ->
+    replay of every unacked tail -> full bitwise tail audit vs the
+    uninjected twin."""
+    from tempo_tpu.serve import CohortExecutor, StreamCohort
+
+    rng = np.random.default_rng(seed)
+    events = make_events(rng, n_streams, events_per_stream)
+    n_total = sum(len(ev) for ev in events)
+    golden = _golden_run(events)
+    feeder = _Feeder(events, golden)
+
+    breaker = CircuitBreaker(threshold=3, cooldown_s=0.4)
+    cohort, members = _mk_cohort(n_streams, checkpoint_dir, ckpt_every,
+                                 diff_snapshots=True)
+    ex = CohortExecutor(cohort, batch_rows=8, queue_depth=256,
+                        coalesce_s=0.0, breaker=breaker)
+    cohort.warmup(8)
+    injected = {"flaky": 0, "supervisor_faults": 0, "delays": 0,
+                "poison": 0, "kills": 0}
+    t_start = time.perf_counter()
+
+    def pump(frac):
+        target = int(frac * n_total)
+        guard = 0
+        while feeder.acked_total() < target:
+            feeder.round(ex, members)
+            guard += 1
+            assert guard < 10_000, "campaign feeder stopped progressing"
+
+    # -- phase 1: clean traffic ---------------------------------------
+    pump(0.15)
+
+    # -- phase 2: flaky dispatches — the whole round fails, the feeder
+    # retries, nothing is lost and nothing reorders
+    with faults.FaultInjector() as fi:
+        fi.flaky(StreamCohort, "dispatch", failures=2)
+        pump(0.30)
+        injected["flaky"] = sum(r.action == "raise" for r in fi.records)
+    assert injected["flaky"] >= 2
+    assert feeder.outcomes["injected"] >= 1
+
+    # -- phase 3: a plane-level fault (escapes the worker loop, not a
+    # ticket) — the supervisor fails the in-flight group and restarts
+    # the drain thread; the plane keeps serving
+    with faults.FaultInjector() as fi:
+        fi.flaky(CohortExecutor, "_split", failures=1)
+        pump(0.40)
+        injected["supervisor_faults"] = sum(
+            r.action == "raise" for r in fi.records)
+    assert ex.restarts >= 1, "supervisor never restarted the drain"
+
+    # -- phase 4: latency injection vs a short deadline.  Half the
+    # fleet dispatches behind an injected sleep; the other half is
+    # submitted with a budget strictly under it, dies IN THE QUEUE with
+    # the stage-named error, and is retried after the delay clears.
+    half = [s for s in range(n_streams) if feeder.pending(s)][:n_streams // 2]
+    rest = [s for s in range(n_streams)
+            if feeder.pending(s) and s not in half]
+    with faults.FaultInjector() as fi:
+        fi.delay_on_call(StreamCohort, "dispatch", seconds=delay_s,
+                         call_no=1)
+        a_list = [s for s in half if feeder.pending(s)]
+        a_tickets = ex.submit_many([feeder.tick_of(s, members)
+                                    for s in a_list])
+        # wait until the delayed dispatch has STARTED, then queue the
+        # doomed half behind it
+        t0 = time.perf_counter()
+        while not any(r.action == "delay" for r in fi.records):
+            assert time.perf_counter() - t0 < 30, "delay never fired"
+            time.sleep(0.002)
+        retry_b = feeder.round(ex, members, streams=rest,
+                               deadline=delay_deadline_s)
+        feeder.settle(a_list, a_tickets)
+        injected["delays"] = sum(r.action == "delay" for r in fi.records)
+    assert feeder.outcomes["deadline"] >= 1, (
+        "latency injection produced no DeadlineExceeded")
+    if retry_b:              # nothing was folded: the retries must land
+        feeder.round(ex, members, streams=retry_b)
+    pump(0.55)
+
+    # -- phase 5: poison-pill member -> quarantine -> half-open probe.
+    # Three consecutive bad ticks (unknown series) open the member's
+    # circuit; the next tick fails FAST with QuarantinedError; after
+    # the cooldown one probe (a real event) closes it again.
+    poison = members[0]
+    bad = ("right", poison, "no-such-series", 1, {"px": np.float32(1)},
+           None)
+    for _ in range(3):
+        (bad_ticket,) = ex.submit_many([bad])
+        try:
+            bad_ticket.result(timeout=RESULT_TIMEOUT_S)
+            raise AssertionError("poison tick unexpectedly succeeded")
+        except ValueError:
+            pass
+    injected["poison"] = 3
+    assert breaker.state(poison.name) == "open"
+    assert feeder.pending(0), "campaign sizing: stream 0 ran dry early"
+    (q_ticket,) = ex.submit_many([feeder.tick_of(0, members)])
+    try:
+        q_ticket.result(timeout=RESULT_TIMEOUT_S)
+        raise AssertionError("quarantined member's tick ran")
+    except QuarantinedError:
+        feeder.outcomes["quarantined"] += 1
+    time.sleep(breaker.cooldown_s + 0.05)
+    feeder.round(ex, members, streams=[0])      # the half-open probe
+    assert breaker.state(poison.name) == "closed", (
+        "half-open probe did not close the circuit")
+    pump(0.75)
+
+    # -- phase 6: SimulatedKill mid-dispatch — the plane dies, every
+    # outstanding ticket resolves with ShutdownError, and failover is
+    # resume-from-chain + replay of the unacked tails
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(StreamCohort, "dispatch", call_no=1)
+        live = [s for s in range(n_streams) if feeder.pending(s)]
+        tickets = ex.submit_many([feeder.tick_of(s, members)
+                                  for s in live])
+        retry = feeder.settle(live, tickets)
+        assert any(r.action == "kill" for r in fi.records)
+        injected["kills"] = 1
+    assert retry, "the killed dispatch should have failed its tickets"
+    assert ex.fatal is not None
+    restarts_pre_kill = ex.restarts
+    t_rec = time.perf_counter()
+    ex.close(timeout=5.0)
+
+    ex = CohortExecutor.resume(checkpoint_dir, batch_rows=8,
+                               queue_depth=256, coalesce_s=0.0,
+                               breaker=breaker, ckpt_every=ckpt_every,
+                               diff_snapshots=True)
+    cohort = ex.cohort
+    members = [cohort.stream(f"u{s}") for s in range(n_streams)]
+    # the snapshot's acked cursors say where each stream's source
+    # restarts; successfully-emitted events past the snapshot REPLAY
+    # (their bytes must come out identical — checked by the audit)
+    replayed = 0
+    for s in range(n_streams):
+        acked = cohort.stream(f"u{s}").acked
+        assert acked <= feeder.pos[s], (s, acked, feeder.pos[s])
+        replayed += feeder.pos[s] - acked
+        feeder.pos[s] = acked
+    cohort.warmup(8)
+    recovery_s = time.perf_counter() - t_rec
+    assert recovery_s <= recovery_bound_s, (
+        f"recovery took {recovery_s:.1f}s (bound {recovery_bound_s}s)")
+
+    # -- phase 7: replay + finish with ZERO new builds
+    builds0 = profiling.plan_cache_stats()["builds"]
+    pump(1.0)
+    builds1 = profiling.plan_cache_stats()["builds"]
+    assert builds1 == builds0, (
+        f"post-recovery steady state recompiled: builds went "
+        f"{builds0} -> {builds1}")
+    wall = time.perf_counter() - t_start
+    ex.close(timeout=30.0)
+
+    checked = feeder.audit_tails()
+
+    # snapshot economics: every artifact on disk, split full vs diff
+    from tempo_tpu import checkpoint as ckpt
+    full_b, diff_b = [], []
+    for _, path in ckpt.list_steps(checkpoint_dir):
+        mode = StreamCohort._snapshot_mode(path)["mode"]
+        (diff_b if mode == "differential" else full_b).append(_du(path))
+    assert full_b and diff_b, (
+        f"campaign wrote no full+diff chain: {len(full_b)} fulls, "
+        f"{len(diff_b)} diffs under {checkpoint_dir!r}")
+    # dirty-bucket economics: once the cold streams' bucket went quiet,
+    # an incremental snapshot stopped carrying it — at least one diff
+    # is strictly smaller than every full artifact
+    assert min(diff_b) < min(full_b), (full_b, diff_b)
+    assert feeder.resolved >= n_total
+    return {
+        "ticks_per_sec": round(feeder.outcomes["ok"] / wall, 1),
+        "n_streams": n_streams,
+        "n_events": n_total,
+        "outcomes": dict(feeder.outcomes),
+        "injected": injected,
+        "restarts": restarts_pre_kill + ex.restarts,
+        "recovery_s": round(recovery_s, 3),
+        "replayed_ticks": replayed,
+        "zero_builds_after_recovery": True,
+        "no_hung_tickets": True,
+        "snapshot_bytes": {
+            "full": full_b, "diff": diff_b,
+            "diff_vs_full": (round(min(diff_b) / max(full_b), 3)
+                             if full_b and diff_b else None)},
+        "tail_audit": (f"all {n_streams} streams bitwise vs uninjected "
+                       f"twin ({checked} emissions, replay included)"),
+    }
+
+
+# ----------------------------------------------------------------------
+# The query-service campaign
+# ----------------------------------------------------------------------
+
+def run_service_campaign(*, n_queries: int = 12, seed: int = 5,
+                         delay_s: float = 0.4,
+                         deadline_s: float = 0.1) -> dict:
+    """Chaos campaign for the query-service plane: a poison-pill plan
+    signature driven into quarantine (and probed half-open), a worker
+    killed by a plane-level fault (supervised restart), a delayed
+    execution that expires a queued query's deadline by stage name,
+    and a cancellation that never reaches a worker — while a good
+    tenant's queries keep completing.  Single worker: the scheduling
+    is then deterministic."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+    from tempo_tpu.plan import executor as plan_executor
+    from tempo_tpu.plan import ir
+    from tempo_tpu.service import QueryService, lazy_frame
+    from tempo_tpu.service.service import QueryService as _QS
+
+    rng = np.random.default_rng(seed)
+    n = 256
+    frame = TSDF(pd.DataFrame({
+        "sym": np.repeat(np.arange(4), n // 4),
+        "event_ts": np.tile(np.arange(n // 4, dtype=np.int64), 4),
+        "x": rng.standard_normal(n),
+    }), "event_ts", ["sym"])
+    good = lambda: lazy_frame(frame).EMA("x", exact=True)
+    poison_root = ir.Node("chaos_poison")        # unknown op: always raises
+
+    breaker = CircuitBreaker(threshold=3, cooldown_s=0.4)
+    svc = QueryService(workers=1, breaker=breaker)
+    outcomes = {"ok": 0, "poison_failed": 0, "quarantined": 0,
+                "deadline": 0, "cancelled": 0}
+
+    # steady traffic for the good tenant
+    for _ in range(n_queries // 2):
+        svc.submit("good", good()).result(timeout=RESULT_TIMEOUT_S)
+        outcomes["ok"] += 1
+
+    # -- poison signature -> quarantine -> half-open probe ------------
+    sig = ir.signature(poison_root)
+    for _ in range(3):
+        t = svc.submit("evil", poison_root)
+        try:
+            t.result(timeout=RESULT_TIMEOUT_S)
+            raise AssertionError("poison query unexpectedly succeeded")
+        except ValueError:
+            outcomes["poison_failed"] += 1
+    assert breaker.state(sig) == "open"
+    try:
+        svc.submit("evil", poison_root)
+        raise AssertionError("quarantined signature was admitted")
+    except QuarantinedError:
+        outcomes["quarantined"] += 1
+    time.sleep(breaker.cooldown_s + 0.05)
+    probe = svc.submit("evil", poison_root)      # the half-open probe
+    try:
+        probe.result(timeout=RESULT_TIMEOUT_S)
+    except ValueError:
+        outcomes["poison_failed"] += 1
+    assert breaker.state(sig) == "open"          # failed probe re-opens
+
+    # -- plane-level fault: the scheduler loop dies, the supervisor
+    # restarts the worker and service continues
+    with faults.FaultInjector() as fi:
+        fi.flaky(_QS, "_pick", failures=1)
+        t = svc.submit("good", good())
+        t.result(timeout=RESULT_TIMEOUT_S)
+        outcomes["ok"] += 1
+        assert any(r.action == "raise" for r in fi.records)
+    assert svc.restarts >= 1, "service supervisor never restarted"
+
+    # -- delayed execution vs a queued query's deadline + cancel ------
+    with faults.FaultInjector() as fi:
+        fi.delay_on_call(plan_executor, "execute", seconds=delay_s,
+                         call_no=1)
+        slow = svc.submit("good", good())
+        t0 = time.perf_counter()
+        while not any(r.action == "delay" for r in fi.records):
+            assert time.perf_counter() - t0 < 30, "delay never fired"
+            time.sleep(0.002)
+        doomed = svc.submit("good", good(), deadline_s=deadline_s)
+        victim = svc.submit("good", good())
+        assert victim.cancel(), "queued query was not cancellable"
+        try:
+            victim.result(timeout=RESULT_TIMEOUT_S)
+            raise AssertionError("cancelled query returned a result")
+        except Cancelled:
+            outcomes["cancelled"] += 1
+        try:
+            doomed.result(timeout=RESULT_TIMEOUT_S)
+            raise AssertionError("deadline query returned a result")
+        except DeadlineExceeded as e:
+            assert e.stage in ("admission queue", "dispatch"), e.stage
+            outcomes["deadline"] += 1
+        slow.result(timeout=RESULT_TIMEOUT_S)    # the delayed one lands
+        outcomes["ok"] += 1
+
+    # the plane still serves after the whole gauntlet
+    for _ in range(n_queries // 2):
+        svc.submit("good", good()).result(timeout=RESULT_TIMEOUT_S)
+        outcomes["ok"] += 1
+    st = svc.stats()
+    svc.close(timeout=30.0)
+    assert st["tenants"]["good"]["completed"] == outcomes["ok"]
+    return {
+        "outcomes": outcomes,
+        "restarts": st["restarts"],
+        "breaker": st["breaker"],
+        "good_tenant_completed": st["tenants"]["good"]["completed"],
+        "no_hung_tickets": True,
+    }
+
+
+def run_campaign(checkpoint_dir: str, *, n_streams: int = 12,
+                 events_per_stream: int = 24, seed: int = 17,
+                 recovery_bound_s: float = 60.0) -> dict:
+    """Both planes, one report — the body of bench config 15
+    (``--only-chaos-serving``)."""
+    serving = run_serving_campaign(
+        checkpoint_dir, n_streams=n_streams,
+        events_per_stream=events_per_stream, seed=seed,
+        recovery_bound_s=recovery_bound_s)
+    service = run_service_campaign(seed=seed + 1)
+    serving["service"] = service
+    return serving

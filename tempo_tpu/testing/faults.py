@@ -7,11 +7,13 @@ the ``chaos``-marked test suite.  Three fault families, matching the
 
 * **call-site faults** — :class:`FaultInjector` patches a function on a
   module/object for the duration of a ``with`` block and makes the
-  first N calls fail (:meth:`FaultInjector.flaky`, transient-io) or
+  first N calls fail (:meth:`FaultInjector.flaky`, transient-io),
   raises :class:`SimulatedKill` on the Nth call
   (:meth:`FaultInjector.kill_on_call`, modelling SIGKILL mid-save — it
   derives from ``BaseException`` precisely so retry wrappers, which
-  catch ``Exception``, can never swallow it);
+  catch ``Exception``, can never swallow it), or sleeps before the Nth
+  call (:meth:`FaultInjector.delay_on_call`, the latency injection the
+  deadline plane's chaos coverage drives deterministically);
 * **artifact corruption** — :func:`corrupt_npz_array` (flip one byte
   inside a named npz member's data), :func:`flip_byte`,
   :func:`truncate_file` (a partially-flushed write);
@@ -30,6 +32,7 @@ import functools
 import os
 import shutil
 import struct
+import time
 import zipfile
 from typing import Callable, List, Optional
 
@@ -57,7 +60,7 @@ class InjectedFault(OSError):
 class InjectionRecord:
     target: str
     call_no: int
-    action: str          # "raise" | "kill" | "pass"
+    action: str          # "raise" | "kill" | "delay" | "pass"
 
 
 class FaultInjector:
@@ -140,6 +143,36 @@ class FaultInjector:
                     raise SimulatedKill(
                         f"simulated kill at {name} call #{call_no}")
                 self.records.append(InjectionRecord(name, state["n"], "pass"))
+                return original(*args, **kwargs)
+
+            return wrapper
+
+        return self._patch(obj, attr, make_wrapper)
+
+    def delay_on_call(self, obj, attr: str, seconds: float,
+                      call_no: int = 1, n_calls: int = 1,
+                      label: Optional[str] = None) -> "FaultInjector":
+        """Latency injection: sleep ``seconds`` before calls
+        ``call_no .. call_no + n_calls - 1`` to ``obj.attr``, then pass
+        through (other calls are untouched).  The deterministic lever
+        for the deadline plane: a delayed dispatch makes every tick
+        queued behind it overstay a budget chosen below ``seconds``,
+        so stage-named ``DeadlineExceeded`` paths are exercised without
+        racing a wall clock."""
+        name = self._name(obj, attr, label)
+        state = {"n": 0}
+
+        def make_wrapper(original):
+            @functools.wraps(original)
+            def wrapper(*args, **kwargs):
+                state["n"] += 1
+                if call_no <= state["n"] < call_no + n_calls:
+                    self.records.append(
+                        InjectionRecord(name, state["n"], "delay"))
+                    time.sleep(seconds)
+                else:
+                    self.records.append(
+                        InjectionRecord(name, state["n"], "pass"))
                 return original(*args, **kwargs)
 
             return wrapper

@@ -45,6 +45,7 @@ def test_bench_smoke_prints_one_json_line():
         "9_chunked_1m_single", "10_planned_chain",
         "11_serving_ticks_per_sec", "12_mesh_scaling_top",
         "13_query_service_qps", "14_fleet_serving_ticks_per_sec",
+        "15_chaos_serving_ticks_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -118,6 +119,35 @@ def test_bench_smoke_prints_one_json_line():
     assert cd.get("default_inputs") != cd.get("flipped_inputs"), cd
     assert "bitwise" in cd.get("value_audit", "")
     assert "bitwise" in qs.get("value_audit", "")
+    # config 15 (round 13): the fault-domain chaos campaign — every
+    # availability invariant asserted hard inside the campaign, its
+    # record keys pinned here so the driver-recorded line always
+    # carries the proof (no hung tickets, bounded recovery, zero
+    # recompiles after recovery, bitwise tails, diff-vs-full snapshot
+    # byte economics, and the query plane's gauntlet)
+    cs = rec.get("chaos_serving") or {}
+    assert cs.get("ticks_per_sec", 0) > 0, cs
+    assert cs.get("no_hung_tickets") is True
+    assert cs.get("zero_builds_after_recovery") is True
+    assert cs.get("recovery_s") is not None and cs["recovery_s"] < 60
+    inj = cs.get("injected") or {}
+    assert inj.get("kills", 0) >= 1 and inj.get("delays", 0) >= 1
+    assert inj.get("flaky", 0) >= 1 and inj.get("poison", 0) >= 1
+    out_c = cs.get("outcomes") or {}
+    assert out_c.get("deadline", 0) >= 1
+    assert out_c.get("quarantined", 0) >= 1
+    assert out_c.get("shutdown", 0) >= 1
+    assert cs.get("restarts", 0) >= 1
+    sb = cs.get("snapshot_bytes") or {}
+    assert sb.get("full") and sb.get("diff"), sb
+    assert 0 < sb.get("diff_vs_full", 1) < 1, sb
+    assert "bitwise" in cs.get("tail_audit", "")
+    svc_c = cs.get("service") or {}
+    assert svc_c.get("no_hung_tickets") is True
+    assert svc_c.get("restarts", 0) >= 1
+    so = svc_c.get("outcomes") or {}
+    assert so.get("quarantined", 0) >= 1
+    assert so.get("deadline", 0) >= 1 and so.get("cancelled", 0) >= 1
     # config 12 (round 10): the mesh-scaling sweep must have measured
     # every device count of its (smoke-clipped) ladder, each point with
     # the in-bench planned==eager bitwise audit and the per-stage comm

@@ -315,16 +315,25 @@ def test_in_bucket_series_growth_needs_no_migration():
 # Mesh sharding: zero per-push collectives, whole-state donation
 # ----------------------------------------------------------------------
 
-def test_sharded_cohort_compiled_contract():
+def test_sharded_cohort_compiled_contract(monkeypatch):
     """The fleet-scaling mechanism, asserted on the artifact: the
     mesh-sharded cohort step's compiled HLO contains ZERO collectives
-    and aliases every retired state buffer (whole-state donation)."""
+    and — where donation is enabled (accelerator backends; it is
+    backend-gated OFF on XLA:CPU, where the virtual-device host
+    platform corrupts donated serve buffers — ``donate_serve_steps``)
+    — aliases every retired state buffer (whole-state donation).
+    The donation half compiles here with the gate FORCED on
+    (``TEMPO_TPU_SERVE_DONATE=1``): the declaration must survive
+    lowering even on the CPU image, it is just not used there."""
     mesh = dist.stream_mesh()
     S = 2 * len(jax.devices())
     cfg = sst.StreamConfig(n_series=2, n_cols=C, skip_nulls=True,
                            max_lookback=4,
                            window_ns=sst.window_ns(9.0), rows_bound=4,
                            ema_alpha=0.2)
+    assert not sst.donate_serve_steps()     # CPU image: gated off
+    monkeypatch.setenv("TEMPO_TPU_SERVE_DONATE", "1")
+    assert sst.donate_serve_steps()
     fn, n_state = sst.cohort_push_jitted(cfg, S, 8, mesh)
     compiled = fn.lower(*sst.cohort_push_avals(cfg, S, 8)).compile()
     assert profiling.collective_counts_from_compiled(compiled) == {}
@@ -541,6 +550,100 @@ def test_cohort_kill_mid_push_resume_byte_identical(tmp_path):
             for key in want:
                 assert np.asarray(got[key]).tobytes() == \
                     np.asarray(want[key]).tobytes(), (s, key)
+
+
+@pytest.mark.chaos
+def test_executor_kill_mid_dispatch_resume_replays_byte_identical(
+        tmp_path):
+    """The cohort chaos case at the EXECUTOR layer: SimulatedKill
+    lands inside a dispatch driven by the CohortExecutor's worker
+    thread (the plane dies, every outstanding ticket resolves with a
+    named shutdown error), ``CohortExecutor.resume`` restores the
+    newest snapshot, the unacked tails replay through
+    ``submit_many``, and both the emissions and the per-stream
+    ``acked`` cursors land byte-identical to a twin plane that never
+    died."""
+    from tempo_tpu import resilience
+
+    rng = np.random.default_rng(31)
+    S, n_ev = 3, 30
+    evsets = [[e for e in _member_events(rng, 2, n_ev, False)
+               if e[1] == "right"] for _ in range(S)]
+
+    def ticks(s, lo, hi, members):
+        return [("right", members[s], members[s].series[e[0]], e[2],
+                 {c: np.float32(e[4][ci]) for ci, c in enumerate(COLS)},
+                 None)
+                for e in evsets[s][lo:hi]]
+
+    def mk(dir_=None, every=0):
+        cohort = StreamCohort(COLS, max_lookback=ML, **WINDOW,
+                              checkpoint_dir=dir_, ckpt_every=every,
+                              slots=4)
+        return cohort, [cohort.add_stream(f"m{s}",
+                                          [f"m{s}s0", f"m{s}s1"])
+                        for s in range(S)]
+
+    # golden twin: the same events through an executor that never dies
+    g_cohort, g_members = mk()
+    golden = [[] for _ in range(S)]
+    with CohortExecutor(g_cohort, coalesce_s=0.0) as gex:
+        for s in range(S):
+            for t in gex.submit_many(ticks(s, 0, len(evsets[s]),
+                                           g_members)):
+                golden[s].append(t.result(timeout=60))
+
+    parent = str(tmp_path / "ck")
+    cohort, members = mk(parent, every=9)
+    ex = CohortExecutor(cohort, coalesce_s=0.0)
+    live = [[] for _ in range(S)]
+    pos = [0] * S
+    # interleave per-stream chunks until the kill fires mid-dispatch
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(StreamCohort, "dispatch", call_no=11)
+        killed = False
+        while not killed and any(pos[s] < len(evsets[s])
+                                 for s in range(S)):
+            for s in range(S):
+                if pos[s] >= len(evsets[s]):
+                    continue
+                try:
+                    (tk,) = ex.submit_many(
+                        ticks(s, pos[s], pos[s] + 1, members))
+                except resilience.ShutdownError:
+                    killed = True
+                    break
+                try:
+                    live[s].append(tk.result(timeout=60))
+                    pos[s] += 1
+                except resilience.ShutdownError:
+                    killed = True
+                    break
+    assert killed and isinstance(ex.fatal, faults.SimulatedKill)
+    ex.close(timeout=5)
+
+    rex = CohortExecutor.resume(parent, coalesce_s=0.0)
+    acked = rex.cohort.acked
+    total = sum(acked.values())
+    assert 0 < total < sum(len(e) for e in evsets)
+    r_members = [rex.cohort.stream(f"m{s}") for s in range(S)]
+    with rex:
+        for s in range(S):
+            cur = acked[f"m{s}"]
+            assert cur <= pos[s]            # never ahead of the feeder
+            del live[s][cur:]               # the tail replays
+            for tk in rex.submit_many(
+                    ticks(s, cur, len(evsets[s]), r_members)):
+                live[s].append(tk.result(timeout=60))
+        # cursors: every stream fully acked, byte-identical emissions
+        for s in range(S):
+            assert r_members[s].acked == len(evsets[s])
+            assert len(live[s]) == len(golden[s])
+            for got, want in zip(live[s], golden[s]):
+                assert set(got) == set(want)
+                for key in want:
+                    assert np.asarray(got[key]).tobytes() == \
+                        np.asarray(want[key]).tobytes(), (s, key)
 
 
 # ----------------------------------------------------------------------

@@ -41,6 +41,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_service.py tests/test_cost.py \
     -q -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || exit $?
 
+echo "== chaos gate (fault-injection suite incl. the campaign smoke) =="
+# the fault-domain contracts, surfaced as their own gate before
+# tier-1: batch-side kill/corrupt/resume (test_chaos.py), the serving
+# + service fault domains (test_fault_domain.py — deadlines,
+# cancellation, supervised recovery, quarantine, differential
+# snapshot chains) and the chaos campaign smoke, plus the cohort
+# executor kill/resume case
+JAX_PLATFORMS=cpu python -m pytest tests -q -m 'chaos and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
