@@ -2345,6 +2345,59 @@ def bench_chaos_serving(seed=15):
     return rep
 
 
+def bench_chaos_pipeline(seed=16):
+    """Config 16 (--only-chaos-pipeline): the BATCH-plane fault-domain
+    chaos campaign (:func:`tempo_tpu.testing.chaos.
+    run_pipeline_campaign`) — the Parquet → resumable OOC ingest →
+    mesh → planned streaming AS-OF + packed-stats path driven to the
+    ROADMAP billion-row target (full mode: >= 1e9 cumulative rows
+    through the planned chain via the out-of-core slab sweep;
+    TEMPO_TPU_CHAOS_ROWS overrides; smoke-clipped in CI) under a
+    kill/corrupt/flaky schedule.  Asserted HARD inside the campaign
+    (a violation nulls the config, which the bench contract test
+    treats as failure):
+
+    * a mid-file ingest kill resumes from the per-shard progress
+      manifest without re-reading ONE committed shard, bitwise equal
+      to a fresh ingest;
+    * corrupt row groups / torn-write files are quarantined with the
+      exact ranges named; a flapping file trips its breaker instead
+      of burning the retry budget; the end-to-end deadline dies
+      stage-named;
+    * a kill between plan-placed checkpoint barriers resumes from the
+      newest intact SIGNED barrier — only post-barrier ops re-run,
+      zero new executables built, output bitwise == the eager twin;
+    * the slab sweep killed mid-run resumes from the newest barrier
+      with zero rebuilds and a final digest (per-slab CRCs of every
+      slab's full output bytes) bitwise == an uninjected twin;
+    * foreign state (other ingest config / other plan / other step
+      chain) is REFUSED by name, never silently restored.
+    """
+    import shutil
+    import tempfile
+
+    from tempo_tpu import config as tt_config
+    from tempo_tpu.testing import chaos
+
+    smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+    if smoke:
+        rows_total, physical, n_windows, ckpt_every = 240_000, 40_000, 3, 2
+    else:
+        rows_total = tt_config.get_int("TEMPO_TPU_CHAOS_ROWS",
+                                       1_000_000_000)
+        physical, n_windows, ckpt_every = 4_000_000, 8, 10
+    d = tempfile.mkdtemp(prefix="tempo_chaos_pipe_")
+    try:
+        rep = chaos.run_pipeline_campaign(
+            d, rows_total=rows_total, physical_rows=physical,
+            n_keys=16 if smoke else 32, seed=seed,
+            n_windows=n_windows, ckpt_every=ckpt_every,
+            recovery_bound_s=120.0)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return rep
+
+
 def bench_skew_1b(t_iter_fused, overlap=1.5):
     """Config 5: the 1B-row tsPartitionVal=10 skew-bracketed join.
 
@@ -2483,6 +2536,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-chaos-pipeline" in sys.argv:
+        res = _attempt("chaos_pipeline", bench_chaos_pipeline)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-mesh-scaling-one" in sys.argv:
         n = int(sys.argv[sys.argv.index("--only-mesh-scaling-one") + 1])
         res = _attempt("mesh_scaling_one", lambda: bench_mesh_scaling_one(n))
@@ -2583,6 +2642,20 @@ def main():
                                        "query_service", timeout=2400)
     chaos_serving = _config_subprocess("--only-chaos-serving",
                                        "chaos_serving", timeout=2400)
+    # config 16 needs a multi-device mesh for real shard-resume
+    # coverage; on the CPU backend the child forces virtual host
+    # devices exactly like the mesh-scaling sweep's children
+    chaos_pipe_env = dict(os.environ)
+    if jax.default_backend() == "cpu":
+        import re as _re
+
+        flags = _re.sub(r"--xla_force_host_platform_device_count=\d+",
+                        "", chaos_pipe_env.get("XLA_FLAGS", ""))
+        chaos_pipe_env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    chaos_pipeline = _config_subprocess("--only-chaos-pipeline",
+                                        "chaos_pipeline", timeout=2400,
+                                        env=chaos_pipe_env)
     mesh_scaling = _config_subprocess("--only-mesh-scaling",
                                       "mesh_scaling", timeout=7200)
     # three-way auto-pick crossover evidence: at the ~10 Hz density all
@@ -2712,6 +2785,14 @@ def main():
             "15_chaos_serving_ticks_per_sec": (
                 round(chaos_serving["ticks_per_sec"])
                 if chaos_serving else None),
+            # rows/sec sustained by the out-of-core slab sweep WHILE
+            # the batch-plane chaos campaign kills and resumes it
+            # (kill + resume + replay overhead in the wall clock); the
+            # record below carries the ingest-resume, quarantine,
+            # plan-barrier and foreign-refusal proofs
+            "16_chaos_pipeline_rows_per_sec": (
+                round(chaos_pipeline["rows_per_sec"])
+                if chaos_pipeline else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -2734,6 +2815,14 @@ def main():
         # byte economics, and the query plane's quarantine/deadline/
         # cancel/supervision gauntlet
         "chaos_serving": chaos_serving,
+        # config 16: the BATCH-plane chaos campaign — transactional
+        # ingest kill/resume (no committed shard re-read), row-group/
+        # torn-write quarantine with named ranges, stage-named ingest
+        # deadline, flapping-file breaker, plan-barrier kill/resume
+        # with zero rebuilds, the billion-row slab sweep resumed from
+        # the newest signed barrier, and every foreign-state restore
+        # refused by name — all bitwise vs uninjected twins
+        "chaos_pipeline": chaos_pipeline,
         # the user-facing API vs the raw fused kernel (VERDICT r5 #5):
         # within ~1.2x is the claim being measured
         "frame_e2e_vs_fused": (

@@ -86,6 +86,14 @@ def _file_crc(path: str, chunk: int = 1 << 20) -> int:
     return c & 0xFFFFFFFF
 
 
+#: Public faces of the checksum primitives: the ingest progress
+#: manifests (io/ingest.py) and the cohort snapshot chain verify with
+#: the SAME CRCs this module writes — one checksum discipline, not
+#: per-module reimplementations.
+array_crc = _array_crc
+file_crc = _file_crc
+
+
 @_IO_RETRY
 def _read_parquet(path: str) -> pd.DataFrame:
     return pd.read_parquet(path)
@@ -215,10 +223,17 @@ def _clean_stale_tmp(path: str) -> None:
     shutil.rmtree(tmp, ignore_errors=True)
 
 
-def save(frame, path: str, sharded: bool = False) -> None:
+def save(frame, path: str, sharded: bool = False,
+         meta: Optional[dict] = None) -> None:
     """Snapshot a :class:`DistributedTSDF` (or host :class:`TSDF`) to
     ``path`` (a directory).  Atomic: the directory appears fully
     written or not at all.
+
+    ``meta`` (JSON-serializable) rides in the manifest under ``"meta"``
+    — the step-checkpoint writers (:func:`tempo_tpu.resilience.
+    run_resumable`, the plan executor's barrier nodes) stamp the
+    pipeline/plan signature and the predecessor-manifest CRC there, and
+    :func:`resolve_step` refuses foreign state by name on resume.
 
     ``sharded=True`` (distributed frames): every process writes ONLY
     its addressable device shards to its own ``shard_p<i>.npz`` — no
@@ -257,17 +272,17 @@ def save(frame, path: str, sharded: bool = False) -> None:
     try:
         if isinstance(frame, DistributedTSDF):
             if sharded:
-                _save_dist_sharded(frame, tmp)
+                _save_dist_sharded(frame, tmp, meta)
             elif jax.process_count() > 1:
                 raise ValueError(
                     "multi-process checkpoints must use sharded=True "
                     "(the dense format fetches the global array)"
                 )
             else:
-                _save_dist(frame, tmp)
+                _save_dist(frame, tmp, meta)
         elif isinstance(frame, TSDF):
             if pid == 0:     # host frames are process-replicated state
-                _save_host(frame, tmp)
+                _save_host(frame, tmp, meta)
         else:
             raise TypeError(f"cannot checkpoint {type(frame)}")
         if jax.process_count() > 1:
@@ -478,18 +493,96 @@ def verify_checkpoint(path: str, verify_arrays: bool = True) -> dict:
     return man
 
 
+def manifest_crc(path: str) -> int:
+    """CRC-32 of a checkpoint's finalized ``manifest.json`` bytes — the
+    link value of the chained step manifests (each step records its
+    predecessor's manifest CRC; :func:`resolve_step` verifies the link
+    on resume, the same scheme the cohort differential snapshots use)."""
+    return _IO_RETRY(_file_crc)(os.path.join(path, "manifest.json"))
+
+
+def read_meta(path: str) -> dict:
+    """The caller-supplied ``meta`` dict stamped into a checkpoint's
+    manifest at save time (empty for pre-stamping checkpoints)."""
+    return _manifest(path).get("meta") or {}
+
+
+def resolve_step(parent: str, signature: Optional[str] = None,
+                 max_step: Optional[int] = None, verify: bool = True,
+                 below_step: Optional[int] = None
+                 ) -> Optional[Tuple[int, str, dict]]:
+    """``(step, path, manifest)`` of the newest step checkpoint under
+    ``parent`` that is *intact* (every CRC verifies), *ours*
+    (``signature`` matches the stamped ``pipeline_signature``) and
+    *chain-consistent* (its recorded predecessor-manifest CRC matches
+    the predecessor still on disk).  ``None`` when no usable step
+    exists.
+
+    Fallback vs refusal: corruption and broken chain links fall back to
+    the next-older candidate (an older intact checkpoint is the
+    recovery), but a *signature mismatch* raises
+    :class:`CheckpointError` by name — state stamped by a different
+    pipeline must never be silently restored into this one (the
+    foreign-resume hazard).  Unstamped (pre-signing) checkpoints are
+    restored with a warning for compatibility.
+
+    ``verify=False`` skips the per-array CRC pass here (cheap manifest
+    checks only) — callers that :func:`load` the result immediately
+    get the full verification there, once, and fall back by re-calling
+    with ``below_step=<failed step>`` (steps at or above it are
+    skipped silently: they were already tried)."""
+    for step_no, path in list_steps(parent):
+        if below_step is not None and step_no >= below_step:
+            continue
+        if max_step is not None and step_no > max_step:
+            logger.warning(
+                "resolve_step: ignoring checkpoint %s beyond the %d-step "
+                "pipeline (stale ckpt_dir?)", path, max_step,
+            )
+            continue
+        try:
+            man = verify_checkpoint(path, verify_arrays=verify)
+        except CheckpointError as e:
+            logger.warning(
+                "checkpoint %s unusable (%s); trying an older one", path, e)
+            continue
+        meta = man.get("meta") or {}
+        stamped = meta.get("pipeline_signature")
+        if signature is not None:
+            if stamped is None:
+                logger.warning(
+                    "checkpoint %s carries no pipeline signature "
+                    "(pre-signing format); restoring it unverified", path)
+            elif stamped != signature:
+                raise CheckpointError(
+                    f"checkpoint {path!r} was written by a DIFFERENT "
+                    f"pipeline: stamped signature {stamped!r} != submitted "
+                    f"{signature!r} — refusing to restore foreign state "
+                    f"(point ckpt_dir at this pipeline's own directory, "
+                    f"or clear it to recompute from scratch)",
+                    kind=FailureKind.PERMANENT,
+                )
+        prev_step = meta.get("prev_step")
+        prev_crc = meta.get("prev_manifest_crc")
+        if prev_step is not None and prev_crc is not None:
+            prev_path = os.path.join(parent, f"step_{int(prev_step):05d}")
+            if os.path.exists(os.path.join(prev_path, "manifest.json")) \
+                    and manifest_crc(prev_path) != int(prev_crc):
+                logger.warning(
+                    "checkpoint %s unusable (chained predecessor step %s "
+                    "manifest CRC mismatch — rewritten under it?); "
+                    "falling back to an older one", path, prev_step)
+                continue
+        return step_no, path, man
+    return None
+
+
 def latest(parent: str, verify: bool = True) -> Optional[str]:
     """Path of the newest *intact* step checkpoint under ``parent``
     (``None`` when there is none).  Corrupt or truncated candidates are
     skipped with a warning — resume falls back to the previous one."""
-    for _, path in list_steps(parent):
-        try:
-            verify_checkpoint(path, verify_arrays=verify)
-            return path
-        except CheckpointError as e:
-            logger.warning(
-                "checkpoint %s unusable (%s); trying an older one", path, e)
-    return None
+    hit = resolve_step(parent, verify=verify)
+    return hit[1] if hit is not None else None
 
 
 def prune(parent: str, keep_last: int = 2) -> None:
@@ -507,13 +600,14 @@ def prune(parent: str, keep_last: int = 2) -> None:
 # host TSDF
 # ----------------------------------------------------------------------
 
-def _save_host(tsdf, d: str) -> None:
+def _save_host(tsdf, d: str, meta: Optional[dict] = None) -> None:
     _write_parquet(tsdf.df, os.path.join(d, "host.parquet"))
     _write_manifest(d, {
         "kind": "host",
         "ts_col": tsdf.ts_col,
         "partition_cols": tsdf.partitionCols,
         "sequence_col": tsdf.sequence_col or None,
+        "meta": meta or {},
     })
 
 
@@ -529,7 +623,7 @@ def _load_host(d: str, man: dict):
 # DistributedTSDF
 # ----------------------------------------------------------------------
 
-def _save_dist(frame, d: str) -> None:
+def _save_dist(frame, d: str, meta: Optional[dict] = None) -> None:
     import jax.numpy as jnp
 
     names = list(frame.cols)
@@ -559,17 +653,17 @@ def _save_dist(frame, d: str) -> None:
         col = frame.cols[c]
         arrays[f"col_{i}_values"] = val_block[i]
         arrays[f"col_{i}_valid"] = ok_block[i] > 0.5
-        meta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
+        cmeta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
         if col.host_gather is not None:
             flat_vals, r_starts, perm = col.host_gather
             arrays[f"hg_{hg_idx}_vals"] = np.asarray(flat_vals, dtype=object) \
                 if flat_vals.dtype == object else flat_vals
             arrays[f"hg_{hg_idx}_starts"] = r_starts
             arrays[f"hg_{hg_idx}_perm"] = perm
-            meta["host_gather"] = hg_idx
-            meta["host_gather_len"] = int(len(flat_vals))
+            cmeta["host_gather"] = hg_idx
+            cmeta["host_gather_len"] = int(len(flat_vals))
             hg_idx += 1
-        col_meta[str(i)] = meta
+        col_meta[str(i)] = cmeta
     crcs = _savez(os.path.join(d, "arrays.npz"),
                   {k: v for k, v in arrays.items() if v.dtype != object})
     _write_host_side(frame, d,
@@ -578,7 +672,8 @@ def _save_dist(frame, d: str) -> None:
     man = _dist_manifest(frame)
     man.update({"kind": "dist", "columns": col_meta,
                 "n_cols": len(names),
-                "array_checksums": {"arrays.npz": crcs}})
+                "array_checksums": {"arrays.npz": crcs},
+                "meta": meta or {}})
     _write_manifest(d, man)
 
 
@@ -626,7 +721,7 @@ def _dist_manifest(frame) -> dict:
     }
 
 
-def _save_dist_sharded(frame, d: str) -> None:
+def _save_dist_sharded(frame, d: str, meta: Optional[dict] = None) -> None:
     """Per-process shard files: each device's addressable blocks of
     every plane, written by the process that holds them."""
     pid = jax.process_index()
@@ -641,16 +736,16 @@ def _save_dist_sharded(frame, d: str) -> None:
         col = frame.cols[c]
         planes[f"col_{i}_values"] = col.values
         planes[f"col_{i}_valid"] = col.valid
-        meta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
+        cmeta = {"name": c, "int64": col.int64, "ts_chunk": col.ts_chunk}
         if col.host_gather is not None:
             flat_vals, r_starts, perm = col.host_gather
             hg_arrays[f"hg_{hg_idx}_vals"] = flat_vals
             hg_arrays[f"hg_{hg_idx}_starts"] = r_starts
             hg_arrays[f"hg_{hg_idx}_perm"] = perm
-            meta["host_gather"] = hg_idx
-            meta["host_gather_len"] = int(len(flat_vals))
+            cmeta["host_gather"] = hg_idx
+            cmeta["host_gather_len"] = int(len(flat_vals))
             hg_idx += 1
-        col_meta[str(i)] = meta
+        col_meta[str(i)] = cmeta
 
     local = {}
     blocks = []
@@ -690,6 +785,7 @@ def _save_dist_sharded(frame, d: str) -> None:
             "shape": [int(s) for s in frame.ts.shape],
             "has_seq": frame.seq is not None,
             "array_checksums": {"host_arrays.npz": host_crcs},
+            "meta": meta or {},
         })
         _write_manifest(d, man)
 

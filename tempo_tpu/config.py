@@ -231,6 +231,26 @@ KNOBS: Dict[str, Knob] = _knobs(
          "written, chained to the last full artifact by CRC'd "
          "manifests (resume walks the chain; bytes per snapshot "
          "scale with dirty state, not fleet size)"),
+    Knob("TEMPO_TPU_CKPT_PLACEMENT", "enum(auto|off)", "auto",
+         "tempo_tpu/plan/checkpoints",
+         "placement of first-class checkpoint barrier nodes on "
+         "planned chains run inside plan.checkpoints.checkpointed(): "
+         "auto places signed step barriers at materialization/reshard "
+         "boundaries (every-th op boundary + the final pre-collect "
+         "frame); off disables plan barriers (run_resumable keeps "
+         "working)"),
+    Knob("TEMPO_TPU_INGEST_DEADLINE_S", "float", None,
+         "tempo_tpu/io/ingest",
+         "default end-to-end deadline (seconds) for from_parquet: ONE "
+         "wall-clock budget across validation, census and every "
+         "streaming/placement stage, dying with a stage-named "
+         "DeadlineExceeded; unset/0 = no deadline (the per-call "
+         "retry-policy deadlines still bound individual IO retries)"),
+    Knob("TEMPO_TPU_CHAOS_ROWS", "int", None, "bench.py",
+         "row target of bench config 16's batch-plane chaos campaign "
+         "(--only-chaos-pipeline) in full mode; unset = 1e9 (the "
+         "ROADMAP billion-row out-of-core sweep), smoke mode ignores "
+         "it"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

@@ -24,6 +24,30 @@ time.  ``budget_bytes`` enforces it — ingest *fails loudly* rather
 than silently ballooning past the cap (the test runs a dataset >= 2x
 the cap to prove the path really streams).
 
+Transactional ingest (the batch-plane fault domain):
+
+* **per-shard progress manifests** — ``resume_dir`` makes the ingest
+  resumable mid-run: the key census and every completed series shard's
+  packed host blocks are persisted (CRC'd, atomic) as they finish, and
+  a restarted ingest re-streams ONLY the shards that never committed —
+  completed shards come back from their manifests without re-reading a
+  byte of Parquet.  A resume directory stamped by a different
+  (dataset, schema, mesh) ingest is refused by name
+  (:class:`~tempo_tpu.resilience.CheckpointError`);
+* **row-group quarantine** — a corrupt row group (or a torn/unreadable
+  file) no longer aborts the whole ingest opaquely: the range is
+  quarantined and either reported in ONE named
+  :class:`CorruptRowGroupError` listing every quarantined range
+  (``on_corrupt="raise"``, the default) or skipped with a warning and
+  recorded on the returned frame (``on_corrupt="quarantine"``);
+* **one end-to-end deadline** — ``deadline_s`` (default
+  ``TEMPO_TPU_INGEST_DEADLINE_S``) is ONE wall-clock budget across
+  validation, census, every shard stream and device placement, dying
+  with a stage-named :class:`~tempo_tpu.resilience.DeadlineExceeded`;
+* **per-file circuit breaker** — ``breaker`` quarantines a flapping
+  file after ``TEMPO_TPU_BREAKER_THRESHOLD`` consecutive failures
+  instead of letting it burn the whole pass's retry budget.
+
 Non-numeric columns cannot ride an out-of-core frame (they would need
 host materialisation) and are skipped with a log notice; sequence
 columns are not supported here.
@@ -31,8 +55,13 @@ columns are not supported here.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import logging
-from typing import Dict, List, Optional, Tuple
+import os
+import zipfile
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
@@ -42,14 +71,120 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tempo_tpu import packing, resilience
+from tempo_tpu.resilience import CheckpointError, FailureKind
 
 logger = logging.getLogger(__name__)
 
+_RESUME_FORMAT = 1
 
-def _dataset(path: str):
+
+class CorruptRowGroupError(RuntimeError):
+    """Parquet data corruption found during ingest, with every
+    quarantined range listed: ``ranges`` is a tuple of dicts
+    ``{"file", "row_group", "rows", "reason"}`` (``row_group`` None =
+    the whole file is unreadable).  Self-describes as
+    ``CORRUPTED_ARTIFACT`` for :func:`tempo_tpu.resilience.classify` —
+    re-reading corrupt bytes is never the recovery."""
+
+    failure_kind = FailureKind.CORRUPTED_ARTIFACT
+
+    def __init__(self, message: str, ranges: Sequence[dict] = ()):
+        super().__init__(message)
+        self.ranges = tuple(ranges)
+
+
+@dataclasses.dataclass
+class _IngestCtx:
+    """Fault-domain state threaded through both streaming passes: the
+    one end-to-end deadline, the per-file circuit breaker, and the
+    quarantine ledger (frozen across passes — a range quarantined
+    during the census stays skipped in the shard pass, so the packed
+    layout can never see rows the census did not count)."""
+
+    deadline: Optional[resilience.Deadline] = None
+    breaker: Optional[resilience.CircuitBreaker] = None
+    on_corrupt: str = "raise"
+    quarantined: List[dict] = dataclasses.field(default_factory=list)
+    skip: set = dataclasses.field(default_factory=set)
+
+    def check(self, stage: str) -> None:
+        if self.deadline is not None:
+            self.deadline.check(stage)
+
+    def quarantine(self, path: str, row_group: Optional[int],
+                   rows: Optional[int], reason: str) -> None:
+        key = (path, row_group)
+        if key in self.skip:
+            return
+        self.skip.add(key)
+        self.quarantined.append({
+            "file": path, "row_group": row_group, "rows": rows,
+            "reason": reason,
+        })
+        logger.warning(
+            "from_parquet: quarantined %s%s (%s)", path,
+            "" if row_group is None else f" row group {row_group}",
+            reason)
+
+    def ledger_crc(self) -> int:
+        """CRC-32 of the current quarantine ledger's key set — stamped
+        into every committed shard manifest, so a resume can tell a
+        shard packed under a DIFFERENT ledger (rows included that are
+        now quarantined, or vice versa) from a current one."""
+        import zlib
+
+        # key=repr: the skip set mixes int and None row-group slots,
+        # which plain tuple comparison cannot order
+        return zlib.crc32(
+            repr(sorted(self.skip, key=repr)).encode()) & 0xFFFFFFFF
+
+    def raise_if_corrupt(self) -> None:
+        """``on_corrupt="raise"``: surface ONE named error listing
+        every quarantined range instead of an opaque mid-stream
+        abort."""
+        if self.on_corrupt == "raise" and self.quarantined:
+            lst = "; ".join(
+                f"{q['file']}"
+                + ("" if q["row_group"] is None
+                   else f"[rg {q['row_group']}]")
+                + f": {q['reason']}" for q in self.quarantined)
+            raise CorruptRowGroupError(
+                f"from_parquet: {len(self.quarantined)} corrupt/"
+                f"unreadable range(s) quarantined — {lst}.  Pass "
+                f"on_corrupt='quarantine' to ingest around them "
+                f"(the skipped ranges are recorded on the frame).",
+                ranges=self.quarantined)
+
+
+def _dataset(path: str, ctx: Optional[_IngestCtx] = None):
     import pyarrow.dataset as pads
 
-    return pads.dataset(path, partitioning="hive")
+    try:
+        return pads.dataset(path, partitioning="hive")
+    except (OSError, ValueError) as e:
+        # discovery itself reads footers: a torn-write file (footer
+        # magic gone) fails the whole dataset open before any
+        # row-group quarantine can act.  Re-discover excluding
+        # unreadable files and quarantine exactly the excluded set.
+        if ctx is None or resilience.classify(e) is FailureKind.TRANSIENT_IO:
+            raise
+        ds = pads.dataset(path, partitioning="hive",
+                          exclude_invalid_files=True)
+        present = set(getattr(ds, "files", ()) or ())
+        if present:
+            on_disk = []
+            for root, _dirs, files in os.walk(path):
+                for f in files:
+                    if not f.startswith(("_", ".")):
+                        on_disk.append(os.path.join(root, f))
+            for missing in sorted(set(on_disk) - present):
+                ctx.quarantine(
+                    missing, None, None,
+                    f"unreadable file (torn write? footer does not "
+                    f"parse): excluded at dataset discovery ({e})")
+        if not ctx.quarantined:
+            raise       # discovery failed for a reason we cannot name
+        return ds
 
 
 def _validate_dataset(ds, path: str, ts_col: str,
@@ -64,18 +199,108 @@ def _validate_dataset(ds, path: str, ts_col: str,
             f"{', '.join(repr(c) for c in missing)}; schema columns are "
             f"{sorted(names)}"
         )
-    if ds.count_rows() == 0:
+    try:
+        n_rows = ds.count_rows()
+    except (OSError, ValueError) as e:
+        # metadata of some file is unreadable (torn footer): the
+        # census pass quarantines it range-by-range; the empty check
+        # just cannot run early
+        logger.warning(
+            "from_parquet: count_rows failed (%s); deferring the "
+            "empty-dataset check to the census pass", e)
+        return
+    if n_rows == 0:
         raise ValueError(
             f"from_parquet: dataset at {path!r} is empty (0 rows) — "
             "nothing to pack"
         )
 
 
-def _census(ds, ts_col: str, partition_cols: List[str], batch_rows: int):
+def _scan_fragment(frag, schema, columns, filt, batch_rows):
+    """One scanner over one (row-group) fragment — module-level so the
+    fault injectors and the flapping-file chaos phases can patch it."""
+    import pyarrow.dataset as pads
+
+    return pads.Scanner.from_fragment(
+        frag, schema=schema, columns=columns, filter=filt,
+        batch_size=batch_rows,
+    ).to_batches()
+
+
+def _iter_batches(ds, columns, filt, batch_rows, ctx: _IngestCtx,
+                  stage: str):
+    """Stream record batches row-group by row-group with the
+    fault-domain contracts applied: the deadline is checked per batch
+    (stage-named), transient IO errors re-raise (the pass-level retry
+    wrapper owns them) after feeding the per-file breaker, an OPEN
+    breaker quarantines the file instead of burning further attempts,
+    and non-transient read failures quarantine exactly the corrupt
+    row group (or the whole file when its footer is unreadable)."""
+    ctx.check(stage)
+    for frag in ds.get_fragments():
+        path = getattr(frag, "path", "<fragment>")
+        if (path, None) in ctx.skip:
+            continue
+        if ctx.breaker is not None:
+            try:
+                ctx.breaker.allow(path, label="ingest file")
+            except resilience.QuarantinedError as e:
+                ctx.quarantine(
+                    path, None, None,
+                    f"circuit breaker open after repeated failures "
+                    f"({e})")
+                continue
+        try:
+            rg_frags = list(frag.split_by_row_group())
+        except (OSError, ValueError) as e:
+            kind = resilience.classify(e)
+            if kind is FailureKind.DEADLINE:
+                raise           # a dead budget is never "corruption"
+            if kind is FailureKind.TRANSIENT_IO:
+                if ctx.breaker is not None:
+                    ctx.breaker.record(path, False)
+                raise
+            ctx.quarantine(path, None, None,
+                           f"unreadable file metadata: {e}")
+            continue
+        file_ok = True
+        for rg in rg_frags:
+            rg_id = rg.row_groups[0].id if rg.row_groups else None
+            if (path, rg_id) in ctx.skip:
+                continue
+            try:
+                for batch in _scan_fragment(rg, ds.schema, columns,
+                                            filt, batch_rows):
+                    ctx.check(stage)
+                    yield batch
+            except (OSError, ValueError) as e:
+                kind = resilience.classify(e)
+                if kind is FailureKind.DEADLINE:
+                    # the per-batch ctx.check fired inside this try
+                    # (DeadlineExceeded IS an OSError via TimeoutError)
+                    # — quarantining readable data as corrupt because
+                    # the BUDGET died would be silent data loss
+                    raise
+                if kind is FailureKind.TRANSIENT_IO:
+                    file_ok = False
+                    if ctx.breaker is not None:
+                        ctx.breaker.record(path, False)
+                    raise
+                rows = rg.row_groups[0].num_rows if rg.row_groups \
+                    else None
+                ctx.quarantine(path, rg_id, rows,
+                               f"corrupt row group: {e}")
+        if file_ok and ctx.breaker is not None:
+            ctx.breaker.record(path, True)
+
+
+def _census(ds, ts_col: str, partition_cols: List[str], batch_rows: int,
+            ctx: Optional[_IngestCtx] = None):
     """Pass 1: per-key row counts + global max series length."""
+    ctx = ctx or _IngestCtx()
     counts: Dict[Tuple, int] = {}
-    for batch in ds.to_batches(columns=partition_cols + [ts_col],
-                               batch_size=batch_rows):
+    for batch in _iter_batches(ds, partition_cols + [ts_col], None,
+                               batch_rows, ctx, stage="census"):
         if batch.num_rows == 0:
             continue
         dfb = batch.to_pandas()
@@ -130,6 +355,10 @@ def from_parquet(
     budget_bytes: Optional[int] = None,
     halo_fraction: float = 0.5,
     retry_policy: Optional["resilience.RetryPolicy"] = None,
+    deadline_s=None,
+    resume_dir: Optional[str] = None,
+    on_corrupt: str = "raise",
+    breaker: Optional["resilience.CircuitBreaker"] = None,
 ):
     """Stream a Parquet dataset into a :class:`DistributedTSDF` with
     bounded host memory (see module docstring).
@@ -138,20 +367,76 @@ def from_parquet(
     network filesystems, connection resets) are retried at pass
     granularity under ``retry_policy`` (default
     :data:`tempo_tpu.resilience.DEFAULT_IO_POLICY`); budget violations
-    and schema errors are permanent and surface immediately."""
+    and schema errors are permanent and surface immediately.
+
+    Fault-domain parameters (module docstring "Transactional ingest"):
+    ``deadline_s`` (one stage-named wall-clock budget end to end;
+    defaults to ``TEMPO_TPU_INGEST_DEADLINE_S``; a live
+    :class:`~tempo_tpu.resilience.Deadline` is accepted too),
+    ``resume_dir`` (per-shard CRC'd progress manifests: a killed ingest
+    restarted with the same directory re-streams only uncommitted
+    shards), ``on_corrupt`` (``"raise"``: one named
+    :class:`CorruptRowGroupError` listing every quarantined range;
+    ``"quarantine"``: skip + record on ``frame.ingest_quarantined``),
+    and ``breaker`` (per-file circuit breaker: a flapping file is
+    quarantined instead of burning the retry budget)."""
+    from tempo_tpu import config
     from tempo_tpu.dist import DistCol, DistributedTSDF
     from tempo_tpu.parallel.mesh import make_mesh
 
+    if on_corrupt not in ("raise", "quarantine"):
+        raise ValueError(
+            f"on_corrupt must be 'raise' or 'quarantine', got "
+            f"{on_corrupt!r}")
     pcols = list(partition_cols or [])
     mesh = mesh if mesh is not None else make_mesh()
     n_s = mesh.shape[series_axis]
     n_t = mesh.shape[time_axis] if time_axis else 1
 
+    if deadline_s is None:
+        deadline_s = config.get_float("TEMPO_TPU_INGEST_DEADLINE_S")
+    ctx = _IngestCtx(
+        deadline=resilience.Deadline.after(deadline_s),
+        breaker=breaker, on_corrupt=on_corrupt,
+    )
     retry = resilience.retrying(
         retry_policy or resilience.DEFAULT_IO_POLICY, label="parquet-ingest")
-    ds = retry(_dataset)(path)
+    ctx.check("dataset open")
+    ds = retry(_dataset)(path, ctx)
+    ctx.raise_if_corrupt()
+    ctx.check("validation")
     _validate_dataset(ds, path, ts_col, pcols)
-    key_frame, lengths = retry(_census)(ds, ts_col, pcols, batch_rows)
+
+    resume = None
+    if resume_dir is not None:
+        resume = _ResumeLog(resume_dir, _resume_signature(
+            path, ts_col, pcols, columns, mesh, series_axis, time_axis))
+        resume.open(ctx)
+    cached = resume.load_census() if resume is not None else None
+    if cached is not None:
+        key_frame, lengths = cached
+        # the frozen quarantine ledger travels with the census: pass 2
+        # of a resumed run must skip exactly what pass 1 skipped, or
+        # rows the census never counted would overflow the layout
+        for q in resume.census_quarantine():
+            ctx.quarantine(q["file"], q.get("row_group"), q.get("rows"),
+                           q["reason"])
+        ctx.raise_if_corrupt()
+        logger.info(
+            "from_parquet: census restored from %s (%d keys, no "
+            "Parquet re-read)", resume_dir, len(lengths))
+    else:
+        key_frame, lengths = retry(_census)(ds, ts_col, pcols,
+                                            batch_rows, ctx)
+        ctx.raise_if_corrupt()
+        if int(lengths.sum()) == 0:
+            raise ValueError(
+                f"from_parquet: dataset at {path!r} is empty"
+                + (f" after quarantining {len(ctx.quarantined)} "
+                   f"range(s)" if ctx.quarantined else " (0 rows)")
+                + " — nothing to pack")
+        if resume is not None:
+            resume.save_census(key_frame, lengths, ctx)
     K = len(lengths)
     k_mult = n_s * n_t
     K_dev = max(1, -(-K // k_mult)) * k_mult
@@ -183,82 +468,171 @@ def from_parquet(
     spec = P(*([series_axis, time_axis] if time_axis else [series_axis, None]))
     sharding = NamedSharding(mesh, spec)
 
-    # per-column per-device block lists, filled shard by shard
-    blocks: Dict[str, List] = {"__ts__": [], "__mask__": []}
-    for c in num_cols:
-        blocks[c] = []
-        blocks[c + "/valid"] = []
-
     import pyarrow.compute as pc
 
     read_cols = pcols + [ts_col] + num_cols
-    for si in range(n_s):
-        k0, k1 = si * blk, min((si + 1) * blk, K)
-        if k1 <= k0:
-            # padding shard past the real key range: all-pad blocks
-            _scatter_shard(blocks["__ts__"],
-                           np.full((blk, L), packing.TS_PAD, np.int64),
-                           order[si], Lt)
-            _scatter_shard(blocks["__mask__"],
-                           np.zeros((blk, L), np.bool_), order[si], Lt)
-            for c in num_cols:
-                _scatter_shard(blocks[c], np.full((blk, L), np.nan, dt),
-                               order[si], Lt)
-                _scatter_shard(blocks[c + "/valid"],
-                               np.zeros((blk, L), np.bool_), order[si], Lt)
-            continue
-        shard_keys = key_frame.iloc[k0:k1] if pcols else None
-        # stream this shard's rows: pushdown on the first partition col
-        filt = None
-        if pcols:
-            vals = shard_keys[pcols[0]].unique().tolist()
-            filt = pc.field(pcols[0]).isin(vals)
-        shard_df = retry(_stream_shard)(
-            ds, read_cols, batch_rows, filt, shard_keys, pcols,
-            budget_bytes, si,
-        )
 
-        # local layout for this shard's keys (ids relative to k0)
-        if pcols and len(shard_df):
-            kid = shard_df.merge(
-                shard_keys.reset_index().rename(columns={"index": "__kid__"}),
-                on=pcols, how="left",
-            )["__kid__"].to_numpy(np.int64) - k0
-        else:
-            kid = np.zeros(len(shard_df), dtype=np.int64)
-        ts_ns = (
-            packing.series_to_ns(shard_df[ts_col])
-            if len(shard_df) else np.zeros(0, np.int64)
-        )
-        order_idx = np.lexsort((ts_ns, kid))
-        kid, ts_ns = kid[order_idx], ts_ns[order_idx]
-        starts = np.zeros(blk + 1, dtype=np.int64)
-        np.cumsum(np.bincount(kid, minlength=blk), out=starts[1:])
-        pos = np.arange(len(kid), dtype=np.int64) - starts[kid]
-
-        def pack(vals, fill, dtype):
-            out = np.full((blk, L), fill, dtype=dtype)
-            if len(vals):
-                out[kid, pos] = vals
-            return out
-
-        local_lens = starts[1:] - starts[:-1]
-        ts_p = pack(ts_ns, packing.TS_PAD, np.int64)
-        mask_p = np.arange(L)[None, :] < local_lens[:, None]
-        _scatter_shard(blocks["__ts__"], ts_p, order[si], Lt)
-        _scatter_shard(blocks["__mask__"], mask_p, order[si], Lt)
+    def run_shard_pass(use_manifests: bool):
+        # per-column per-device block lists, filled shard by shard
+        blocks: Dict[str, List] = {"__ts__": [], "__mask__": []}
         for c in num_cols:
-            raw = (
-                pd.to_numeric(shard_df[c], errors="coerce")
-                .to_numpy(np.float64)[order_idx]
-                if len(shard_df) else np.zeros(0, np.float64)
+            blocks[c] = []
+            blocks[c + "/valid"] = []
+        shards_restored = 0
+        # per-key row counts as actually PACKED (quarantine may have
+        # removed rows the census counted; the layout must not lie)
+        true_lengths = np.zeros(K, dtype=np.int64)
+        for si in range(n_s):
+            ctx.check(f"shard {si} stream")
+            k0, k1 = si * blk, min((si + 1) * blk, K)
+            if k1 <= k0:
+                # padding shard past the real key range: all-pad blocks
+                _scatter_shard(blocks["__ts__"],
+                               np.full((blk, L), packing.TS_PAD, np.int64),
+                               order[si], Lt)
+                _scatter_shard(blocks["__mask__"],
+                               np.zeros((blk, L), np.bool_), order[si], Lt)
+                for c in num_cols:
+                    _scatter_shard(blocks[c],
+                                   np.full((blk, L), np.nan, dt),
+                                   order[si], Lt)
+                    _scatter_shard(blocks[c + "/valid"],
+                                   np.zeros((blk, L), np.bool_),
+                                   order[si], Lt)
+                continue
+            if use_manifests and resume is not None:
+                planes = resume.load_shard(si, num_cols, (blk, L),
+                                           ledger_crc=ctx.ledger_crc())
+                if planes is not None:
+                    _scatter_shard(blocks["__ts__"], planes["__ts__"],
+                                   order[si], Lt)
+                    _scatter_shard(blocks["__mask__"], planes["__mask__"],
+                                   order[si], Lt)
+                    for c in num_cols:
+                        _scatter_shard(blocks[c], planes[c],
+                                       order[si], Lt)
+                        _scatter_shard(blocks[c + "/valid"],
+                                       planes[c + "/valid"],
+                                       order[si], Lt)
+                    true_lengths[k0:k1] = \
+                        planes["__mask__"].sum(axis=1)[: k1 - k0]
+                    shards_restored += 1
+                    continue
+            shard_keys = key_frame.iloc[k0:k1] if pcols else None
+            # stream this shard's rows: pushdown on the first
+            # partition col
+            filt = None
+            if pcols:
+                vals = shard_keys[pcols[0]].unique().tolist()
+                filt = pc.field(pcols[0]).isin(vals)
+            shard_df = retry(_stream_shard)(
+                ds, read_cols, batch_rows, filt, shard_keys, pcols,
+                budget_bytes, si, ctx,
             )
-            valid = ~np.isnan(raw)
-            _scatter_shard(blocks[c], pack(raw.astype(dt), np.nan, dt),
-                           order[si], Lt)
-            _scatter_shard(blocks[c + "/valid"],
-                           pack(valid, False, np.bool_), order[si], Lt)
-        del shard_df
+
+            # local layout for this shard's keys (ids relative to k0)
+            if pcols and len(shard_df):
+                kid = shard_df.merge(
+                    shard_keys.reset_index().rename(
+                        columns={"index": "__kid__"}),
+                    on=pcols, how="left",
+                )["__kid__"].to_numpy(np.int64) - k0
+            else:
+                kid = np.zeros(len(shard_df), dtype=np.int64)
+            ts_ns = (
+                packing.series_to_ns(shard_df[ts_col])
+                if len(shard_df) else np.zeros(0, np.int64)
+            )
+            order_idx = np.lexsort((ts_ns, kid))
+            kid, ts_ns = kid[order_idx], ts_ns[order_idx]
+            starts = np.zeros(blk + 1, dtype=np.int64)
+            np.cumsum(np.bincount(kid, minlength=blk), out=starts[1:])
+            pos = np.arange(len(kid), dtype=np.int64) - starts[kid]
+            overflow = pos >= L
+            if overflow.any():
+                # defensive: rows the census never counted (e.g. a file
+                # probed back to life after pass-1 quarantined it)
+                # cannot fit the padded layout — drop them loudly
+                # rather than corrupt neighbouring series
+                logger.warning(
+                    "from_parquet: shard %d holds %d row(s) beyond the "
+                    "census length L=%d (rows the census pass never "
+                    "counted); dropping them", si, int(overflow.sum()),
+                    L)
+                keep = ~overflow
+                kid, ts_ns, pos = kid[keep], ts_ns[keep], pos[keep]
+                order_idx = order_idx[keep]
+                starts = np.zeros(blk + 1, dtype=np.int64)
+                np.cumsum(np.bincount(kid, minlength=blk),
+                          out=starts[1:])
+
+            def pack(vals, fill, dtype):
+                out = np.full((blk, L), fill, dtype=dtype)
+                if len(vals):
+                    out[kid, pos] = vals
+                return out
+
+            local_lens = starts[1:] - starts[:-1]
+            true_lengths[k0:k1] = local_lens[: k1 - k0]
+            ts_p = pack(ts_ns, packing.TS_PAD, np.int64)
+            mask_p = np.arange(L)[None, :] < local_lens[:, None]
+            shard_planes = {"__ts__": ts_p, "__mask__": mask_p}
+            _scatter_shard(blocks["__ts__"], ts_p, order[si], Lt)
+            _scatter_shard(blocks["__mask__"], mask_p, order[si], Lt)
+            for c in num_cols:
+                raw = (
+                    pd.to_numeric(shard_df[c], errors="coerce")
+                    .to_numpy(np.float64)[order_idx]
+                    if len(shard_df) else np.zeros(0, np.float64)
+                )
+                valid = ~np.isnan(raw)
+                vals_p = pack(raw.astype(dt), np.nan, dt)
+                ok_p = pack(valid, False, np.bool_)
+                shard_planes[c] = vals_p
+                shard_planes[c + "/valid"] = ok_p
+                _scatter_shard(blocks[c], vals_p, order[si], Lt)
+                _scatter_shard(blocks[c + "/valid"], ok_p, order[si], Lt)
+            if resume is not None:
+                resume.save_shard(si, shard_planes, int(len(shard_df)),
+                                  ledger_crc=ctx.ledger_crc())
+            del shard_df
+        return blocks, shards_restored, true_lengths
+
+    passes = 0
+    while True:
+        q_mark = len(ctx.quarantined)
+        blocks, shards_restored, true_lengths = run_shard_pass(
+            use_manifests=passes == 0)
+        passes += 1
+        if len(ctx.quarantined) == q_mark or ctx.on_corrupt != "quarantine":
+            break       # raise mode surfaces growth via raise_if_corrupt
+        if passes >= 3:
+            raise CorruptRowGroupError(
+                f"from_parquet: the quarantine kept growing across "
+                f"{passes} shard-pass restarts ({len(ctx.quarantined)} "
+                f"range(s)) — refusing to return a partially-ingested "
+                f"frame", ranges=ctx.quarantined)
+        # a range quarantined mid-pass (breaker trip, corruption that
+        # only surfaced while streaming shards) leaves EARLIER shards
+        # holding its rows while later ones lost them — re-stream every
+        # shard under the now-frozen ledger (manifests bypassed: the
+        # ones just written contain the quarantined rows)
+        logger.warning(
+            "from_parquet: %d new range(s) quarantined while streaming "
+            "shards; re-streaming every shard under the frozen ledger "
+            "for a consistent frame", len(ctx.quarantined) - q_mark)
+
+    ctx.raise_if_corrupt()
+    ctx.check("device placement")
+    if resume is not None and ctx.quarantined:
+        # future resumes must expect the FINAL ledger (shards stamped
+        # under an older one are invalidated on load)
+        resume.update_quarantine(ctx)
+    if shards_restored:
+        logger.info(
+            "from_parquet: %d/%d shard(s) restored from the progress "
+            "manifest at %s (no Parquet re-read)", shards_restored, n_s,
+            resume_dir)
 
     def assemble(name):
         shape = (K_dev, L)
@@ -272,16 +646,28 @@ def from_parquet(
         c: DistCol(assemble(c), assemble(c + "/valid")) for c in num_cols
     }
 
+    # layout lengths come from what was actually PACKED, not the
+    # census: quarantine may have removed rows mid-shard-pass, and a
+    # layout that counts vanished rows would lie to every consumer
     layout = packing.FlatLayout(
         key_ids=np.zeros(0, np.int64), ts_ns=np.zeros(0, np.int64),
         order=np.zeros(0, np.int64),
-        starts=np.concatenate([[0], np.cumsum(lengths)]).astype(np.int64),
+        starts=np.concatenate(
+            [[0], np.cumsum(true_lengths)]).astype(np.int64),
         key_frame=key_frame,
     )
+    audits = []
+    if ctx.quarantined:
+        audits.append((
+            "ingest: corrupt/unreadable Parquet ranges quarantined "
+            "(frame.ingest_quarantined lists them)",
+            np.int64(len(ctx.quarantined))))
     frame = DistributedTSDF(
         mesh, series_axis, time_axis, ts_d, mask_d, cols, layout, ts_col,
         pcols, np.dtype("datetime64[ns]"), None, {}, halo_fraction,
+        audits=audits,
     )
+    frame.ingest_quarantined = tuple(ctx.quarantined)
     # count as one logical pack event for the residency accounting
     from tempo_tpu import dist as dist_mod
 
@@ -291,14 +677,16 @@ def from_parquet(
 
 def _stream_shard(ds, read_cols: List[str], batch_rows: int, filt,
                   shard_keys, pcols: List[str],
-                  budget_bytes: Optional[int], si: int) -> pd.DataFrame:
+                  budget_bytes: Optional[int], si: int,
+                  ctx: Optional[_IngestCtx] = None) -> pd.DataFrame:
     """Pass 2 unit of work: stream one series shard's row batches into
     a host frame.  Pure read (local ``parts`` rebuilt on every call),
     so the caller can retry it wholesale on transient IO faults."""
+    ctx = ctx or _IngestCtx()
     parts = []
     held = 0
-    for batch in ds.to_batches(columns=read_cols, batch_size=batch_rows,
-                               filter=filt):
+    for batch in _iter_batches(ds, read_cols, filt, batch_rows, ctx,
+                               stage=f"shard {si} stream"):
         if batch.num_rows == 0:
             continue
         dfb = batch.to_pandas()
@@ -330,3 +718,239 @@ def _scatter_shard(sink: List, host_block: np.ndarray, dev_row, Lt: int):
         sink.append(
             jax.device_put(host_block[:, ti * Lt:(ti + 1) * Lt], dev)
         )
+
+
+# ----------------------------------------------------------------------
+# Transactional resume: per-shard progress manifests
+# ----------------------------------------------------------------------
+
+def _dataset_file_state(path: str) -> tuple:
+    """(relpath, size, mtime_ns) of every data file under ``path`` —
+    the cheap content fingerprint of the SOURCE.  Committed shard
+    manifests hold packed rows of the dataset *as it was*; if the
+    upstream writer rewrites a file between the kill and the resume,
+    restoring them would silently stitch old and new data together —
+    the same stale-restore hazard the plan barriers fingerprint their
+    sources against."""
+    if not os.path.isdir(path):
+        st = os.stat(path)
+        return ((os.path.basename(path), st.st_size, st.st_mtime_ns),)
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f.startswith(("_", ".")):
+                continue
+            fp = os.path.join(root, f)
+            st = os.stat(fp)
+            out.append((os.path.relpath(fp, path), st.st_size,
+                        st.st_mtime_ns))
+    return tuple(sorted(out))
+
+
+def _resume_signature(path, ts_col, pcols, columns, mesh, series_axis,
+                      time_axis) -> str:
+    """Identity of one ingest configuration INCLUDING the dataset's
+    file-level state (:func:`_dataset_file_state`).  A progress
+    manifest stamped by a different (dataset content, schema, mesh)
+    combination must be refused — resuming it would stitch foreign or
+    stale packed blocks into this frame."""
+    mesh_state = (tuple(mesh.axis_names), tuple(sorted(mesh.shape.items())))
+    h = hashlib.sha1(repr((
+        _RESUME_FORMAT, os.path.abspath(path), ts_col, tuple(pcols),
+        tuple(columns or ()), mesh_state, series_axis, time_axis,
+        _dataset_file_state(path),
+    )).encode())
+    return h.hexdigest()[:16]
+
+
+def _array_crc(arr: np.ndarray) -> int:
+    from tempo_tpu import checkpoint
+
+    return checkpoint.array_crc(arr)
+
+
+def _plane_key(name: str) -> str:
+    # npz member names cannot hold '/', the valid-plane separator
+    return name.replace("/", "__")
+
+
+class _ResumeLog:
+    """Per-shard progress manifest of one out-of-core ingest.
+
+    Layout under ``resume_dir``: ``ingest.json`` (the stamped ingest
+    signature), ``census.npz`` + ``keys.parquet`` + ``census.json``
+    (the pass-1 key census, CRC'd, including the quarantine ledger so
+    pass 2 of a resumed run skips exactly what pass 1 skipped), and
+    per shard ``shard_NNNN.npz`` + ``shard_NNNN.json`` (the packed
+    host blocks with per-array CRCs).  Every artifact is written
+    ``.tmp``-then-rename, and the sidecar JSON is written LAST — its
+    presence is the commit record, so a kill mid-write can never leave
+    a shard that looks complete.  Corrupt artifacts are detected by
+    CRC on load and silently re-streamed (the Parquet source is the
+    recovery); only a *foreign signature* refuses by name."""
+
+    def __init__(self, resume_dir: str, signature: str):
+        self.dir = str(resume_dir)
+        self.signature = signature
+
+    # -- paths ----------------------------------------------------------
+
+    def _p(self, name: str) -> str:
+        return os.path.join(self.dir, name)
+
+    @staticmethod
+    def _write_json(path: str, doc: dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    # -- signature ------------------------------------------------------
+
+    def open(self, ctx: _IngestCtx) -> None:
+        os.makedirs(self.dir, exist_ok=True)
+        ip = self._p("ingest.json")
+        if os.path.exists(ip):
+            try:
+                with open(ip) as f:
+                    doc = json.load(f)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                doc = {}
+            stamped = doc.get("signature")
+            if stamped != self.signature:
+                raise CheckpointError(
+                    f"ingest resume directory {self.dir!r} was written "
+                    f"by a DIFFERENT ingest (stamped signature "
+                    f"{stamped!r} != this call's {self.signature!r}: "
+                    f"other dataset path, changed source files, other "
+                    f"schema, columns or mesh) — refusing to stitch "
+                    f"foreign/stale shards; point resume_dir elsewhere "
+                    f"or clear it",
+                    kind=FailureKind.PERMANENT,
+                )
+        else:
+            self._write_json(ip, {"signature": self.signature,
+                                  "format": _RESUME_FORMAT})
+
+    # -- census ---------------------------------------------------------
+
+    def save_census(self, key_frame: pd.DataFrame, lengths: np.ndarray,
+                    ctx: _IngestCtx) -> None:
+        tmp = self._p("census.npz.tmp.npz")
+        np.savez(tmp, lengths=lengths)
+        os.replace(tmp, self._p("census.npz"))
+        key_frame.to_parquet(self._p("keys.parquet.tmp"))
+        os.replace(self._p("keys.parquet.tmp"), self._p("keys.parquet"))
+        from tempo_tpu import checkpoint
+
+        self._write_json(self._p("census.json"), {
+            "signature": self.signature,
+            "lengths_crc": _array_crc(lengths),
+            "keys_crc": checkpoint.file_crc(self._p("keys.parquet")),
+            "quarantined": list(ctx.quarantined),
+        })
+
+    def load_census(self):
+        cp = self._p("census.json")
+        if not os.path.exists(cp):
+            return None
+        try:
+            with open(cp) as f:
+                doc = json.load(f)
+            lengths = np.load(self._p("census.npz"),
+                              allow_pickle=False)["lengths"]
+            key_frame = pd.read_parquet(self._p("keys.parquet"))
+            from tempo_tpu import checkpoint
+
+            if _array_crc(lengths) != int(doc["lengths_crc"]) or \
+                    checkpoint.file_crc(self._p("keys.parquet")) \
+                    != int(doc["keys_crc"]):
+                raise ValueError("census CRC mismatch")
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile,
+                EOFError, json.JSONDecodeError) as e:
+            logger.warning(
+                "from_parquet: cached census at %s unusable (%s); "
+                "re-running the census pass", self.dir, e)
+            return None
+        return key_frame, lengths
+
+    def update_quarantine(self, ctx: _IngestCtx) -> None:
+        """Re-persist the quarantine ledger after it grew during the
+        shard pass, so a later resume expects the FINAL ledger and
+        invalidates shard manifests stamped under older ones."""
+        cp = self._p("census.json")
+        if not os.path.exists(cp):
+            return
+        try:
+            with open(cp) as f:
+                doc = json.load(f)
+        except (OSError, ValueError, json.JSONDecodeError):
+            return
+        doc["quarantined"] = list(ctx.quarantined)
+        self._write_json(cp, doc)
+
+    def census_quarantine(self) -> List[dict]:
+        cp = self._p("census.json")
+        if not os.path.exists(cp):
+            return []
+        try:
+            with open(cp) as f:
+                return list(json.load(f).get("quarantined") or [])
+        except (OSError, ValueError, json.JSONDecodeError):
+            return []
+
+    # -- shards ---------------------------------------------------------
+
+    def save_shard(self, si: int, planes: Dict[str, np.ndarray],
+                   rows: int, ledger_crc: int = 0) -> None:
+        """Persist one completed shard's packed host blocks; the JSON
+        sidecar (written last) commits it, stamped with the quarantine
+        ledger the shard was packed under."""
+        npz = self._p(f"shard_{si:04d}.npz")
+        tmp = npz + ".tmp.npz"
+        np.savez(tmp, **{_plane_key(k): v for k, v in planes.items()})
+        os.replace(tmp, npz)
+        self._write_json(self._p(f"shard_{si:04d}.json"), {
+            "si": si, "rows": rows, "ledger_crc": int(ledger_crc),
+            "crcs": {_plane_key(k): _array_crc(v)
+                     for k, v in planes.items()},
+        })
+
+    def load_shard(self, si: int, num_cols: List[str], shape,
+                   ledger_crc: int = 0
+                   ) -> Optional[Dict[str, np.ndarray]]:
+        """Packed host blocks of a committed shard, CRC-verified; None
+        (re-stream from Parquet) when absent, corrupt, shaped for a
+        different layout, or stamped with a DIFFERENT quarantine
+        ledger than the current run's (a kill during a consistency
+        re-stream leaves manifests packed under mixed ledgers — the
+        stale ones must not be stitched in)."""
+        jp = self._p(f"shard_{si:04d}.json")
+        if not os.path.exists(jp):
+            return None
+        wanted = ["__ts__", "__mask__"] + [n for c in num_cols
+                                           for n in (c, c + "/valid")]
+        try:
+            with open(jp) as f:
+                doc = json.load(f)
+            crcs = doc["crcs"]
+            if int(doc.get("ledger_crc", 0)) != int(ledger_crc):
+                raise ValueError(
+                    "packed under a different quarantine ledger")
+            with np.load(self._p(f"shard_{si:04d}.npz"),
+                         allow_pickle=False) as z:
+                planes = {}
+                for name in wanted:
+                    arr = z[_plane_key(name)]
+                    if _array_crc(arr) != int(crcs[_plane_key(name)]) \
+                            or tuple(arr.shape) != tuple(shape):
+                        raise ValueError(
+                            f"plane {name!r} CRC/shape mismatch")
+                    planes[name] = arr
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile,
+                EOFError, json.JSONDecodeError) as e:
+            logger.warning(
+                "from_parquet: shard %d progress manifest unusable "
+                "(%s); re-streaming it from Parquet", si, e)
+            return None
+        return planes

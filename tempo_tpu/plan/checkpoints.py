@@ -1,0 +1,176 @@
+"""Plan-integrated checkpoint barriers.
+
+PR-1 gave the batch plane ``run_resumable`` — eager-only, invisible to
+the planner, and (until this round) happy to restore a checkpoint
+written by a different pipeline.  This module makes checkpointing a
+*plan* property instead: inside a :func:`checkpointed` context the
+optimizer's ``TEMPO_TPU_CKPT_PLACEMENT`` pass
+(:func:`tempo_tpu.plan.optimizer._place_checkpoints`) inserts
+first-class ``checkpoint`` nodes at the materialization/reshard
+boundaries of the chain, ``explain()`` renders them with estimated
+checkpoint bytes, and the executor:
+
+* **saves** each barrier as a ``step_NNNNN`` checkpoint whose manifest
+  is stamped with the optimized-plan signature and the predecessor
+  barrier's manifest CRC-32 (the chained-manifest scheme the cohort
+  differential snapshots introduced);
+* **resumes** a re-submitted plan from the newest intact,
+  chain-consistent barrier — the whole subtree under it is SKIPPED
+  (never re-executed, never re-compiled: the executable comes from the
+  plan cache) — and REFUSES by name
+  (:class:`~tempo_tpu.resilience.CheckpointError`) to restore a
+  barrier stamped by a different plan.
+
+``run_resumable`` is the eager wrapper over the same stamping/refusal
+machinery (:func:`tempo_tpu.checkpoint.resolve_step`).
+
+The context is a contextvar, so concurrent planned queries (the query
+service) only checkpoint the chains explicitly run inside it.  The
+placement spec (``every``) is folded into the executable-cache key
+(:func:`fingerprint`), the *directory* is read at run time — one cached
+executable serves any number of checkpoint directories.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointSpec:
+    """Active barrier policy: where step checkpoints land, how often a
+    boundary gets one, and how many are retained."""
+
+    ckpt_dir: str
+    every: int = 1
+    keep_last: int = 3
+    sharded: bool = False
+
+
+_ACTIVE: contextvars.ContextVar[Optional[CheckpointSpec]] = \
+    contextvars.ContextVar("tempo_tpu_plan_ckpt", default=None)
+
+
+def active() -> Optional[CheckpointSpec]:
+    """The live :class:`CheckpointSpec`, or None outside any
+    :func:`checkpointed` context."""
+    return _ACTIVE.get()
+
+
+@contextlib.contextmanager
+def checkpointed(ckpt_dir, every: int = 1, keep_last: int = 3,
+                 sharded: bool = False):
+    """Run planned chains with checkpoint barriers: every ``every``-th
+    materialization boundary (and the reshard boundaries / the final
+    pre-collect frame) becomes a signed ``step_NNNNN`` checkpoint under
+    ``ckpt_dir``; re-running the SAME chain inside the context resumes
+    from the newest intact barrier and re-executes only the ops above
+    it."""
+    if every < 1:
+        raise ValueError(f"every must be >= 1, got {every}")
+    spec = CheckpointSpec(str(ckpt_dir), int(every), int(keep_last),
+                          bool(sharded))
+    token = _ACTIVE.set(spec)
+    try:
+        yield spec
+    finally:
+        _ACTIVE.reset(token)
+
+
+def placement_mode() -> str:
+    """``TEMPO_TPU_CKPT_PLACEMENT`` — ``auto`` (default: barriers at
+    materialization/reshard boundaries of chains run inside a
+    :func:`checkpointed` context) or ``off`` (no plan barriers; the
+    context then has no effect on planned chains)."""
+    from tempo_tpu import config
+
+    mode = (config.get("TEMPO_TPU_CKPT_PLACEMENT") or "auto")
+    mode = mode.strip().lower()
+    return mode if mode in ("auto", "off") else "auto"
+
+
+def fingerprint() -> Optional[tuple]:
+    """Executable-cache key component: barrier placement changes the
+    optimized plan, so a chain planned inside a checkpointed context
+    must never replay the barrier-free executable (or vice versa).
+    Directory/retention are runtime-only and stay out of the key."""
+    spec = active()
+    if spec is None or placement_mode() == "off":
+        return None
+    return ("ckpt", spec.every)
+
+
+def source_fingerprint(frame) -> str:
+    """Content fingerprint of one source frame, folded into the
+    stamped barrier signature.  The plan signature alone covers only
+    STRUCTURE — without this, re-running the same chain over
+    different same-shape data inside the same checkpoint directory
+    would silently restore the previous data's barriers (exactly the
+    stale-restore hazard the refusal semantics exist for).
+
+    Content-derived (host frames: ``pd.util.hash_pandas_object``;
+    distributed frames: CRC over every fetched plane + the layout), so
+    it is stable across process restarts — a crash-resumed pipeline
+    that re-ingests the same bytes matches its own barriers.  Memoized
+    on the frame (frames are immutable), so repeated submissions of a
+    live frame pay the O(data) fetch once."""
+    cached = getattr(frame, "_plan_ckpt_fp", None)
+    if cached is not None:
+        return cached
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    from tempo_tpu.dist import DistributedTSDF
+
+    h = hashlib.sha1()
+
+    def eat(a):
+        h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+
+    if isinstance(frame, DistributedTSDF):
+        h.update(repr(("dist", tuple(frame.cols), frame.ts_col,
+                       tuple(frame.partitionCols),
+                       frame.seq_col or "")).encode())
+        if jax.process_count() > 1:
+            # multi-process arrays span non-addressable devices — a
+            # global fetch is illegal here.  Fall back to the
+            # host-resident layout (keys + per-series lengths): weaker
+            # (same-layout different-value frames collide) but every
+            # process computes the same stamp without a collective.
+            h.update(repr(("multiprocess",
+                           tuple(int(s) for s in frame.ts.shape))
+                          ).encode())
+        else:
+            eat(frame.ts)
+            eat(frame.mask)
+            if frame.seq is not None:
+                eat(frame.seq)
+            for col in frame.cols.values():
+                eat(col.values)
+                eat(col.valid)
+                if col.host_gather is not None:
+                    _vals, starts, perm = col.host_gather
+                    h.update(repr(len(_vals)).encode())
+                    eat(starts)
+                    eat(perm)
+        eat(frame.layout.starts)
+        h.update(frame.layout.key_frame.to_json().encode())
+    else:
+        import pandas as pd
+
+        h.update(repr(("host", tuple(frame.df.columns), frame.ts_col,
+                       tuple(frame.partitionCols),
+                       frame.sequence_col or "")).encode())
+        eat(pd.util.hash_pandas_object(frame.df, index=False).to_numpy())
+    fp = h.hexdigest()[:16]
+    try:
+        frame._plan_ckpt_fp = fp
+    except AttributeError:  # pragma: no cover - slotted frame class
+        pass
+    return fp
+

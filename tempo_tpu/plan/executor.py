@@ -17,10 +17,12 @@ eager methods never re-records.
 from __future__ import annotations
 
 import logging
+import os
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from tempo_tpu.plan import cache, hints, ir, optimizer
+from tempo_tpu.plan import checkpoints as plan_ckpt
 
 logger = logging.getLogger(__name__)
 
@@ -35,12 +37,14 @@ def execute(root: ir.Node):
     snap = cost.snapshot()
     key = ir.state_key(root)
     if key is not None:
-        # the reshard-placement mode and the active cost-model inputs
-        # both change the OPTIMIZED plan without touching the logical
-        # signature — fold them into the cache key so flipping
-        # TEMPO_TPU_RESHARD_PLACEMENT or a measured cost input never
-        # replays a plan decided under the other configuration
-        key = key + (optimizer.reshard_mode(), cost.fingerprint(snap))
+        # the reshard-placement mode, the active cost-model inputs and
+        # the checkpoint-barrier spec all change the OPTIMIZED plan
+        # without touching the logical signature — fold them into the
+        # cache key so flipping TEMPO_TPU_RESHARD_PLACEMENT, a measured
+        # cost input, or a checkpointed() context never replays a plan
+        # decided under the other configuration
+        key = key + (optimizer.reshard_mode(), cost.fingerprint(snap),
+                     plan_ckpt.fingerprint())
 
     def build():
         t0 = time.perf_counter()
@@ -81,8 +85,84 @@ class Executable:
                 f"got {len(payloads)}")
         self.runs += 1
         env: Dict[int, object] = {}
+        spec = plan_ckpt.active()
+        # barrier nodes only exist in plans optimized under an active
+        # context (the spec is in the cache key), so the hot path —
+        # every query-service dispatch — skips the plan walk entirely
+        ckpt_nodes = ([n for n in self.plan.walk()
+                       if n.op == "checkpoint"]
+                      if spec is not None else [])
+        sig = None
+        resume_id, resume_frame, prev0 = None, None, None
+        skip = frozenset()
+        if spec is not None and ckpt_nodes:
+            from tempo_tpu import checkpoint as ckpt_mod
+            from tempo_tpu.resilience import CheckpointError
+
+            os.makedirs(spec.ckpt_dir, exist_ok=True)
+            sig = _stamped_signature(self.plan, payloads)
+            below = None
+            while True:
+                # manifest-only resolve; load verifies the arrays ONCE
+                # — an unloadable barrier falls back to an older one
+                hit = ckpt_mod.resolve_step(
+                    spec.ckpt_dir, signature=sig,
+                    max_step=len(ckpt_nodes), verify=False,
+                    below_step=below)
+                if hit is None:
+                    break
+                step_no, path, _man = hit
+                target = next((n for n in ckpt_nodes
+                               if n.param("step") == step_no), None)
+                if target is None:
+                    break
+                try:
+                    resume_frame = _load_barrier(target, path, payloads,
+                                                 sources)
+                except (CheckpointError, ValueError) as e:
+                    logger.warning(
+                        "plan: barrier %s unusable (%s); falling back "
+                        "to an older one", path, e)
+                    below = step_no
+                    continue
+                resume_id = id(target)
+                prev0 = (step_no, ckpt_mod.manifest_crc(path))
+                # skip the resumed subtree — EXCEPT nodes a consumer
+                # outside the subtree still needs (a DAG may share a
+                # source across the barrier: it must stay live)
+                live = set()
+
+                def _mark(n):
+                    if id(n) in live or id(n) == resume_id:
+                        return
+                    live.add(id(n))
+                    for c in n.inputs:
+                        _mark(c)
+
+                _mark(self.plan)
+                skip = (frozenset(id(c) for c in target.walk())
+                        - live - {resume_id})
+                logger.info(
+                    "plan: resuming from barrier step %d (%s); "
+                    "%d upstream plan node(s) skipped",
+                    step_no, path, len(skip))
+                break
+        prev: Optional[tuple] = prev0   # (step, manifest CRC) chain link
         with plan_mod.suspended():
             for node in self.plan.walk():
+                if id(node) in skip:
+                    # everything under the resumed barrier: its value IS
+                    # the restored checkpoint — never re-executed
+                    env[id(node)] = None
+                    continue
+                if node.op == "checkpoint":
+                    if id(node) == resume_id:
+                        env[id(node)] = resume_frame
+                    else:
+                        env[id(node)], prev = _save_barrier(
+                            node, env[id(node.inputs[0])], spec, sig,
+                            prev)
+                    continue
                 if node.is_source():
                     env[id(node)] = _bind_source(
                         node, payloads[sources.index(node)])
@@ -92,6 +172,71 @@ class Executable:
                             env[id(c)] for c in node.inputs
                         ])
         return env[id(self.plan)]
+
+
+def _stamped_signature(plan: ir.Node, payloads: List) -> str:
+    """What a barrier manifest is stamped with: the optimized-plan
+    signature (structure + params + annotations) PLUS each source
+    frame's content fingerprint.  Structure alone would let the same
+    chain over different same-shape data restore the previous data's
+    barriers — the stale-restore variant of the foreign-resume
+    hazard."""
+    import hashlib
+
+    fps = "|".join(plan_ckpt.source_fingerprint(p) for p in payloads)
+    return hashlib.sha1(
+        f"{ir.signature(plan)}|{fps}".encode()).hexdigest()[:16]
+
+
+def _save_barrier(node: ir.Node, frame, spec, sig: str,
+                  prev: Optional[tuple]):
+    """Write one plan barrier: a ``step_NNNNN`` checkpoint whose
+    manifest is stamped with the optimized-plan signature and the
+    predecessor barrier's manifest CRC (the chained-manifest scheme);
+    the frame passes through unchanged.  A barrier node run OUTSIDE a
+    checkpointed context (same cached executable, context since
+    exited) is a transparent no-op."""
+    if spec is None:
+        return frame, prev
+    from tempo_tpu import checkpoint as ckpt_mod
+
+    step = int(node.param("step"))
+    path = os.path.join(spec.ckpt_dir, f"step_{step:05d}")
+    meta = {"pipeline_signature": sig, "step": step,
+            "plan_op": node.inputs[0].op}
+    if prev is not None:
+        meta["prev_step"], meta["prev_manifest_crc"] = prev
+    ckpt_mod.save(frame, path, sharded=spec.sharded, meta=meta)
+    logger.info("plan: barrier step %d (%s) checkpointed to %s",
+                step, node.inputs[0].op, path)
+    ckpt_mod.prune(spec.ckpt_dir, keep_last=spec.keep_last)
+    return frame, (step, ckpt_mod.manifest_crc(path))
+
+
+def _load_barrier(node: ir.Node, path: str, payloads: List,
+                  sources: List[ir.Node]):
+    """Restore the frame a barrier checkpoint holds, re-placed onto the
+    mesh the CURRENT submission's source frames live on (cached
+    executables drop build-time payloads, so the mesh comes from the
+    caller's live frames / the recorded on_mesh node)."""
+    from tempo_tpu import checkpoint as ckpt_mod
+
+    mesh, s_ax, t_ax, on_mesh_seen = None, "series", None, False
+    for n in node.walk():
+        if n.op == "on_mesh":
+            on_mesh_seen = True
+            mesh = n.objs.get("mesh") or mesh
+            s_ax = n.param("series_axis", "series")
+            t_ax = n.param("time_axis")
+        elif n.op == "dist_source":
+            p = payloads[sources.index(n)]
+            mesh, s_ax, t_ax = p.mesh, p.series_axis, p.time_axis
+    if mesh is None and on_mesh_seen:
+        from tempo_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+    return ckpt_mod.load(path, mesh=mesh, series_axis=s_ax,
+                         time_axis=t_ax)
 
 
 def _bind_source(node: ir.Node, payload):

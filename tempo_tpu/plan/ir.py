@@ -216,7 +216,7 @@ def output_columns(node: Node) -> Optional[List[str]]:
     cols = output_columns(node.inputs[0])
     if cols is None:
         return None
-    if node.op in ("on_mesh", "reshard"):
+    if node.op in ("on_mesh", "reshard", "checkpoint"):
         return cols
     if node.op == "select":
         sel = node.param("cols", ())
@@ -270,6 +270,7 @@ def consumed_columns(node: Node) -> Optional[List[str]]:
         return list(pick) if pick else None
     if node.op == "fourier":
         return [node.param("valueCol")]
-    if node.op in ("collect", "count", "on_mesh", "reshard"):
+    if node.op in ("collect", "count", "on_mesh", "reshard",
+                   "checkpoint"):
         return []
     return None

@@ -60,6 +60,7 @@ def optimize(root: ir.Node) -> ir.Node:
     root = _place_reshards(root)
     _prune_columns(root)
     _mark_barriers(root)
+    root = _place_checkpoints(root)
     return root
 
 
@@ -486,7 +487,7 @@ def _device_plane_count(node: ir.Node) -> Optional[int]:
     base = _device_plane_count(node.inputs[0])
     if base is None:
         return None
-    if node.op == "reshard":
+    if node.op in ("reshard", "checkpoint"):
         return base
     if node.op == "asof_join":
         right = _device_plane_count(node.inputs[1])
@@ -698,7 +699,7 @@ def _required_inputs(node: ir.Node, wanted: Wanted):
     if node.op == "count":
         return [frozenset()] * n_in
     if node.op in ("collect", "on_mesh", "source", "dist_source",
-                   "reshard"):
+                   "reshard", "checkpoint"):
         return [wanted] * n_in
     if node.op == "select":
         sel = node.param("cols", ())
@@ -784,6 +785,124 @@ def _prune_columns(root: ir.Node) -> None:
             n.ann["prune_to"] = tuple(keep)
             n.ann["pruned"] = tuple(c for c in t.df.columns
                                     if c not in keep)
+
+
+# ----------------------------------------------------------------------
+# Pass 5: plan-integrated checkpoint barriers (TEMPO_TPU_CKPT_PLACEMENT)
+# ----------------------------------------------------------------------
+
+#: frame-producing ops after which a checkpoint barrier may be placed —
+#: each materialises a new device/host frame, so the boundary above it
+#: is a legal resume point (the saved frame IS the subtree's value)
+_CKPT_BOUNDARY_OPS = ("asof_join", "range_stats", "ema", "resample",
+                      "resample_ema", "interpolate", "fourier",
+                      "fused_asof_stats_ema")
+
+
+def _est_ckpt_bytes(node: ir.Node) -> Optional[int]:
+    """Estimated on-disk bytes of checkpointing this node's result
+    frame (ts plane + mask + value/validity per plane), rendered by
+    ``explain()`` next to each placed barrier; None when the geometry
+    is not derivable at plan time."""
+    try:
+        src = next(iter(node.sources()), None)
+        if src is None:
+            return None
+        planes = _device_plane_count(node)
+        if planes is None:
+            planes = 1
+        if src.op == "dist_source":
+            K, L = int(src.payload.K_dev), int(src.payload.L)
+        else:
+            import numpy as np
+
+            from tempo_tpu import packing
+
+            lay = src.payload.layout
+            K = lay.n_series
+            L = packing.pad_length(int(np.max(lay.lengths, initial=0)))
+        return int(K * L * (8 + 1 + planes * 5))
+    except Exception:  # pragma: no cover - estimate must never kill a plan
+        return None
+
+
+def _place_checkpoints(root: ir.Node) -> ir.Node:
+    """Insert first-class ``checkpoint`` plan nodes when a
+    :func:`tempo_tpu.plan.checkpoints.checkpointed` context is active
+    (and ``TEMPO_TPU_CKPT_PLACEMENT`` is not ``off``): one barrier
+    after every ``every``-th materialization boundary
+    (:data:`_CKPT_BOUNDARY_OPS`), one before each placed reshard's
+    layout switch (the canonical-layout frame is what gets saved), and
+    always one under the terminal materialisation (``collect`` /
+    ``count`` / host barriers) so a completed chain's final frame is a
+    resume point.  Interiors of series-local reshard regions are never
+    checkpointed — their joint layout is not restorable through
+    ``checkpoint.load``'s canonical re-placement path.  Uncacheable
+    plans (opaque params) are left barrier-free: their signatures are
+    not stable across submissions, so stamped barriers could never be
+    matched on resume."""
+    from tempo_tpu.plan import checkpoints as plan_ckpt
+
+    spec = plan_ckpt.active()
+    if spec is None or plan_ckpt.placement_mode() == "off" \
+            or root.uncacheable():
+        return root
+    every = max(1, int(spec.every))
+    layout: Dict[int, Optional[str]] = {}
+    state = {"ops": 0, "steps": 0}
+
+    def wrap(child: ir.Node) -> ir.Node:
+        state["steps"] += 1
+        node = ir.Node("checkpoint", params=dict(step=state["steps"]),
+                       inputs=(child,))
+        node.ann["ckpt"] = (
+            "plan barrier: signed step manifest (plan signature + "
+            "predecessor CRC), resume point")
+        est = _est_ckpt_bytes(child)
+        if est:
+            node.ann["ckpt_bytes_est"] = est
+        layout[id(node)] = layout.get(id(child))
+        return node
+
+    def fn(n: ir.Node) -> ir.Node:
+        # layout tracking mirrors _place_reshards_impl: barriers must
+        # only land on canonically-laid frames
+        if n.op == "dist_source":
+            p = n.payload
+            layout[id(n)] = ("time" if p.time_axis is not None else
+                             "joint" if isinstance(p.series_axis, tuple)
+                             else None)
+            return n
+        if n.op == "on_mesh":
+            layout[id(n)] = ("time" if n.param("time_axis") is not None
+                             else None)
+            return n
+        if not n.inputs:
+            return n
+        if n.op == "reshard":
+            child = n.inputs[0]
+            if n.param("target") == "series_local" \
+                    and child.op in _CKPT_BOUNDARY_OPS \
+                    and layout.get(id(child)) != "joint":
+                n.inputs = (wrap(child),) + n.inputs[1:]
+            layout[id(n)] = ("joint" if n.param("target") == "series_local"
+                             else "time")
+            return n
+        layout[id(n)] = layout.get(id(n.inputs[0]))
+        if n.op in _CKPT_BOUNDARY_OPS and layout.get(id(n)) != "joint":
+            state["ops"] += 1
+            if state["ops"] % every == 0:
+                return wrap(n)
+            return n
+        if n.op in ("collect", "count", "lookback_features"):
+            child = n.inputs[0]
+            if child.op in _CKPT_BOUNDARY_OPS \
+                    and layout.get(id(child)) != "joint":
+                n.inputs = (wrap(child),) + n.inputs[1:]
+            return n
+        return n
+
+    return _rewrite(root, fn)
 
 
 # ----------------------------------------------------------------------

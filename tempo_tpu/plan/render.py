@@ -42,6 +42,13 @@ def _node_line(node: ir.Node) -> str:
         line += ("  <- PLACED: explicit all_to_all layout switch"
                  + (f", ~{model} B/shard modeled comm" if model else ""))
         return line
+    if node.op == "checkpoint":
+        line = f"checkpoint[step {node.param('step')}]"
+        est = node.ann.get("ckpt_bytes_est")
+        line += ("  <- PLACED: plan barrier (signed step manifest, "
+                 "resume point)"
+                 + (f", ~{est} B est" if est else ""))
+        return line
     line = f"{node.op}({_param_str(node)})"
     notes = []
     if "reshard_eliminated" in node.ann:

@@ -520,6 +520,79 @@ def _step_label(step) -> str:
     return str(step[0])
 
 
+def _sig_canon(value) -> str:
+    """Process-stable canonical string of one step kwarg: scalars by
+    value (numpy scalars unwrapped — by type alone, two pipelines
+    differing only in an np.int64 kwarg would collide and resume each
+    other's state), containers recursively, everything else by TYPE
+    only.  A bare ``repr`` would fold memory addresses into the
+    signature for objects without a stable ``__repr__`` (a TSDF
+    operand, say) — a restarted process would then refuse its OWN
+    checkpoints."""
+    import numpy as np
+
+    if isinstance(value, np.generic) and value.shape == ():
+        value = value.item()
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return repr(value)
+    if isinstance(value, (list, tuple)):
+        return "[" + ",".join(_sig_canon(v) for v in value) + "]"
+    if isinstance(value, dict):
+        items = sorted(value.items(), key=lambda kv: str(kv[0]))
+        return "{" + ",".join(f"{k}:{_sig_canon(v)}" for k, v in items) + "}"
+    return f"<{type(value).__name__}>"
+
+
+def pipeline_signature(steps: Sequence) -> str:
+    """Stable signature of a ``run_resumable`` step chain, stamped into
+    every step manifest so resume can refuse FOREIGN state by name
+    (the silent-restore hazard: a stale ``ckpt_dir`` from a different
+    pipeline restoring cleanly into this one).
+
+    Covers step count, method names and canonical kwargs
+    (:func:`_sig_canon` — stable across process restarts).  Callables
+    canonicalize to their *position* only (two closures compiled from
+    the same source are not provably the same step, and instrumented
+    re-wraps of the same pipeline must keep resuming), so two
+    all-callable chains of equal length collide — the hazard this
+    guards is cross-pipeline shape drift, which always shows up in
+    length or in the named steps."""
+    import hashlib
+
+    parts = []
+    for step in steps:
+        if callable(step):
+            parts.append("<callable>")
+        elif isinstance(step, str):
+            parts.append(f"method:{step}")
+        else:
+            kwargs = step[1] if len(step) > 1 else {}
+            parts.append(f"method:{step[0]}:{_sig_canon(dict(kwargs))}")
+    h = hashlib.sha1(repr((len(parts), parts)).encode())
+    return h.hexdigest()[:16]
+
+
+def resume_signature(frame, steps: Sequence) -> str:
+    """The signature :func:`run_resumable` stamps by default: the step
+    chain (:func:`pipeline_signature`) PLUS the input frame's content
+    fingerprint.  Steps alone would let a reused ``ckpt_dir`` restore
+    a PREVIOUS run's retained final checkpoint when the same chain is
+    re-run over new data — zero steps re-run, yesterday's output
+    returned as today's.  The content fingerprint is the same one the
+    plan barriers stamp (:func:`tempo_tpu.plan.checkpoints.
+    source_fingerprint` — memoized, stable across restarts), so a
+    crash-resumed pipeline re-fed the same bytes still matches its own
+    checkpoints."""
+    import hashlib
+
+    from tempo_tpu.plan import checkpoints as plan_ckpt
+
+    return hashlib.sha1(
+        f"{pipeline_signature(steps)}|"
+        f"{plan_ckpt.source_fingerprint(frame)}".encode()
+    ).hexdigest()[:16]
+
+
 def run_resumable(
     frame,
     steps: Sequence,
@@ -527,22 +600,32 @@ def run_resumable(
     every: int = 1,
     keep_last: int = 2,
     sharded: bool = False,
+    signature: Optional[str] = None,
 ):
     """Run a chain of device ops with periodic checkpoints and
-    crash-resume.
+    crash-resume — the eager wrapper over the same signed-barrier
+    machinery the plan executor's checkpoint nodes use
+    (:mod:`tempo_tpu.plan.checkpoints`).
 
     ``steps`` is a sequence of callables ``frame -> frame`` (or
     ``(method_name, kwargs)`` tuples resolved against the frame).  After
     every ``every``-th step — and always after the last — the
     intermediate frame is checkpointed to ``ckpt_dir/step_NNNNN`` via
-    :func:`tempo_tpu.checkpoint.save` (atomic, checksummed), and older
-    checkpoints beyond ``keep_last`` are pruned.
+    :func:`tempo_tpu.checkpoint.save` (atomic, checksummed), its
+    manifest stamped with the pipeline signature
+    (:func:`resume_signature` — steps + input-frame content; or the
+    caller's ``signature``) and the predecessor checkpoint's manifest
+    CRC-32 (the chained-manifest scheme); older checkpoints beyond
+    ``keep_last`` are pruned.
 
-    On restart with the same ``ckpt_dir``, the newest *intact*
-    checkpoint is restored (corrupt or truncated ones are detected by
-    checksum, logged, and skipped in favour of the next-older one —
-    crash residue ``*.tmp`` directories are cleaned) and only the steps
-    after it re-run.  Steps must be deterministic for the resumed result
+    On restart with the same ``ckpt_dir``, the newest intact,
+    chain-consistent checkpoint STAMPED BY THIS PIPELINE is restored
+    and only the steps after it re-run
+    (:func:`tempo_tpu.checkpoint.resolve_step`): corrupt/truncated
+    candidates and broken chain links fall back to older ones with a
+    warning, but a checkpoint stamped by a *different* pipeline raises
+    :class:`CheckpointError` by name instead of silently restoring
+    foreign state.  Steps must be deterministic for the resumed result
     to be bit-identical to an uninterrupted run; all tempo-tpu device
     ops are.
 
@@ -555,39 +638,53 @@ def run_resumable(
     if every < 1:
         raise ValueError(f"every must be >= 1, got {every}")
     os.makedirs(ckpt_dir, exist_ok=True)
+    sig = signature or resume_signature(frame, steps)
     mesh = getattr(frame, "mesh", None)
     series_axis = getattr(frame, "series_axis", "series")
     time_axis = getattr(frame, "time_axis", None)
 
     state, done = frame, 0
-    for step_no, path in checkpoint.list_steps(ckpt_dir):
-        if step_no > len(steps):
-            logger.warning(
-                "run_resumable: ignoring checkpoint %s beyond the %d-step "
-                "pipeline (stale ckpt_dir?)", path, len(steps),
-            )
-            continue
+    prev = None          # (step, manifest CRC) of the chain predecessor
+    below = None
+    while True:
+        # resolve cheaply (manifest-only), verify the arrays ONCE in
+        # load below; an intact-on-disk checkpoint this process cannot
+        # load (corrupt arrays, a sharded save resumed single-process)
+        # falls back to the next-older candidate
+        hit = checkpoint.resolve_step(ckpt_dir, signature=sig,
+                                      max_step=len(steps), verify=False,
+                                      below_step=below)
+        if hit is None:
+            break
+        step_no, path, _man = hit
         try:
             state = checkpoint.load(path, mesh=mesh,
                                     series_axis=series_axis,
                                     time_axis=time_axis)
-            done = step_no
-            logger.info(
-                "run_resumable: resumed after step %d/%d from %s",
-                done, len(steps), path,
-            )
-            break
-        except CheckpointError as e:
+        except (CheckpointError, ValueError) as e:
             logger.warning(
-                "run_resumable: checkpoint %s unusable (%s); falling back "
-                "to an older one", path, e,
-            )
+                "run_resumable: checkpoint %s unusable (%s); falling "
+                "back to an older one", path, e)
+            state, below = frame, step_no
+            continue
+        done = step_no
+        prev = (step_no, checkpoint.manifest_crc(path))
+        logger.info(
+            "run_resumable: resumed after step %d/%d from %s",
+            done, len(steps), path,
+        )
+        break
 
     for i in range(done, len(steps)):
         state = _apply_step(state, steps[i])
         if (i + 1) % every == 0 or i + 1 == len(steps):
             path = os.path.join(ckpt_dir, f"step_{i + 1:05d}")
-            checkpoint.save(state, path, sharded=sharded)
+            meta = {"pipeline_signature": sig, "step": i + 1,
+                    "step_label": _step_label(steps[i])}
+            if prev is not None:
+                meta["prev_step"], meta["prev_manifest_crc"] = prev
+            checkpoint.save(state, path, sharded=sharded, meta=meta)
+            prev = (i + 1, checkpoint.manifest_crc(path))
             logger.info(
                 "run_resumable: step %d/%d (%s) checkpointed to %s",
                 i + 1, len(steps), _step_label(steps[i]), path,

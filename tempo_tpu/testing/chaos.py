@@ -32,21 +32,28 @@ event source implements).
 
 Entry points: :func:`run_serving_campaign`,
 :func:`run_service_campaign`, and :func:`run_campaign` (both planes,
-one report — bench config 15's ``--only-chaos-serving`` body).
+one report — bench config 15's ``--only-chaos-serving`` body); the
+BATCH plane's campaign is :func:`run_pipeline_campaign` (bench config
+16's ``--only-chaos-pipeline`` body): the Parquet→mesh→planned-chain
+path driven through ingest kills, row-group corruption, torn writes,
+deadlines, a flapping file, a mid-chain plan-barrier kill, and the
+≥1B-row out-of-core slab sweep killed and resumed mid-run — with
+every resumed artifact asserted bitwise against an uninjected twin.
 """
 
 from __future__ import annotations
 
 import os
 import time
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from tempo_tpu import profiling
-from tempo_tpu.resilience import (Cancelled, CircuitBreaker,
-                                  DeadlineExceeded, QuarantinedError,
-                                  ShutdownError)
+from tempo_tpu.resilience import (Cancelled, CheckpointError,
+                                  CircuitBreaker, DeadlineExceeded,
+                                  QuarantinedError, ShutdownError)
 from tempo_tpu.testing import faults
 
 #: per-ticket result() bound: anything still unresolved after this is a
@@ -581,3 +588,459 @@ def run_campaign(checkpoint_dir: str, *, n_streams: int = 12,
     service = run_service_campaign(seed=seed + 1)
     serving["service"] = service
     return serving
+
+
+# ----------------------------------------------------------------------
+# The batch-pipeline campaign (bench config 16)
+# ----------------------------------------------------------------------
+
+def make_parquet_dataset(path: str, *, n_rows: int, n_keys: int,
+                         seed: int, n_files: int = 4,
+                         row_group_rows: Optional[int] = None) -> str:
+    """A real multi-file, multi-row-group Parquet dataset (columns:
+    symbol, event_ts, px, qty) — several row groups per file so the
+    corruption injections have sibling groups to leave intact."""
+    import pandas as pd
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    per = max(1, n_rows // n_files)
+    rg = row_group_rows or max(64, per // 4)
+    for i in range(n_files):
+        df = pd.DataFrame({
+            "symbol": rng.choice([f"s{k:03d}" for k in range(n_keys)], per),
+            "event_ts": pd.to_datetime(
+                (np.sort(rng.integers(0, 10 ** 6, per))
+                 + np.int64(i) * 10 ** 6) * 1_000_000_000),
+            "px": rng.standard_normal(per),
+            "qty": rng.integers(1, 9, per).astype(float),
+        })
+        pq.write_table(pa.Table.from_pandas(df, preserve_index=False),
+                       os.path.join(path, f"part-{i}.parquet"),
+                       row_group_size=rg)
+    return path
+
+
+def _df_crc(df) -> int:
+    """CRC-32 of a DataFrame's raw column bytes (sorted column order;
+    object columns via their UTF-8 reprs) — the bitwise fingerprint
+    the slab digests chain, so 'digest equal' means 'every byte of
+    every slab's full output equal'."""
+    c = 0
+    for col in sorted(df.columns):
+        arr = df[col].to_numpy()
+        if arr.dtype == object:
+            arr = arr.astype(str).astype("S")
+        c = zlib.crc32(np.ascontiguousarray(arr).tobytes(), c)
+    return c & 0xFFFFFFFF
+
+
+def _sorted_df(frame_out):
+    return frame_out.df.sort_values(
+        ["symbol", "event_ts"], kind="stable").reset_index(drop=True)
+
+
+def run_pipeline_campaign(workdir: str, *, rows_total: int = 360_000,
+                          physical_rows: int = 60_000,
+                          n_keys: int = 24, seed: int = 29,
+                          n_windows: int = 3,
+                          ckpt_every: int = 2,
+                          recovery_bound_s: float = 120.0) -> dict:
+    """The batch-plane chaos campaign — Parquet → resumable OOC ingest
+    → mesh → planned streaming AS-OF join + packed range stats, driven
+    to ``rows_total`` cumulative rows through the out-of-core slab
+    sweep, under a kill/flaky/corrupt schedule.  Asserted HARD (a
+    violation raises and nulls bench config 16):
+
+    * a mid-file ingest kill resumes from the per-shard progress
+      manifest: completed shards are NOT re-read, and the resumed
+      frame is bitwise-identical to a fresh ingest;
+    * a foreign resume directory / a foreign checkpoint signature is
+      refused by name (``CheckpointError``), never silently restored;
+    * a corrupt row group and a torn-write file are quarantined with
+      the exact ranges named (``CorruptRowGroupError`` in raise mode);
+      a flapping file trips its circuit breaker and is quarantined
+      instead of burning the pass's retry budget;
+    * the end-to-end ingest deadline dies with a STAGE-named
+      ``DeadlineExceeded``;
+    * a kill mid-chain between plan-placed checkpoint barriers
+      resumes from the newest intact signed barrier: ONLY the ops
+      above it re-run, ZERO new executables are built, and the final
+      frame is bitwise-identical to the uninjected eager twin;
+    * the slab sweep (``run_resumable`` over the same signed-barrier
+      machinery) killed mid-run resumes from the newest barrier,
+      replays only post-barrier slabs with ZERO new executable
+      builds, and its final digest — the per-slab CRCs of every
+      slab's FULL collected output — is bitwise-identical to an
+      uninjected twin sweep's.
+    """
+    import glob
+    import shutil
+
+    import pandas as pd
+
+    from tempo_tpu import TSDF, checkpoint, resilience
+    from tempo_tpu.dist import DistributedTSDF
+    from tempo_tpu.io import ingest
+    from tempo_tpu.parallel.mesh import make_mesh
+    from tempo_tpu.plan import checkpoints as plan_ckpt
+    from tempo_tpu.service import lazy_frame
+
+    t_start = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    half = physical_rows // 2
+    left_path = make_parquet_dataset(
+        os.path.join(workdir, "left"), n_rows=half, n_keys=n_keys,
+        seed=seed)
+    right_path = make_parquet_dataset(
+        os.path.join(workdir, "right"), n_rows=half, n_keys=n_keys,
+        seed=seed + 1)
+    import jax
+
+    n_shards = min(8, jax.device_count())
+    mesh = make_mesh({"series": n_shards})
+    ingest_kw = dict(ts_col="event_ts", partition_cols=["symbol"],
+                     mesh=mesh, batch_rows=1 << 14)
+
+    # -- phase 1: transactional ingest — kill mid-stream, resume from
+    # the per-shard progress manifest, no completed shard re-read.
+    # Needs >= 2 shards so at least one commits before the kill; a
+    # 1-device backend records the phase as skipped instead of
+    # asserting a kill that can never land
+    resume_dir = os.path.join(workdir, "ingest_resume")
+    ingest_kill = n_shards >= 2
+    committed = restreamed = 0
+    if ingest_kill:
+        kill_shard = min(max(1, n_shards // 2), n_shards - 1)
+        with faults.FaultInjector() as fi:
+            fi.kill_on_call(ingest, "_stream_shard",
+                            call_no=kill_shard + 1)
+            try:
+                ingest.from_parquet(left_path, resume_dir=resume_dir,
+                                    **ingest_kw)
+                raise AssertionError("ingest kill never fired")
+            except faults.SimulatedKill:
+                pass
+        committed = len(glob.glob(os.path.join(resume_dir,
+                                               "shard_*.json")))
+        assert committed >= kill_shard, (committed, kill_shard)
+        with faults.FaultInjector() as fi:
+            fi.flaky(ingest, "_stream_shard", failures=0)  # call counter
+            left_f = ingest.from_parquet(left_path,
+                                         resume_dir=resume_dir,
+                                         **ingest_kw)
+            restreamed = len(fi.records)
+        assert restreamed == n_shards - committed, (
+            f"resume re-read committed shards: {restreamed} streamed, "
+            f"{committed} were committed of {n_shards}")
+    else:
+        left_f = ingest.from_parquet(left_path, resume_dir=resume_dir,
+                                     **ingest_kw)
+    fresh = ingest.from_parquet(left_path, **ingest_kw)
+    pd.testing.assert_frame_equal(
+        _sorted_df(left_f.collect()), _sorted_df(fresh.collect()),
+        check_exact=True)
+    del fresh
+    # foreign resume refusal: same dir, different mesh shape.  On a
+    # 1-device backend there is no second mesh shape to probe with —
+    # the phase is recorded as None (skipped), never a false failure
+    foreign_refused = {"ingest": None if n_shards == 1 else False,
+                       "plan": False, "sweep": False}
+    if n_shards > 1:
+        try:
+            ingest.from_parquet(
+                left_path, resume_dir=resume_dir, ts_col="event_ts",
+                partition_cols=["symbol"],
+                mesh=make_mesh({"series": max(1, n_shards // 2)}),
+                batch_rows=1 << 14)
+            raise AssertionError("foreign ingest resume was admitted")
+        except CheckpointError:
+            foreign_refused["ingest"] = True
+    right_f = ingest.from_parquet(right_path, **ingest_kw)
+
+    # -- phase 2: corrupt row group + torn write -> quarantine with
+    # the exact ranges named; raise mode surfaces ONE named error
+    qdir = os.path.join(workdir, "corrupt_ds")
+    shutil.copytree(right_path, qdir)
+    rec = faults.corrupt_parquet_row_group(
+        os.path.join(qdir, "part-1.parquet"), row_group=1)
+    try:
+        ingest.from_parquet(qdir, **ingest_kw)
+        raise AssertionError("corrupt row group was ingested silently")
+    except ingest.CorruptRowGroupError as e:
+        assert any(r["row_group"] == rec["row_group"]
+                   and r["file"].endswith("part-1.parquet")
+                   for r in e.ranges), e.ranges
+    faults.tear_parquet_footer(os.path.join(qdir, "part-2.parquet"))
+    q_frame = ingest.from_parquet(qdir, on_corrupt="quarantine",
+                                  **ingest_kw)
+    q_ranges = list(q_frame.ingest_quarantined)
+    assert any(r["row_group"] == rec["row_group"] for r in q_ranges)
+    assert any(r["file"].endswith("part-2.parquet")
+               and r["row_group"] is None for r in q_ranges), q_ranges
+    clean_rows = int(right_f.collect().df.shape[0])
+    q_rows = int(q_frame.collect().df.shape[0])
+    assert q_rows < clean_rows
+    del q_frame
+
+    # -- phase 3: the end-to-end ingest deadline dies stage-named
+    try:
+        ingest.from_parquet(left_path, deadline_s=1e-6, **ingest_kw)
+        raise AssertionError("ingest deadline never fired")
+    except DeadlineExceeded as e:
+        assert e.stage, "DeadlineExceeded carried no stage name"
+        deadline_stage = e.stage
+
+    # -- phase 4: flapping file -> circuit breaker -> quarantined
+    # instead of burning the whole retry budget
+    flap_path = os.path.join(left_path, "part-1.parquet")
+    flap_breaker = CircuitBreaker(threshold=2, cooldown_s=600.0)
+    orig_scan = ingest._scan_fragment
+
+    def _flapping_scan(frag, *a, **k):
+        if getattr(frag, "path", "") == flap_path:
+            raise faults.InjectedFault(
+                f"flapping network read at {flap_path}")
+        return orig_scan(frag, *a, **k)
+
+    ingest._scan_fragment = _flapping_scan
+    try:
+        flap_frame = ingest.from_parquet(
+            left_path, on_corrupt="quarantine", breaker=flap_breaker,
+            **ingest_kw)
+    finally:
+        ingest._scan_fragment = orig_scan
+    flap_q = [r for r in flap_frame.ingest_quarantined
+              if "circuit" in r["reason"]]
+    assert flap_q and flap_q[0]["file"] == flap_path, (
+        flap_frame.ingest_quarantined)
+    assert flap_breaker.stats()["trips"] >= 1
+    del flap_frame
+
+    # -- phase 5: plan-integrated checkpoint barriers — kill mid-chain,
+    # resume from the newest intact signed barrier
+    def chain():
+        return (lazy_frame(left_f)
+                .asofJoin(lazy_frame(right_f), right_prefix="q",
+                          skipNulls=False)
+                .withRangeStats(colsToSummarize=["q_px", "q_qty"],
+                                rangeBackWindowSecs=60)
+                .EMA("q_px", exact=True))
+
+    eager_golden = _sorted_df(
+        left_f.asofJoin(right_f, right_prefix="q", skipNulls=False)
+        .withRangeStats(colsToSummarize=["q_px", "q_qty"],
+                        rangeBackWindowSecs=60)
+        .EMA("q_px", exact=True).collect())
+    plan_dir = os.path.join(workdir, "plan_ckpt")
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(np, "savez", call_no=2)     # dies saving barrier 2
+        try:
+            with plan_ckpt.checkpointed(plan_dir, every=1):
+                chain().collect()
+            raise AssertionError("plan-barrier kill never fired")
+        except faults.SimulatedKill:
+            pass
+    assert checkpoint.latest(plan_dir).endswith("step_00001")
+    t_rec = time.perf_counter()
+    builds0 = profiling.plan_cache_stats()["builds"]
+    with faults.FaultInjector() as fi:
+        fi.flaky(DistributedTSDF, "asofJoin", failures=0)
+        fi.flaky(DistributedTSDF, "withRangeStats", failures=0,
+                 label="stats")
+        with plan_ckpt.checkpointed(plan_dir, every=1):
+            resumed = _sorted_df(chain().collect())
+        join_calls = sum(r.target != "stats" for r in fi.records)
+        stats_calls = sum(r.target == "stats" for r in fi.records)
+    builds1 = profiling.plan_cache_stats()["builds"]
+    assert join_calls == 0, (
+        f"resume re-ran the pre-barrier join ({join_calls} call(s))")
+    assert stats_calls == 1, stats_calls
+    assert builds1 == builds0, (
+        f"plan-barrier resume recompiled: builds {builds0}->{builds1}")
+    pd.testing.assert_frame_equal(resumed, eager_golden,
+                                  check_exact=True)
+    plan_recovery_s = time.perf_counter() - t_rec
+    barriers = sorted(s for s, _ in checkpoint.list_steps(plan_dir))
+    assert barriers == [1, 2, 3], barriers
+    # foreign plan refusal: a longer chain against the same barrier dir
+    try:
+        with plan_ckpt.checkpointed(plan_dir, every=1):
+            chain().EMA("q_qty", exact=True).collect()
+        raise AssertionError("foreign plan resume was admitted")
+    except CheckpointError:
+        foreign_refused["plan"] = True
+
+    # -- phase 6: the out-of-core slab sweep to rows_total, killed
+    # mid-run and resumed via run_resumable (the eager wrapper over
+    # the same signed-barrier machinery)
+    slab_rows = int(left_f.collect().df.shape[0]
+                    + right_f.collect().df.shape[0])
+    n_slabs = max(2, -(-rows_total // slab_rows))
+    windows = [30.0 + 15.0 * i for i in range(max(1, n_windows))]
+    kill_at = max(len(windows) + 1, int(n_slabs * 0.6))
+    if kill_at % ckpt_every == 0:
+        # never kill exactly ON a barrier: the campaign must prove the
+        # REPLAY of the slabs between the newest barrier and the kill
+        kill_at += 1
+    kill_at = min(kill_at, n_slabs - 1)
+    if kill_at % ckpt_every == 0:       # the clamp landed on a barrier
+        kill_at -= 1
+
+    def digest_seed():
+        return TSDF(pd.DataFrame({
+            "event_ts": pd.to_datetime([0]),
+            "slab": np.int64([-1]),
+            "out_crc": np.int64([0]),
+            "out_rows": np.int64([0]),
+        }), "event_ts", [])
+
+    def make_steps(ran: List[int], kill_slab: Optional[int] = None):
+        killed = {"done": False}
+
+        def mk(k):
+            w = windows[k % len(windows)]
+
+            def step(digest):
+                if k == kill_slab and not killed["done"]:
+                    killed["done"] = True
+                    raise faults.SimulatedKill(
+                        f"simulated kill at slab {k}")
+                ran.append(k)
+                out = (lazy_frame(left_f)
+                       .asofJoin(lazy_frame(right_f), right_prefix="q",
+                                 skipNulls=False)
+                       .withRangeStats(
+                           colsToSummarize=["q_px", "q_qty"],
+                           rangeBackWindowSecs=w)
+                       .collect())
+                df = _sorted_df(out)
+                row = pd.DataFrame({
+                    "event_ts": pd.to_datetime([(k + 1) * 10 ** 9]),
+                    "slab": np.int64([k]),
+                    "out_crc": np.int64([_df_crc(df)]),
+                    "out_rows": np.int64([len(df)]),
+                })
+                return TSDF(
+                    pd.concat([digest.df, row], ignore_index=True),
+                    "event_ts", [])
+
+            step.__name__ = f"slab{k}"
+            return step
+
+        return [mk(k) for k in range(n_slabs)]
+
+    sweep_dir = os.path.join(workdir, "sweep_ckpt")
+    ran_killed: List[int] = []
+    t_sweep = time.perf_counter()
+    steps = make_steps(ran_killed, kill_slab=kill_at)
+    try:
+        resilience.run_resumable(digest_seed(), steps, sweep_dir,
+                                 every=ckpt_every, keep_last=3)
+        raise AssertionError("sweep kill never fired")
+    except faults.SimulatedKill:
+        pass
+    assert ran_killed == list(range(kill_at)), ran_killed
+    barrier_slab = (kill_at // ckpt_every) * ckpt_every
+    t_rec2 = time.perf_counter()
+    builds0 = profiling.plan_cache_stats()["builds"]
+    ran_resume: List[int] = []
+    digest = resilience.run_resumable(
+        digest_seed(), make_steps(ran_resume), sweep_dir,
+        every=ckpt_every, keep_last=3)
+    builds_resume = profiling.plan_cache_stats()["builds"] - builds0
+    sweep_recovery_s = time.perf_counter() - t_rec2
+    assert ran_resume == list(range(barrier_slab, n_slabs)), (
+        f"resume re-ran pre-barrier slabs: {ran_resume[:4]}... "
+        f"(barrier at {barrier_slab})")
+    assert kill_at > barrier_slab, (
+        "campaign sizing bug: the kill landed on a barrier, so no "
+        "slab replay was exercised")
+    assert builds_resume == 0, (
+        f"sweep resume built {builds_resume} new executable(s); every "
+        f"window was compiled before the kill")
+    sweep_wall = time.perf_counter() - t_sweep
+    assert sweep_recovery_s <= recovery_bound_s, (
+        f"sweep recovery took {sweep_recovery_s:.1f}s "
+        f"(bound {recovery_bound_s}s)")
+
+    # the uninjected twin (runs entirely on cached executables)
+    ran_golden: List[int] = []
+    golden = resilience.run_resumable(
+        digest_seed(), make_steps(ran_golden),
+        os.path.join(workdir, "sweep_golden"), every=ckpt_every,
+        keep_last=3)
+    assert ran_golden == list(range(n_slabs))
+    pd.testing.assert_frame_equal(digest.df.reset_index(drop=True),
+                                  golden.df.reset_index(drop=True),
+                                  check_exact=True)
+    # foreign sweep refusal: a different-length pipeline, same dir
+    try:
+        resilience.run_resumable(
+            digest_seed(), make_steps([])[: n_slabs - 1], sweep_dir,
+            every=ckpt_every, keep_last=3)
+        raise AssertionError("foreign sweep resume was admitted")
+    except CheckpointError:
+        foreign_refused["sweep"] = True
+
+    rows_driven = slab_rows * n_slabs
+    wall = time.perf_counter() - t_start
+    assert all(v for v in foreign_refused.values()
+               if v is not None), foreign_refused
+    return {
+        "rows_per_sec": round(rows_driven / sweep_wall, 1),
+        "rows_total": rows_driven,
+        "physical_rows": physical_rows,
+        "n_slabs": n_slabs,
+        "slab_rows": slab_rows,
+        "wall_s": round(wall, 1),
+        "ingest_resume": {
+            "kill": ingest_kill,
+            "shards_total": n_shards,
+            "shards_committed_before_kill": committed,
+            "shards_restreamed_on_resume": restreamed,
+            "reread_committed_shards": 0,
+            "value_audit": "resumed ingest bitwise == fresh ingest "
+                           "(assert_frame_equal check_exact)",
+        },
+        "quarantine": {
+            "named_error": True,
+            "corrupt_row_group": {"file": "part-1.parquet",
+                                  "row_group": rec["row_group"],
+                                  "rows": rec["rows"]},
+            "torn_footer_file_quarantined": True,
+            "rows_kept": q_rows,
+            "rows_clean": clean_rows,
+        },
+        "ingest_deadline_stage": deadline_stage,
+        "flapping_file": {
+            "breaker_tripped": True,
+            "quarantined": os.path.basename(flap_path),
+        },
+        "plan_barriers": {
+            "placed": len(barriers),
+            "resume_from_step": 1,
+            "pre_barrier_ops_rerun": join_calls,
+            "post_barrier_ops_rerun": stats_calls,
+            "zero_builds_after_resume": True,
+            "recovery_s": round(plan_recovery_s, 3),
+            "value_audit": "resumed planned chain bitwise == "
+                           "uninjected eager twin",
+        },
+        "sweep": {
+            "killed_at_slab": kill_at,
+            "resumed_from_barrier_slab": barrier_slab,
+            "replayed_slabs": kill_at - barrier_slab,
+            "new_slabs_after_kill": n_slabs - kill_at,
+            "builds_after_resume": builds_resume,
+            "recovery_s": round(sweep_recovery_s, 3),
+        },
+        "foreign_signature_refused": foreign_refused,
+        "no_silent_restores": True,
+        "tail_audit": (
+            f"digest of all {n_slabs} slabs (per-slab CRC-32 of the "
+            f"FULL collected output bytes) bitwise == uninjected twin; "
+            f"plan-barrier resume bitwise == eager twin"),
+    }

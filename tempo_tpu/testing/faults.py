@@ -254,6 +254,44 @@ def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
     return keep
 
 
+def corrupt_parquet_row_group(path: str, row_group: int = 0,
+                              column: int = 0) -> dict:
+    """Corrupt ONE row group of a real Parquet file in place by
+    smashing its first column chunk's page header (the minimal
+    corruption a reader reliably detects: byte flips inside compressed
+    page *data* can decode silently when page checksums are off, but a
+    garbled page header always fails deserialization).  Sibling row
+    groups stay readable — exactly the shape the ingest quarantine
+    must isolate.  Returns ``{"file", "row_group", "rows", "offset"}``
+    so tests can assert the quarantine names this precise range."""
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    rg = pf.metadata.row_group(row_group)
+    col = rg.column(column)
+    off = col.data_page_offset
+    if col.dictionary_page_offset is not None:
+        off = min(off, col.dictionary_page_offset)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        f.write(b"\xFF" * 8)
+    return {"file": path, "row_group": row_group,
+            "rows": rg.num_rows, "offset": off}
+
+
+def tear_parquet_footer(path: str) -> int:
+    """Torn-write injection: truncate a real Parquet file just short of
+    its trailing footer magic, the state a hard kill mid-flush (or a
+    partial object-store upload) leaves behind.  EVERY read of the file
+    then fails at open ('magic bytes not found in footer'), so the
+    whole file is the quarantine unit.  Returns the new size."""
+    size = os.path.getsize(path)
+    keep = max(0, size - 6)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
 # ----------------------------------------------------------------------
 # Crash residue
 # ----------------------------------------------------------------------

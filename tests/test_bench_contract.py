@@ -46,6 +46,7 @@ def test_bench_smoke_prints_one_json_line():
         "11_serving_ticks_per_sec", "12_mesh_scaling_top",
         "13_query_service_qps", "14_fleet_serving_ticks_per_sec",
         "15_chaos_serving_ticks_per_sec",
+        "16_chaos_pipeline_rows_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -148,6 +149,44 @@ def test_bench_smoke_prints_one_json_line():
     so = svc_c.get("outcomes") or {}
     assert so.get("quarantined", 0) >= 1
     assert so.get("deadline", 0) >= 1 and so.get("cancelled", 0) >= 1
+    # config 16 (round 14): the BATCH-plane chaos campaign — every
+    # invariant asserted hard inside the campaign, the record keys
+    # pinned here so the driver-recorded line always carries the proof
+    # (transactional ingest resume with zero committed-shard re-reads,
+    # quarantine with named ranges, stage-named deadline, breaker,
+    # plan-barrier resume with zero rebuilds, the slab sweep resumed
+    # from the newest signed barrier, foreign-state refusal, bitwise
+    # tails vs uninjected twins)
+    cp = rec.get("chaos_pipeline") or {}
+    assert cp.get("rows_per_sec", 0) > 0, cp
+    assert cp.get("rows_total", 0) >= cp.get("physical_rows", 1)
+    ir_ = cp.get("ingest_resume") or {}
+    assert ir_.get("kill") is True
+    assert ir_.get("shards_committed_before_kill", 0) >= 1
+    assert ir_.get("reread_committed_shards") == 0
+    assert "bitwise" in ir_.get("value_audit", "")
+    qr = cp.get("quarantine") or {}
+    assert qr.get("named_error") is True
+    assert qr.get("corrupt_row_group", {}).get("rows", 0) > 0
+    assert qr.get("torn_footer_file_quarantined") is True
+    assert 0 < qr.get("rows_kept", 0) < qr.get("rows_clean", 0)
+    assert cp.get("ingest_deadline_stage")
+    assert (cp.get("flapping_file") or {}).get("breaker_tripped") is True
+    pb = cp.get("plan_barriers") or {}
+    assert pb.get("placed", 0) >= 3
+    assert pb.get("pre_barrier_ops_rerun") == 0
+    assert pb.get("post_barrier_ops_rerun", 0) >= 1
+    assert pb.get("zero_builds_after_resume") is True
+    assert "bitwise" in pb.get("value_audit", "")
+    sw = cp.get("sweep") or {}
+    assert sw.get("killed_at_slab", 0) > sw.get(
+        "resumed_from_barrier_slab", -1)
+    assert sw.get("replayed_slabs", -1) >= 1
+    assert sw.get("builds_after_resume") == 0
+    fr = cp.get("foreign_signature_refused") or {}
+    assert fr.get("ingest") is True and fr.get("plan") is True \
+        and fr.get("sweep") is True
+    assert "bitwise" in cp.get("tail_audit", "")
     # config 12 (round 10): the mesh-scaling sweep must have measured
     # every device count of its (smoke-clipped) ladder, each point with
     # the in-bench planned==eager bitwise audit and the per-stage comm

@@ -1,0 +1,341 @@
+"""Transactional out-of-core ingest (io/ingest.py): per-shard progress
+manifests, row-group quarantine, the end-to-end deadline, and the
+per-file circuit breaker."""
+
+import glob
+import json
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import resilience
+from tempo_tpu.io import ingest
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.resilience import (CheckpointError, DeadlineExceeded,
+                                  FailureKind)
+from tempo_tpu.testing import chaos, faults
+
+N_ROWS = 12_000
+N_KEYS = 24
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("txn") / "ds")
+    chaos.make_parquet_dataset(d, n_rows=N_ROWS, n_keys=N_KEYS, seed=3,
+                               n_files=4)
+    return d
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"series": 8})
+
+
+KW = dict(ts_col="event_ts", partition_cols=["symbol"],
+          batch_rows=2048)
+
+
+def _srt(frame):
+    return frame.collect().df.sort_values(
+        ["symbol", "event_ts"], kind="stable").reset_index(drop=True)
+
+
+# ----------------------------------------------------------------------
+# Per-shard progress manifests
+# ----------------------------------------------------------------------
+
+def test_kill_mid_stream_then_resume_skips_committed_shards(
+        dataset, mesh, tmp_path):
+    rd = str(tmp_path / "resume")
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(ingest, "_stream_shard", call_no=3)
+        with pytest.raises(faults.SimulatedKill):
+            ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd, **KW)
+    committed = len(glob.glob(os.path.join(rd, "shard_*.json")))
+    assert committed == 2
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_stream_shard", failures=0)   # call counter
+        frame = ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                    **KW)
+        assert len(fi.records) == 8 - committed, (
+            "resume re-streamed committed shards")
+    fresh = ingest.from_parquet(dataset, mesh=mesh, **KW)
+    pd.testing.assert_frame_equal(_srt(frame), _srt(fresh),
+                                  check_exact=True)
+
+
+def test_completed_resume_rereads_nothing(dataset, mesh, tmp_path):
+    rd = str(tmp_path / "resume")
+    ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd, **KW)
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_stream_shard", failures=0)
+        fi.flaky(ingest, "_census", failures=0, label="census")
+        frame = ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                    **KW)
+        assert fi.records == [], (
+            "a fully-committed resume dir still re-read Parquet")
+    assert len(frame.collect().df) == N_ROWS
+
+
+def test_corrupt_shard_manifest_restreams_that_shard(
+        dataset, mesh, tmp_path):
+    rd = str(tmp_path / "resume")
+    ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd, **KW)
+    faults.flip_byte(os.path.join(rd, "shard_0003.npz"), 2000)
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_stream_shard", failures=0)
+        frame = ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                    **KW)
+        assert len(fi.records) == 1     # only the corrupt shard
+    fresh = ingest.from_parquet(dataset, mesh=mesh, **KW)
+    pd.testing.assert_frame_equal(_srt(frame), _srt(fresh),
+                                  check_exact=True)
+
+
+def test_stale_ledger_shard_manifest_is_restreamed(dataset, mesh,
+                                                   tmp_path):
+    """A shard manifest stamped under a DIFFERENT quarantine ledger
+    (the state a kill during a consistency re-stream leaves behind)
+    is invalidated on load, never stitched in."""
+    rd = str(tmp_path / "resume")
+    ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd, **KW)
+    jp = os.path.join(rd, "shard_0002.json")
+    with open(jp) as f:
+        doc = json.load(f)
+    doc["ledger_crc"] = 0xDEAD
+    with open(jp, "w") as f:
+        json.dump(doc, f)
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_stream_shard", failures=0)
+        frame = ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                    **KW)
+        assert len(fi.records) == 1     # only the stale-stamped shard
+    fresh = ingest.from_parquet(dataset, mesh=mesh, **KW)
+    pd.testing.assert_frame_equal(_srt(frame), _srt(fresh),
+                                  check_exact=True)
+
+
+def test_foreign_resume_dir_refused_by_name(dataset, mesh, tmp_path):
+    rd = str(tmp_path / "resume")
+    ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd, **KW)
+    with pytest.raises(CheckpointError, match="DIFFERENT ingest"):
+        ingest.from_parquet(dataset, mesh=make_mesh({"series": 4}),
+                            resume_dir=rd, **KW)
+
+
+def test_changed_source_file_refuses_stale_resume(dataset, mesh,
+                                                  tmp_path):
+    """Committed shards hold the dataset AS IT WAS: if a source file
+    is rewritten between the kill and the resume, restoring them would
+    silently stitch old and new data — the resume signature covers the
+    dataset's file-level state, so the stale directory refuses by
+    name."""
+    qd = str(tmp_path / "mutds")
+    shutil.copytree(dataset, qd)
+    rd = str(tmp_path / "resume")
+    ingest.from_parquet(qd, mesh=mesh, resume_dir=rd, **KW)
+    faults.flip_byte(os.path.join(qd, "part-0.parquet"), 64)
+    with pytest.raises(CheckpointError, match="DIFFERENT ingest"):
+        ingest.from_parquet(qd, mesh=mesh, resume_dir=rd, **KW)
+
+
+# ----------------------------------------------------------------------
+# Row-group quarantine
+# ----------------------------------------------------------------------
+
+def test_corrupt_row_group_raises_named_error_with_ranges(
+        dataset, mesh, tmp_path):
+    qd = str(tmp_path / "qds")
+    shutil.copytree(dataset, qd)
+    rec = faults.corrupt_parquet_row_group(
+        os.path.join(qd, "part-1.parquet"), row_group=2)
+    with pytest.raises(ingest.CorruptRowGroupError) as ei:
+        ingest.from_parquet(qd, mesh=mesh, **KW)
+    ranges = ei.value.ranges
+    assert any(r["row_group"] == 2 and r["file"].endswith("part-1.parquet")
+               and r["rows"] == rec["rows"] for r in ranges), ranges
+
+
+def test_quarantine_mode_skips_exactly_the_corrupt_range(
+        dataset, mesh, tmp_path):
+    qd = str(tmp_path / "qds")
+    shutil.copytree(dataset, qd)
+    rec = faults.corrupt_parquet_row_group(
+        os.path.join(qd, "part-1.parquet"), row_group=2)
+    frame = ingest.from_parquet(qd, mesh=mesh, on_corrupt="quarantine",
+                                **KW)
+    assert [(os.path.basename(r["file"]), r["row_group"])
+            for r in frame.ingest_quarantined] == [("part-1.parquet", 2)]
+    assert len(frame.collect().df) == N_ROWS - rec["rows"]
+    # the skipped range is reported on the frame's audit trail too
+    assert any("quarantined" in msg for msg, _ in frame.audits)
+
+
+def test_torn_footer_quarantines_the_whole_file(dataset, mesh, tmp_path):
+    qd = str(tmp_path / "tds")
+    shutil.copytree(dataset, qd)
+    faults.tear_parquet_footer(os.path.join(qd, "part-0.parquet"))
+    with pytest.raises(ingest.CorruptRowGroupError):
+        ingest.from_parquet(qd, mesh=mesh, **KW)
+    frame = ingest.from_parquet(qd, mesh=mesh, on_corrupt="quarantine",
+                                **KW)
+    assert [(os.path.basename(r["file"]), r["row_group"])
+            for r in frame.ingest_quarantined] == [("part-0.parquet",
+                                                    None)]
+    assert len(frame.collect().df) == N_ROWS - N_ROWS // 4
+
+
+def test_resumed_census_freezes_the_quarantine_ledger(
+        dataset, mesh, tmp_path):
+    """A range quarantined during pass 1 stays skipped in pass 2 of a
+    RESUMED run (census from the manifest): rows the census never
+    counted must not reappear."""
+    qd = str(tmp_path / "qds")
+    shutil.copytree(dataset, qd)
+    faults.corrupt_parquet_row_group(os.path.join(qd, "part-1.parquet"),
+                                     row_group=1)
+    rd = str(tmp_path / "resume")
+    want = ingest.from_parquet(qd, mesh=mesh, on_corrupt="quarantine",
+                               resume_dir=rd, **KW)
+    # census manifest records the ledger
+    with open(os.path.join(rd, "census.json")) as f:
+        assert json.load(f)["quarantined"]
+    # wipe the shard manifests so pass 2 re-streams, keep the census
+    for p in glob.glob(os.path.join(rd, "shard_*")):
+        os.remove(p)
+    got = ingest.from_parquet(qd, mesh=mesh, on_corrupt="quarantine",
+                              resume_dir=rd, **KW)
+    pd.testing.assert_frame_equal(_srt(got), _srt(want),
+                                  check_exact=True)
+
+
+# ----------------------------------------------------------------------
+# Deadline + circuit breaker
+# ----------------------------------------------------------------------
+
+def test_end_to_end_deadline_dies_stage_named(dataset, mesh):
+    with pytest.raises(DeadlineExceeded) as ei:
+        ingest.from_parquet(dataset, mesh=mesh, deadline_s=1e-6, **KW)
+    assert ei.value.stage == "dataset open"
+
+
+def test_deadline_names_the_census_stage(dataset, mesh):
+    """A deadline that survives open/validation but dies mid-census
+    names THAT stage."""
+
+    class DiesAtCensus(resilience.Deadline):
+        def check(self, stage):
+            if stage == "census":
+                self.expires_at = self._clock() - 1.0
+            return super().check(stage)
+
+    with pytest.raises(DeadlineExceeded) as ei:
+        ingest.from_parquet(dataset, mesh=mesh,
+                            deadline_s=DiesAtCensus(3600.0), **KW)
+    assert ei.value.stage == "census"
+
+
+def test_deadline_knob_default(dataset, mesh, monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_INGEST_DEADLINE_S", "0.000001")
+    with pytest.raises(DeadlineExceeded):
+        ingest.from_parquet(dataset, mesh=mesh, **KW)
+
+
+def test_flapping_file_trips_breaker_and_is_quarantined(
+        dataset, mesh, tmp_path):
+    """2 transient failures of ONE file open its breaker: the third
+    pass attempt quarantines the file and the ingest COMPLETES —
+    instead of the flapping file exhausting the whole retry budget."""
+    bad = os.path.join(dataset, "part-2.parquet")
+    orig = ingest._scan_fragment
+
+    def flapping(frag, *a, **k):
+        if getattr(frag, "path", "") == bad:
+            raise faults.InjectedFault(f"flapping read at {bad}")
+        return orig(frag, *a, **k)
+
+    brk = resilience.CircuitBreaker(threshold=2, cooldown_s=600.0)
+    ingest._scan_fragment = flapping
+    try:
+        frame = ingest.from_parquet(dataset, mesh=mesh,
+                                    on_corrupt="quarantine",
+                                    breaker=brk, **KW)
+    finally:
+        ingest._scan_fragment = orig
+    q = [r for r in frame.ingest_quarantined if r["file"] == bad]
+    assert q and "circuit" in q[0]["reason"]
+    assert brk.stats()["trips"] >= 1
+    assert len(frame.collect().df) == N_ROWS - N_ROWS // 4
+
+
+def test_pass2_quarantine_restreams_for_a_consistent_frame(
+        dataset, mesh):
+    """A file that streams cleanly through the census AND the first
+    shards, then starts flapping, is quarantined mid-pass-2: the shard
+    pass restarts under the frozen ledger so EARLIER shards cannot
+    retain rows later shards lost — the file's rows are absent
+    everywhere, never partially present."""
+    bad = os.path.join(dataset, "part-1.parquet")
+    orig = ingest._scan_fragment
+    calls = {"n": 0}
+
+    def late_flapping(frag, schema, columns, filt, batch_rows):
+        if getattr(frag, "path", "") == bad and columns \
+                and "px" in columns:
+            calls["n"] += 1
+            if calls["n"] > 2:      # healthy for the first two shards
+                raise faults.InjectedFault(f"late flap at {bad}")
+        return orig(frag, schema, columns, filt, batch_rows)
+
+    brk = resilience.CircuitBreaker(threshold=2, cooldown_s=600.0)
+    ingest._scan_fragment = late_flapping
+    try:
+        frame = ingest.from_parquet(dataset, mesh=mesh,
+                                    on_corrupt="quarantine",
+                                    breaker=brk, **KW)
+    finally:
+        ingest._scan_fragment = orig
+    assert calls["n"] > 2, "the late flap never fired"
+    q = [r for r in frame.ingest_quarantined if r["file"] == bad]
+    assert q and "circuit" in q[0]["reason"]
+    # consistent: the file's rows are gone from EVERY shard
+    assert len(frame.collect().df) == N_ROWS - N_ROWS // 4
+
+
+# ----------------------------------------------------------------------
+# classify(): every new ingest error maps to its recovery action
+# ----------------------------------------------------------------------
+
+class TestClassifyIngestErrors:
+    def test_corrupt_row_group_is_corrupted_artifact(self):
+        e = ingest.CorruptRowGroupError("bad", ranges=[{"file": "f"}])
+        assert resilience.classify(e) is FailureKind.CORRUPTED_ARTIFACT
+
+    def test_foreign_resume_is_permanent(self):
+        e = CheckpointError("foreign", kind=FailureKind.PERMANENT)
+        assert resilience.classify(e) is FailureKind.PERMANENT
+
+    def test_stage_named_deadline_is_deadline(self):
+        assert resilience.classify(
+            DeadlineExceeded("out of budget", stage="census")
+        ) is FailureKind.DEADLINE
+
+    def test_page_header_corruption_classifies_permanent_not_transient(
+            self, tmp_path):
+        """The real pyarrow error a smashed page header raises must
+        NOT classify transient (it would be retried forever)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        p = str(tmp_path / "f.parquet")
+        pq.write_table(pa.table({"x": np.arange(100.)}), p,
+                       row_group_size=25)
+        faults.corrupt_parquet_row_group(p, row_group=1)
+        with pytest.raises((OSError, ValueError)) as ei:
+            pq.ParquetFile(p).read()
+        kind = resilience.classify(ei.value)
+        assert kind is not FailureKind.TRANSIENT_IO

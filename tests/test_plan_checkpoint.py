@@ -1,0 +1,280 @@
+"""Plan-integrated checkpoint barriers (tempo_tpu/plan/checkpoints.py
++ the optimizer's TEMPO_TPU_CKPT_PLACEMENT pass + the executor's
+signed save/resume).
+
+The contracts: barriers are first-class plan nodes placed at
+materialization boundaries and rendered by explain() with estimated
+bytes; execution under a checkpointed() context writes signed,
+CRC-chained step manifests; re-submission resumes from the newest
+intact barrier re-running ONLY the ops above it with ZERO new
+executable builds; and a barrier stamped by a different plan is
+refused by name (CheckpointError) — never silently restored.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import TSDF, checkpoint, profiling
+from tempo_tpu.dist import DistributedTSDF
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.plan import checkpoints as plan_ckpt
+from tempo_tpu.plan import ir, optimizer
+from tempo_tpu.resilience import CheckpointError
+from tempo_tpu.service import lazy_frame
+from tempo_tpu.testing import faults
+
+
+def _mk_df(seed, n=240):
+    r = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "sym": r.choice(["a", "b", "c", "d"], n),
+        "event_ts": pd.to_datetime(
+            np.sort(r.integers(0, 4000, n)) * 1_000_000_000),
+        "px": r.standard_normal(n),
+        "qty": r.integers(1, 50, n).astype(float),
+    })
+
+
+@pytest.fixture(scope="module")
+def frames():
+    mesh = make_mesh({"series": 4})
+    left = TSDF(_mk_df(1), "event_ts", ["sym"]).on_mesh(mesh)
+    right = TSDF(_mk_df(2), "event_ts", ["sym"]).on_mesh(mesh)
+    return left, right
+
+
+def _chain(left, right, extra_ema=False):
+    # skipNulls=False keeps the chain un-fused: three distinct device
+    # ops -> three distinct barriers
+    c = (lazy_frame(left)
+         .asofJoin(lazy_frame(right), right_prefix="q", skipNulls=False)
+         .withRangeStats(colsToSummarize=["q_px", "q_qty"],
+                         rangeBackWindowSecs=60)
+         .EMA("q_px", exact=True))
+    if extra_ema:
+        c = c.EMA("q_qty", exact=True)
+    return c
+
+
+def _srt(df):
+    return df.sort_values(["sym", "event_ts"],
+                          kind="stable").reset_index(drop=True)
+
+
+def _eager(left, right):
+    return _srt(
+        left.asofJoin(right, right_prefix="q", skipNulls=False)
+        .withRangeStats(colsToSummarize=["q_px", "q_qty"],
+                        rangeBackWindowSecs=60)
+        .EMA("q_px", exact=True).collect().df)
+
+
+# ----------------------------------------------------------------------
+# Placement + rendering
+# ----------------------------------------------------------------------
+
+def test_no_context_no_barriers(frames):
+    left, right = frames
+    opt = optimizer.optimize(_chain(left, right)._node)
+    assert not [n for n in opt.walk() if n.op == "checkpoint"]
+
+
+def test_barriers_placed_at_every_boundary(frames, tmp_path):
+    left, right = frames
+    with plan_ckpt.checkpointed(str(tmp_path)):
+        root = ir.Node("collect", inputs=(_chain(left, right)._node,))
+        opt = optimizer.optimize(root)
+    ckpts = [n for n in opt.walk() if n.op == "checkpoint"]
+    assert [n.param("step") for n in ckpts] == [1, 2, 3]
+    # each barrier's input is a device op, in execution order
+    assert [n.inputs[0].op for n in ckpts] == [
+        "asof_join", "range_stats", "ema"]
+    # bytes estimate annotated for explain()
+    assert all(n.ann.get("ckpt_bytes_est", 0) > 0 for n in ckpts)
+
+
+def test_every_k_thins_barriers_and_keeps_the_terminal_one(
+        frames, tmp_path):
+    left, right = frames
+    with plan_ckpt.checkpointed(str(tmp_path), every=2):
+        root = ir.Node("collect", inputs=(_chain(left, right)._node,))
+        opt = optimizer.optimize(root)
+    ckpts = [n for n in opt.walk() if n.op == "checkpoint"]
+    # op 2 (stats) hits every=2; the terminal EMA is barriered as the
+    # materialisation boundary under collect
+    assert [n.inputs[0].op for n in ckpts] == ["range_stats", "ema"]
+
+
+def test_placement_off_knob(frames, tmp_path, monkeypatch):
+    left, right = frames
+    monkeypatch.setenv("TEMPO_TPU_CKPT_PLACEMENT", "off")
+    with plan_ckpt.checkpointed(str(tmp_path)):
+        opt = optimizer.optimize(_chain(left, right)._node)
+    assert not [n for n in opt.walk() if n.op == "checkpoint"]
+
+
+def test_uncacheable_plan_gets_no_barriers(tmp_path):
+    t = TSDF(_mk_df(3), "event_ts", ["sym"])
+    lazy = lazy_frame(t).withColumn("z", lambda df: df["px"])
+    with plan_ckpt.checkpointed(str(tmp_path)):
+        opt = optimizer.optimize(
+            lazy.EMA("px", exact=True)._node)
+    assert not [n for n in opt.walk() if n.op == "checkpoint"]
+
+
+def test_explain_renders_barriers(frames, tmp_path):
+    left, right = frames
+    with plan_ckpt.checkpointed(str(tmp_path)):
+        text = _chain(left, right).explain()
+    assert "checkpoint[step 1]" in text
+    assert "signed step manifest" in text
+    assert "B est" in text
+
+
+# ----------------------------------------------------------------------
+# Execution: signed saves, bitwise identity, resume, refusal
+# ----------------------------------------------------------------------
+
+def test_checkpointed_run_is_bitwise_and_writes_signed_chain(
+        frames, tmp_path):
+    left, right = frames
+    d = str(tmp_path / "ck")
+    with plan_ckpt.checkpointed(d):
+        got = _srt(_chain(left, right).collect().df)
+    pd.testing.assert_frame_equal(got, _eager(left, right),
+                                  check_exact=True)
+    steps = sorted(s for s, _ in checkpoint.list_steps(d))
+    assert steps == [1, 2, 3]
+    # signed + chained manifests
+    metas = {s: checkpoint.read_meta(p)
+             for s, p in checkpoint.list_steps(d)}
+    sigs = {m["pipeline_signature"] for m in metas.values()}
+    assert len(sigs) == 1
+    assert metas[2]["prev_step"] == 1
+    assert metas[3]["prev_manifest_crc"] == checkpoint.manifest_crc(
+        os.path.join(d, "step_00002"))
+
+
+def test_kill_mid_chain_resumes_from_newest_intact_barrier(
+        frames, tmp_path):
+    left, right = frames
+    d = str(tmp_path / "killed")
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(np, "savez", call_no=2)   # dies saving barrier 2
+        with pytest.raises(faults.SimulatedKill):
+            with plan_ckpt.checkpointed(d):
+                _chain(left, right).collect()
+    assert checkpoint.latest(d).endswith("step_00001")
+    builds0 = profiling.plan_cache_stats()["builds"]
+    with faults.FaultInjector() as fi:
+        fi.flaky(DistributedTSDF, "asofJoin", failures=0)
+        fi.flaky(DistributedTSDF, "withRangeStats", failures=0,
+                 label="stats")
+        with plan_ckpt.checkpointed(d):
+            got = _srt(_chain(left, right).collect().df)
+        join_calls = sum(r.target != "stats" for r in fi.records)
+        stats_calls = sum(r.target == "stats" for r in fi.records)
+    assert join_calls == 0, "the pre-barrier join was re-executed"
+    assert stats_calls == 1
+    assert profiling.plan_cache_stats()["builds"] == builds0, (
+        "resume rebuilt an executable")
+    pd.testing.assert_frame_equal(got, _eager(left, right),
+                                  check_exact=True)
+
+
+def test_corrupt_newest_barrier_falls_back(frames, tmp_path):
+    left, right = frames
+    d = str(tmp_path / "corrupt")
+    with plan_ckpt.checkpointed(d):
+        want = _srt(_chain(left, right).collect().df)
+    faults.corrupt_npz_array(os.path.join(d, "step_00003", "arrays.npz"))
+    with faults.FaultInjector() as fi:
+        fi.flaky(DistributedTSDF, "EMA", failures=0)
+        with plan_ckpt.checkpointed(d):
+            got = _srt(_chain(left, right).collect().df)
+        # resumed from barrier 2: only the EMA re-ran
+        assert len(fi.records) == 1
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_foreign_plan_signature_refused_by_name(frames, tmp_path):
+    left, right = frames
+    d = str(tmp_path / "foreign")
+    with plan_ckpt.checkpointed(d):
+        _chain(left, right).collect()
+    with pytest.raises(CheckpointError, match="DIFFERENT pipeline"):
+        with plan_ckpt.checkpointed(d):
+            _chain(left, right, extra_ema=True).collect()
+
+
+def test_run_outside_context_is_unaffected(frames, tmp_path):
+    """The same logical chain outside the context takes the
+    barrier-free executable (distinct cache key) and writes nothing."""
+    left, right = frames
+    d = str(tmp_path / "ck2")
+    with plan_ckpt.checkpointed(d):
+        _chain(left, right).collect()
+    n_before = len(checkpoint.list_steps(d))
+    got = _srt(_chain(left, right).collect().df)
+    assert len(checkpoint.list_steps(d)) == n_before
+    pd.testing.assert_frame_equal(got, _eager(left, right),
+                                  check_exact=True)
+
+
+def test_same_chain_different_data_is_refused(frames, tmp_path):
+    """The stale-restore hazard: the SAME plan structure over
+    different same-shape data must not resume the old data's barriers
+    — the stamped signature folds each source's content fingerprint."""
+    left, right = frames
+    d = str(tmp_path / "stale")
+    with plan_ckpt.checkpointed(d):
+        _chain(left, right).collect()
+    df2 = _mk_df(1)
+    df2["px"] = df2["px"] + 100.0           # same shapes, new values
+    left2 = TSDF(df2, "event_ts", ["sym"]).on_mesh(left.mesh)
+    with pytest.raises(CheckpointError, match="DIFFERENT pipeline"):
+        with plan_ckpt.checkpointed(d):
+            _chain(left2, right).collect()
+
+
+def test_shared_source_across_barrier_resumes(frames, tmp_path):
+    """A DAG sharing one source across the resume barrier: the shared
+    node has a live consumer ABOVE the barrier, so it must stay bound
+    on resume (not nulled with the skipped subtree)."""
+    left, right = frames
+
+    def chain2():
+        lr = lazy_frame(right)
+        return (lazy_frame(left)
+                .asofJoin(lr, right_prefix="q", skipNulls=False)
+                .withRangeStats(colsToSummarize=["q_px"],
+                                rangeBackWindowSecs=60)
+                .asofJoin(lr, right_prefix="z", skipNulls=False))
+
+    want = _srt(chain2().collect().df)      # barrier-free golden
+    d = str(tmp_path / "dag")
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(np, "savez", call_no=3)   # dies saving barrier 3
+        with pytest.raises(faults.SimulatedKill):
+            with plan_ckpt.checkpointed(d):
+                chain2().collect()
+    assert checkpoint.latest(d).endswith("step_00002")
+    with plan_ckpt.checkpointed(d):
+        got = _srt(chain2().collect().df)
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_host_chain_barriers_roundtrip(tmp_path):
+    """Host (non-mesh) planned chains checkpoint and resume through
+    the same machinery."""
+    t = TSDF(_mk_df(9), "event_ts", ["sym"])
+    d = str(tmp_path / "host")
+    with plan_ckpt.checkpointed(d):
+        want = lazy_frame(t).EMA("px", exact=True).to_pandas()
+    assert checkpoint.list_steps(d)
+    with plan_ckpt.checkpointed(d):
+        got = lazy_frame(t).EMA("px", exact=True).to_pandas()
+    pd.testing.assert_frame_equal(got, want, check_exact=True)

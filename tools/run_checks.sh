@@ -51,6 +51,17 @@ echo "== chaos gate (fault-injection suite incl. the campaign smoke) =="
 JAX_PLATFORMS=cpu python -m pytest tests -q -m 'chaos and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
 
+echo "== batch chaos gate (plan barriers + transactional ingest + campaign) =="
+# the BATCH-plane fault domain, surfaced before tier-1: plan-integrated
+# checkpoint barriers (signed manifests, resume-with-zero-rebuilds,
+# foreign-signature refusal), the transactional OOC ingest (per-shard
+# progress manifests, row-group quarantine, stage-named deadline,
+# flapping-file breaker), and the config-16 campaign smoke
+JAX_PLATFORMS=cpu python -m pytest tests/test_plan_checkpoint.py \
+    tests/test_ingest_resume.py tests/test_batch_chaos.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
