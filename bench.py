@@ -82,18 +82,20 @@ if os.environ.get("TEMPO_BENCH_SMOKE"):
 V5E_HBM_BYTES_PER_SEC = 819e9
 
 
-def make_data(seed=0):
+def make_data(seed=0, k=None, l=None):
+    k = K if k is None else k
+    l = L if l is None else l
     rng = np.random.default_rng(seed)
     # ~1 event/sec with jitter, like the accelerometer quickstart data
-    gaps = rng.integers(1, 3, size=(K, L)).astype(np.int64)
+    gaps = rng.integers(1, 3, size=(k, l)).astype(np.int64)
     l_secs = np.cumsum(gaps, axis=-1)
     l_ts = l_secs * np.int64(1_000_000_000)
-    r_secs = np.cumsum(rng.integers(1, 3, size=(K, L)).astype(np.int64), axis=-1)
+    r_secs = np.cumsum(rng.integers(1, 3, size=(k, l)).astype(np.int64), axis=-1)
     r_ts = r_secs * np.int64(1_000_000_000)
-    x = rng.standard_normal((K, L)).astype(np.float32)
-    valid = np.ones((K, L), dtype=bool)
-    r_values = rng.standard_normal((N_RIGHT_COLS, K, L)).astype(np.float32)
-    r_valids = rng.random((N_RIGHT_COLS, K, L)) > 0.1
+    x = rng.standard_normal((k, l)).astype(np.float32)
+    valid = np.ones((k, l), dtype=bool)
+    r_values = rng.standard_normal((N_RIGHT_COLS, k, l)).astype(np.float32)
+    r_valids = rng.random((N_RIGHT_COLS, k, l)) > 0.1
     return l_ts, l_secs, x, valid, r_ts, r_valids, r_values
 
 
@@ -232,8 +234,10 @@ def _loop_rate(body, args, n_rows, label, want_outputs=False, run=None,
             f"measurement is invalid."
         )
     implied_bw = (bytes_per_iter or in_bytes) / t_iter
+    # one decimal: the windowed engines run well under 1 GB/s and the
+    # old :.0f rendered every such line as "(0 GB/s implied)"
     print(f"[{label}] {n_rows / t_iter:,.0f} rows/s  "
-          f"({implied_bw / 1e9:.0f} GB/s implied)", file=sys.stderr,
+          f"({implied_bw / 1e9:,.1f} GB/s implied)", file=sys.stderr,
           flush=True)
     if want_outputs:
         # one more n=1 trip of the same compiled program at scale 1.0
@@ -404,17 +408,11 @@ def _measured_rowbounds(secs, w):
     return behind, ahead
 
 
-def bench_range_stats(data):
-    """Config 2: withRangeStats 10s window.
-
-    Round 6: the bounds are the ones the DATA needs
-    (:func:`_measured_rowbounds`, ~11+0 rows here) instead of the
-    static MAX_WINDOW_ROWS/MAX_TIE_ROWS headroom (20+8 = 29 unrolled
-    passes — over 2x the necessary sweep), and the x*scale pre-pass
-    rides into the kernel as an SMEM scalar instead of re-streaming
-    the column (8B/row, ~0.1 ms/iteration at the measured stream
-    rate).  The on-device truncation audit threads through the timing
-    carry and must be zero."""
+def _range_stats_setup(data):
+    """(body, args, bytes_per_iter) of config 2 — ONE builder shared by
+    the headline measurement (:func:`bench_range_stats`) and the tuned
+    re-measurement (:func:`bench_tuned`), so the tuned-vs-default
+    comparison can never drift onto a different kernel body."""
     _, l_secs, x, valid, _, _, _ = data
     args = [jax.device_put(a) for a in (l_secs, x, valid)]
     behind, ahead = _measured_rowbounds(l_secs, int(WINDOW_SECS))
@@ -427,12 +425,28 @@ def bench_range_stats(data):
             max_behind=behind, max_ahead=ahead, scale=scale,
         ))
 
+    # reads (i64 secs + x + valid) + the i32 jitter-cast re-stream
+    # + 8 written stat planes — the same per-row accounting the
+    # roofline record uses (_roofline_report)
+    return body, args, l_secs.size * (8 + 4 + 1 + 8 + 8 * 4), (behind,
+                                                               ahead)
+
+
+def bench_range_stats(data):
+    """Config 2: withRangeStats 10s window.
+
+    Round 6: the bounds are the ones the DATA needs
+    (:func:`_measured_rowbounds`, ~11+0 rows here) instead of the
+    static MAX_WINDOW_ROWS/MAX_TIE_ROWS headroom (20+8 = 29 unrolled
+    passes — over 2x the necessary sweep), and the x*scale pre-pass
+    rides into the kernel as an SMEM scalar instead of re-streaming
+    the column (8B/row, ~0.1 ms/iteration at the measured stream
+    rate).  The on-device truncation audit threads through the timing
+    carry and must be zero."""
+    body, args, bpi, (behind, ahead) = _range_stats_setup(data)
     rate, bw, t_iter, out_small = _loop_rate(
         body, args, K * L, label="range_stats", want_outputs=True,
-        # reads (i64 secs + x + valid) + the i32 jitter-cast re-stream
-        # + 8 written stat planes — the same per-row accounting the
-        # roofline record uses (_roofline_report)
-        bytes_per_iter=K * L * (8 + 4 + 1 + 8 + 8 * 4),
+        bytes_per_iter=bpi,
     )
     clipped = float(np.asarray(out_small["clipped"]).sum())
     assert clipped == 0, (
@@ -456,6 +470,19 @@ def bench_resample_ema(data):
     own HBM round trip and the bucket division ran in emulated i64.
     The audit (TPU f32 vs numpy f64, resampled + EMA planes) rides the
     timing carry like the fused config."""
+    body, args, bpi = _resample_ema_setup(data)
+    rate, bw, t_iter, out_small = _loop_rate(
+        body, args, K * L, label="resample_ema", want_outputs=True,
+        bytes_per_iter=bpi,
+    )
+    _resample_audit(out_small, data)
+    return rate, bw, t_iter
+
+
+def _resample_ema_setup(data):
+    """(body, args, bytes_per_iter) of config 3 — shared by
+    :func:`bench_resample_ema` and :func:`bench_tuned` (see
+    :func:`_range_stats_setup`)."""
     from tempo_tpu.ops import pallas_bucket as pb
 
     _, l_secs, x, valid, _, _, _ = data
@@ -483,12 +510,7 @@ def bench_resample_ema(data):
         ema = pk.ema_scan(x * scale, head, 0.2)
         return {"resampled": res, "ema": ema}
 
-    rate, bw, t_iter, out_small = _loop_rate(
-        body, args, K * L, label="resample_ema", want_outputs=True,
-        bytes_per_iter=K * L * (8 + 4 + 1 + 8 + 2 * 4),
-    )
-    _resample_audit(out_small, data)
-    return rate, bw, t_iter
+    return body, args, l_secs.size * (8 + 4 + 1 + 8 + 2 * 4)
 
 
 def _resample_audit(out_small, data):
@@ -797,7 +819,7 @@ def _seq_audit(out_small, data, r_seq):
 # _roofline_report hbm_frac entries for configs 2/2b
 _STATS_BYTES_ROW = 8 + 4 + 1 + 8 + 8 * 4
 
-def _dense_stats_data(mean_gap_ms, seed=2):
+def _dense_stats_data(mean_gap_ms, seed=2, k=None, l=None):
     """~1000/mean_gap_ms Hz ticks: a 10s window spans ~10000/gap rows.
     Gap jitter is ±25% so the densest stretch bounds the row extent at
     ~4/3 of the mean — this keeps the medium config's XLA shifted form
@@ -806,14 +828,35 @@ def _dense_stats_data(mean_gap_ms, seed=2):
     the W=512 OOM).  The ~140-row extent is far above the Pallas
     kernel's 64-row ceiling either way, so the shifted measurement IS
     the XLA form — exactly what the auto-pick would run here."""
+    k = K if k is None else k
+    l = L if l is None else l
     rng = np.random.default_rng(seed)
     gaps = rng.integers(max(3 * mean_gap_ms // 4, 1),
                         max(5 * mean_gap_ms // 4, 2),
-                        size=(K, L)).astype(np.int64)
+                        size=(k, l)).astype(np.int64)
     ms = np.cumsum(gaps, axis=-1)
-    x = rng.standard_normal((K, L)).astype(np.float32)
-    valid = np.ones((K, L), dtype=bool)
+    x = rng.standard_normal((k, l)).astype(np.float32)
+    valid = np.ones((k, l), dtype=bool)
     return ms, x, valid
+
+
+def _windowed_bytes_row(nlev):
+    """Real per-row plane traffic of the windowed (prefix-scan + RMQ)
+    engine — the accounting the streaming configs already had but the
+    windowed configs never got (their lines billed only the compulsory
+    input reads, printing "(0 GB/s implied)" and under-reporting the
+    engine's traffic in the crossover record).  Per row: the i64/f32/
+    bool inputs; the start/end i32 bound planes written then re-read by
+    the window gathers; the three f32 prefix planes (sum, sum-of-
+    squares, count) written and gathered back twice (hi/lo); the two
+    min/max sparse tables at ``nlev`` f32 levels each plus the 2x2
+    range-query gathers; and the 7 written stat planes."""
+    return ((8 + 4 + 1)            # ts + x + valid inputs
+            + 2 * (4 + 4)          # start/end bounds: write + gather read
+            + 3 * 4 + 2 * 3 * 4    # prefix planes: build + hi/lo gathers
+            + 2 * nlev * 4         # min/max sparse-table levels
+            + 2 * 2 * 4            # range-query gathers (2 tables x 2)
+            + 7 * 4)               # stat planes out
 
 
 def bench_dense_stats():
@@ -833,12 +876,19 @@ def bench_dense_stats():
 
     run = _make_run(body)
     out = {}
+    # windowed_stats at max_window=1024 builds (1024-1).bit_length()+1
+    # sparse-table levels — the windowed engine's REAL traffic model,
+    # not the streaming kernels' _STATS_BYTES_ROW (ISSUE 15 satellite:
+    # the old accounting billed input reads only and the crossover
+    # record under-reported this engine)
+    nlev = (1024 - 1).bit_length() + 1
     for name, gap in (("dense_50hz", 20), ("medium_10hz", 100)):
         ms, x, valid = _dense_stats_data(gap)
         args = [jax.device_put(a) for a in (ms, x, valid)]
         rate, bw, t = _loop_rate(body, args, K * L,
                                  label=f"windowed_{name}", run=run,
-                                 bytes_per_iter=K * L * _STATS_BYTES_ROW)
+                                 bytes_per_iter=K * L
+                                 * _windowed_bytes_row(nlev))
         out[name] = {"rows_per_sec": rate, "t_iter": t,
                      "implied_gbps": round(bw / 1e9, 1)}
     return out
@@ -1373,6 +1423,551 @@ def bench_packed_stream(n_cols: int = 4):
         rec["packed_vs_single"] = round(
             rec["packed_rows_per_sec"] / rec["single_rows_per_sec"], 2)
     return rec
+
+
+# ----------------------------------------------------------------------
+# Autotuner probes + the tuned-profile re-measurement (ISSUE 15)
+# ----------------------------------------------------------------------
+
+def _tune_rate(body, args, n_rows, label, run=None):
+    """Compact probe timing for the autotuner: the same chained-fori +
+    trip-count-differencing harness as ``_loop_rate`` with a small wall
+    target (the sweep runs dozens of child probes) and none of the
+    headline ceremony.  Returns (rows_per_sec, t_iter)."""
+    if run is None:
+        run = _make_run(body)
+    print(f"[{label}] compiling...", file=sys.stderr, flush=True)
+    float(run(jnp.int32(1), jnp.float32(1.0), *args)[1])
+    target = 0.5 if os.environ.get("TEMPO_BENCH_SMOKE") else 3.0
+
+    def timed(n, salt):
+        ts = []
+        for i in range(2):
+            t0 = time.perf_counter()
+            float(run(jnp.int32(n), jnp.float32(1.0 + salt + i * 1e-6),
+                      *args)[1])
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t_pilot = timed(2, 1e-4)
+    est = max(t_pilot / 2, 1e-6)
+    n_long = int(np.clip(target / est, 4, 2048))
+    n_short = max(n_long // 8, 1)
+    t_short, t_long = timed(n_short, 2e-4), timed(n_long, 3e-4)
+    t_iter = max(t_long - t_short, 1e-9) / (n_long - n_short)
+    print(f"[{label}] {n_rows / t_iter:,.0f} rows/s", file=sys.stderr,
+          flush=True)
+    return n_rows / t_iter, t_iter
+
+
+def _out_digest(body, args):
+    """CRC-32 of the FULL outputs of one deterministic body call
+    (scale=1.0, zero jitter): the autotuner's bitwise value-audit gate
+    — a candidate knob setting must reproduce the default-knob output
+    bytes exactly or it is rejected, not just slow."""
+    import zlib
+
+    out = jax.jit(body)(jnp.float32(1.0), *args)
+    h = 0
+    for key in sorted(out):
+        h = zlib.crc32(np.asarray(out[key]).tobytes(), h)
+    return h
+
+
+def _stream_saxpy_rate(k, l):
+    """Measured read+write stream rate (GB/s) of an elementwise saxpy
+    at [k, l] — the same measurement ``bench_roofline`` records as
+    ``stream_gbps``, compact enough to run inside the tune probes and
+    the tuned re-measurement child (the ≥0.5 acceptance is a fraction
+    of THIS image's measured rate, not of a spec sheet)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((k, l)).astype(np.float32)
+
+    def stream(scale, a):
+        return {"y": a * scale + 1.0}
+
+    _, t_iter = _tune_rate(stream, (jax.device_put(x),), x.size,
+                           label="tune_stream_saxpy")
+    return 2 * x.size * 4 / t_iter / 1e9
+
+
+def bench_tune_probe(probe):
+    """One autotuner measurement point (child of
+    ``tempo_tpu/tune/harness.py``): a compact rate measurement plus a
+    CRC-32 digest of the full kernel outputs on deterministic data —
+    the harness compares every candidate's digest against the
+    default-knob baseline and rejects any mismatch.  The candidate
+    knobs arrive via the child environment (the harness clears every
+    other tunable knob and forces ``TEMPO_TPU_TUNE_PROFILE=off`` so the
+    sweep measures raw knob values); shapes are probe-sized and
+    ``TEMPO_BENCH_SMOKE`` shrinks them further for the CI smoke
+    sweep."""
+    from tempo_tpu import tune as tune_mod
+
+    Kp, Lp = min(K, 256), min(L, 4096)
+    out = {"class": probe,
+           "knobs": {name: os.environ[name]
+                     for name in tune_mod.TUNABLE_KNOBS
+                     if name in os.environ}}
+
+    if probe in ("stream_dense", "stream_medium"):
+        gap = 20 if probe == "stream_dense" else 100
+        ms, x, valid = _dense_stats_data(gap, k=Kp, l=Lp)
+        behind, ahead = _measured_rowbounds(ms, 10_000)
+        w_ms = jnp.asarray(10_000, jnp.int32)
+
+        def body(scale, ms, x, valid, mb, ma):
+            ms32 = (ms + _jitter_secs(scale) * 1000).astype(jnp.int32)
+            return dict(rk.range_stats_streaming(ms32, x, valid, w_ms,
+                                                 mb, ma, scale=scale))
+
+        args = [jax.device_put(a) for a in
+                (ms, x, valid, np.int32(behind), np.int32(ahead))]
+        rate, t_iter = _tune_rate(body, args, Kp * Lp,
+                                  label=f"tune_{probe}")
+        out.update(
+            rows_per_sec=rate, t_iter=t_iter,
+            bytes_per_iter=Kp * Lp * _STATS_BYTES_ROW,
+            digest=_out_digest(body, args))
+        if not out["knobs"] and not os.environ.get(
+                "TEMPO_BENCH_TUNE_NO_SAXPY"):
+            # the saxpy stream rate feeds the profile's measured cost
+            # inputs, and the harness reads it off the FIRST baseline
+            # probe only — candidate children (non-empty knobs) and
+            # the incumbent-bias baseline re-probe (which sets the
+            # marker) skip the measurement
+            out["stream_gbps"] = round(_stream_saxpy_rate(Kp, 4 * Lp),
+                                       2)
+    elif probe == "packed_stream":
+        C = 4
+        rng = np.random.default_rng(21)
+        ms, x, valid = _dense_stats_data(20, k=Kp, l=Lp)
+        xs = np.stack([x * np.float32(1.0 + 0.25 * c)
+                       for c in range(C)])
+        vs = np.stack([valid if c == 0 else (rng.random(x.shape) > 0.1)
+                       for c in range(C)])
+        behind, ahead = _measured_rowbounds(ms, 10_000)
+        w_ms = jnp.asarray(10_000, jnp.int32)
+
+        def body(scale, ms, xs, vs, mb, ma):
+            ms32 = (ms + _jitter_secs(scale) * 1000).astype(jnp.int32)
+            return dict(rk.range_stats_streaming_packed(
+                ms32, xs, vs, w_ms, mb, ma, scales=scale))
+
+        args = [jax.device_put(a) for a in
+                (ms, xs, vs, np.int32(behind), np.int32(ahead))]
+        rate, t_iter = _tune_rate(body, args, C * Kp * Lp,
+                                  label="tune_packed_stream")
+        out.update(
+            rows_per_sec=rate, t_iter=t_iter,
+            bytes_per_iter=Kp * Lp * (8 + 8 + C * (4 + 1 + 8 * 4)),
+            digest=_out_digest(body, args))
+    elif probe == "fused_chain":
+        data = make_data(k=Kp, l=Lp)
+
+        def body(scale, l_ts, l_secs, x, valid, r_ts, r_valids,
+                 r_values):
+            js = _jitter_secs(scale)
+            ns = js * 1_000_000_000
+            return _forward_step(l_ts + ns, l_secs + js, x * scale,
+                                 valid, r_ts + ns, r_valids, r_values)
+
+        args = [jax.device_put(a) for a in data]
+        rate, t_iter = _tune_rate(body, args, Kp * Lp,
+                                  label="tune_fused_chain")
+        out.update(rows_per_sec=rate, t_iter=t_iter,
+                   bytes_per_iter=_tree_bytes(args),
+                   digest=_out_digest(body, args))
+    elif probe == "join_chunk":
+        if jax.default_backend() != "tpu":
+            out["error"] = ("join_chunk probe requires the TPU backend "
+                            "(Mosaic chunked merge kernel); the class "
+                            "is hardware-gated, not faked")
+            print(json.dumps(out))
+            return out
+        from tempo_tpu.ops import pallas_merge as pm
+
+        Kc, Ls = min(K, 64), min(L * 2, 16384)
+        l_ts, r_ts, r_valids, r_values = _chunked_case(Kc, Ls)
+        keys, planes, plan, meta = pm.build_chunked_planes(
+            l_ts, r_ts, r_valids, r_values)
+
+        def body(scale, *args, _meta=meta, _plan=plan):
+            ks = args[:_meta["n_keys"]]
+            ps = tuple(p * scale for p in args[_meta["n_keys"]:])
+            outs = pm._chunked_call(
+                ks, ps, n_payload=_meta["n_payload"],
+                n_out=_meta["n_out"], Cm=_plan.merged_lanes,
+                segmented=False, keyed_fill=False,
+                chunk_rows=_plan.chunk_rows)
+            return {f"o{i}": o for i, o in enumerate(outs)}
+
+        args = [jax.device_put(jnp.asarray(a)) for a in (*keys, *planes)]
+        rate, t_iter = _tune_rate(body, args, Kc * Ls,
+                                  label="tune_join_chunk")
+        read_b = (meta["n_keys"] + meta["n_payload"]) \
+            * Kc * plan.n_chunks * plan.merged_lanes * 4
+        out.update(rows_per_sec=rate, t_iter=t_iter,
+                   bytes_per_iter=read_b,
+                   chunk_lanes=plan.merged_lanes,
+                   digest=_out_digest(body, args))
+    elif probe == "serve_batch":
+        from tempo_tpu.serve import MicroBatchExecutor, StreamingTSDF
+
+        rng = np.random.default_rng(5)
+        Ks, C = 8, 2
+        cols = ("bid", "ask")
+        n = 400 if os.environ.get("TEMPO_BENCH_SMOKE") else 2500
+        stream = StreamingTSDF(
+            [f"s{i}" for i in range(Ks)], list(cols), window_secs=10.0,
+            window_rows_bound=32, ema_alpha=0.2, max_lookback=64)
+        # batch_rows=None: the executor reads the knob under test
+        ex = MicroBatchExecutor(stream)
+        stream.warmup(16)
+        gaps = rng.exponential(scale=4e7, size=n).astype(np.int64) + 1
+        ts = np.cumsum(gaps) + np.int64(10**9)
+        series = rng.integers(0, Ks, n)
+        is_left = rng.random(n) < 0.25
+        vals = rng.standard_normal((n, C)).astype(np.float32)
+
+        def feed(i0, i1):
+            tickets = []
+            for i in range(i0, i1):
+                sym = f"s{series[i]}"
+                if is_left[i]:
+                    tickets.append(ex.submit("left", sym, ts[i]))
+                else:
+                    tickets.append(ex.submit(
+                        "right", sym, ts[i],
+                        {c: vals[i, j] for j, c in enumerate(cols)}))
+            return tickets
+
+        n_warm = n // 8
+        for t in feed(0, n_warm):
+            t.result(timeout=120)
+        print("[tune_serve_batch] timing...", file=sys.stderr,
+              flush=True)
+        t0 = time.perf_counter()
+        results = [t.result(timeout=300) for t in feed(n_warm, n)]
+        wall = time.perf_counter() - t0
+        ex.close()
+        # digest in submission order: per-tick results are bitwise
+        # invariant to the micro-batch split (the round-8 streamed ==
+        # batch contract), so every admissible batch_rows value must
+        # reproduce these bytes exactly
+        import zlib
+
+        h = 0
+        for res in results:
+            for key in sorted(res):
+                h = zlib.crc32(
+                    np.asarray(res[key], np.float64).tobytes(), h)
+        out.update(rows_per_sec=(n - n_warm) / wall,
+                   t_iter=wall / (n - n_warm),
+                   batch_rows=ex.batch_rows, digest=h)
+    else:
+        out["error"] = f"unknown tune probe {probe!r}"
+    print(json.dumps(out))
+    return out
+
+
+def bench_tuned():
+    """``--only-tuned`` (child of the main record): re-measure configs
+    2/3 under the persisted tuned profile vs the built-in defaults —
+    the ISSUE-15 acceptance numbers.
+
+    In ONE child process: measure both configs with the profile active,
+    flip ``TEMPO_TPU_TUNE_PROFILE=off`` and measure the default-knob
+    twins, and assert the full outputs BITWISE identical across the
+    flip (tuning must never change result bits).  The measured saxpy
+    stream rate of THIS image anchors the ≥0.5 stream-rate acceptance
+    (``profiling.window_roofline`` fracs); a small planned chain run
+    across the flip proves the profile rides the executable-cache key:
+    the steady state is zero-build, the flip re-plans (never replays a
+    stale executable), and flipping back HITS the original entry."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF, profiling, tune
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.plan import cache as plan_cache
+
+    try:
+        prof = tune.load(strict=True)
+    except tune.TuneProfileError as e:
+        # a profile EXISTS but was refused (corrupt CRC, foreign
+        # fingerprint, malformed value): the record must carry the
+        # named refusal, not claim no profile was found
+        return {"no_profile": True, "refused": True, "reason": str(e)}
+    if prof is None:
+        return {"no_profile": True,
+                "reason": "no tuned profile resolved "
+                          "(TEMPO_TPU_TUNE_PROFILE off/unset and no "
+                          "checked-in profile for this device kind) — "
+                          "run `python -m tempo_tpu.tune` first"}
+    out = {"profile": {
+        "path": tune.active_path(), "crc": prof["crc"],
+        "device_kind": prof["fingerprint"]["device_kind"],
+        "jaxlib": prof["fingerprint"]["jaxlib"],
+        "smoke_profile": bool(prof.get("smoke")),
+        "knobs": prof.get("knobs") or {},
+    }}
+    saved = os.environ.get("TEMPO_TPU_TUNE_PROFILE")
+
+    def set_profile(on):
+        if on:
+            if saved is None:
+                os.environ.pop("TEMPO_TPU_TUNE_PROFILE", None)
+            else:
+                os.environ["TEMPO_TPU_TUNE_PROFILE"] = saved
+        else:
+            os.environ["TEMPO_TPU_TUNE_PROFILE"] = "off"
+        tune.reload()
+
+    data = make_data()
+    stream_gbps = _stream_saxpy_rate(K, 4 * L)
+    out["stream_gbps_measured"] = round(stream_gbps, 2)
+    setups = {
+        # (setup result, roofline read/write/restream bytes per row —
+        # the same accounting _roofline_report uses for configs 2/3)
+        "2_range_stats_10s": (_range_stats_setup(data)[:3],
+                              (8 + 4 + 1, 8 * 4, 4 + 4)),
+        "3_resample_ema": (_resample_ema_setup(data),
+                           (8 + 4 + 1, 2 * 4, 4 + 4)),
+    }
+    fracs = {}
+    try:
+        for key, ((body, args, bpi), rwr) in setups.items():
+            set_profile(True)
+            rate_t, t_t = _tune_rate(body, args, K * L,
+                                     label=f"tuned_{key}")
+            dig_t = _out_digest(body, args)
+            set_profile(False)
+            rate_d, t_d = _tune_rate(body, args, K * L,
+                                     label=f"default_{key}")
+            dig_d = _out_digest(body, args)
+            assert dig_t == dig_d, (
+                f"{key}: tuned-profile outputs diverged from the "
+                f"default-knob outputs (digest {dig_t} != {dig_d}) — "
+                f"tuning must never change result bits")
+            roof = profiling.window_roofline(
+                K * L, *rwr, t_t, stream_gbps * 1e9)
+            fracs[key] = roof["achieved_frac"]
+            out[key] = {
+                "tuned_rows_per_sec": round(rate_t),
+                "default_rows_per_sec": round(rate_d),
+                "tuned_vs_default": round(rate_t / rate_d, 3),
+                "t_iter_tuned": t_t, "t_iter_default": t_d,
+                "stream_roofline": roof,
+                "value_audit": "tuned == default bitwise (full-output "
+                               "CRC across the profile flip)",
+            }
+
+        # profile-in-cache-key: planned chain across the flip
+        set_profile(True)
+        rng = np.random.default_rng(11)
+        Kf, Lf = min(K, 64), min(L, 1024)
+        secs = np.cumsum(rng.integers(1, 3, size=(Kf, Lf)).astype(
+            np.int64), axis=-1)
+        syms = np.repeat(np.arange(Kf), Lf)
+        lt = TSDF(pd.DataFrame({
+            "sym": syms, "event_ts": secs.ravel(),
+            "x": rng.standard_normal(Kf * Lf)}), "event_ts", ["sym"])
+        rt = TSDF(pd.DataFrame({
+            "sym": syms,
+            "event_ts": np.cumsum(rng.integers(1, 3, size=(Kf, Lf))
+                                  .astype(np.int64), axis=-1).ravel(),
+            "v0": rng.standard_normal(Kf * Lf)}), "event_ts", ["sym"])
+        mesh = make_mesh({"series": 1})
+        dl, dr = lt.on_mesh(mesh), rt.on_mesh(mesh)
+
+        def chain():
+            return (dl.asofJoin(dr)
+                    .withRangeStats(colsToSummarize=["x"],
+                                    rangeBackWindowSecs=WINDOW_SECS)
+                    .collect().df)
+
+        os.environ["TEMPO_TPU_PLAN"] = "1"
+        try:
+            plan_cache.CACHE.clear()
+            r1 = chain()
+            r2 = chain()
+            st1 = profiling.plan_cache_stats()
+            assert st1["builds"] == 1 and st1["hits"] >= 1, st1
+            set_profile(False)
+            r3 = chain()
+            st2 = profiling.plan_cache_stats()
+            assert st2["builds"] == 2, (
+                f"profile flip did NOT re-plan: {st2} — a stale "
+                f"executable built under the tuned knobs replayed")
+            pd.testing.assert_frame_equal(r1, r3, check_exact=True)
+            del r1, r2, r3
+            set_profile(True)
+            chain()
+            st3 = profiling.plan_cache_stats()
+            assert st3["builds"] == 2 and st3["hits"] >= 2, st3
+        finally:
+            os.environ.pop("TEMPO_TPU_PLAN", None)
+        out["plan_cache_across_flip"] = {
+            "builds_profile_on": 1, "builds_after_swap": 2,
+            "hit_after_swap_back": True,
+            "value_audit": "planned chain bitwise across the profile "
+                           "flip (assert_frame_equal check_exact)",
+        }
+        out["zero_builds_after_profile_load"] = True
+    finally:
+        set_profile(True)
+
+    accept = {
+        "target": 0.5,
+        "achieved": {k: fracs.get(k) for k in setups},
+        "met": all(v is not None and v >= 0.5 for v in fracs.values()),
+    }
+    if jax.default_backend() != "tpu":
+        accept["reason"] = (
+            "cpu image: the streaming kernels (DMA ring, column "
+            "packing, megacore) are Mosaic/TPU-only, so configs 2/3 "
+            "execute the XLA fallback forms here and the tuned "
+            "kernel-structure knobs are structurally inert — the "
+            "fractions above measure the fallback against this "
+            "image's own measured saxpy stream rate; the ≥0.5 "
+            "acceptance is hardware-gated and this child runs "
+            "unchanged on a real TPU")
+    out["stream_accept"] = accept
+    return out
+
+
+def bench_skew_plan(seed=5):
+    """``--only-skew-plan`` — config 5's audit companion: the skew
+    ladder replayed under ``TEMPO_TPU_PLAN=1``, closing the open half
+    of ROADMAP item 4's audit.
+
+    A Zipf-skewed host frame pair (config 4's length distribution) runs
+    the ``asofJoin -> withRangeStats`` chain at three rungs of the
+    bracketing ladder: the plain join, the explicit ``tsPartitionVal``
+    skew brackets (config 5's machinery), and the oversize auto-bracket
+    (``TEMPO_TPU_MAX_MERGED_LANES`` forced under the frame's merged-lane
+    width).  At every rung the chain runs eager AND planned; the
+    planned chain's hoisted join engine is read off the optimized plan,
+    and planned == eager is asserted BITWISE — engine hoisting must
+    survive bracketing (a hoisted hint that no longer matches the
+    runtime's feasibility falls through and re-picks; either way the
+    bits must not move)."""
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+    from tempo_tpu.plan import cache as plan_cache
+    from tempo_tpu.plan import optimizer as plan_opt
+
+    Kf, Lf = min(K, 64), min(L, 2048)
+    rng = np.random.default_rng(seed)
+    mask, _ = _zipf_row_mask(rng, Kf, Lf)
+    lengths = mask.sum(axis=-1)
+
+    def skewed_df(col, seed2):
+        r2 = np.random.default_rng(seed2)
+        rows = {"sym": [], "event_ts": [], col: []}
+        for k in range(Kf):
+            n = int(lengths[k])
+            rows["sym"].append(np.full(n, k))
+            rows["event_ts"].append(np.cumsum(
+                r2.integers(1, 3, size=n).astype(np.int64)))
+            rows[col].append(r2.standard_normal(n))
+        return pd.DataFrame({c: np.concatenate(v)
+                             for c, v in rows.items()})
+
+    lt = TSDF(skewed_df("x", seed + 1), "event_ts", ["sym"])
+    rt = TSDF(skewed_df("v0", seed + 2), "event_ts", ["sym"])
+    from tempo_tpu import packing as pkg
+
+    est_lanes = int(pkg.pad_length(int(lengths.max())) * 2)
+    span = int(lengths.max()) * 2  # seconds, gaps are 1..2
+    rungs = (
+        ("plain", dict(), None),
+        ("ts_partition", dict(tsPartitionVal=max(span // 8, 4)), None),
+        ("auto_bracket", dict(), max(est_lanes // 2, 512)),
+    )
+    saved_plan = os.environ.pop("TEMPO_TPU_PLAN", None)
+    saved_lanes = os.environ.pop("TEMPO_TPU_MAX_MERGED_LANES", None)
+    ladder = []
+    try:
+        for name, join_kw, lane_limit in rungs:
+            if lane_limit is None:
+                os.environ.pop("TEMPO_TPU_MAX_MERGED_LANES", None)
+            else:
+                os.environ["TEMPO_TPU_MAX_MERGED_LANES"] = \
+                    str(lane_limit)
+            os.environ.pop("TEMPO_TPU_PLAN", None)
+            t0 = time.perf_counter()
+            eager = (lt.asofJoin(rt, **join_kw)
+                     .withRangeStats(colsToSummarize=["x"],
+                                     rangeBackWindowSecs=10).df)
+            t_eager = time.perf_counter() - t0
+            os.environ["TEMPO_TPU_PLAN"] = "1"
+            plan_cache.CACHE.clear()
+            lz = (lt.asofJoin(rt, **join_kw)
+                  .withRangeStats(colsToSummarize=["x"],
+                                  rangeBackWindowSecs=10))
+            opt = plan_opt.optimize(lz.plan)
+            hoisted = next((n.ann.get("join_engine")
+                            for n in opt.walk()
+                            if n.op in ("asof_join",
+                                        "fused_asof_stats_ema")
+                            and n.ann.get("join_engine")), None)
+            t0 = time.perf_counter()
+            planned = lz.df
+            t_planned = time.perf_counter() - t0
+            pd.testing.assert_frame_equal(eager, planned,
+                                          check_exact=True)
+            # the engine the eager path actually picks at THIS rung
+            # (the hoist assumes chunked_ok=True at plan time; the
+            # runtime hint revalidation falls through to this pick
+            # when the backend cannot honor it — all join engines are
+            # bit-identical, so the bitwise assert above proves the
+            # fall-through is loss-free)
+            from tempo_tpu import profiling, resilience
+            from tempo_tpu.ops import pallas_merge as pm
+
+            if name == "ts_partition":
+                runtime_engine = "single+tsPartitionVal-brackets"
+            else:
+                limit_eff = resilience.max_merged_lanes()
+                if 0 < limit_eff < est_lanes:
+                    runtime_engine = profiling.pick_join_engine(
+                        est_lanes, limit_eff,
+                        pm.chunked_join_available(est_lanes, 1))
+                else:
+                    runtime_engine = "single"
+            ladder.append({
+                "rung": name,
+                "join_kwargs": {k: v for k, v in join_kw.items()},
+                "lane_limit": lane_limit,
+                "merged_lanes_est": est_lanes,
+                "hoisted_engine": hoisted,
+                "runtime_engine": runtime_engine,
+                "t_eager_s": round(t_eager, 4),
+                "t_planned_s": round(t_planned, 4),
+            })
+            del eager, planned
+    finally:
+        os.environ.pop("TEMPO_TPU_PLAN", None)
+        os.environ.pop("TEMPO_TPU_MAX_MERGED_LANES", None)
+        if saved_plan is not None:
+            os.environ["TEMPO_TPU_PLAN"] = saved_plan
+        if saved_lanes is not None:
+            os.environ["TEMPO_TPU_MAX_MERGED_LANES"] = saved_lanes
+    engines = sorted({r["hoisted_engine"] for r in ladder
+                      if r["hoisted_engine"]})
+    bracketed = [r for r in ladder if r["rung"] != "plain"]
+    assert bracketed and all(r["hoisted_engine"] for r in ladder), ladder
+    return {
+        "rows": int(lengths.sum()),
+        "ladder": ladder,
+        "engines_hoisted": engines,
+        "value_audit": "planned == eager bitwise at every rung "
+                       "(assert_frame_equal check_exact) — engine "
+                       "hoisting survives tsPartitionVal and oversize "
+                       "auto-bracketing",
+    }
 
 
 def bench_frame_e2e():
@@ -2442,6 +3037,22 @@ def _attempt(label, fn):
 
 
 def main():
+    if "--only-tune-probe" in sys.argv:
+        probe = sys.argv[sys.argv.index("--only-tune-probe") + 1]
+        res = bench_tune_probe(probe)   # prints its own JSON line
+        raise SystemExit(1 if "error" in res else 0)
+    if "--only-tuned" in sys.argv:
+        res = _attempt("tuned", bench_tuned)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
+    if "--only-skew-plan" in sys.argv:
+        res = _attempt("skew_plan", bench_skew_plan)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-nbbo" in sys.argv:
         res = _attempt("nbbo", bench_nbbo)
         if res is None:
@@ -2590,36 +3201,69 @@ def main():
     res = _attempt("resample_ema", lambda: bench_resample_ema(data))
     pipelined = _config_subprocess("--only-pipelined", "pipelined",
                                    timeout=2400)
+    # the tuned-profile re-measurement (ISSUE 15): its per-config
+    # tuned rates join the configs-2/3 re-decision below, and the
+    # whole child record lands as "tuned_vs_default" in the main JSON
+    tuned = _config_subprocess("--only-tuned", "tuned", timeout=2400)
 
-    # re-decide configs 2/3 between the measured default (implicit
-    # double-buffered BlockSpec pipeline) and the measured explicit DMA
-    # ring — never crowning an unmeasured variant: a missing/crashed
-    # pipelined child leaves the default standing and says so
+    # re-decide configs 2/3 among the measured default (implicit
+    # double-buffered BlockSpec pipeline), the measured explicit DMA
+    # ring, and the tuned-profile child — never crowning an unmeasured
+    # variant: a missing/crashed child leaves the default standing and
+    # says so
     def _redecide(key, default):
         cand = (pipelined or {}).get(key)
-        if default is None and cand is None:
+        tuned_rec = (tuned or {}).get(key) or {}
+        tuned_rate = tuned_rec.get("tuned_rows_per_sec")
+        # the tuned rate comes from the compact _tune_rate harness, the
+        # blockspec/ring rates from _loop_rate's headline ceremony: the
+        # two are only comparable when the profile actually changes a
+        # knob.  With an empty merged-knob profile (this image) the
+        # "tuned" configuration is bit-for-bit the default, so any rate
+        # delta is cross-harness bias — report it, never crown it.
+        profile_knobs = ((tuned or {}).get("profile") or {}).get(
+            "knobs") or {}
+        if default is None and cand is None and tuned_rate is None:
             return None, {"winner": "unmeasured"}
-        if cand is None:
-            return default, {"winner": "blockspec-2", "ring": "unmeasured",
-                             "blockspec_rows_per_sec": round(default[0])}
         decision = {
             "blockspec_rows_per_sec":
                 round(default[0]) if default else None,
-            "ring_rows_per_sec": cand["rows_per_sec"],
+            "ring_rows_per_sec":
+                cand["rows_per_sec"] if cand else None,
+            "tuned_rows_per_sec": tuned_rate,
             "dma_buffers_measured": [2, (pipelined or {}).get(
                 "dma_buffers", 4)],
         }
-        if default is None or cand["rows_per_sec"] > default[0]:
-            decision["winner"] = f"dma-ring({pipelined['dma_buffers']})"
-            bw = default[1] if default else 0.0
-            return (cand["rows_per_sec"], bw, cand["t_iter"]), decision
-        decision["winner"] = "blockspec-2"
-        return default, decision
+        best, winner = default, "blockspec-2"
+        if cand is not None and (best is None
+                                 or cand["rows_per_sec"] > best[0]):
+            best = (cand["rows_per_sec"], default[1] if default else 0.0,
+                    cand["t_iter"])
+            winner = f"dma-ring({(pipelined or {}).get('dma_buffers')})"
+        if tuned_rate is not None and profile_knobs \
+                and (best is None or tuned_rate > best[0]):
+            best = (tuned_rate, best[1] if best else 0.0,
+                    tuned_rec.get("t_iter_tuned"))
+            winner = "tuned-profile"
+        elif tuned_rate is not None and not profile_knobs:
+            decision["tuned"] = ("not-comparable (profile merges no "
+                                 "knobs: tuned == default config, rate "
+                                 "delta is cross-harness bias)")
+        if best is None:
+            return None, {"winner": "unmeasured"}
+        decision["winner"] = winner
+        if cand is None:
+            decision["ring"] = "unmeasured"
+        return best, decision
 
     stats, stats_decision = _redecide("2_range_stats_10s", stats)
     res, res_decision = _redecide("3_resample_ema", res)
     nbbo = _nbbo_subprocess()
     skew_rs = bench_skew_1b(t_iter_fused)
+    # config 5's planner audit: the skew ladder replayed under
+    # TEMPO_TPU_PLAN=1 (ROADMAP item 4's open half)
+    skew_plan = _config_subprocess("--only-skew-plan", "skew_plan",
+                                   timeout=2400)
     roof = _roofline_subprocess()
     seq = _config_subprocess("--only-seq", "seq_asof")
     dense = _config_subprocess("--only-dense-stats", "dense_stats")
@@ -2684,6 +3328,12 @@ def main():
             "streaming_rows_per_sec_at_10hz": round(at10["streaming"]),
             "windowed_rows_per_sec_at_50hz": round(at50["windowed"]),
             "streaming_rows_per_sec_at_50hz": round(at50["streaming"]),
+            # the windowed engine's real traffic (prefix planes + RMQ
+            # tables + gathers, _windowed_bytes_row) — the crossover
+            # table under-reported it as input-reads-only before
+            # ISSUE 15's satellite fix
+            "windowed_implied_gbps_at_10hz": med_w.get("implied_gbps"),
+            "windowed_implied_gbps_at_50hz": dns_w.get("implied_gbps"),
             "shifted_max_behind": (shifted_med or {}).get("max_behind"),
             # a crashed/absent child contributes 0 rows/s — it is
             # unmeasured, not a crossover loser; never crown a winner
@@ -2847,6 +3497,18 @@ def main():
             "2_range_stats_10s": stats_decision,
             "3_resample_ema": res_decision,
         },
+        # ISSUE 15: the tuned-profile re-measurement — per-config
+        # tuned-vs-default deltas asserted bitwise across the profile
+        # flip, the measured stream-rate fractions for the ≥0.5
+        # acceptance (or the measured reason this image cannot meet
+        # it), and the profile-in-cache-key proof (zero steady-state
+        # builds with the profile loaded; a swap re-plans)
+        "tuned_vs_default": tuned,
+        # config 5's audit companion: the skew ladder under
+        # TEMPO_TPU_PLAN=1 — engine hoisting survives tsPartitionVal
+        # and oversize auto-bracketing, planned == eager bitwise at
+        # every rung (ROADMAP item 4's open half)
+        "skew_plan": skew_plan,
         "rolling_crossover": crossover,
         "roofline": roofline,
         "roofline_measured": roof,

@@ -251,6 +251,13 @@ KNOBS: Dict[str, Knob] = _knobs(
          "(--only-chaos-pipeline) in full mode; unset = 1e9 (the "
          "ROADMAP billion-row out-of-core sweep), smoke mode ignores "
          "it"),
+    Knob("TEMPO_TPU_TUNE_PROFILE", "path|off", None, "tempo_tpu/tune",
+         "tuned-knob profile source: a path to a harness-produced "
+         "profile, 'off' to disable profile loading, unset = the "
+         "checked-in per-device-kind profile under tempo_tpu/tune/"
+         "profiles/.  Tuned values are PRIORS: an explicitly-set env "
+         "knob always wins; a corrupt or foreign-fingerprint profile "
+         "is refused by name with fallback to the built-in defaults"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads
@@ -300,6 +307,23 @@ def get_float(name: str, default: Optional[float] = None) -> Optional[float]:
     if val is None or not val.strip():
         return default
     return float(val)
+
+
+def child_env(overrides: Optional[Dict[str, Optional[str]]] = None
+              ) -> Dict[str, str]:
+    """Snapshot of the process environment for CHILD processes (the
+    autotuner's probe children, bench subprocesses), with
+    ``overrides`` applied: value ``None`` removes the name, anything
+    else is stringified.  Lives here so the env-knobs lint keeps its
+    single-owner guarantee — ``os.environ`` access stays inside the
+    registry module even for subprocess plumbing."""
+    env = dict(os.environ)
+    for name, value in (overrides or {}).items():
+        if value is None:
+            env.pop(name, None)
+        else:
+            env[name] = str(value)
+    return env
 
 
 def env_external(name: str, default: Optional[str] = None) -> Optional[str]:

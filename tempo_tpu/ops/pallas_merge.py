@@ -918,11 +918,15 @@ def merge_join_supported(l_ts, r_ts, r_values, l_seq, r_seq,
 
 def join_chunk_lanes_override():
     """``TEMPO_TPU_JOIN_CHUNK_LANES`` — explicit merged-lane chunk width
-    (power of two >= 256) for the streaming engine; unset = the largest
-    width the VMEM plan admits."""
-    from tempo_tpu import config
+    (power of two >= 256) for the streaming engine; env unset falls
+    back to the tuned-profile prior (tempo_tpu/tune), then to the
+    largest width the VMEM plan admits."""
+    from tempo_tpu import config, tune
 
-    return config.get_int("TEMPO_TPU_JOIN_CHUNK_LANES")
+    n = config.get_int("TEMPO_TPU_JOIN_CHUNK_LANES")
+    if n is None:
+        n = tune.knob_value("TEMPO_TPU_JOIN_CHUNK_LANES")
+    return None if n is None else int(n)
 
 
 def _chunk_plane_counts(C: int, nsq: int, segmented: bool, keyed: bool,

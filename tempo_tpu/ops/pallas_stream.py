@@ -56,18 +56,27 @@ def dma_buffers() -> int:
     default) keeps the implicit double-buffered BlockSpec pipeline;
     3..8 engage the explicit ring.  Clamped to [2, 8]: one buffer
     cannot overlap anything, and past 8 the ring's VMEM share starves
-    the compute planes."""
-    from tempo_tpu import config
+    the compute planes.  Env unset falls back to the tuned-profile
+    prior (tempo_tpu/tune — the autotuner's measured winner for this
+    device kind), then to the built-in 2."""
+    from tempo_tpu import config, tune
 
-    n = config.get_int("TEMPO_TPU_DMA_BUFFERS", 2) or 2
+    n = config.get_int("TEMPO_TPU_DMA_BUFFERS")
+    if n is None:
+        n = tune.knob_value("TEMPO_TPU_DMA_BUFFERS") or 2
     return max(2, min(int(n), 8))
 
 
 def megacore_enabled() -> bool:
     """``TEMPO_TPU_MEGACORE`` — declare carry-free grid axes
-    ``"parallel"`` (default on; harmless on single-core chips)."""
-    from tempo_tpu import config
+    ``"parallel"`` (default on; harmless on single-core chips).  Env
+    unset falls back to the tuned-profile prior, then on."""
+    from tempo_tpu import config, tune
 
+    val = config.get("TEMPO_TPU_MEGACORE")
+    if val is None:
+        tuned = tune.knob_value("TEMPO_TPU_MEGACORE")
+        return True if tuned is None else bool(int(tuned))
     return config.get_bool("TEMPO_TPU_MEGACORE", True)
 
 
@@ -121,12 +130,14 @@ def plan_with_ring(K: int, L: int, arrays_fn, depth: int,
 
 def pack_cols_cap() -> int:
     """``TEMPO_TPU_PACK_COLS`` — cap on the payload pack width; unset
-    = the VMEM folding alone decides (bounded at 8: past that the
-    per-step block shrinks below a sublane and the grid overhead eats
-    the saved key reads)."""
-    from tempo_tpu import config
+    = the tuned-profile prior (tempo_tpu/tune), then the VMEM folding
+    alone (bounded at 8: past that the per-step block shrinks below a
+    sublane and the grid overhead eats the saved key reads)."""
+    from tempo_tpu import config, tune
 
     n = config.get_int("TEMPO_TPU_PACK_COLS")
+    if n is None:
+        n = tune.knob_value("TEMPO_TPU_PACK_COLS")
     return max(1, min(int(n), 8)) if n else 8
 
 

@@ -135,10 +135,17 @@ def _stream_max_rows() -> int:
     (~15us per [1024, 8192] rotate) against the measured RMQ-path
     floor (~1.05 s/iteration at that shape, BENCH_r05) puts the
     crossover above 20k rows.  Re-measure with bench.py
-    --only-stream-stats and override here."""
-    from tempo_tpu import config
+    --only-stream-stats and override here.  Env unset falls back to
+    the tuned-profile prior (tempo_tpu/tune — the autotuner's
+    audit-gated winner: a candidate ceiling that flipped the engine
+    pick changed result bits and was rejected at sweep time), then to
+    the built-in 16384."""
+    from tempo_tpu import config, tune
 
-    return config.get_int("TEMPO_TPU_STREAM_MAX_ROWS", 16384)
+    n = config.get_int("TEMPO_TPU_STREAM_MAX_ROWS")
+    if n is None:
+        n = tune.knob_value("TEMPO_TPU_STREAM_MAX_ROWS")
+    return 16384 if n is None else int(n)
 
 
 def pack_cols_budget(K: int, L: int, n_cols: int,

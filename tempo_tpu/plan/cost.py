@@ -154,22 +154,35 @@ def clear_measured() -> None:
 
 
 def params() -> Dict[str, float]:
-    """The active cost inputs: priors, the demoted thresholds (read
-    from their knobs — they are priors now, not laws), and any
-    :func:`set_measured` overlay.  Inside a :func:`pinned` block the
-    snapshot wins outright (build-time consistency)."""
+    """The active cost inputs: priors, then the tuned-profile overlay
+    (tempo_tpu/tune — the autotuner's MEASURED rates for this image,
+    e.g. the real saxpy stream rate instead of the BENCH r5 TPU
+    figure), the demoted thresholds (read from their knobs — they are
+    priors now, not laws), and any :func:`set_measured` overlay on
+    top.  A loaded profile also contributes ``tune_profile_crc`` — an
+    inert-to-the-arithmetic stamp that rides :func:`fingerprint` into
+    the executable-cache key, so swapping profiles (which can change
+    the kernel-structure knobs the rates don't cover) re-plans instead
+    of replaying.  Inside a :func:`pinned` block the snapshot wins
+    outright (build-time consistency)."""
     pin = _PINNED.get()
     if pin is not None:
         return dict(pin)
-    from tempo_tpu import config
+    from tempo_tpu import config, tune
 
     out = dict(PRIORS)
+    out.update(tune.measured())
+    crc = tune.stamp()
+    if crc is not None:
+        out["tune_profile_crc"] = crc
     # 32768 is the auto chunk-width CEILING of the streaming join's
     # VMEM plan (pallas_merge._plan_chunk_lanes doubles while
     # Cm <= 1 << 15) — a wider prior would undercount the per-chunk
     # overhead of chunk plans the engine can never actually run
-    out["join_chunk_lanes"] = float(
-        config.get_int("TEMPO_TPU_JOIN_CHUNK_LANES", 0) or 32768)
+    lanes = config.get_int("TEMPO_TPU_JOIN_CHUNK_LANES")
+    if lanes is None:
+        lanes = tune.knob_value("TEMPO_TPU_JOIN_CHUNK_LANES")
+    out["join_chunk_lanes"] = float(lanes or 32768)
     with _lock:
         out.update(_measured)
     return out
@@ -190,7 +203,13 @@ def fingerprint(snap: Optional[Dict[str, float]] = None) -> tuple:
     decision made under the other inputs."""
     if snap is None:
         if not enabled():
-            return ("cost-off",)
+            from tempo_tpu import tune
+
+            crc = tune.stamp()
+            # the tuned profile changes kernel-structure knobs (DMA
+            # depth, pack width) even with the cost model off — its
+            # stamp must still key the cache so a swap re-plans
+            return ("cost-off",) if crc is None else ("cost-off", crc)
         snap = params()
     return tuple(sorted(snap.items()))
 

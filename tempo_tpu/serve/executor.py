@@ -205,7 +205,14 @@ class MicroBatchExecutor:
             queue_depth = config.get_int("TEMPO_TPU_SERVE_QUEUE_DEPTH",
                                          1024)
         if batch_rows is None:
-            batch_rows = config.get_int("TEMPO_TPU_SERVE_BATCH_ROWS", 64)
+            # env knob first, then the autotuner's measured winner for
+            # this device kind (tempo_tpu/tune), then the built-in 64
+            from tempo_tpu import tune
+
+            batch_rows = config.get_int("TEMPO_TPU_SERVE_BATCH_ROWS")
+            if batch_rows is None:
+                batch_rows = tune.knob_value(
+                    "TEMPO_TPU_SERVE_BATCH_ROWS", "serve_batch") or 64
         self.stream = stream
         self.batch_rows = max(1, int(batch_rows))
         # micro-batch coalescing window: after the first tick of a

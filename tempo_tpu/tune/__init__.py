@@ -1,0 +1,42 @@
+"""Self-tuning kernels: the persistent autotuner (ROADMAP item 5).
+
+``python -m tempo_tpu.tune`` sweeps the registered knob space
+(:mod:`tempo_tpu.tune.space`) per (device kind, shape class) in child
+processes, gates every candidate on a bitwise value audit, and
+persists the winners as a CRC'd profile
+(:mod:`tempo_tpu.tune.profile`).  The package's read faces below are
+what the engine picks consume at run time — an explicitly-set env knob
+always wins, the profile is the prior underneath it, and the built-in
+default is the floor:
+
+* :func:`knob_value` — tuned knob priors for the readers in
+  ``ops/pallas_stream.py`` / ``ops/pallas_window.py`` /
+  ``ops/pallas_merge.py`` / ``serve/executor.py``;
+* :func:`measured` — measured cost-model inputs, overlaid by
+  ``plan/cost.params()`` under any ``cost.set_measured`` call;
+* :func:`stamp` — the profile CRC folded into ``cost.fingerprint()``
+  and therefore the executable-cache key: a profile swap re-plans,
+  never replays.
+
+Import-light on purpose: jax is only touched when a profile is
+actually resolved (the fingerprint check needs the device kind).
+"""
+
+from tempo_tpu.tune.profile import (   # noqa: F401
+    TUNABLE_KNOBS,
+    TuneProfileError,
+    active_path,
+    default_path,
+    knob_value,
+    load,
+    measured,
+    reload,
+    runtime_fingerprint,
+    stamp,
+)
+
+__all__ = [
+    "TUNABLE_KNOBS", "TuneProfileError", "active_path", "default_path",
+    "knob_value", "load", "measured", "reload", "runtime_fingerprint",
+    "stamp",
+]

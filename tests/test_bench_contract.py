@@ -24,6 +24,10 @@ def test_bench_smoke_prints_one_json_line():
         # single-chip program
         "XLA_FLAGS": "",
     })
+    # the conftest pins the SUITE to profile-off determinism; the bench
+    # is the profile's consumer — let it resolve the checked-in
+    # per-device-kind profile so the --only-tuned child really runs
+    env.pop("TEMPO_TPU_TUNE_PROFILE", None)
     out = subprocess.run(
         [sys.executable, "bench.py"], cwd=REPO, env=env,
         capture_output=True, text=True, timeout=900,
@@ -61,6 +65,13 @@ def test_bench_smoke_prints_one_json_line():
     for k in ("streaming_rows_per_sec_at_10hz",
               "streaming_rows_per_sec_at_50hz"):
         assert rec["rolling_crossover"].get(k, 0) > 0, k
+    # round 15: the windowed engine's real traffic is billed (its
+    # bytes_per_iter accounting previously never landed — the
+    # crossover table reported "0 GB/s implied")
+    for k in ("windowed_implied_gbps_at_10hz",
+              "windowed_implied_gbps_at_50hz"):
+        assert rec["rolling_crossover"].get(k) is not None \
+            and rec["rolling_crossover"][k] > 0, k
     # the op-surface sweep (round 6): every op must report a number
     sweep = rec.get("opsweep") or {}
     for op in ("interpolate", "fourier", "grouped_stats", "vwap",
@@ -187,6 +198,75 @@ def test_bench_smoke_prints_one_json_line():
     assert fr.get("ingest") is True and fr.get("plan") is True \
         and fr.get("sweep") is True
     assert "bitwise" in cp.get("tail_audit", "")
+    # round 15: the tuned-profile re-measurement — the checked-in
+    # profile must load, the configs-2/3 deltas must be asserted
+    # bitwise across the profile flip, the ≥0.5 stream-rate acceptance
+    # must carry either the met fractions or the measured reason this
+    # image cannot meet it, and the profile-in-cache-key proof must
+    # have run (zero steady-state builds with the profile on; a swap
+    # re-plans, never replays).  The checked-in artifact is keyed by
+    # (device_kind, jaxlib): on an image whose jaxlib differs from the
+    # one that produced it, the CORRECT behaviour is refusal by name —
+    # assert the refusal path instead of failing the contract on an
+    # un-retuned environment.
+    import json as _json
+
+    from tempo_tpu.tune import profile as _tp
+
+    tv = rec.get("tuned_vs_default") or {}
+    ckd_path = _tp.default_path("cpu")
+    ckd_fp = {}
+    if os.path.exists(ckd_path):
+        with open(ckd_path) as f:
+            ckd_fp = _json.load(f).get("fingerprint") or {}
+    if ckd_fp != _tp.runtime_fingerprint():
+        # foreign profile for this runtime: the tuned child must have
+        # refused it by falling back, not half-applied it — and the
+        # record must carry the NAMED refusal, not claim no profile
+        # was found
+        assert tv.get("no_profile"), (
+            f"checked-in profile fingerprint {ckd_fp} is foreign to "
+            f"this runtime but the tuned child did not refuse: {tv}")
+        assert tv.get("refused") and "refused" in tv.get("reason", ""), tv
+    else:
+        assert not tv.get("no_profile"), tv
+        assert tv.get("profile", {}).get("crc"), tv
+        assert tv.get("stream_gbps_measured", 0) > 0
+        for k in ("2_range_stats_10s", "3_resample_ema"):
+            cfg = tv.get(k) or {}
+            assert cfg.get("tuned_rows_per_sec", 0) > 0, (k, cfg)
+            assert cfg.get("default_rows_per_sec", 0) > 0, (k, cfg)
+            assert cfg.get("tuned_vs_default", 0) > 0, (k, cfg)
+            assert "bitwise" in cfg.get("value_audit", ""), (k, cfg)
+            roof = cfg.get("stream_roofline") or {}
+            assert roof.get("achieved_frac") is not None, (k, cfg)
+        acc = tv.get("stream_accept") or {}
+        assert acc.get("target") == 0.5
+        assert acc.get("met") is True or acc.get("reason"), acc
+        assert tv.get("zero_builds_after_profile_load") is True
+        flip = tv.get("plan_cache_across_flip") or {}
+        assert flip.get("builds_profile_on") == 1
+        assert flip.get("builds_after_swap") == 2
+        assert flip.get("hit_after_swap_back") is True
+        assert "bitwise" in flip.get("value_audit", "")
+    # round 15: the skew ladder replayed under TEMPO_TPU_PLAN=1 —
+    # engine hoisting survives tsPartitionVal and oversize
+    # auto-bracketing, planned == eager bitwise at every rung
+    # (ROADMAP item 4's open half)
+    sp = rec.get("skew_plan") or {}
+    ladder = sp.get("ladder") or []
+    assert len(ladder) >= 3, sp
+    rungs = {r["rung"]: r for r in ladder}
+    assert {"plain", "ts_partition", "auto_bracket"} <= set(rungs)
+    for r in ladder:
+        assert r.get("hoisted_engine") in (
+            "single", "chunked", "bracket"), r
+    assert rungs["plain"]["runtime_engine"] == "single"
+    assert "brackets" in rungs["ts_partition"]["runtime_engine"]
+    # on the CPU contract run the oversize rung must really have
+    # re-routed to the host time-bracketing engine
+    assert rungs["auto_bracket"]["runtime_engine"] == "bracket"
+    assert "bitwise" in sp.get("value_audit", "")
     # config 12 (round 10): the mesh-scaling sweep must have measured
     # every device count of its (smoke-clipped) ladder, each point with
     # the in-bench planned==eager bitwise audit and the per-stage comm

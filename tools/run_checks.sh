@@ -51,6 +51,16 @@ echo "== chaos gate (fault-injection suite incl. the campaign smoke) =="
 JAX_PLATFORMS=cpu python -m pytest tests -q -m 'chaos and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
 
+echo "== autotuner gate (smoke sweep + profile lifecycle tests) =="
+# the tune harness end-to-end on tiny shapes: child probes, the
+# coordinate-descent walk, and the bitwise value-audit gate — the CLI
+# exits nonzero if any contract-bitwise knob changed result bits —
+# plus the profile lifecycle suite (roundtrip, corrupt/foreign
+# refusal by name, env-over-profile priority, profile-in-cache-key)
+JAX_PLATFORMS=cpu python -m tempo_tpu.tune --smoke || exit $?
+JAX_PLATFORMS=cpu python -m pytest tests/test_tune.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== batch chaos gate (plan barriers + transactional ingest + campaign) =="
 # the BATCH-plane fault domain, surfaced before tier-1: plan-integrated
 # checkpoint barriers (signed manifests, resume-with-zero-rebuilds,
