@@ -346,6 +346,15 @@ def load(path: str, mesh=None, series_axis: str = "series",
             f"tempo_tpu.serve.StreamCohort.resume",
             kind=FailureKind.PERMANENT,
         )
+    if man["kind"] == "cohort_member":
+        raise CheckpointError(
+            f"{path!r} holds ONE spilled cohort member's slot state "
+            f"(the StreamCohort LRU spill tier), not a frame: it is "
+            f"faulted back in by its own cohort on the member's next "
+            f"tick, or inspect it with "
+            f"load_state(kind='cohort_member')",
+            kind=FailureKind.PERMANENT,
+        )
     if man["kind"] == "host":
         return _load_host(path, man)
     if mesh is None:

@@ -258,6 +258,28 @@ KNOBS: Dict[str, Knob] = _knobs(
          "profiles/.  Tuned values are PRIORS: an explicitly-set env "
          "knob always wins; a corrupt or foreign-fingerprint profile "
          "is refused by name with fallback to the built-in defaults"),
+    Knob("TEMPO_TPU_STORE_SEGMENT_ROWS", "int", "1048576",
+         "tempo_tpu/store/engine",
+         "target rows per clustered segment of one store generation "
+         "(the transactional write-back chunk: each segment commits "
+         "with a chained CRC'd sidecar; compaction merges into 8x "
+         "this by default)"),
+    Knob("TEMPO_TPU_STORE_KEEP_GENERATIONS", "int", "2",
+         "tempo_tpu/store/engine",
+         "generation retention of store tables (min 1 = current "
+         "only); >= 2 keeps the previous generation on disk so "
+         "readers opened on it stay bitwise-correct while the next "
+         "one commits"),
+    Knob("TEMPO_TPU_STORE_COMPACT_MIN_SEGMENTS", "int", "2",
+         "tempo_tpu/store/compact",
+         "segment count below which store.compact() is a no-op (the "
+         "table is already compact)"),
+    Knob("TEMPO_TPU_SERVE_COHORT_RESIDENT", "int", "0",
+         "tempo_tpu/serve/cohort",
+         "LRU resident-member budget of a StreamCohort with a "
+         "spill_dir: members beyond it spill their slot state to "
+         "CRC'd kind=\"cohort_member\" artifacts and fault back in "
+         "on their next tick; 0 = unlimited (no spill)"),
 )
 
 #: Non-TEMPO_TPU environment variables the package legitimately reads

@@ -388,6 +388,14 @@ def from_parquet(
         raise ValueError(
             f"on_corrupt must be 'raise' or 'quarantine', got "
             f"{on_corrupt!r}")
+    # store-aware: a transactional table directory (store engine
+    # _CURRENT.json pointer) resolves to its committed generation — a
+    # plain clustered Parquet dataset whose (series, time) sort order
+    # the census pass reads back without a shuffle.  Torn pointer or
+    # commit state refuses by name here, before any streaming pass.
+    from tempo_tpu.store.engine import resolve_dataset_path
+
+    path = resolve_dataset_path(path)
     pcols = list(partition_cols or [])
     mesh = mesh if mesh is not None else make_mesh()
     n_s = mesh.shape[series_axis]

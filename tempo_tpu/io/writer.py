@@ -36,57 +36,86 @@ def _table_path(tab_name: str, base_dir: Optional[str]) -> str:
 
 def write(tsdf, tab_name: str, optimization_cols: Optional[List[str]] = None,
           base_dir: Optional[str] = None, format: str = "parquet") -> str:
-    """Write the TSDF as a partitioned, sort-optimized Parquet dataset.
+    """Write the TSDF as a clustered, sort-optimized Parquet table.
 
     Returns the table path.  Derived columns mirror io.py:29-33:
     ``event_dt`` = date of ts, ``event_time`` = HHMMSS.fff as double.
 
-    ``format="delta"`` also commits a Delta transaction log
-    (``_delta_log/...0.json`` with protocol/metaData/add actions) so the
-    output is a table Spark + delta readers accept as-is — the two-way
-    leg of the reference's Delta writer (io.py:10-43).
-    """
+    Overwrite semantics (v0.16): "write a new generation
+    transactionally, then atomically swing a pointer" — the table is a
+    :mod:`tempo_tpu.store` generation table, so the previous version
+    survives ANY kill, a killed write re-issued with the same frame
+    resumes with zero committed-segment re-writes, and foreign staged
+    state is refused by name.  The pre-v0.16 destructive
+    rmtree-then-rewrite is gone (MIGRATION.md).
+
+    ``format="delta"`` keeps the Spark-readable root layout (hive
+    partitions + ``_delta_log``) and therefore cannot use generation
+    directories; it stages the whole table to a temp sibling, fsyncs,
+    and atomically swaps — the old table survives a kill at any point
+    (``read`` falls back to the ``.bak`` survivor of a mid-swap
+    crash)."""
     if format not in ("parquet", "delta"):
         raise ValueError("format must be 'parquet' or 'delta'")
-    import pyarrow as pa
-    import pyarrow.parquet as pq
+    from tempo_tpu.store import engine as store_engine
 
-    df = tsdf.df.copy()
-    ts = pd.to_datetime(df[tsdf.ts_col])
-    df["event_dt"] = ts.dt.date.astype(str)
-    df["event_time"] = (
-        ts.dt.hour * 10000 + ts.dt.minute * 100 + ts.dt.second
-        + ts.dt.microsecond / 1e6
-    ).astype(float)
-
-    # column rotation parity (io.py:34-36): derived cols lead
-    cols = list(df.columns)
-    df = df[cols[-1:] + cols[:-1]]
-
-    opt_cols = (optimization_cols or []) + ["event_time"]
-    sort_cols = [c for c in tsdf.partitionCols + opt_cols if c in df.columns]
-    if sort_cols:
-        df = df.sort_values(sort_cols, kind="stable")
-
+    df, sort_cols = store_engine.clustered_frame(tsdf, optimization_cols)
     path = _table_path(tab_name, base_dir)
-    # full-table overwrite like the reference's write.mode("overwrite")
-    # (io.py:37): stale partitions from prior writes must not survive
-    import shutil
-
-    if os.path.isdir(path):
-        shutil.rmtree(path)
-
     if format == "delta":
-        _write_delta(df, path)
+        df = df.sort_values(sort_cols, kind="stable") if sort_cols else df
+        _replace_table_dir(path, lambda tmp: _write_delta(df, tmp))
     else:
-        table = pa.Table.from_pandas(df, preserve_index=False)
-        pq.write_to_dataset(
-            table,
-            root_path=path,
-            partition_cols=["event_dt"],
-        )
+        store_engine.Store(os.path.dirname(path)).write_table(
+            tab_name, df, sort_cols,
+            source_fp=store_engine.source_fingerprint(tsdf))
     logger.info("wrote %d rows to %s (sorted by %s)", len(df), path, sort_cols)
     return path
+
+
+def _fsync_tree(path: str) -> None:
+    """fsync every file (and directory) under ``path`` so the staged
+    replacement is durable BEFORE the atomic swap makes it live."""
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            fd = os.open(os.path.join(root, f), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(root, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def _replace_table_dir(path: str, build) -> None:
+    """The data-loss fix for the seed-era overwrite: NEVER delete the
+    old table before its replacement exists.  ``build(tmp)`` writes the
+    new table into a temp sibling; it is fsync'd, then swapped in with
+    the checkpoint three-step (old → ``.bak``, staged → live, drop
+    ``.bak``) — a kill at any point leaves either the old table at
+    ``path`` or, mid-swap, at ``path + ".bak"`` where ``read`` finds
+    it."""
+    import shutil
+
+    tmp = path + ".staging"
+    bak = path + ".bak"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)          # residue of an earlier killed write
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    try:
+        build(tmp)
+        _fsync_tree(tmp)
+        if os.path.exists(bak):
+            shutil.rmtree(bak)
+        if os.path.exists(path):
+            os.replace(path, bak)
+        os.replace(tmp, path)
+        shutil.rmtree(bak, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
 
 
 # Spark SQL type names for the Delta schemaString
@@ -184,13 +213,23 @@ def _write_delta(df: pd.DataFrame, path: str) -> None:
 
 def read(tab_name: str, ts_col: str = "event_ts",
          partition_cols: Optional[List[str]] = None,
-         base_dir: Optional[str] = None):
-    """Read a table written by :func:`write` back into a TSDF."""
-    import pyarrow.parquet as pq
-
+         base_dir: Optional[str] = None, on_corrupt: str = "raise"):
+    """Read a table written by :func:`write` back into a TSDF, through
+    the hardened read path: store tables resolve their committed
+    generation (torn pointer/commit state refused by name), and corrupt
+    row groups surface :class:`~tempo_tpu.io.ingest.
+    CorruptRowGroupError` with the exact ranges named
+    (``on_corrupt="quarantine"`` reads around them) instead of an
+    opaque pyarrow traceback.  Legacy (pre-v0.16) and delta-format
+    tables read through the same machinery; a table caught mid-swap by
+    a crash falls back to its ``.bak`` survivor."""
     from tempo_tpu.frame import TSDF
+    from tempo_tpu.store import engine as store_engine
 
     path = _table_path(tab_name, base_dir)
-    df = pq.read_table(path).to_pandas()
+    if not os.path.isdir(path) and os.path.isdir(path + ".bak"):
+        path = path + ".bak"    # crash between the two swap renames
+    ds_path = store_engine.resolve_dataset_path(path)
+    df = store_engine.read_dataset_df(ds_path, on_corrupt=on_corrupt)
     df = df.drop(columns=[c for c in ("event_dt", "event_time") if c in df.columns])
     return TSDF(df, ts_col=ts_col, partition_cols=partition_cols)

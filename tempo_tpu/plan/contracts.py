@@ -66,7 +66,14 @@ Registry coverage map (program -> production user):
                                 construction) + the ``serve.cohort_
                                 loop`` chain pinning that the step's
                                 out-shardings ARE its own (and the
-                                query's) in-shardings
+                                query's) in-shardings.  The tiered
+                                member-state spill (spill_dir +
+                                resident_budget) adds NO device
+                                program: spill/fault-in are host-side
+                                slot copies around the same
+                                ``serve.cohort_push`` step, so its
+                                contracts cover the spilling cohort
+                                unchanged
 ``service.dispatch_stats`` /    the query service's steady-state
 ``service.dispatch_ema``        dispatch programs: the cached planner
                                 executables (plan/fused.py) at the

@@ -49,6 +49,7 @@ included.
 
 from __future__ import annotations
 
+import hashlib
 import logging
 import os
 import shutil
@@ -129,10 +130,22 @@ class CohortMember:
         self.acked = 0
         self._group: Optional["_Group"] = None
         self.slot: Optional[int] = None
+        # spill tier: the bucket a non-resident member belongs to
+        # (``_group is None`` = spilled or never-allocated cold member)
+        self._spill_bucket: Optional[int] = None
+
+    @property
+    def resident(self) -> bool:
+        """True when this member holds a live slot (hot tier); False
+        when its state is spilled to a CRC'd artifact (or it has never
+        ticked and its fresh state needs no artifact at all)."""
+        return self._group is not None
 
     @property
     def bucket(self) -> int:
         """The member's current shape bucket (padded series rows)."""
+        if self._group is None:
+            return int(self._spill_bucket)
         return self._group.cfg.n_series
 
     # -- the StreamingTSDF-shaped surface ------------------------------
@@ -211,6 +224,14 @@ class CohortMember:
         declared row bound (truncated — the declared-bound audit)."""
         if not self.cohort.cfg_has_window:
             return 0
+        if self._group is None:
+            # spilled member: its counts live in the artifact (a
+            # never-ticked cold member has no artifact and no clips)
+            arrays = self.cohort._spilled_arrays(self)
+            if arrays is None:
+                return 0
+            return int(np.asarray(
+                arrays["s.clipped"])[:len(self.series)].sum())
         plane = np.asarray(self._group.state["clipped"])
         return int(plane[self.slot, :len(self.series)].sum())
 
@@ -333,7 +354,9 @@ class StreamCohort:
                  checkpoint_dir: Optional[str] = None,
                  ckpt_every: Optional[int] = None, keep_last: int = 3,
                  diff_snapshots: Optional[bool] = None,
-                 full_every: int = 16):
+                 full_every: int = 16,
+                 spill_dir: Optional[str] = None,
+                 resident_budget: Optional[int] = None):
         self.value_cols = [str(c) for c in value_cols]
         self.skip_nulls = bool(skip_nulls)
         self.max_lookback = int(max_lookback)
@@ -373,6 +396,23 @@ class StreamCohort:
         self._last_snapshot: Optional[str] = None
         self._last_full: Optional[str] = None
         self._diffs_since_full = 0
+        # -- tiered member state: with a spill_dir, cold members live
+        # as CRC'd kind="cohort_member" artifacts instead of slots —
+        # "millions registered, resident_budget hot".  0 = unlimited
+        # (no LRU eviction; explicit spill() still works).
+        self.spill_dir = spill_dir
+        if resident_budget is None:
+            resident_budget = config.get_int(
+                "TEMPO_TPU_SERVE_COHORT_RESIDENT", 0)
+        self.resident_budget = max(0, int(resident_budget))
+        if self.resident_budget and not self.spill_dir:
+            raise ValueError(
+                "a resident_budget needs a spill_dir to evict into")
+        self._spilled: Dict[str, str] = {}   # member name -> artifact
+        self._lru: Dict[str, None] = {}      # resident members, LRU order
+        self._resident = 0
+        self.spills = 0
+        self.restores = 0
 
     # -- membership ----------------------------------------------------
 
@@ -397,12 +437,24 @@ class StreamCohort:
 
     def add_stream(self, name: str, series: Sequence) -> CohortMember:
         """Admit a stream: allocate a slot in its shape bucket's group
-        (creating/growing the group as needed) and return its handle."""
+        (creating/growing the group as needed) and return its handle.
+
+        With a ``resident_budget``, admission past the budget registers
+        the stream COLD: no slot, no artifact (a fresh slot IS the init
+        state, so nothing needs persisting) — it faults into a slot on
+        its first tick.  Registration is O(1) regardless of fleet
+        size."""
         name = str(name)
         if name in self._members:
             raise ValueError(f"stream {name!r} already exists")
         member = CohortMember(self, name, series)
-        self._group(row_bucket(len(member.series))).alloc(member)
+        bucket = row_bucket(len(member.series))
+        if self.resident_budget and self._resident >= self.resident_budget:
+            member._spill_bucket = bucket
+        else:
+            self._group(bucket).alloc(member)
+            self._resident += 1
+            self._lru[name] = None
         self._members[name] = member
         return member
 
@@ -429,10 +481,14 @@ class StreamCohort:
             for m in g.members:
                 if m is not None:
                     total += int(plane[m.slot, :len(m.series)].sum())
+        for name in self._spilled:
+            total += self._members[name].clipped
         return total
 
     def _grow_member(self, member: CohortMember,
                      new_series: Sequence) -> None:
+        if member._group is None:    # spilled: surgery needs a slot
+            self._fault_in(member)
         new_k = len(member.series) + len(new_series)
         old_g, old_slot = member._group, member.slot
         target = row_bucket(new_k)
@@ -494,6 +550,28 @@ class StreamCohort:
             else:
                 prev.append(i)
 
+        # spill tier: fault cold members back into slots BEFORE
+        # admission — per-member isolation holds here too: a corrupt or
+        # foreign member artifact rejects only that member's ticks (the
+        # refusal delivered by name as their result), never the
+        # dispatch
+        dead: set = set()
+        touched: List[CohortMember] = []
+        if self.spill_dir is not None:
+            for key, idxs in by_member.items():
+                member = items[idxs if type(idxs) is int else idxs[0]][0]
+                if member.cohort is not self:
+                    continue       # admission loop raises, as ever
+                touched.append(member)
+                if member._group is not None:
+                    continue
+                try:
+                    self._fault_in(member)
+                except Exception as e:  # noqa: BLE001 - per member
+                    dead.add(key)
+                    for i in ([idxs] if type(idxs) is int else idxs):
+                        results[i] = e
+
         # per-member admission: validate payloads + watermark order,
         # assign lanes; a failing member is recorded and EXCLUDED.
         # Single-tick members take a deferred path: payloads validated
@@ -504,7 +582,9 @@ class StreamCohort:
         groups: Dict[int, List] = {}
         singles: Dict[int, "_Singles"] = {}
         n_cols = len(self.value_cols)
-        for idxs in by_member.values():
+        for key, idxs in by_member.items():
+            if key in dead:
+                continue
             if type(idxs) is int:
                 i = idxs
                 member, skey, ts, sq, vals = items[i]
@@ -553,6 +633,15 @@ class StreamCohort:
                                  singles.get(bucket), results)
             self._dirty.add(bucket)
         self.dispatches += 1
+        # spill tier: everything that dispatched is hot (move to MRU),
+        # then evict coldest residents past the budget — never a member
+        # of THIS dispatch
+        if self.spill_dir is not None and self.resident_budget:
+            for m in touched:
+                if m._group is not None:
+                    self._lru.pop(m.name, None)
+                    self._lru[m.name] = None
+            self._enforce_budget({m.name for m in touched})
         self._maybe_snapshot()
         return results
 
@@ -828,6 +917,142 @@ class StreamCohort:
         member.acked += n_ticks
         self.acked_total += n_ticks
 
+    # -- tiered member-state spill -------------------------------------
+
+    def _member_artifact(self, name: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in name)[:40]
+        h = hashlib.sha1(name.encode()).hexdigest()[:12]
+        return os.path.join(self.spill_dir, f"member_{safe}_{h}")
+
+    def spill(self, name: str) -> str:
+        """Explicitly demote one resident member to the cold tier;
+        returns the artifact path.  The LRU does this automatically
+        past ``resident_budget``."""
+        member = self._members[str(name)]
+        if member._group is None:
+            raise ValueError(f"stream {name!r} is not resident")
+        return self._spill(member)
+
+    def _spill(self, member: CohortMember) -> str:
+        """Persist one member's slot rows (every state plane + its
+        watermark rows) as a CRC'd ``kind="cohort_member"`` artifact
+        and free the slot.  The artifact is the member's EXACT state:
+        faulting it back in and ticking is bitwise the never-spilled
+        run."""
+        if not self.spill_dir:
+            raise ValueError("StreamCohort has no spill_dir")
+        g, slot = member._group, member.slot
+        g._host()
+        arrays = {f"s.{n}": np.ascontiguousarray(a[slot])
+                  for n, a in g.state.items()}
+        arrays["wm_ts"] = np.ascontiguousarray(g.wm_ts[slot])
+        arrays["wm_seq"] = np.ascontiguousarray(g.wm_seq[slot])
+        arrays["wm_side"] = np.ascontiguousarray(g.wm_side[slot])
+        meta = {"cohort_config": self._config_meta(),
+                "name": member.name,
+                "series_repr": [repr(s) for s in member.series],
+                "acked": int(member.acked),
+                "bucket": int(g.bucket)}
+        path = self._member_artifact(member.name)
+        ckpt.save_state(arrays, path, meta, kind="cohort_member")
+        member._spill_bucket = g.bucket
+        g.release(slot)
+        member._group, member.slot = None, None
+        self._spilled[member.name] = path
+        self._lru.pop(member.name, None)
+        self._resident -= 1
+        self.spills += 1
+        return path
+
+    def _fault_in(self, member: CohortMember) -> None:
+        """Promote a cold member into a slot.  With an artifact, its
+        rows install bit-for-bit (the artifact stays on disk for any
+        snapshot that references it); a never-ticked cold member just
+        allocates — a fresh slot IS its state, no artifact needed.  A
+        foreign, stale, or corrupt artifact is refused by name
+        (CheckpointError), the member stays cold."""
+        path = self._spilled.get(member.name)
+        if path is None:
+            bucket = int(member._spill_bucket
+                         if member._spill_bucket is not None
+                         else row_bucket(len(member.series)))
+            self._group(bucket).alloc(member)
+            member._spill_bucket = None
+            self._resident += 1
+            self._lru[member.name] = None
+            return
+        arrays, meta = ckpt.load_state(path, kind="cohort_member")
+        if (meta.get("name") != member.name
+                or meta.get("series_repr") != [repr(s)
+                                               for s in member.series]
+                or meta.get("cohort_config") != self._config_meta()):
+            raise ckpt.CheckpointError(
+                f"spilled member artifact {path!r} is FOREIGN to "
+                f"stream {member.name!r} of this cohort (name / series "
+                f"set / cohort config mismatch): refusing to install "
+                f"it; delete the artifact to re-admit the stream with "
+                f"fresh state")
+        if int(meta["acked"]) != int(member.acked):
+            # a spilled member's state is frozen, so artifact and
+            # cursor agree by construction — disagreement means this
+            # cohort resumed an OLD snapshot and the member re-spilled
+            # NEWER state over the artifact since: installing it would
+            # double-apply the replay tail
+            raise ckpt.CheckpointError(
+                f"spilled member artifact {path!r} holds stream "
+                f"{member.name!r} at acked={meta['acked']} but this "
+                f"cohort's cursor is {member.acked}: the artifact "
+                f"outlived the snapshot this cohort resumed from — "
+                f"resume from a newer snapshot")
+        bucket = int(meta["bucket"])
+        g = self._group(bucket)
+        slot = g.alloc(member)
+        g._host()
+        for n in g.state:
+            g.state[n][slot] = arrays[f"s.{n}"]
+        g.wm_ts[slot] = np.asarray(arrays["wm_ts"], np.int64)
+        g.wm_seq[slot] = np.asarray(arrays["wm_seq"], np.float64)
+        g.wm_side[slot] = np.asarray(arrays["wm_side"], np.int8)
+        member._spill_bucket = None
+        # the artifact STAYS on disk: any cohort snapshot taken while
+        # the member was spilled references it by name, and the
+        # member's state was frozen from spill to now — the file is
+        # exact for every one of those snapshots.  A later re-spill
+        # overwrites it atomically.
+        del self._spilled[member.name]
+        self._resident += 1
+        self._lru[member.name] = None
+        self.restores += 1
+        self._dirty.add(bucket)
+
+    def _enforce_budget(self, protect: set) -> None:
+        """Evict coldest-first until resident count fits the budget;
+        members named in ``protect`` (this dispatch) are never
+        evicted, so a dispatch touching more members than the budget
+        temporarily exceeds it rather than thrash."""
+        while self._resident > self.resident_budget:
+            victim = next((n for n in self._lru if n not in protect),
+                          None)
+            if victim is None:
+                return
+            self._spill(self._members[victim])
+
+    def _spilled_arrays(self, member: CohortMember):
+        path = self._spilled.get(member.name)
+        if path is None:
+            return None
+        arrays, _meta = ckpt.load_state(path, kind="cohort_member")
+        return arrays
+
+    @property
+    def spill_stats(self) -> dict:
+        """Tier occupancy and traffic counters."""
+        return {"registered": len(self._members),
+                "resident": self._resident,
+                "spilled_artifacts": len(self._spilled),
+                "spills": self.spills, "restores": self.restores}
+
     # -- warmup --------------------------------------------------------
 
     def warmup(self, max_rows: int) -> int:
@@ -911,10 +1136,24 @@ class StreamCohort:
         buckets = (sorted(b for b in self._dirty if b in self._groups)
                    if differential else sorted(self._groups))
         arrays, groups_meta = self._snapshot_arrays(buckets)
-        members_meta = [
-            {"name": m.name, "bucket": m._group.bucket, "slot": m.slot,
-             "series": list(m.series), "acked": m.acked}
-            for m in self._members.values()]
+        members_meta = []
+        for m in self._members.values():
+            mm = {"name": m.name, "series": list(m.series),
+                  "acked": m.acked}
+            if m._group is not None:
+                mm["bucket"] = m._group.bucket
+                mm["slot"] = m.slot
+            else:
+                # cold member: no slot; its artifact (if any — a
+                # never-ticked member has none) is referenced by name
+                # so resume reattaches the SAME spilled state
+                mm["bucket"] = m._spill_bucket
+                mm["slot"] = None
+                mm["spilled"] = True
+                ap = self._spilled.get(m.name)
+                if ap is not None:
+                    mm["artifact"] = os.path.basename(ap)
+            members_meta.append(mm)
         meta = {"cohort_config": self._config_meta(),
                 "groups": groups_meta, "members": members_meta,
                 "acked_total": self.acked_total}
@@ -1074,19 +1313,40 @@ class StreamCohort:
             g.wm_side = np.asarray(arrays[f"g{bucket}.wm_side"], np.int8)
             self._groups[bucket] = g
         self._members.clear()
+        self._spilled.clear()
         for g in self._groups.values():
             g.members = [None] * g.capacity
         for mm in meta["members"]:
             member = CohortMember(self, mm["name"], mm["series"])
+            member.acked = int(mm["acked"])
+            self._members[member.name] = member
+            if mm.get("spilled"):
+                member._spill_bucket = (None if mm["bucket"] is None
+                                        else int(mm["bucket"]))
+                art = mm.get("artifact")
+                if art is not None:
+                    if not self.spill_dir:
+                        raise ckpt.CheckpointError(
+                            f"cohort snapshot records stream "
+                            f"{member.name!r} spilled to artifact "
+                            f"{art!r} but this cohort has no "
+                            f"spill_dir: resume with the original "
+                            f"spill_dir, or that member's state is "
+                            f"unreachable")
+                    self._spilled[member.name] = os.path.join(
+                        self.spill_dir, art)
+                continue
             g = self._groups[int(mm["bucket"])]
             slot = int(mm["slot"])
             g.members[slot] = member
             member._group, member.slot = g, slot
-            member.acked = int(mm["acked"])
-            self._members[member.name] = member
         for g in self._groups.values():
             g._free = [i for i in range(g.capacity - 1, -1, -1)
                        if g.members[i] is None]
+        self._resident = sum(1 for m in self._members.values()
+                             if m._group is not None)
+        self._lru = {m.name: None for m in self._members.values()
+                     if m._group is not None}
         self.acked_total = int(meta["acked_total"])
 
     @classmethod

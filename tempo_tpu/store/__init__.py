@@ -1,0 +1,31 @@
+"""Transactional storage engine: crash-consistent clustered write-back.
+
+The write-side fault domain (ROADMAP item 2): sharded Parquet
+write-back of frames, distributed frames and query results as
+*generations* of (series, time)-clustered segments, committed by
+per-segment CRC'd manifests chained by predecessor CRC with a JSON
+commit record written last, published by an atomic pointer swing — so
+the previous table version survives ANY kill, a killed write resumes
+with zero committed-segment re-writes, and torn/foreign/corrupt
+staged state is refused by name.  ``compact`` merges small segments
+into clustered large ones as a new transactional generation under
+live readers.  See BUILDING.md "Storage engine".
+"""
+
+from tempo_tpu.store.compact import compact
+from tempo_tpu.store.engine import (
+    Store,
+    StoreCommitError,
+    StoreError,
+    resolve_dataset_path,
+    write_back,
+)
+
+__all__ = [
+    "Store",
+    "StoreError",
+    "StoreCommitError",
+    "compact",
+    "resolve_dataset_path",
+    "write_back",
+]

@@ -1044,3 +1044,407 @@ def run_pipeline_campaign(workdir: str, *, rows_total: int = 360_000,
             f"FULL collected output bytes) bitwise == uninjected twin; "
             f"plan-barrier resume bitwise == eager twin"),
     }
+
+
+# ----------------------------------------------------------------------
+# Storage-engine chaos (bench config 17)
+# ----------------------------------------------------------------------
+
+def run_store_campaign(workdir: str, *, rows: int = 20_000,
+                       n_keys: int = 8, seed: int = 31,
+                       segment_rows: int = 2_000,
+                       n_streams: int = 24, resident_budget: int = 6,
+                       events_per_stream: int = 14) -> dict:
+    """The storage-plane chaos campaign — transactional clustered
+    write-back, background compaction, and the tiered cohort-state
+    spill, under a kill/corrupt schedule.  Asserted HARD (a violation
+    raises and nulls bench config 17):
+
+    * a mid-write kill resumes the staged generation with ZERO
+      committed-segment re-writes (segment writes are call-counted),
+      and the resumed table is bitwise-identical to an uninjected
+      fresh write of the same frame; a kill between the commit record
+      and the pointer swing resumes with zero segment writes at all;
+    * while a write is staged or killed, readers see EXACTLY the old
+      generation — and a foreign resume frame, a torn commit record, a
+      corrupt pointer, and a corrupt committed segment are each
+      refused BY NAME (classified PERMANENT / CORRUPTED_ARTIFACT,
+      never transient);
+    * the legacy ``io.writer.write`` overwrite survives kills at every
+      stage: mid-build, mid-fsync, and BETWEEN the two swap renames
+      (the old table is readable at every probe — the seed-era
+      rmtree-then-rewrite data-loss window is gone);
+    * a compaction kill leaves the table at exactly generation N; the
+      re-issued compaction commits N+1; a reader holding N's dataset
+      path stays bitwise-correct after N+1 commits (never a blend);
+    * an over-memory cohort sweep (``resident_budget`` slots for
+      ``n_streams`` streams under Poisson load) spills cold members to
+      CRC'd artifacts and faults them back in on their next tick, with
+      the FULL per-tick emission history bitwise-identical to a
+      never-spilled twin; corrupt and foreign member artifacts are
+      refused by name, rejecting only their own member's ticks.
+    """
+    import shutil
+
+    import pandas as pd
+
+    from tempo_tpu import resilience
+    from tempo_tpu.io import writer
+    from tempo_tpu.store import engine as store_engine
+    from tempo_tpu.store.compact import compact as store_compact
+    from tempo_tpu.resilience import FailureKind
+
+    t_start = time.perf_counter()
+    os.makedirs(workdir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    wh = os.path.join(workdir, "warehouse")
+    store = store_engine.Store(wh)
+
+    def mk_df(salt: float) -> "pd.DataFrame":
+        r = np.random.default_rng(seed + int(salt * 1000))
+        return pd.DataFrame({
+            "symbol": r.choice([f"s{k:03d}" for k in range(n_keys)],
+                               rows),
+            "event_ts": pd.to_datetime(
+                np.sort(r.integers(0, 10 ** 6, rows)) * 1_000_000_000),
+            "px": r.standard_normal(rows),
+        })
+
+    def sorted_twin(df):
+        return df.sort_values(["symbol"], kind="stable").reset_index(
+            drop=True)
+
+    # -- phase 1: write kill -> resume, zero committed re-writes ------
+    df0 = mk_df(0.0)
+    store.write_table("orders", df0, ["symbol"], source_fp="base",
+                      segment_rows=segment_rows)
+    df1 = mk_df(1.0)
+    n_segments = -(-rows // segment_rows)
+    kill_at = max(2, n_segments // 2)
+    try:
+        with faults.FaultInjector().kill_on_call(
+                store_engine, "_write_segment", call_no=kill_at):
+            store.write_table("orders", df1, ["symbol"],
+                              source_fp="v1", segment_rows=segment_rows)
+        raise AssertionError("write kill did not land")
+    except faults.SimulatedKill:
+        pass
+    # killed mid-write: readers still see the OLD generation, bitwise
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  sorted_twin(df0))
+    with faults.FaultInjector().flaky(
+            store_engine, "_write_segment", failures=0) as fi:
+        stats = store.write_table("orders", df1, ["symbol"],
+                                  source_fp="v1",
+                                  segment_rows=segment_rows)
+    rewrites = len(fi.records)
+    assert stats["resumed"] and stats["segments_rewritten"] == 0
+    assert stats["segments_reused"] == kill_at - 1, stats
+    assert rewrites == n_segments - (kill_at - 1), (rewrites, stats)
+    # bitwise vs an uninjected fresh write of the same frame
+    fresh = store_engine.Store(os.path.join(workdir, "wh_twin"))
+    fresh.write_table("orders", df1, ["symbol"], source_fp="v1",
+                      segment_rows=segment_rows)
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  fresh.read("orders", verify=True))
+    # kill AFTER the commit record, before the pointer swing: the
+    # re-issue verifies + swings with ZERO segment writes
+    df2 = mk_df(2.0)
+    try:
+        with faults.FaultInjector().kill_on_call(
+                store_engine, "_swing_pointer", call_no=1):
+            store.write_table("orders", df2, ["symbol"],
+                              source_fp="v2", segment_rows=segment_rows)
+        raise AssertionError("pointer-swing kill did not land")
+    except faults.SimulatedKill:
+        pass
+    pd.testing.assert_frame_equal(store.read("orders"),
+                                  sorted_twin(df1))   # still v1
+    with faults.FaultInjector().flaky(
+            store_engine, "_write_segment", failures=0) as fi:
+        stats2 = store.write_table("orders", df2, ["symbol"],
+                                   source_fp="v2",
+                                   segment_rows=segment_rows)
+    assert len(fi.records) == 0, "post-commit resume rewrote segments"
+    assert stats2["resumed"] and stats2["segments_reused"] == n_segments
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  sorted_twin(df2))
+
+    # -- phase 2: refusal matrix (all BY NAME, correctly classified) --
+    refusals: Dict[str, str] = {}
+    df3 = mk_df(3.0)
+    try:
+        with faults.FaultInjector().kill_on_call(
+                store_engine, "_write_segment", call_no=2):
+            store.write_table("orders", df3, ["symbol"],
+                              source_fp="v3", segment_rows=segment_rows)
+    except faults.SimulatedKill:
+        pass
+    try:
+        store.write_table("orders", mk_df(4.0), ["symbol"],
+                          source_fp="OTHER",
+                          segment_rows=segment_rows)
+        raise AssertionError("foreign staged resume was admitted")
+    except store_engine.StoreError as e:
+        assert resilience.classify(e) is FailureKind.PERMANENT
+        assert "DIFFERENT write" in str(e)
+        refusals["foreign_staged_write"] = "PERMANENT"
+    assert store.discard_staging("orders")
+    gen, _ = store.current("orders")
+    gen_dir = os.path.join(store.table_path("orders"), gen)
+    commit_path = os.path.join(gen_dir, store_engine.COMMIT_NAME)
+    blob = open(commit_path, "rb").read()
+    with open(commit_path, "wb") as f:
+        f.write(blob[: len(blob) // 2])          # torn commit record
+    try:
+        store.read("orders")
+        raise AssertionError("torn commit record was admitted")
+    except store_engine.StoreCommitError as e:
+        k = resilience.classify(e)
+        assert k is FailureKind.CORRUPTED_ARTIFACT, k
+        refusals["torn_commit_record"] = "CORRUPTED_ARTIFACT"
+    with open(commit_path, "wb") as f:
+        f.write(blob)
+    cur_path = os.path.join(store.table_path("orders"),
+                            store_engine.CURRENT_NAME)
+    cur_blob = open(cur_path, "rb").read()
+    with open(cur_path, "wb") as f:
+        f.write(b'{"generation": "gen_99999999", "commit_crc": 1}')
+    try:
+        store.read("orders")
+        raise AssertionError("dangling pointer was admitted")
+    except store_engine.StoreCommitError:
+        refusals["corrupt_pointer"] = "CORRUPTED_ARTIFACT"
+    with open(cur_path, "wb") as f:
+        f.write(cur_blob)
+    seg_path = os.path.join(gen_dir, store_engine._seg_name(0))
+    seg_off = max(0, os.path.getsize(seg_path) // 2)
+    faults.flip_byte(seg_path, offset=seg_off)
+    try:
+        store.read("orders", verify=True)
+        raise AssertionError("corrupt committed segment passed verify")
+    except store_engine.StoreCommitError as e:
+        assert store_engine._seg_name(0) in str(e)
+        refusals["corrupt_committed_segment"] = "CORRUPTED_ARTIFACT"
+    faults.flip_byte(seg_path, offset=seg_off)   # XOR twice = restore
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  sorted_twin(df2))
+
+    # -- phase 3: legacy writer overwrite survives every kill stage --
+    from tempo_tpu.frame import TSDF
+    base_dir = os.path.join(workdir, "legacy_wh")
+    dfa = mk_df(5.0)
+    dfb = mk_df(6.0)
+    tsa = TSDF(dfa, ts_col="event_ts", partition_cols=["symbol"])
+    tsb = TSDF(dfb, ts_col="event_ts", partition_cols=["symbol"])
+    writer.write(tsa, "legacy", base_dir=base_dir, format="delta")
+    old_px = np.sort(dfa.px.to_numpy())
+
+    def legacy_survives(tag: str) -> None:
+        got = writer.read("legacy", partition_cols=["symbol"],
+                          base_dir=base_dir)
+        np.testing.assert_array_equal(
+            np.sort(got.df.px.to_numpy()), old_px,
+            err_msg=f"old table lost after kill {tag}")
+
+    survived = []
+    try:                                     # kill mid-build
+        with faults.FaultInjector().kill_on_call(
+                writer, "_write_delta", call_no=1):
+            writer.write(tsb, "legacy", base_dir=base_dir,
+                         format="delta")
+        raise AssertionError("mid-build kill did not land")
+    except faults.SimulatedKill:
+        pass
+    legacy_survives("mid-build")
+    survived.append("mid-build")
+    try:                                     # kill mid-fsync
+        with faults.FaultInjector().kill_on_call(
+                writer, "_fsync_tree", call_no=1):
+            writer.write(tsb, "legacy", base_dir=base_dir,
+                         format="delta")
+        raise AssertionError("mid-fsync kill did not land")
+    except faults.SimulatedKill:
+        pass
+    legacy_survives("mid-fsync")
+    survived.append("mid-fsync")
+    try:                                     # kill BETWEEN the swaps
+        with faults.FaultInjector().kill_on_call(
+                writer.os, "replace", call_no=2):
+            writer.write(tsb, "legacy", base_dir=base_dir,
+                         format="delta")
+        raise AssertionError("mid-swap kill did not land")
+    except faults.SimulatedKill:
+        pass
+    legacy_survives("mid-swap (.bak fallback)")
+    survived.append("mid-swap")
+    writer.write(tsb, "legacy", base_dir=base_dir, format="delta")
+    got = writer.read("legacy", partition_cols=["symbol"],
+                      base_dir=base_dir)
+    np.testing.assert_array_equal(np.sort(got.df.px.to_numpy()),
+                                  np.sort(dfb.px.to_numpy()))
+
+    # -- phase 4: compaction under live traffic, killed mid-merge ----
+    gen_n, commit_n = store.current("orders")
+    reader_path = store.dataset_path("orders")   # a live reader on N
+    segs_before = len(commit_n["segments"])
+    reader_df = store_engine.read_dataset_df(reader_path)
+    try:
+        with faults.FaultInjector().kill_on_call(
+                store_engine, "_write_segment",
+                call_no=1):
+            store_compact("orders", base_dir=wh, min_segments=2)
+        raise AssertionError("compaction kill did not land")
+    except faults.SimulatedKill:
+        pass
+    # table is EXACTLY generation N (pointer untouched, reads bitwise)
+    assert store.current("orders")[0] == gen_n
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  sorted_twin(df2))
+    cstats = store_compact("orders", base_dir=wh, min_segments=2)
+    gen_n1 = store.current("orders")[0]
+    assert gen_n1 != gen_n and cstats["compacted_from"] == gen_n
+    assert cstats["segments"] < segs_before
+    # reader holding N's path is still bitwise-correct after N+1
+    pd.testing.assert_frame_equal(
+        store_engine.read_dataset_df(reader_path), reader_df)
+    pd.testing.assert_frame_equal(store.read("orders", verify=True),
+                                  sorted_twin(df2))
+
+    # -- phase 5: over-memory cohort sweep under Poisson load --------
+    from tempo_tpu.serve import StreamCohort
+
+    events = make_events(rng, n_streams, events_per_stream,
+                         left_frac=0.15)
+
+    def mk(budget: int, tag: str) -> "StreamCohort":
+        return StreamCohort(
+            ("px",), window_secs=10.0, window_rows_bound=8,
+            ema_alpha=0.2, max_lookback=16, slots=4,
+            spill_dir=(os.path.join(workdir, f"spill_{tag}")
+                       if budget else None),
+            resident_budget=budget)
+
+    def feed(cohort, record_lat: bool):
+        for s in range(n_streams):
+            cohort.add_stream(f"u{s}", ["s0"])
+        history = [[] for _ in range(n_streams)]
+        cold_lat, hot_lat = [], []
+        pos = [0] * n_streams
+        live = [s for s in range(n_streams) if events[s]]
+        while live:
+            nxt = []
+            for s in live:
+                kind, ts, val = events[s][pos[s]]
+                m = cohort.stream(f"u{s}")
+                was_cold = not m.resident
+                t0 = time.perf_counter()
+                if kind == "right":
+                    r = m.push(["s0"], [ts],
+                               {"px": np.float32(val)})
+                else:
+                    r = m.push_left(["s0"], [ts])
+                dt = time.perf_counter() - t0
+                if record_lat:
+                    (cold_lat if was_cold else hot_lat).append(dt)
+                history[s].append(
+                    {k: np.asarray(v).copy() for k, v in r.items()})
+                pos[s] += 1
+                if pos[s] < len(events[s]):
+                    nxt.append(s)
+            live = nxt
+        return history, cold_lat, hot_lat
+
+    twin = mk(0, "never")
+    golden, _, _ = feed(twin, record_lat=False)
+    spill_t0 = time.perf_counter()
+    cohort = mk(resident_budget, "lru")
+    hist, cold_lat, hot_lat = feed(cohort, record_lat=True)
+    spill_wall = time.perf_counter() - spill_t0
+    st = cohort.spill_stats
+    assert st["spills"] > 0 and st["restores"] > 0, st
+    assert st["resident"] <= resident_budget, st
+    for s in range(n_streams):
+        assert len(hist[s]) == len(golden[s])
+        for a, b in zip(hist[s], golden[s]):
+            assert a.keys() == b.keys()
+            for k in a:
+                assert np.array_equal(a[k], b[k], equal_nan=True), \
+                    (s, k)
+
+    def p99(lat):
+        return (round(float(np.percentile(lat, 99)) * 1e3, 3)
+                if lat else None)
+
+    # corrupt member artifact: refused by name, only ITS ticks fail
+    victim = next(iter(cohort._spilled))
+    art = cohort._spilled[victim]
+    npzs = [os.path.join(art, f) for f in os.listdir(art)
+            if f.endswith(".npz")]
+    faults.flip_byte(npzs[0], offset=os.path.getsize(npzs[0]) // 2)
+    try:
+        cohort.stream(victim).push(["s0"], [np.int64(10 ** 15)],
+                                   {"px": np.float32(1.0)})
+        raise AssertionError("corrupt member artifact was admitted")
+    except CheckpointError:
+        refusals["corrupt_member_artifact"] = "CORRUPTED_ARTIFACT"
+    resident_name = next(n for n, m in cohort._members.items()
+                         if m.resident)
+    r = cohort.stream(resident_name).push(
+        ["s0"], [np.int64(10 ** 15)], {"px": np.float32(1.0)})
+    assert r and not isinstance(r, Exception)
+    # foreign member artifact (another member's state under this
+    # member's path): refused by name
+    others = [n for n in cohort._spilled if n != victim]
+    shutil.rmtree(art)
+    shutil.copytree(cohort._spilled[others[0]], art)
+    try:
+        cohort.stream(victim).push(["s0"], [np.int64(10 ** 15) + 1],
+                                   {"px": np.float32(1.0)})
+        raise AssertionError("foreign member artifact was admitted")
+    except CheckpointError as e:
+        assert "FOREIGN" in str(e)
+        refusals["foreign_member_artifact"] = "PERMANENT"
+
+    total_ticks = sum(len(h) for h in hist)
+    wall = time.perf_counter() - t_start
+    return {
+        "rows": rows,
+        "segments": n_segments,
+        "wall_s": round(wall, 1),
+        "write_resume": {
+            "killed_at_segment": kill_at,
+            "segments_reused": kill_at - 1,
+            "segments_rewritten_committed": 0,
+            "segments_written_on_resume": rewrites,
+            "pointer_swing_resume_segment_writes": 0,
+            "value_audit": "resumed write bitwise == uninjected "
+                           "fresh write (assert_frame_equal "
+                           "check_exact)",
+        },
+        "refusals_by_name": refusals,
+        "legacy_overwrite": {
+            "kills_survived": survived,
+            "old_table_lost": False,
+        },
+        "compaction": {
+            "killed_mid_merge": True,
+            "state_after_kill": "generation N exactly",
+            "segments_before": segs_before,
+            "segments_after": cstats["segments"],
+            "reader_on_old_generation": "bitwise after N+1 commit",
+        },
+        "cohort_spill": {
+            "streams_registered": n_streams,
+            "resident_budget": resident_budget,
+            "ticks": total_ticks,
+            "spills": st["spills"],
+            "restores": st["restores"],
+            "ticks_per_sec": round(total_ticks / spill_wall, 1),
+            "cold_tick_p99_ms": p99(cold_lat),
+            "hot_tick_p99_ms": p99(hot_lat),
+            "value_audit": "full per-tick emission history bitwise "
+                           "== never-spilled twin",
+        },
+        "no_silent_restores": True,
+    }

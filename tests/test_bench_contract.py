@@ -51,6 +51,7 @@ def test_bench_smoke_prints_one_json_line():
         "13_query_service_qps", "14_fleet_serving_ticks_per_sec",
         "15_chaos_serving_ticks_per_sec",
         "16_chaos_pipeline_rows_per_sec",
+        "17_chaos_store_ticks_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -198,6 +199,42 @@ def test_bench_smoke_prints_one_json_line():
     assert fr.get("ingest") is True and fr.get("plan") is True \
         and fr.get("sweep") is True
     assert "bitwise" in cp.get("tail_audit", "")
+    # config 17 (round 16): the STORAGE-plane chaos campaign — the
+    # transactional write-back engine's zero-committed-re-write
+    # resume, the refusal-by-name matrix with classifications, the
+    # legacy overwrite surviving every kill stage, compaction
+    # atomicity, and the tiered cohort spill bitwise vs its
+    # never-spilled twin with cold-tick p99 recorded
+    cs = rec.get("chaos_store") or {}
+    wr = cs.get("write_resume") or {}
+    assert wr.get("killed_at_segment", 0) >= 2
+    assert wr.get("segments_rewritten_committed") == 0
+    assert wr.get("pointer_swing_resume_segment_writes") == 0
+    assert "bitwise" in wr.get("value_audit", "")
+    rf = cs.get("refusals_by_name") or {}
+    assert rf.get("foreign_staged_write") == "PERMANENT"
+    assert rf.get("torn_commit_record") == "CORRUPTED_ARTIFACT"
+    assert rf.get("corrupt_pointer") == "CORRUPTED_ARTIFACT"
+    assert rf.get("corrupt_committed_segment") == "CORRUPTED_ARTIFACT"
+    assert rf.get("corrupt_member_artifact") == "CORRUPTED_ARTIFACT"
+    assert rf.get("foreign_member_artifact") == "PERMANENT"
+    lo = cs.get("legacy_overwrite") or {}
+    assert lo.get("old_table_lost") is False
+    assert set(lo.get("kills_survived") or ()) == {
+        "mid-build", "mid-fsync", "mid-swap"}
+    cc = cs.get("compaction") or {}
+    assert cc.get("killed_mid_merge") is True
+    assert cc.get("state_after_kill") == "generation N exactly"
+    assert cc.get("segments_after", 1 << 30) < cc.get(
+        "segments_before", 0)
+    assert "bitwise" in cc.get("reader_on_old_generation", "")
+    sp = cs.get("cohort_spill") or {}
+    assert sp.get("streams_registered", 0) > sp.get(
+        "resident_budget", 1 << 30)
+    assert sp.get("spills", 0) >= 1 and sp.get("restores", 0) >= 1
+    assert sp.get("ticks_per_sec", 0) > 0
+    assert sp.get("cold_tick_p99_ms") is not None
+    assert "bitwise" in sp.get("value_audit", "")
     # round 15: the tuned-profile re-measurement — the checked-in
     # profile must load, the configs-2/3 deltas must be asserted
     # bitwise across the profile flip, the ≥0.5 stream-rate acceptance

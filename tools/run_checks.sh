@@ -72,6 +72,18 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_plan_checkpoint.py \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || exit $?
 
+echo "== storage chaos gate (transactional store + cohort spill) =="
+# the storage engine's crash-consistency contracts, surfaced before
+# tier-1: generation commit/resume with zero committed re-writes,
+# refusal-by-name of foreign/torn/corrupt state, live compaction
+# kills, the legacy writer's staged-swap survival, the write->ingest
+# clustering contract, the tiered cohort-state spill's bitwise
+# identity, and the config-17 campaign smoke
+JAX_PLATFORMS=cpu python -m pytest tests/test_store.py \
+    tests/test_store_chaos.py tests/test_cohort_spill.py -q \
+    -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
+    || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
