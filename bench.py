@@ -1665,6 +1665,143 @@ def bench_tune_probe(probe):
         out.update(rows_per_sec=(n - n_warm) / wall,
                    t_iter=wall / (n - n_warm),
                    batch_rows=ex.batch_rows, digest=h)
+    elif probe == "ingest_sweep":
+        import zlib
+
+        from tempo_tpu.io import ingest as tpu_ingest
+
+        smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+        n_slabs = 4 if smoke else 10
+        slab_rows = (1 << 13) if smoke else (1 << 19)
+
+        def load(i):
+            rng = np.random.default_rng(100 + i)
+            return np.sort(rng.standard_normal(slab_rows)
+                           .astype(np.float32), kind="stable")
+
+        step = jax.jit(lambda x: jnp.cumsum(x) * jnp.float32(0.5))
+        jax.block_until_ready(step(jnp.zeros(slab_rows, jnp.float32)))
+
+        def compute(i, x):
+            return jax.block_until_ready(step(jnp.asarray(x)))
+
+        def drain(i, y):
+            return zlib.crc32(np.asarray(y).tobytes())
+
+        # ring=None: sweep_slabs reads the knob under test from env
+        tpu_ingest.sweep_slabs(2, load, compute, drain)   # warm
+        t0 = time.perf_counter()
+        res = tpu_ingest.sweep_slabs(n_slabs, load, compute, drain)
+        wall = time.perf_counter() - t0
+        h = 0
+        for c in res:
+            h = zlib.crc32(int(c).to_bytes(8, "little"), h)
+        out.update(rows_per_sec=n_slabs * slab_rows / wall,
+                   t_iter=wall / n_slabs, bytes_per_iter=slab_rows * 4,
+                   digest=h)
+    elif probe == "stitched_chain":
+        import zlib
+
+        import pandas as pd
+
+        from tempo_tpu import TSDF
+        from tempo_tpu.parallel import make_mesh
+        from tempo_tpu.plan import cache as plan_cache
+
+        smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+        Ks, Ls = (16, 512) if smoke else (64, 4096)
+        rng = np.random.default_rng(7)
+        secs = np.cumsum(rng.integers(1, 3, size=(Ks, Ls))
+                         .astype(np.int64), axis=-1)
+        df = pd.DataFrame({"sym": np.repeat(np.arange(Ks), Ls),
+                           "event_ts": secs.ravel(),
+                           "x": rng.standard_normal(Ks * Ls)})
+        frame = TSDF(df, "event_ts", ["sym"]).on_mesh(
+            make_mesh({"series": 1}))
+
+        def chain():
+            return (frame.resample("5 seconds", "mean")
+                    .EMA("x", window=6)
+                    .withRangeStats(colsToSummarize=["x"],
+                                    rangeBackWindowSecs=20)
+                    .collect().df)
+
+        os.environ["TEMPO_TPU_PLAN"] = "1"
+        try:
+            plan_cache.CACHE.clear()
+            ref = chain()                       # plan + compile
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                res = chain()
+                ts.append(time.perf_counter() - t0)
+                del res
+            t_iter = float(np.median(ts))
+        finally:
+            os.environ.pop("TEMPO_TPU_PLAN", None)
+            plan_cache.CACHE.clear()
+        h = 0
+        for c in sorted(ref.select_dtypes(include=[np.number])):
+            h = zlib.crc32(np.ascontiguousarray(
+                ref[c].to_numpy()).tobytes(), h)
+        out.update(rows_per_sec=Ks * Ls / t_iter, t_iter=t_iter,
+                   bytes_per_iter=Ks * Ls * 12, digest=h)
+    elif probe == "serve_cohort":
+        import zlib
+
+        from tempo_tpu.serve import CohortExecutor, StreamCohort
+
+        smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+        Sc = 32
+        n = 600 if smoke else 4000
+        rng = np.random.default_rng(9)
+        cohort = StreamCohort(("px",), window_secs=10.0,
+                              window_rows_bound=8, ema_alpha=0.2,
+                              max_lookback=16, slots=Sc)
+        members = [cohort.add_stream(f"u{i}", ["ticks"])
+                   for i in range(Sc)]
+        # coalesce_s=None: the executor reads the knob under test
+        ex = CohortExecutor(cohort, batch_rows=16, queue_depth=64)
+        cohort.warmup(16)
+        gaps = rng.exponential(scale=4e7, size=n).astype(np.int64) + 1
+        ts_arr = np.cumsum(gaps) + np.int64(10**9)
+        stream_of = np.concatenate([
+            rng.permutation(Sc),
+            rng.integers(0, Sc, max(0, n - Sc))])[:n]
+        is_left = rng.random(n) < 0.25
+        is_left[:Sc] = False
+        vals = rng.standard_normal(n).astype(np.float32)
+
+        def feed(i0, i1):
+            return ex.submit_many([
+                ("left", members[stream_of[q]], "ticks",
+                 int(ts_arr[q]), None, None)
+                if is_left[q] else
+                ("right", members[stream_of[q]], "ticks",
+                 int(ts_arr[q]), {"px": vals[q]}, None)
+                for q in range(i0, i1)])
+
+        n_warm = n // 8
+        for t in feed(0, n_warm):
+            t.result(timeout=120)
+        print("[tune_serve_cohort] timing...", file=sys.stderr,
+              flush=True)
+        t0 = time.perf_counter()
+        results = [t.result(timeout=300) for t in feed(n_warm, n)]
+        wall = time.perf_counter() - t0
+        ex.close()
+        # digest in submission order: per-tick results are bitwise
+        # invariant to the coalescing window (the batch split never
+        # changes per-(slot,row) state math), so every admissible
+        # coalesce value must reproduce these bytes exactly
+        h = 0
+        for res in results:
+            for key in sorted(res):
+                h = zlib.crc32(
+                    np.asarray(res[key], np.float64).tobytes(), h)
+        out.update(rows_per_sec=(n - n_warm) / wall,
+                   t_iter=wall / (n - n_warm),
+                   coalesce_s=ex.coalesce_s, digest=h)
     else:
         out["error"] = f"unknown tune probe {probe!r}"
     print(json.dumps(out))
@@ -2121,6 +2258,235 @@ def bench_plan_chain():
     }
 
 
+def bench_overlap(seed=18):
+    """Config 18 (``--only-overlap``): the PR 17 dispatch-floor planes
+    measured end to end.
+
+    Three phases, each with its own bitwise audit:
+
+    * **sweep_slabs twin** — a three-stage slab sweep (CPU-bound
+      decode, device compute, D2H drain) run serial (``ring=1``) and
+      pipelined (``ring=4``) on identical slabs: wall time both ways,
+      per-stage accumulated times, the max-stage pipeline floor, and
+      the hard assert that the pipelined per-slab results are
+      byte-identical to the serial twin's.
+    * **from_parquet** — the REAL ingest shard pipeline on a generated
+      clustered dataset, ``ring=1`` vs ``ring=4``: rows/sec both ways
+      and the collected frames compared exactly.
+    * **stitched-chain roofline** — a resample -> EMA -> range_stats
+      chain under ``TEMPO_TPU_PLAN=1`` with whole-chain stitching on
+      (one executable) vs off (``TEMPO_TPU_STITCH_MAX_OPS=1``, three):
+      rates, the in-bench proof that ``explain()`` renders the stitch
+      group, bitwise equality of the two variants, and the chain's
+      compulsory traffic as a fraction of the measured stream rate
+      (``cost.params()["hbm_stream_rate"]``).
+
+    The serial-vs-pipelined wall ratio is recorded either way; the
+    overlap >= 1x assert is full-mode-only (smoke slabs are too small
+    to amortise the thread handoff — the same gating as config 14's
+    ratio asserts).
+    """
+    import tempfile
+    import threading
+    import zlib
+
+    import pandas as pd
+
+    from tempo_tpu import TSDF
+    from tempo_tpu.io import ingest
+    from tempo_tpu.parallel import make_mesh
+    from tempo_tpu.plan import cache as plan_cache
+    from tempo_tpu.plan import cost as plan_cost
+    from tempo_tpu.testing import chaos
+
+    smoke = bool(os.environ.get("TEMPO_BENCH_SMOKE"))
+
+    # ---- phase A: the three-stage slab sweep, serial vs pipelined --
+    n_slabs = 4 if smoke else 16
+    slab_rows = (1 << 13) if smoke else (1 << 20)
+    stage_t = {"load": 0.0, "compute": 0.0, "drain": 0.0}
+    t_lock = threading.Lock()
+
+    def timed_stage(name, fn):
+        def wrapped(i, *a):
+            t0 = time.perf_counter()
+            res = fn(i, *a)
+            dt = time.perf_counter() - t0
+            with t_lock:
+                stage_t[name] += dt
+            return res
+        return wrapped
+
+    def load(i):
+        # decode/pack stand-in: genuinely CPU-bound per slab
+        rng = np.random.default_rng(seed * 1000 + i)
+        return np.sort(rng.standard_normal(slab_rows)
+                       .astype(np.float32), kind="stable")
+
+    step = jax.jit(lambda x: jnp.cumsum(x) * jnp.float32(0.5))
+
+    def compute(i, x):
+        return jax.block_until_ready(step(jnp.asarray(x)))
+
+    def drain(i, y):
+        # D2H + digest: the per-slab CRC is the bitwise evidence
+        return zlib.crc32(np.asarray(y).tobytes())
+
+    jax.block_until_ready(step(jnp.zeros(slab_rows, jnp.float32)))
+
+    def run(ring):
+        for k in stage_t:
+            stage_t[k] = 0.0
+        t0 = time.perf_counter()
+        res = ingest.sweep_slabs(n_slabs, timed_stage("load", load),
+                                 timed_stage("compute", compute),
+                                 timed_stage("drain", drain), ring=ring)
+        wall = time.perf_counter() - t0
+        rec = {"wall_s": round(wall, 4),
+               "stage_s": {k: round(v, 4) for k, v in stage_t.items()},
+               "stage_sum_s": round(sum(stage_t.values()), 4),
+               "stage_max_s": round(max(stage_t.values()), 4)}
+        return res, rec, wall
+
+    print("[overlap] sweep_slabs serial twin...", file=sys.stderr,
+          flush=True)
+    res_serial, rec_serial, wall_serial = run(1)
+    print("[overlap] sweep_slabs pipelined...", file=sys.stderr,
+          flush=True)
+    res_piped, rec_piped, wall_piped = run(4)
+    assert res_piped == res_serial, (
+        "pipelined slab sweep diverged from the serial twin")
+    sweep = {
+        "n_slabs": n_slabs, "rows_per_slab": slab_rows, "ring": 4,
+        "serial": rec_serial, "pipelined": rec_piped,
+        "speedup_vs_serial": round(wall_serial / wall_piped, 3),
+        # steady-state floor: the slowest stage's total is the least
+        # wall a 3-stage pipeline can take
+        "overlap_efficiency": round(
+            rec_piped["stage_max_s"] / wall_piped, 3),
+        "value_audit": "pipelined == serial bitwise (per-slab CRC-32 "
+                       "of the drained result bytes)",
+    }
+    if not smoke:
+        assert wall_piped <= wall_serial * 1.05, (
+            f"pipelined sweep slower than its serial twin: {sweep}")
+
+    # ---- phase B: the real from_parquet shard pipeline ------------
+    n_rows = 24_000 if smoke else 2_000_000
+    n_keys = 32 if smoke else 128
+    batch = 4096 if smoke else (1 << 18)
+    with tempfile.TemporaryDirectory() as td:
+        ds = os.path.join(td, "ds")
+        chaos.make_parquet_dataset(ds, n_rows=n_rows, n_keys=n_keys,
+                                   seed=seed, n_files=8)
+        mesh = make_mesh({"series": 1})
+        kw = dict(ts_col="event_ts", partition_cols=["symbol"],
+                  mesh=mesh, batch_rows=batch)
+
+        def _ingest(ring):
+            print(f"[overlap] from_parquet ring={ring}...",
+                  file=sys.stderr, flush=True)
+            t0 = time.perf_counter()
+            frame = ingest.from_parquet(ds, ring=ring, **kw)
+            wall = time.perf_counter() - t0
+            df = frame.collect().df.sort_values(
+                ["symbol", "event_ts"], kind="stable").reset_index(
+                    drop=True)
+            return df, wall
+
+        df1, t_ser = _ingest(1)
+        df4, t_pipe = _ingest(4)
+        pd.testing.assert_frame_equal(df4, df1, check_exact=True)
+        n_got = len(df1)
+        del df1, df4
+    ingest_rec = {
+        "rows": n_got, "shards": -(-n_rows // batch), "ring": 4,
+        "serial_rows_per_sec": round(n_got / t_ser),
+        "pipelined_rows_per_sec": round(n_got / t_pipe),
+        "speedup_vs_serial": round(t_ser / t_pipe, 3),
+        "value_audit": "ring=4 frame == ring=1 frame bitwise "
+                       "(assert_frame_equal check_exact)",
+    }
+
+    # ---- phase C: whole-pipeline roofline under stitching ----------
+    Kc, Lc = min(K, 64), min(L, 4096)
+    rng = np.random.default_rng(seed)
+    secs = np.cumsum(rng.integers(1, 3, size=(Kc, Lc)).astype(np.int64),
+                     axis=-1)
+    df = pd.DataFrame({"sym": np.repeat(np.arange(Kc), Lc),
+                       "event_ts": secs.ravel(),
+                       "x": rng.standard_normal(Kc * Lc)})
+    frame = TSDF(df, "event_ts", ["sym"]).on_mesh(
+        make_mesh({"series": 1}))
+
+    def chain():
+        return (frame.resample("5 seconds", "mean")
+                .EMA("x", window=6)
+                .withRangeStats(colsToSummarize=["x"],
+                                rangeBackWindowSecs=20))
+
+    def timed_chain(label):
+        print(f"[overlap] {label} chain...", file=sys.stderr,
+              flush=True)
+        plan_cache.CACHE.clear()
+        warm = chain().collect().df
+        ts = []
+        for _ in range(max(ITERS, 2)):
+            t0 = time.perf_counter()
+            res = chain().collect().df
+            ts.append(time.perf_counter() - t0)
+            del res
+        return warm, float(np.median(ts))
+
+    plan_prev = os.environ.get("TEMPO_TPU_PLAN")
+    stitch_prev = os.environ.get("TEMPO_TPU_STITCH_MAX_OPS")
+    os.environ["TEMPO_TPU_PLAN"] = "1"
+    os.environ.pop("TEMPO_TPU_STITCH_MAX_OPS", None)
+    try:
+        txt = chain().explain()
+        assert "stitched[resample -> ema -> range_stats]" in txt, txt
+        out_s, t_stitch = timed_chain("stitched")
+        os.environ["TEMPO_TPU_STITCH_MAX_OPS"] = "1"
+        txt1 = chain().explain()
+        assert "stitched[" not in txt1, txt1
+        out_u, t_unstitch = timed_chain("unstitched")
+        pd.testing.assert_frame_equal(out_s, out_u, check_exact=True)
+    finally:
+        for name, prev in (("TEMPO_TPU_PLAN", plan_prev),
+                           ("TEMPO_TPU_STITCH_MAX_OPS", stitch_prev)):
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+        plan_cache.CACHE.clear()
+    # compulsory traffic: packed inputs once (ts i64 + x f32) +
+    # numeric outputs once — intermediates excluded, so the fraction
+    # is a floor on how much of the measured stream rate the stitched
+    # chain sustains
+    num = out_u.select_dtypes(include=[np.number])
+    traffic = Kc * Lc * (8 + 4) + int(
+        sum(num[c].to_numpy().nbytes for c in num))
+    del out_s, out_u, num
+    stream_rate = float(plan_cost.params()["hbm_stream_rate"])
+    stitched = {
+        "rows": Kc * Lc,
+        "chain": "resample -> ema -> range_stats (one stitched "
+                 "executable vs three)",
+        "stitched_rows_per_sec": round(Kc * Lc / t_stitch),
+        "unstitched_rows_per_sec": round(Kc * Lc / t_unstitch),
+        "stitched_vs_unstitched": round(t_unstitch / t_stitch, 3),
+        "implied_gbps": round(traffic / t_stitch / 1e9, 3),
+        "stream_rate_gbps": round(stream_rate / 1e9, 2),
+        "roofline_fraction_of_stream_rate": round(
+            traffic / t_stitch / stream_rate, 4),
+        "value_audit": "stitched == unstitched bitwise "
+                       "(assert_frame_equal check_exact); explain() "
+                       "renders the stitch group",
+    }
+    return {"sweep_slabs": sweep, "ingest": ingest_rec,
+            "stitched_chain": stitched}
+
+
 def bench_serving(seed=11):
     """Config 11: the online serving engine under a Poisson arrival
     load (``--only-serving``).
@@ -2302,7 +2668,13 @@ def bench_fleet_serving(seed=14):
     * **sampled streamed == batch** — for >= 64 sampled streams, every
       measured emission (join values/found/idx, stats planes, EMA) is
       compared bitwise against the batch operators over that stream's
-      concatenated history.
+      concatenated history;
+    * **batched native dispatch (PR 17)** — the same tick mix re-fed
+      as columnar blocks (``submit_block`` ->
+      ``StreamCohort.dispatch_block``), measured against the per-tick
+      executor and asserted bitwise against its results, zero builds
+      in the measured phase (the block programs join the warmup
+      ladder).
     """
     from tempo_tpu import profiling
     from tempo_tpu.ops import rolling as ops_rolling
@@ -2411,6 +2783,63 @@ def bench_fleet_serving(seed=14):
             f"the per-instance baseline {base_rate:.0f} ticks/s "
             f"(target >= 20x)")
 
+    # ---- batched native dispatch (PR 17): the SAME tick mix re-fed
+    # to a fresh cohort as columnar blocks — submit_block -> at most
+    # ONE device scatter-step-gather program per side per chunk for
+    # single-tick members (H2D/D2H O(ticks), not O(cohort)), per-tick
+    # fallback for intra-chunk duplicate members — measured against
+    # the per-tick executor above and asserted BITWISE against its
+    # results, with the block programs on the warmup ladder (zero
+    # builds in the measured phase).
+    cohort_b = StreamCohort(cols, window_secs=wsecs,
+                            window_rows_bound=rows_bound,
+                            ema_alpha=alpha, max_lookback=ml, slots=S)
+    members_b = [cohort_b.add_stream(f"u{i}", ["ticks"])
+                 for i in range(S)]
+    ex_b = CohortExecutor(cohort_b, batch_rows=32, queue_depth=64,
+                          coalesce_s=0.004)
+    cohort_b.warmup(32, max_block=chunk_len)
+
+    def feed_blocks(i0, i1):
+        bts = []
+        for c0 in range(i0, i1, chunk_len):
+            sel = slice(c0, min(i1, c0 + chunk_len))
+            bts.append(ex_b.submit_block(
+                is_left[sel], [members_b[s] for s in stream_of[sel]],
+                "ticks", ts[sel], values={"px": vals[sel]}))
+        return bts
+
+    for bt in feed_blocks(0, n_warm):
+        bt.result(timeout=300)
+        assert not bt.errors, list(bt.errors.items())[:3]
+    builds_b0 = profiling.plan_cache_stats()["builds"]
+    tb0 = time.perf_counter()
+    bts = feed_blocks(n_warm, n)
+    block_out = [bt.result(timeout=600) for bt in bts]
+    block_wall = time.perf_counter() - tb0
+    ex_b.close()
+    builds_b1 = profiling.plan_cache_stats()["builds"]
+    assert builds_b1 == builds_b0, (
+        f"block steady state recompiled: builds went "
+        f"{builds_b0} -> {builds_b1}")
+    for bt in bts:
+        assert not bt.errors, list(bt.errors.items())[:3]
+    assert cohort_b.clipped == 0
+    block_rate = n_meas / block_wall
+
+    # bitwise: every measured tick's block row == its per-tick result
+    pos = n_warm
+    for bo in block_out:
+        ln = len(next(iter(bo.values())))
+        for j in range(ln):
+            r = measured[pos + j - n_warm]
+            for key, v in r.items():
+                a, b = np.asarray(bo[key][j]), np.asarray(v)
+                assert a.dtype == b.dtype and \
+                    a.tobytes() == b.tobytes(), (pos + j, key)
+        pos += ln
+    assert pos == n, (pos, n)
+
     # ---- sampled identity: streamed emissions == batch operators
     # over each sampled stream's concatenated history
     audit_streams = sorted(set(
@@ -2490,6 +2919,20 @@ def bench_fleet_serving(seed=14):
             "n_ticks": 3 * n_base,
         },
         "aggregate_vs_per_instance": round(ratio, 1),
+        "block_dispatch": {
+            "ticks_per_sec": round(block_rate, 1),
+            "vs_per_tick_executor": round(block_rate / agg_rate, 2),
+            "dispatches": ex_b.batches,
+            "n_ticks": n_meas,
+            "chunk_len": chunk_len,
+            "zero_builds_steady_state": True,
+            "value_audit": "block rows == per-tick executor results "
+                           "bitwise over the whole measured phase",
+            "target": ">= 5x vs per-tick on-image is a TPU target "
+                      "(the XLA:CPU fallback is step-program-bound, "
+                      "not dispatch-bound); the measured number is "
+                      "reported either way",
+        },
         "audit_streams": len(audit_streams),
         "value_audit": f"sampled streamed == batch bitwise over "
                        f"{len(audit_streams)} streams ({checked} "
@@ -3171,6 +3614,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-overlap" in sys.argv:
+        res = _attempt("overlap", bench_overlap)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-serving" in sys.argv:
         res = _attempt("serving", bench_serving)
         if res is None:
@@ -3332,6 +3781,8 @@ def main():
                                    timeout=2400)
     plan_chain = _config_subprocess("--only-plan-chain", "plan_chain",
                                     timeout=2400)
+    overlap = _config_subprocess("--only-overlap", "overlap",
+                                 timeout=2400)
     serving = _config_subprocess("--only-serving", "serving",
                                  timeout=2400)
     fleet_serving = _config_subprocess("--only-fleet-serving",
@@ -3508,6 +3959,13 @@ def main():
             "17_chaos_store_ticks_per_sec": (
                 round(chaos_store["cohort_spill"]["ticks_per_sec"])
                 if chaos_store else None),
+            # rows/sec through the REAL pipelined from_parquet shard
+            # loop (ring=4 vs the ring=1 serial twin, bitwise); the
+            # record below carries the per-stage sweep_slabs times and
+            # the stitched-chain roofline (PR 17)
+            "18_overlap_rows_per_sec": (
+                round(overlap["ingest"]["pipelined_rows_per_sec"])
+                if overlap else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -3557,6 +4015,12 @@ def main():
             round(fused_rows_sec / plan_chain["planned_rows_per_sec"], 2)
             if plan_chain else None),
         "plan_chain": plan_chain,
+        # config 18: the PR 17 dispatch-floor planes — the serial-vs-
+        # pipelined slab-sweep twin (per-stage times, bitwise CRC),
+        # the real from_parquet ring=1 vs ring=4 (bitwise frames),
+        # and the stitched-chain roofline (explain() renders the
+        # stitch group; stitched == unstitched bitwise)
+        "overlap": overlap,
         "chunked": chunked,
         "opsweep": opsweep,
         "nbbo_slot_occupancy": (round(nbbo[2], 3) if nbbo else None),

@@ -274,6 +274,25 @@ KNOBS: Dict[str, Knob] = _knobs(
          "tempo_tpu/store/compact",
          "segment count below which store.compact() is a no-op (the "
          "table is already compact)"),
+    Knob("TEMPO_TPU_STITCH_MAX_OPS", "int", "8",
+         "tempo_tpu/plan/optimizer",
+         "longest run of adjacent series-local planned ops stitched "
+         "into ONE jitted executable (optimization_barrier pins every "
+         "op boundary, so stitched == op-by-op bitwise); 1 or 0 "
+         "disables stitching"),
+    Knob("TEMPO_TPU_INGEST_RING", "int", "2",
+         "tempo_tpu/io/ingest",
+         "slab-buffer ring depth of the out-of-core pipelines "
+         "(io.ingest.sweep_slabs + the from_parquet shard loop): "
+         "decode of slab N+1 and D2H of slab N-1 overlap compute of "
+         "slab N behind a bounded ring; 1 = fully serial (identical "
+         "loop, same bits by construction)"),
+    Knob("TEMPO_TPU_SERVE_COALESCE_S", "float", "0.002",
+         "tempo_tpu/serve/executor",
+         "dispatch coalescing window (seconds) of the serving "
+         "executors: ticks arriving within it batch into one device "
+         "dispatch (the batched cohort path scatters the whole window "
+         "on-device); per-constructor coalesce_s overrides win"),
     Knob("TEMPO_TPU_SERVE_COHORT_RESIDENT", "int", "0",
          "tempo_tpu/serve/cohort",
          "LRU resident-member budget of a StreamCohort with a "

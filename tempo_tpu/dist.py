@@ -843,7 +843,7 @@ class DistributedTSDF:
                           resampled=True, seq=None, seq_col="",
                           resample_freq=freq)
 
-    def calc_bars(self, freq: str, func=None, metricCols=None,  # plan-ok: eager-only
+    def calc_bars(self, freq: str, func=None, metricCols=None,
                   fill=None) -> "DistributedTSDF":
         """OHLC bars (tsdf.py:813-826) device-resident.  The reference
         runs four resamples and joins them on key+ts; here the four
@@ -860,6 +860,11 @@ class DistributedTSDF:
         fill-then-merge and merge-then-fill commute)."""
         from tempo_tpu import plan
 
+        if plan.recording():
+            return self._plan_record("calc_bars", params=dict(
+                freq=freq, func=func,
+                metricCols=tuple(metricCols) if metricCols else None,
+                fill=fill))
         with plan.suspended():
             # eager-only op whose body chains recorded methods
             # (resample/interpolate): those must not re-enter planning

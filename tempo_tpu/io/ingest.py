@@ -51,6 +51,17 @@ Transactional ingest (the batch-plane fault domain):
 Non-numeric columns cannot ride an out-of-core frame (they would need
 host materialisation) and are skipped with a log notice; sequence
 columns are not supported here.
+
+Slab pipelining (``TEMPO_TPU_INGEST_RING``): the shard loop above and
+any out-of-core slab sweep built on :func:`sweep_slabs` run as a
+bounded-ring three-stage pipeline — decode/pack of slab N+1 (a
+background producer thread) and the drain of slab N-1 (a background
+collector thread) overlap the compute/placement of slab N (the main
+thread).  The main thread still consumes slabs strictly in order, so
+the pipelined result is BITWISE-identical to the serial loop by
+construction; ``ring<=1`` runs the identical code fully serially.
+Worst case ≈ ``ring + 1`` slab buffers are resident (one loading, up
+to ``ring - 1`` queued, one computing).
 """
 
 from __future__ import annotations
@@ -359,6 +370,7 @@ def from_parquet(
     resume_dir: Optional[str] = None,
     on_corrupt: str = "raise",
     breaker: Optional["resilience.CircuitBreaker"] = None,
+    ring: Optional[int] = None,
 ):
     """Stream a Parquet dataset into a :class:`DistributedTSDF` with
     bounded host memory (see module docstring).
@@ -379,7 +391,16 @@ def from_parquet(
     :class:`CorruptRowGroupError` listing every quarantined range;
     ``"quarantine"``: skip + record on ``frame.ingest_quarantined``),
     and ``breaker`` (per-file circuit breaker: a flapping file is
-    quarantined instead of burning the retry budget)."""
+    quarantined instead of burning the retry budget).
+
+    ``ring`` (default ``TEMPO_TPU_INGEST_RING``) is the slab-buffer
+    ring depth of the shard pipeline (:func:`sweep_slabs`): the
+    producer thread streams + packs shard N+1 while the main thread
+    places shard N on devices and commits its manifest in shard order.
+    ``ring=1`` runs the identical loop serially; any depth produces
+    the same bits (the main thread consumes shards in order either
+    way), and the host working set grows to ≈ ``ring + 1`` packed
+    shards."""
     from tempo_tpu import config
     from tempo_tpu.dist import DistCol, DistributedTSDF
     from tempo_tpu.parallel.mesh import make_mesh
@@ -486,46 +507,34 @@ def from_parquet(
         for c in num_cols:
             blocks[c] = []
             blocks[c + "/valid"] = []
-        shards_restored = 0
+        state = {"restored": 0}
         # per-key row counts as actually PACKED (quarantine may have
         # removed rows the census counted; the layout must not lie)
         true_lengths = np.zeros(K, dtype=np.int64)
-        for si in range(n_s):
+
+        def load_slab(si: int):
+            """Producer half (background thread under sweep_slabs):
+            stream + decode + pack one shard — the CPU/IO-heavy work.
+            The producer runs shards strictly in order, so the
+            quarantine-ledger CRC captured here is the SAME one the
+            serial loop would stamp (no later shard has streamed
+            yet)."""
             ctx.check(f"shard {si} stream")
             k0, k1 = si * blk, min((si + 1) * blk, K)
             if k1 <= k0:
                 # padding shard past the real key range: all-pad blocks
-                _scatter_shard(blocks["__ts__"],
-                               np.full((blk, L), packing.TS_PAD, np.int64),
-                               order[si], Lt)
-                _scatter_shard(blocks["__mask__"],
-                               np.zeros((blk, L), np.bool_), order[si], Lt)
+                planes = {"__ts__": np.full((blk, L), packing.TS_PAD,
+                                            np.int64),
+                          "__mask__": np.zeros((blk, L), np.bool_)}
                 for c in num_cols:
-                    _scatter_shard(blocks[c],
-                                   np.full((blk, L), np.nan, dt),
-                                   order[si], Lt)
-                    _scatter_shard(blocks[c + "/valid"],
-                                   np.zeros((blk, L), np.bool_),
-                                   order[si], Lt)
-                continue
+                    planes[c] = np.full((blk, L), np.nan, dt)
+                    planes[c + "/valid"] = np.zeros((blk, L), np.bool_)
+                return ("pad", planes, 0, 0)
             if use_manifests and resume is not None:
                 planes = resume.load_shard(si, num_cols, (blk, L),
                                            ledger_crc=ctx.ledger_crc())
                 if planes is not None:
-                    _scatter_shard(blocks["__ts__"], planes["__ts__"],
-                                   order[si], Lt)
-                    _scatter_shard(blocks["__mask__"], planes["__mask__"],
-                                   order[si], Lt)
-                    for c in num_cols:
-                        _scatter_shard(blocks[c], planes[c],
-                                       order[si], Lt)
-                        _scatter_shard(blocks[c + "/valid"],
-                                       planes[c + "/valid"],
-                                       order[si], Lt)
-                    true_lengths[k0:k1] = \
-                        planes["__mask__"].sum(axis=1)[: k1 - k0]
-                    shards_restored += 1
-                    continue
+                    return ("restored", planes, 0, 0)
             shard_keys = key_frame.iloc[k0:k1] if pcols else None
             # stream this shard's rows: pushdown on the first
             # partition col
@@ -580,13 +589,10 @@ def from_parquet(
                     out[kid, pos] = vals
                 return out
 
-            local_lens = starts[1:] - starts[:-1]
-            true_lengths[k0:k1] = local_lens[: k1 - k0]
             ts_p = pack(ts_ns, packing.TS_PAD, np.int64)
+            local_lens = starts[1:] - starts[:-1]
             mask_p = np.arange(L)[None, :] < local_lens[:, None]
-            shard_planes = {"__ts__": ts_p, "__mask__": mask_p}
-            _scatter_shard(blocks["__ts__"], ts_p, order[si], Lt)
-            _scatter_shard(blocks["__mask__"], mask_p, order[si], Lt)
+            planes = {"__ts__": ts_p, "__mask__": mask_p}
             for c in num_cols:
                 raw = (
                     pd.to_numeric(shard_df[c], errors="coerce")
@@ -594,17 +600,38 @@ def from_parquet(
                     if len(shard_df) else np.zeros(0, np.float64)
                 )
                 valid = ~np.isnan(raw)
-                vals_p = pack(raw.astype(dt), np.nan, dt)
-                ok_p = pack(valid, False, np.bool_)
-                shard_planes[c] = vals_p
-                shard_planes[c + "/valid"] = ok_p
-                _scatter_shard(blocks[c], vals_p, order[si], Lt)
-                _scatter_shard(blocks[c + "/valid"], ok_p, order[si], Lt)
-            if resume is not None:
-                resume.save_shard(si, shard_planes, int(len(shard_df)),
-                                  ledger_crc=ctx.ledger_crc())
-            del shard_df
-        return blocks, shards_restored, true_lengths
+                planes[c] = pack(raw.astype(dt), np.nan, dt)
+                planes[c + "/valid"] = pack(valid, False, np.bool_)
+            return ("packed", planes, int(len(shard_df)),
+                    ctx.ledger_crc())
+
+        def place_slab(si: int, loaded):
+            """Main-thread half: async device placement in shard order
+            + the ordered manifest commit (commit order == shard order
+            keeps the crash-consistency story of the serial loop)."""
+            kind, planes, n_rows, ledger = loaded
+            ctx.check(f"shard {si} place")
+            k0, k1 = si * blk, min((si + 1) * blk, K)
+            _scatter_shard(blocks["__ts__"], planes["__ts__"],
+                           order[si], Lt)
+            _scatter_shard(blocks["__mask__"], planes["__mask__"],
+                           order[si], Lt)
+            for c in num_cols:
+                _scatter_shard(blocks[c], planes[c], order[si], Lt)
+                _scatter_shard(blocks[c + "/valid"],
+                               planes[c + "/valid"], order[si], Lt)
+            if kind == "pad":
+                return
+            # mask row sums ARE the packed per-key lengths
+            true_lengths[k0:k1] = \
+                planes["__mask__"].sum(axis=1)[: k1 - k0]
+            if kind == "restored":
+                state["restored"] += 1
+            elif resume is not None:
+                resume.save_shard(si, planes, n_rows, ledger_crc=ledger)
+
+        sweep_slabs(n_s, load_slab, place_slab, ring=ring)
+        return blocks, state["restored"], true_lengths
 
     passes = 0
     while True:
@@ -721,11 +748,142 @@ def _stream_shard(ds, read_cols: List[str], batch_rows: int, filt,
 
 def _scatter_shard(sink: List, host_block: np.ndarray, dev_row, Lt: int):
     """Split one series-shard host block along time and place each
-    piece on its device; appends in mesh device order."""
+    piece on its device; appends in mesh device order.  ``device_put``
+    dispatches the H2D copy asynchronously, so placement of shard N
+    overlaps the producer thread's decode of shard N+1 under
+    :func:`sweep_slabs`."""
     for ti, dev in enumerate(dev_row):
         sink.append(
             jax.device_put(host_block[:, ti * Lt:(ti + 1) * Lt], dev)
         )
+
+
+# ----------------------------------------------------------------------
+# Slab pipelining: the bounded-ring three-stage sweep
+# ----------------------------------------------------------------------
+
+def sweep_slabs(n_slabs: int, load, compute, drain=None,
+                ring: Optional[int] = None) -> List:
+    """Run ``drain(i, compute(i, load(i)))`` for every slab, pipelined
+    behind a bounded ring of slab buffers.
+
+    ``load`` (decode/ingest, CPU- or IO-bound) runs on a producer
+    thread one slab AHEAD of the main thread; ``drain`` (D2H fetch,
+    digesting, spill) runs on a collector thread one slab BEHIND; the
+    main thread runs ``compute`` (device dispatch / placement) on every
+    slab strictly IN ORDER.  Slab N+1's load and slab N-1's drain
+    overlap slab N's compute, so steady-state wall time approaches
+    ``max(load, compute, drain)`` per slab instead of their sum.
+
+    Bitwise contract: the main thread consumes load results in slab
+    order and the collector drains compute results in slab order —
+    exactly the serial loop's data flow — so the pipelined sweep is
+    bit-identical to ``ring=1`` (the serial loop) by construction.
+
+    ``ring`` is the slab-buffer ring depth (default
+    ``TEMPO_TPU_INGEST_RING``): at most ``ring - 1`` loaded slabs
+    queue ahead of compute and ``ring - 1`` computed slabs queue ahead
+    of drain; ``ring <= 1`` (or a single slab) runs fully serially.
+    The first failure from any stage re-raises in the caller with the
+    pipeline cleanly drained (threads joined, no orphan slabs).
+    Returns the per-slab results in slab order.
+    """
+    from tempo_tpu import config, tune
+
+    if ring is None:
+        # env knob wins, then the tuned profile's winner (tune/space.py
+        # ``ingest_sweep`` class), then the built-in 2
+        ring = config.get_int("TEMPO_TPU_INGEST_RING")
+        if ring is None:
+            ring = tune.knob_value("TEMPO_TPU_INGEST_RING",
+                                   "ingest_sweep") or 2
+    ring = max(1, int(ring))
+    n = int(n_slabs)
+    if ring <= 1 or n <= 1:
+        out = []
+        for i in range(n):
+            y = compute(i, load(i))
+            out.append(y if drain is None else drain(i, y))
+        return out
+
+    import queue as queue_mod
+    import threading
+
+    depth = ring - 1
+    loaded: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+    to_drain: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+    stop = threading.Event()
+    results: List = [None] * n
+    fail: List[BaseException] = []    # first failure wins
+
+    def _offer(q, item) -> bool:
+        """Bounded put that never deadlocks a dying pipeline."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for i in range(n):
+                if stop.is_set():
+                    return
+                x = load(i)
+                if not _offer(loaded, (i, x)):
+                    return
+        except BaseException as e:            # noqa: BLE001
+            fail.append(e)
+            stop.set()
+
+    def collector():
+        try:
+            while True:
+                try:
+                    item = to_drain.get(timeout=0.05)
+                except queue_mod.Empty:
+                    if stop.is_set():
+                        return
+                    continue
+                if item is None:
+                    return
+                i, y = item
+                results[i] = y if drain is None else drain(i, y)
+        except BaseException as e:            # noqa: BLE001
+            fail.append(e)
+            stop.set()
+
+    tp = threading.Thread(target=producer, name="slab-load", daemon=True)
+    tc = threading.Thread(target=collector, name="slab-drain", daemon=True)
+    tp.start()
+    tc.start()
+    try:
+        for i in range(n):
+            while True:
+                try:
+                    j, x = loaded.get(timeout=0.05)
+                    break
+                except queue_mod.Empty:
+                    if stop.is_set():
+                        raise fail[0] if fail else RuntimeError(
+                            "slab pipeline stopped without a recorded "
+                            "failure")
+            assert j == i, "slab pipeline delivered out of order"
+            y = compute(i, x)
+            if not _offer(to_drain, (i, y)):
+                break
+        _offer(to_drain, None)
+    except BaseException as e:                # noqa: BLE001
+        if not fail:
+            fail.append(e)
+        stop.set()
+    tp.join()
+    tc.join()
+    if fail:
+        raise fail[0]
+    return results
 
 
 # ----------------------------------------------------------------------

@@ -16,9 +16,10 @@ ladder is built from the primitives it does support: ``pltpu.roll``
 Kernels:
 
 * ``ema_scan``  - y_t = (1-a) * y_{t-1} + a * x_t, invalid rows carry
-  the previous EMA forward (exact infinite-horizon EMA; the reference
-  truncates to ``window`` lags, tsdf.py:617-618 TODO).  Wired into the
-  flagship fused pipeline (__graft_entry__).
+  the previous EMA forward (exact infinite-horizon EMA; why the
+  reference truncates and this stack never has to:
+  resample.py:resample_ema, "Truncated-lag EMA — the canonical
+  note").  Wired into the flagship fused pipeline (__graft_entry__).
 * ``last_valid_index_scan`` / ``first_valid_index_scan`` - running
   index of the last/next valid element, the engine under
   ``window_utils.last_valid_index``/``first_valid_index`` (which back

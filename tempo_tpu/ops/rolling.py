@@ -521,9 +521,10 @@ def ema_scan(x: jnp.ndarray, valid: jnp.ndarray, alpha,
 @jax.jit
 def ema_exact(x: jnp.ndarray, valid: jnp.ndarray, alpha: float) -> jnp.ndarray:
     """Exact infinite-horizon EMA y_t = (1-a) y_{t-1} + a x_t via an
-    associative scan - the TPU-native upgrade the reference approximates
-    with truncated lags (tsdf.py:617-618 TODO).  Null inputs carry the
-    previous EMA forward."""
+    associative scan (the full story of the reference's truncated-lag
+    approximation and this stack's exact forms:
+    resample.py:resample_ema, "Truncated-lag EMA — the canonical
+    note").  Null inputs carry the previous EMA forward."""
     a = jnp.asarray(alpha, x.dtype)
     decay = jnp.where(valid, 1.0 - a, 1.0)
     inp = jnp.where(valid, a * x, 0.0)

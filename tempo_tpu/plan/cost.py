@@ -328,6 +328,26 @@ def fusion_worthwhile(n_ops: int, est_bytes: int) -> Tuple[bool, dict]:
         "fused_s": cost_fused, "chain_s": cost_chain, "n_ops": n_ops}
 
 
+def stitch_worthwhile(n_ops: int, est_bytes: int) -> Tuple[bool, dict]:
+    """Should a maximal run of ``n_ops`` adjacent series-local planned
+    ops (resample / interpolate / EMA / range stats / calc_bars) stitch
+    into ONE jitted program (plan/stitch.py)?  Same shape as
+    :func:`fusion_worthwhile` — both forms are bitwise-identical (the
+    stitched program pins every op boundary with
+    ``jax.lax.optimization_barrier``), so the decision is free: the
+    op-by-op chain pays ``n_ops`` dispatches plus the between-op HBM
+    re-reads of the intermediate frame; the stitched program pays one
+    dispatch plus ``fused_overhead_s`` (0 under the priors — stitching
+    always wins, and a measured profile can charge it)."""
+    p = params()
+    re_read = float(est_bytes) / p["hbm_stream_rate"]
+    cost_chain = n_ops * p["dispatch_overhead_s"] + (n_ops - 1) * re_read
+    cost_stitched = p["dispatch_overhead_s"] + p["fused_overhead_s"]
+    return cost_stitched <= cost_chain, {
+        "stitched_s": cost_stitched, "chain_s": cost_chain,
+        "n_ops": n_ops}
+
+
 def reshard_decision(n_placed: int, placed_bytes: Optional[int],
                      n_internal: int,
                      internal_bytes: Optional[int]) -> Tuple[bool, dict]:

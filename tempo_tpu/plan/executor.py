@@ -335,6 +335,11 @@ def _eval_op(node: ir.Node, ins: List):
         return ins[0].collect()
     if op == "count":
         return ins[0].count()
+    if op == "calc_bars":
+        mc = p("metricCols")
+        return ins[0].calc_bars(
+            p("freq"), func=p("func"),
+            metricCols=list(mc) if mc else None, fill=p("fill"))
     if op == "fused_asof_stats_ema":
         from tempo_tpu.plan import fused
 
@@ -344,6 +349,15 @@ def _eval_op(node: ir.Node, ins: List):
         logger.debug("plan: fused chain guard failed at run time — "
                      "executing the chain op-by-op")
         return _sequential_chain(node, ins)
+    if op == "stitched":
+        from tempo_tpu.plan import stitch
+
+        out = stitch.run(ins[0], node)
+        if out is not None:
+            return out
+        logger.debug("plan: stitched chain guard failed at run time — "
+                     "executing the chain op-by-op")
+        return stitch.run_sequential(ins[0], node)
     raise ValueError(f"plan executor: unknown op {op!r}")
 
 

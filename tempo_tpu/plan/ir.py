@@ -33,7 +33,7 @@ PLANNED_METHODS = {
     ),
     "DistributedTSDF": (
         "asofJoin", "withRangeStats", "EMA", "resample", "interpolate",
-        "fourier_transform", "withLookbackFeatures",
+        "calc_bars", "fourier_transform", "withLookbackFeatures",
     ),
 }
 
@@ -263,6 +263,9 @@ def consumed_columns(node: Node) -> Optional[List[str]]:
     if node.op == "resample_ema":
         return [node.param("colName")]
     if node.op == "resample":
+        pick = node.param("metricCols")
+        return list(pick) if pick else None
+    if node.op == "calc_bars":
         pick = node.param("metricCols")
         return list(pick) if pick else None
     if node.op == "interpolate":

@@ -254,6 +254,13 @@ class LazyDistributedTSDF(_LazyBase):
             target_cols=tuple(target_cols) if target_cols else None,
             show_interpolated=show_interpolated))
 
+    def calc_bars(self, freq: str, func=None, metricCols=None,
+                  fill=None):
+        return self._rec("calc_bars", params=dict(
+            freq=freq, func=func,
+            metricCols=tuple(metricCols) if metricCols else None,
+            fill=fill))
+
     def fourier_transform(self, timestep: float, valueCol: str):
         return self._rec("fourier", params=dict(
             timestep=timestep, valueCol=valueCol))

@@ -61,6 +61,10 @@ def optimize(root: ir.Node) -> ir.Node:
     _prune_columns(root)
     _mark_barriers(root)
     root = _place_checkpoints(root)
+    # stitching runs LAST so reshard and checkpoint nodes (placed
+    # above) are natural stitch boundaries: a resumed chain re-runs
+    # only whole post-barrier stitch groups, zero recompiles
+    root = _stitch_chains(root)
     return root
 
 
@@ -456,7 +460,7 @@ def _plan_range_engine(node: ir.Node, w: float):
 #: ELIMINATED (producer and consumer shardings already agree), and a
 #: pending reshard-back SINKS through further members of the set.
 _SERIES_LOCAL_OPS = ("asof_join", "range_stats", "resample", "fourier",
-                     "interpolate")
+                     "interpolate", "calc_bars")
 
 #: ops a pending reshard-back may NOT sink past: their time-sharded
 #: and series-local executions differ in f32 association — EMA's
@@ -505,6 +509,11 @@ def _device_plane_count(node: ir.Node) -> Optional[int]:
     if node.op in ("resample",):
         pick = node.param("metricCols")
         return len(pick) if pick else base
+    if node.op == "calc_bars":
+        # four prefixed planes per metric (open/low/high/close); the
+        # optional zero-fill interpolate adds no columns
+        pick = node.param("metricCols")
+        return 4 * (len(pick) if pick else base)
     return None
 
 
@@ -796,7 +805,7 @@ def _prune_columns(root: ir.Node) -> None:
 #: is a legal resume point (the saved frame IS the subtree's value)
 _CKPT_BOUNDARY_OPS = ("asof_join", "range_stats", "ema", "resample",
                       "resample_ema", "interpolate", "fourier",
-                      "fused_asof_stats_ema")
+                      "fused_asof_stats_ema", "calc_bars")
 
 
 def _est_ckpt_bytes(node: ir.Node) -> Optional[int]:
@@ -921,3 +930,104 @@ def _mark_barriers(root: ir.Node) -> None:
             n.ann["barrier"] = ("host materialisation: fourier on a "
                                 "resampled (bucket-head) view collects "
                                 "to host (dist.py fallback)")
+
+
+# ----------------------------------------------------------------------
+# Pass 6: whole-chain program stitching (TEMPO_TPU_STITCH_MAX_OPS)
+# ----------------------------------------------------------------------
+
+def _stitch_max_ops() -> int:
+    """``TEMPO_TPU_STITCH_MAX_OPS`` — longest run of adjacent
+    series-local planned ops collapsed into one ``stitched`` node
+    (plan/stitch.py); < 2 disables the pass.  Env knob wins, then the
+    tuned profile's winner (tune/space.py ``stitched_chain`` class),
+    then the built-in 8."""
+    from tempo_tpu import config, tune
+
+    n = config.get_int("TEMPO_TPU_STITCH_MAX_OPS")
+    if n is None:
+        tuned = tune.knob_value("TEMPO_TPU_STITCH_MAX_OPS",
+                                "stitched_chain")
+        n = 8 if tuned is None else int(tuned)
+    return n
+
+
+def _stitch_chains(root: ir.Node) -> ir.Node:
+    """Collapse maximal single-consumer runs of adjacent stitchable
+    mesh ops into ONE ``stitched`` node executed as a single jitted
+    program (plan/stitch.py).  Runs after every other pass, so fused
+    nodes, placed reshards and checkpoint barriers all act as stitch
+    boundaries — a mid-chain barrier splits the chain into two stitch
+    groups and resume replays only the downstream one.  Top-down so a
+    chain is grouped from its TOPMOST member; interior nodes are
+    consumed by the group and never visited."""
+    from tempo_tpu.plan import cost as plan_cost
+    from tempo_tpu.plan.stitch import STITCHABLE_OPS
+
+    max_ops = _stitch_max_ops()
+    if max_ops < 2:
+        return root
+    counts: Dict[int, int] = {}
+    for n in root.walk():
+        for c in n.inputs:
+            counts[id(c)] = counts.get(id(c), 0) + 1
+    memo: Dict[int, ir.Node] = {}
+
+    def rec(n: ir.Node) -> ir.Node:
+        if id(n) in memo:
+            return memo[id(n)]
+        out = n
+        if n.op in STITCHABLE_OPS and _mesh_side(n):
+            chain = [n]
+            cur = n
+            while (cur.inputs and cur.inputs[0].op in STITCHABLE_OPS
+                   and counts.get(id(cur.inputs[0]), 0) == 1
+                   and len(chain) < max_ops):
+                cur = cur.inputs[0]
+                chain.append(cur)
+            if len(chain) >= 2:
+                bottom = chain[-1]
+                stitch_costs = None
+                worthwhile = True
+                if plan_cost.enabled():
+                    # cost-decided stitching: one program vs the
+                    # op-by-op chain — both bitwise-identical
+                    # (plan/stitch.py pins every op boundary with
+                    # optimization_barrier), so the decision is free
+                    est = (_est_frame_bytes(bottom.inputs[0])
+                           if bottom.inputs else 0)
+                    worthwhile, stitch_costs = \
+                        plan_cost.stitch_worthwhile(len(chain), est)
+                if worthwhile:
+                    stitched = ir.Node("stitched", params=dict(
+                        stages=tuple((c.op, c.params)
+                                     for c in reversed(chain)),
+                        n_ops=len(chain)), inputs=bottom.inputs)
+                    stitched.ann["rewrite"] = (
+                        f"{len(chain)} adjacent series-local ops "
+                        f"stitched into ONE jitted program "
+                        f"(plan/stitch.py)")
+                    # reshard decisions recorded on swallowed members
+                    # (pass 2b ran first) must stay visible in the
+                    # walked plan and in explain()
+                    for c in reversed(chain):
+                        for key in ("reshard_eliminated",
+                                    "reshard_note"):
+                            if key in c.ann:
+                                note = f"{c.op}: {c.ann[key]}"
+                                prev = stitched.ann.get(key)
+                                stitched.ann[key] = (
+                                    note if prev is None
+                                    else f"{prev}; {note}")
+                    if stitch_costs is not None:
+                        stitched.ann["stitch_cost"] = dict(
+                            stitch_costs, decision="stitched")
+                    out = stitched
+                else:
+                    n.ann["stitch_cost"] = dict(stitch_costs,
+                                                decision="op-by-op")
+        out.inputs = tuple(rec(c) for c in out.inputs)
+        memo[id(n)] = out
+        return out
+
+    return rec(root)

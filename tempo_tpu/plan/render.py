@@ -49,6 +49,17 @@ def _node_line(node: ir.Node) -> str:
                  "resume point)"
                  + (f", ~{est} B est" if est else ""))
         return line
+    if node.op == "stitched":
+        ops = [op for op, _ in (node.param("stages") or ())]
+        line = (f"stitched[{' -> '.join(ops)}]  <- STITCHED: "
+                f"{len(ops)} ops -> 1 dispatch "
+                f"(optimization_barrier-pinned boundaries)")
+        sc = node.ann.get("stitch_cost")
+        if sc:
+            line += (f"; cost-decided: {sc['decision']} "
+                     f"(stitched~{sc['stitched_s'] * 1e6:.1f}us vs "
+                     f"chain~{sc['chain_s'] * 1e6:.1f}us)")
+        return line
     line = f"{node.op}({_param_str(node)})"
     notes = []
     if "reshard_eliminated" in node.ann:
@@ -70,6 +81,12 @@ def _node_line(node: ir.Node) -> str:
             f"cost-decided fusion: {fc['decision']} "
             f"(fused~{fc['fused_s'] * 1e6:.1f}us vs "
             f"chain~{fc['chain_s'] * 1e6:.1f}us)")
+    if "stitch_cost" in node.ann:
+        sc = node.ann["stitch_cost"]
+        notes.append(
+            f"cost-decided stitch: {sc['decision']} "
+            f"(stitched~{sc['stitched_s'] * 1e6:.1f}us vs "
+            f"chain~{sc['chain_s'] * 1e6:.1f}us)")
     if "rewrite" in node.ann:
         notes.append(f"rewrite: {node.ann['rewrite']}")
     if "barrier" in node.ann:

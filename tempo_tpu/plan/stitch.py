@@ -1,0 +1,552 @@
+"""Whole-chain program stitching: a maximal run of adjacent
+series-local planned ops as ONE jitted executable.
+
+``plan/fused.py`` covers exactly one chain shape (asofJoin ->
+withRangeStats [-> EMA]).  This module covers the general case the
+optimizer's ``_stitch_chains`` pass collapses: any single-consumer run
+of resample / interpolate / EMA / withRangeStats / calc_bars over a
+mesh frame, executed as one dispatch instead of one per op.  Stage-N
+out_shardings equal stage-N+1 in_shardings by contract (every op here
+is series-local under the run-time guards), so the stitched program is
+just the composition of the SAME ``lru_cache``'d kernel factories the
+eager methods call (``dist._resample_fn`` / ``dist._interp_fn`` /
+``dist._ema_local`` / ``dist._range_stats_local_packed``) — nested
+jits inline under the outer trace — with
+``jax.lax.optimization_barrier`` over the live plane set at every op
+boundary.  The barriers pin each op's outputs to the same
+fusion-cluster roots the op-by-op chain has (the eager chain
+materialises them between dispatches), so stitched == op-by-op is
+BITWISE: XLA cannot re-fuse producer arithmetic into a consumer stage
+and flip an FMA-contraction decision in the last ulp.
+
+Execution is two phases:
+
+* **Plan** (host, per ``run`` call): a tiny metadata interpreter
+  (:class:`_Sim`) replays each stage's host-side decisions EXACTLY as
+  the eager method makes them — column selection, bucket step, fkey /
+  mkey lookup, engine choice, the layout-vouched static grid bound G —
+  and records a pure-data *recipe*: program inputs (frame planes
+  promoted on first consumption), one emit descriptor per device
+  dispatch the eager chain would make (calc_bars contributes its four
+  resamples; a non-resampled interpolate contributes its internal
+  resample), the per-boundary live key sets, and the output planes.
+  Any decision that is not host-static under the guards — a
+  device-fetched grid bound, audited rowbounds (device scalars the
+  eager path fetches at collect), a consumed host-gather/ts-chunk
+  plane — raises :class:`_Refuse`, ``run`` returns None, and the
+  executor replays the chain op-by-op through the eager methods
+  instead (still planned + cached, just not single-program — and any
+  real argument error surfaces with the eager message).
+* **Emit** (device, one dispatch): :func:`_stitched_program` builds
+  the jitted program from the recipe.  Recipes are hashable and the
+  builder is ``lru_cache``'d, so re-running a cached plan executable
+  re-uses the compiled program — zero recompiles at steady state, the
+  same property the per-op factories have.
+
+The untouched-column discipline mirrors the eager methods exactly:
+a column the chain never rewrites rides through BY REFERENCE (eager's
+``new_cols = dict(self.cols)`` keeps the DistCol object), never
+through the program.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu import packing
+from tempo_tpu.plan import ir
+
+logger = logging.getLogger(__name__)
+
+#: ops the stitcher may collapse (all single-input, all series-local
+#: under the run-time guards; calc_bars is a macro over resample +
+#: interpolate)
+STITCHABLE_OPS = ("resample", "interpolate", "ema", "range_stats",
+                  "calc_bars")
+
+
+class _Refuse(Exception):
+    """A stage decision is not host-static under the stitched-program
+    guards — fall back to the op-by-op replay."""
+
+
+class _Plane:
+    """One [K, L] device plane threaded through the stitched program.
+    Concrete (``ref`` = the frame's array, promoted to a program input
+    on first consumption) or traced (``key`` only, produced by an
+    emit)."""
+
+    __slots__ = ("key", "ref")
+
+    def __init__(self, key=None, ref=None):
+        self.key = key
+        self.ref = ref
+
+
+class _Col:
+    """A column's value/validity planes plus the original DistCol when
+    it is still carried by reference (never rewritten by the chain)."""
+
+    __slots__ = ("v", "g", "int64", "src")
+
+    def __init__(self, v, g, int64=False, src=None):
+        self.v = v
+        self.g = g
+        self.int64 = int64
+        self.src = src
+
+
+class _Sim:
+    """Plan-time frame-metadata simulator.  Replays the host-side half
+    of each eager op over plane handles instead of arrays and records
+    the emit descriptors the device half becomes."""
+
+    def __init__(self, frame, sort_kernels: bool):
+        self.frame = frame
+        self.sort_kernels = bool(sort_kernels)
+        self.K_dev = int(frame.K_dev)
+        self.L = int(frame.L)
+        self.n_series_shards = int(frame.n_series_shards)
+        self.resampled = bool(frame.resampled)
+        self.resample_freq = frame._resample_freq
+        self.grid_replaced = False
+        self.ts = _Plane(ref=frame.ts)
+        self.mask = _Plane(ref=frame.mask)
+        self.cols: Dict[str, _Col] = {
+            name: _Col(_Plane(ref=c.values), _Plane(ref=c.valid),
+                       int64=c.int64, src=c)
+            for name, c in frame.cols.items()
+        }
+        self._next = 0
+        self.in_keys: List[int] = []
+        self.in_arrays: List[object] = []
+        #: (descriptor, read keys, written keys) per device dispatch
+        self.emits: List[Tuple[tuple, Tuple[int, ...], Tuple[int, ...]]] = []
+
+    # -- plane bookkeeping ---------------------------------------------
+
+    def _key(self) -> int:
+        self._next += 1
+        return self._next
+
+    def _promote(self, plane: _Plane) -> int:
+        if plane.key is None:
+            plane.key = self._key()
+            self.in_keys.append(plane.key)
+            self.in_arrays.append(plane.ref)
+        return plane.key
+
+    def _consume_col(self, name: str) -> Tuple[int, int]:
+        col = self.cols.get(name)
+        if col is None:
+            raise _Refuse(f"column {name!r} not on the frame")
+        if col.src is not None and (col.src.ts_chunk is not None
+                                    or col.src.host_gather is not None):
+            # eager CAN stack these planes, but the result frame's
+            # metadata handling is not worth simulating — replay
+            raise _Refuse(f"column {name!r} rides a non-plain plane")
+        return self._promote(col.v), self._promote(col.g)
+
+    def numeric_names(self) -> List[str]:
+        # dist.numeric_columns: plain device planes only.  Traced
+        # (chain-produced) columns are always plain.
+        return [n for n, c in self.cols.items()
+                if c.src is None or (c.src.ts_chunk is None
+                                     and c.src.host_gather is None)]
+
+    def _emit(self, desc: tuple, reads, writes) -> None:
+        self.emits.append((desc, tuple(reads), tuple(writes)))
+
+    # -- per-op planners (each replicates its eager method's host half)
+
+    def sim_resample(self, freq, func, metricCols) -> None:
+        from tempo_tpu import dist
+        from tempo_tpu.freq import (freq_to_seconds, average, ceiling,
+                                    floor, max_func, min_func)
+
+        try:
+            step = int(freq_to_seconds(freq) * packing.NS_PER_S)
+            fkey = {floor: 0, ceiling: 1, average: 2, min_func: 3,
+                    max_func: 4}[dist._canon_func(func)]
+        except Exception as e:
+            raise _Refuse(f"resample args: {e}")
+        cols = list(metricCols) if metricCols else self.numeric_names()
+        if not cols:
+            raise _Refuse("resample over zero columns")
+        ts_k = self._promote(self.ts)
+        mask_k = self._promote(self.mask)
+        in_cols = tuple(self._consume_col(c) for c in cols)
+        o_ts, o_mask = self._key(), self._key()
+        o_cols = tuple((self._key(), self._key()) for _ in cols)
+        self._emit(
+            ("resample", step, fkey, self.sort_kernels, ts_k, mask_k,
+             in_cols, o_ts, o_mask, o_cols),
+            reads=[ts_k, mask_k] + [k for vg in in_cols for k in vg],
+            writes=[o_ts, o_mask] + [k for vg in o_cols for k in vg])
+        self.ts = _Plane(key=o_ts)
+        self.mask = _Plane(key=o_mask)
+        self.cols = {c: _Col(_Plane(key=vk), _Plane(key=gk))
+                     for c, (vk, gk) in zip(cols, o_cols)}
+        self.resampled = True
+        self.resample_freq = freq
+        self.grid_replaced = True
+
+    def sim_ema(self, colName, window, exp_factor, exact,
+                inclusive_window) -> None:
+        vk, gk = self._consume_col(colName)
+        n_taps = int(window) + (1 if inclusive_window else 0)
+        out = self._key()
+        self._emit(("ema", float(exp_factor), bool(exact), n_taps,
+                    vk, gk, out),
+                   reads=[vk, gk], writes=[out])
+        # eager: new_cols["EMA_" + colName] = DistCol(y, self.mask) —
+        # the validity IS the current mask plane (shared)
+        self.cols["EMA_" + colName] = _Col(_Plane(key=out), self.mask)
+
+    def sim_range_stats(self, colsToSummarize, rangeBackWindowSecs,
+                        strategy) -> None:
+        from tempo_tpu import dist
+
+        if strategy not in ("exact", "halo"):
+            raise _Refuse(f"strategy {strategy!r}")
+        cols = (list(colsToSummarize) if colsToSummarize
+                else self.numeric_names())
+        w = float(rangeBackWindowSecs)
+        if not cols:
+            # eager no-ops (dict copy, no kernel)
+            return
+        if strategy == "exact" and self.sort_kernels:
+            # dist._range_engine_choice: host-layout rowbounds feed the
+            # three-way engine pick; the shifted-window form's audits
+            # are device scalars the eager path defers to collect —
+            # keep those out of stitched programs
+            lay = self.frame.layout
+            rb = None
+            if (not self.resampled and lay.n_rows > 0
+                    and int(lay.starts[-1]) == lay.n_rows):
+                rb = packing.layout_rowbounds(lay, w)
+            shard_k = self.K_dev // max(self.n_series_shards, 1)
+            engine, rowbounds = dist._pick_range_engine_for_shard(
+                shard_k, self.L, rb)
+            if rowbounds is not None:
+                raise _Refuse("row-bounded stats window carries a "
+                              "deferred clip audit")
+        else:
+            engine = "shifted"
+        ts_k = self._promote(self.ts)
+        in_cols = tuple(self._consume_col(c) for c in cols)
+        outs = tuple(tuple(self._key() for _ in packing.RANGE_STATS)
+                     for _ in cols)
+        self._emit(
+            ("stats", w, self.sort_kernels, engine, ts_k, in_cols, outs),
+            reads=[ts_k] + [k for vg in in_cols for k in vg],
+            writes=[k for per_col in outs for k in per_col])
+        for ci, c in enumerate(cols):
+            for si, stat in enumerate(packing.RANGE_STATS):
+                self.cols[f"{stat}_{c}"] = _Col(
+                    _Plane(key=outs[ci][si]), self.mask,
+                    int64=(stat == "count"))
+
+    def sim_interpolate(self, freq, func, method, target_cols,
+                        show_interpolated) -> None:
+        from tempo_tpu.freq import freq_to_seconds, validateFuncExists
+
+        if method not in ("zero", "null", "ffill", "bfill", "linear"):
+            raise _Refuse(f"method {method!r}")
+        if self.resampled:
+            freq = freq or self.resample_freq
+            if freq != self.resample_freq:
+                raise _Refuse("freq mismatch on a resampled frame")
+        if freq is None:
+            raise _Refuse("interpolate requires freq")
+        cols = (list(target_cols) if target_cols
+                else self.numeric_names())
+        if not cols:
+            raise _Refuse("interpolate over zero columns")
+        if not self.resampled:
+            try:
+                validateFuncExists(func)
+            except Exception as e:
+                raise _Refuse(f"interpolate func: {e}")
+            # eager: res = self.resample(freq, func, metricCols=cols) —
+            # a separate device dispatch, so a separate emit here
+            self.sim_resample(freq, func, tuple(cols))
+        step = int(freq_to_seconds(freq) * packing.NS_PER_S)
+        # static grid bound: ONLY the layout-vouched host path is
+        # stitchable; the eager fallback fetches [K] device scalars
+        lay = self.frame.layout
+        if not (lay.n_rows > 0 and int(lay.starts[-1]) == lay.n_rows):
+            raise _Refuse("grid bound needs a device fetch")
+        spans = []
+        for k in range(lay.n_series):
+            s = lay.ts_ns[lay.starts[k]: lay.starts[k + 1]]
+            if len(s):
+                spans.append(int(s[-1] - s[0]))
+        span = max(spans, default=0)
+        G = span // step + 2
+        G = max(8, -(-G // 8) * 8)
+        mkey = ("zero", "null", "ffill", "bfill", "linear").index(method)
+        flags = bool(show_interpolated)
+        ts_k = self._promote(self.ts)
+        mask_k = self._promote(self.mask)
+        in_cols = tuple(self._consume_col(c) for c in cols)
+        o_ts, o_mask = self._key(), self._key()
+        o_cols = tuple((self._key(), self._key()) for _ in cols)
+        o_fts = self._key() if flags else None
+        o_fcols = tuple(self._key() for _ in cols) if flags else ()
+        writes = [o_ts, o_mask] + [k for vg in o_cols for k in vg]
+        if flags:
+            writes += [o_fts] + list(o_fcols)
+        self._emit(
+            ("interp", step, G, mkey, flags, ts_k, mask_k, in_cols,
+             o_ts, o_mask, o_cols, o_fts, o_fcols),
+            reads=[ts_k, mask_k] + [k for vg in in_cols for k in vg],
+            writes=writes)
+        self.ts = _Plane(key=o_ts)
+        self.mask = _Plane(key=o_mask)
+        new_cols = {c: _Col(_Plane(key=vk), _Plane(key=gk))
+                    for c, (vk, gk) in zip(cols, o_cols)}
+        if flags:
+            new_cols["is_ts_interpolated"] = _Col(
+                _Plane(key=o_fts), self.mask, int64=True)
+            for c, fk in zip(cols, o_fcols):
+                new_cols[f"is_interpolated_{c}"] = _Col(
+                    _Plane(key=fk), self.mask, int64=True)
+        self.cols = new_cols
+        self.L = G
+        self.resampled = True
+        self.resample_freq = freq
+        self.grid_replaced = True
+
+    def sim_calc_bars(self, freq, func, metricCols, fill) -> None:
+        mc = list(metricCols) if metricCols else self.numeric_names()
+        if not mc:
+            raise _Refuse("calc_bars over zero columns")
+        # four resamples over the SAME input planes (eager loops
+        # self.resample four times), merged by name, sorted — the close
+        # (ceil) grid is the one the merged frame physically keeps
+        pre_ts, pre_mask, pre_cols = self.ts, self.mask, self.cols
+        merged: Dict[str, _Col] = {}
+        last = None
+        for prefix, f in (("open", "floor"), ("low", "min"),
+                          ("high", "max"), ("close", "ceil")):
+            self.ts, self.mask, self.cols = pre_ts, pre_mask, dict(pre_cols)
+            self.sim_resample(freq, f, tuple(mc))
+            for c in mc:
+                merged[f"{prefix}_{c}"] = self.cols[c]
+            last = (self.ts, self.mask)
+        self.ts, self.mask = last
+        self.cols = {c: merged[c] for c in sorted(merged)}
+        if fill:
+            self.sim_interpolate(None, None, "zero", None, False)
+
+    # -- recipe ---------------------------------------------------------
+
+    def recipe(self) -> tuple:
+        out_keys: Dict[int, None] = {}
+
+        def want(plane: Optional[_Plane]):
+            if plane is not None and plane.ref is None:
+                out_keys.setdefault(plane.key)
+
+        want(self.ts)
+        want(self.mask)
+        for col in self.cols.values():
+            if col.src is None:
+                want(col.v)
+                want(col.g)
+        out = tuple(out_keys)
+        # per-boundary live sets: keys any later emit reads (or the
+        # program returns), restricted to keys defined by then
+        n = len(self.emits)
+        defined = set(self.in_keys)
+        defined_after = []
+        for _, _, writes in self.emits:
+            defined |= set(writes)
+            defined_after.append(set(defined))
+        suffix = set(out)
+        barriers: List[Tuple[int, ...]] = [()] * max(n - 1, 0)
+        for j in range(n - 1, 0, -1):
+            suffix |= set(self.emits[j][1])
+            barriers[j - 1] = tuple(sorted(suffix & defined_after[j - 1]))
+        return (tuple(self.in_keys),
+                tuple(d for d, _, _ in self.emits),
+                tuple(barriers), out)
+
+
+def _plan(frame, stages, sort_kernels: bool) -> _Sim:
+    sim = _Sim(frame, sort_kernels)
+    for op, params in stages:
+        p = dict(params)
+        if op == "resample":
+            sim.sim_resample(p.get("freq"), p.get("func"),
+                             p.get("metricCols"))
+        elif op == "ema":
+            sim.sim_ema(p.get("colName"), p.get("window", 30),
+                        p.get("exp_factor", 0.2), p.get("exact", False),
+                        p.get("inclusive_window", False))
+        elif op == "range_stats":
+            sim.sim_range_stats(p.get("colsToSummarize"),
+                                p.get("rangeBackWindowSecs", 1000),
+                                p.get("strategy", "exact"))
+        elif op == "interpolate":
+            sim.sim_interpolate(p.get("freq"), p.get("func"),
+                                p.get("method"), p.get("target_cols"),
+                                p.get("show_interpolated", False))
+        elif op == "calc_bars":
+            sim.sim_calc_bars(p.get("freq"), p.get("func"),
+                              p.get("metricCols"), p.get("fill"))
+        else:
+            raise _Refuse(f"op {op!r} is not stitchable")
+    return sim
+
+
+# ----------------------------------------------------------------------
+# Device half: the stitched program
+# ----------------------------------------------------------------------
+
+def _run_emit(env: dict, em: tuple, mesh, series_axis) -> None:
+    from tempo_tpu import dist
+
+    kind = em[0]
+    if kind == "resample":
+        _, step, fkey, sk, ts_k, mask_k, cols, o_ts, o_mask, o_cols = em
+        kernel = dist._resample_fn(mesh, series_axis, None, step, fkey,
+                                   len(cols), sk)
+        vals = jnp.stack([env[vk] for vk, _ in cols])
+        valids = jnp.stack([env[gk] for _, gk in cols])
+        new_ts, head, ov, og = kernel(env[ts_k], env[mask_k], vals,
+                                      valids)
+        env[o_ts], env[o_mask] = new_ts, head
+        for i, (vk, gk) in enumerate(o_cols):
+            env[vk], env[gk] = ov[i], og[i]
+    elif kind == "ema":
+        _, alpha, exact, n_taps, vk, gk, out = em
+        env[out] = dist._ema_local(mesh, series_axis, alpha, exact,
+                                   n_taps)(env[vk], env[gk])
+    elif kind == "stats":
+        _, w, sk, engine, ts_k, cols, outs = em
+        kernel = dist._range_stats_local_packed(mesh, series_axis, w,
+                                                None, sk, engine)
+        xs = jnp.stack([env[vk] for vk, _ in cols])
+        vs = jnp.stack([env[gk] for _, gk in cols])
+        stats, _clipped = kernel(env[ts_k], xs, vs)
+        for ci in range(len(cols)):
+            for si, stat in enumerate(packing.RANGE_STATS):
+                env[outs[ci][si]] = stats[stat][ci]
+    elif kind == "interp":
+        (_, step, G, mkey, flags, ts_k, mask_k, cols, o_ts, o_mask,
+         o_cols, o_fts, o_fcols) = em
+        kernel = dist._interp_fn(mesh, series_axis, None, step, G, mkey,
+                                 len(cols), flags)
+        vals = jnp.stack([env[vk] for vk, _ in cols])
+        valids = jnp.stack([env[gk] for _, gk in cols])
+        out = kernel(env[ts_k], env[mask_k], vals, valids)
+        grid_ts, grid_mask, ov, og = out[:4]
+        env[o_ts], env[o_mask] = grid_ts, grid_mask
+        for i, (vk, gk) in enumerate(o_cols):
+            env[vk], env[gk] = ov[i], og[i]
+        if flags:
+            # eager: DistCol(flag.astype(vals.dtype), ...) — exact
+            # bool->float cast, traced here instead of post-dispatch
+            env[o_fts] = out[4].astype(vals.dtype)
+            for i, fk in enumerate(o_fcols):
+                env[fk] = out[5][i].astype(vals.dtype)
+    else:  # pragma: no cover - descriptors come from _Sim only
+        raise ValueError(f"unknown emit {kind!r}")
+
+
+@functools.lru_cache(maxsize=64)
+def _stitched_program(mesh, series_axis, recipe: tuple):
+    """ONE jitted program for the whole recipe.  Between consecutive
+    emits the live plane set crosses an ``optimization_barrier`` — the
+    op boundaries stay exactly where the op-by-op chain materialises
+    its frames, so the stitched result is bitwise-identical while XLA
+    still sees one dispatch."""
+    in_keys, emits, barriers, out_keys = recipe
+
+    def fn(*inputs):
+        env = dict(zip(in_keys, inputs))
+        for j, em in enumerate(emits):
+            if j and barriers[j - 1]:
+                live = barriers[j - 1]
+                pinned = jax.lax.optimization_barrier(
+                    tuple(env[k] for k in live))
+                env.update(zip(live, pinned))
+            _run_emit(env, em, mesh, series_axis)
+        return tuple(env[k] for k in out_keys)
+
+    return jax.jit(fn)
+
+
+# ----------------------------------------------------------------------
+# Executor entry points
+# ----------------------------------------------------------------------
+
+def run(frame, node: ir.Node):
+    """Execute a ``stitched`` plan node over one DistributedTSDF, or
+    None when a run-time guard fails (the executor then replays the
+    chain op-by-op via :func:`run_sequential`)."""
+    from tempo_tpu import dist
+    from tempo_tpu.dist import DistCol, DistributedTSDF
+
+    if not isinstance(frame, DistributedTSDF):
+        return None
+    if frame.time_axis is not None:
+        # the series-local kernels assert n_time == 1; time-sharded
+        # chains reach here only if the reshard pass did not bracket
+        # them — replay op-by-op (each eager op reshards itself)
+        return None
+    stages = node.param("stages") or ()
+    try:
+        sim = _plan(frame, stages, dist._use_sort_kernels())
+    except _Refuse as e:
+        logger.debug("plan: stitched chain refused at run time (%s)", e)
+        return None
+    except (KeyError, ValueError, TypeError) as e:
+        logger.debug("plan: stitched chain planning failed (%s)", e)
+        return None
+    in_keys, emits, barriers, out_keys = recipe = sim.recipe()
+    if emits:
+        prog = _stitched_program(frame.mesh, frame.series_axis, recipe)
+        outs = prog(*sim.in_arrays)
+    else:
+        outs = ()
+    env = dict(zip(out_keys, outs))
+
+    def val(plane: _Plane):
+        return plane.ref if plane.ref is not None else env[plane.key]
+
+    new_cols = {}
+    for name, col in sim.cols.items():
+        if col.src is not None:
+            new_cols[name] = col.src      # by-ref ride-through
+        else:
+            new_cols[name] = DistCol(val(col.v), val(col.g),
+                                     int64=col.int64)
+    kw: Dict[str, object] = dict(cols=new_cols)
+    if sim.ts.ref is None:
+        kw["ts"] = env[sim.ts.key]
+    if sim.mask.ref is None:
+        kw["mask"] = env[sim.mask.key]
+    if sim.grid_replaced:
+        kw.update(resampled=True, resample_freq=sim.resample_freq,
+                  seq=None, seq_col="")
+    return frame._with(**kw)
+
+
+def run_sequential(frame, node: ir.Node):
+    """Op-by-op fallback: replay the recorded stages through the eager
+    methods (one dispatch per op, same results — and the eager error
+    messages — as an unstitched plan)."""
+    from tempo_tpu.plan import executor
+
+    cur = frame
+    for op, params in node.param("stages") or ():
+        cur = executor._eval_op(ir.Node(op, params=dict(params)), [cur])
+    return cur

@@ -166,11 +166,31 @@ def resample_ema(tsdf, freq: str, colName: str, exp_factor: float = 0.2):
     of the bucket's first row *when that row is non-null* (a bucket
     whose first row is null yields a null sample and the EMA carries —
     the ``ema_exact`` null contract); the EMA is the exact
-    infinite-horizon scan over those samples (the scan-based upgrade
-    of the reference's truncated-lag EMA, tsdf.py:617-618 TODO).
+    infinite-horizon scan over those samples.
     Returns a TSDF with one row per bucket: partition cols, the bucket
     start as the new ts, ``colName`` (the floor sample) and
     ``EMA_<colName>``.
+
+    **Truncated-lag EMA — the canonical note** (other kernels point
+    here).  The reference computes EMA as an explicit ``window``-term
+    lag sum — ``EMA_t = sum_{i=0}^{window-1} e(1-e)^i x_{t-i}`` —
+    because one Spark window expression per lag is the only form it
+    has; its own tsdf.py:617-618 TODO asks for the exact recursive
+    formulation.  On this stack the recursion ``y_t = (1-a) y_{t-1} +
+    a x_t`` IS the native form, in three interchangeable guises:
+    ``ops/rolling.ema_exact`` (associative scan — fastest, but its
+    combine-tree bracketing, and so its f32 rounding, depends on the
+    total length), ``ops/rolling.ema_scan`` (sequential ``lax.scan`` —
+    one multiply-add per element, split-invariant bitwise, the
+    serving engine's resumable form), and
+    ``ops/pallas_kernels.ema_scan`` (the Mosaic roll-ladder kernel in
+    the fused pipeline).  All three are exact infinite-horizon: no
+    truncation error, and null inputs carry the previous EMA forward.
+    ``TSDF.EMA(exact=False)`` keeps reference-parity truncation
+    (``ops/rolling.ema_compat``, one causal depthwise convolution) for
+    drop-in compatibility; the exact form is also what lets the
+    distributed EMA cross time shards by carrying ``y_end``
+    (dist.py) — a lag sum cannot.
     """
     from tempo_tpu.ops import pallas_bucket as pb
     from tempo_tpu.ops import pallas_kernels as pkk

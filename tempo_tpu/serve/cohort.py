@@ -318,13 +318,22 @@ class _Group:
             out[k] = a
         self.state = out
 
+    _BUILDERS = {
+        "push": sst.cohort_push_executable,
+        "query": sst.cohort_query_executable,
+        # block kinds: the second key is the pow2 TICK-count bucket Nb,
+        # not a per-series row bucket (the block step always runs at
+        # the singles lane width, state.block_lanes())
+        "block_push": sst.cohort_block_push_executable,
+        "block_query": sst.cohort_block_query_executable,
+    }
+
     def executable(self, kind: str, Lb: int):
         exe = self._exes.get((kind, Lb))
         if exe is None:
-            build = (sst.cohort_push_executable if kind == "push"
-                     else sst.cohort_query_executable)
-            exe = build(self.cfg, self.capacity, Lb,
-                        self.cohort.mesh, self.cohort.stream_axis)
+            exe = self._BUILDERS[kind](
+                self.cfg, self.capacity, Lb,
+                self.cohort.mesh, self.cohort.stream_axis)
             self._exes[(kind, Lb)] = exe
         return exe
 
@@ -917,6 +926,297 @@ class StreamCohort:
         member.acked += n_ticks
         self.acked_total += n_ticks
 
+    # -- batched native dispatch ---------------------------------------
+
+    def dispatch_block(self, kinds, members, series_ids, ts, seq=None,
+                       values=None):
+        """Dispatch a columnar tick BLOCK: parallel arrays instead of a
+        per-tick item list, and (for the single-tick-per-(member,
+        series) majority) ONE device program per side that scatters the
+        whole block into the padded batch on device, steps, and gathers
+        the emissions back compact (``state.cohort_block_push/
+        query_executable``) — the host never builds or reads an
+        ``[S, ...]`` array, which is the per-tick path's dispatch
+        floor.
+
+        ``kinds`` is ``'right'``/``'left'`` for a side-homogeneous
+        block, or a per-tick array (booleans, True = left/query, or the
+        side strings).  ``series_ids`` is one key applied to every tick
+        or a per-tick sequence; ``ts`` int64 per tick; ``seq`` optional
+        per-tick floats (NaN = no sequence number, NULLS FIRST);
+        ``values`` maps every cohort value column to a float32 array
+        (required when the block has data ticks).
+
+        Returns ``(out, errors)``: ``out`` maps each emission field to
+        a full-length column (rows of the other side, or rejected
+        ticks, keep the fill value — NaN / False / -1), ``errors`` maps
+        tick index to the exception that rejected it (late tick,
+        unknown series, ...).  Everything else about the contract is
+        :meth:`dispatch`'s, bitwise: ticks that need per-tick machinery
+        — duplicate (member, series) ticks in one block (lane
+        assignment and strict arrival order), spilled/tiered members,
+        members of other shape buckets, or any mesh-sharded cohort —
+        fall back to :meth:`dispatch` internally, in arrival order per
+        member.  Single-tick members may legally reorder around each
+        other (each member's own merged-stream order is the only
+        contract), which is what lets a mixed block run as one push
+        program plus one query program."""
+        n = len(members)
+        out: Dict[str, np.ndarray] = {}
+        errors: Dict[int, Exception] = {}
+        if n == 0:
+            return out, errors
+        ts = np.asarray(ts, np.int64)
+        if ts.shape != (n,):
+            raise ValueError(
+                f"members and ts are parallel arrays: got {n} members "
+                f"but ts of shape {ts.shape}")
+        if isinstance(kinds, str):
+            if kinds not in ("right", "left"):
+                raise ValueError(f"kinds must be 'right' or 'left', "
+                                 f"got {kinds!r}")
+            is_left = np.full(n, kinds == "left")
+        else:
+            ka = np.asarray(kinds)
+            is_left = (ka == "left") if ka.dtype.kind in "UO" \
+                else ka.astype(bool)
+            if is_left.shape != (n,):
+                raise ValueError(
+                    f"per-tick kinds must align with members: "
+                    f"{is_left.shape} != ({n},)")
+        skeys = None
+        if isinstance(series_ids, (list, tuple, np.ndarray)):
+            if len(series_ids) != n:
+                raise ValueError(
+                    f"per-tick series_ids must align with members: "
+                    f"{len(series_ids)} != {n}")
+            skeys = series_ids
+        if seq is None:
+            sq_arr = np.full(n, -np.inf)
+        else:
+            sq_arr = np.asarray(seq, np.float64)
+            if sq_arr.shape != (n,):
+                raise ValueError(
+                    f"seq must align with members: {sq_arr.shape} != "
+                    f"({n},)")
+            sq_arr = np.where(np.isnan(sq_arr), -np.inf, sq_arr)
+        colv_full = None
+        if not is_left.all():
+            if values is None:
+                raise ValueError(
+                    "block has data (right) ticks but no values")
+            cols = []
+            for col in self.value_cols:
+                if col not in values:
+                    raise ValueError(
+                        f"push block is missing value column {col!r} "
+                        f"(cohort columns: {self.value_cols})")
+            for col in self.value_cols:
+                v = np.asarray(values[col], np.float32)
+                if v.shape != (n,):
+                    raise ValueError(
+                        f"values[{col!r}] must align with members: "
+                        f"{v.shape} != ({n},)")
+                cols.append(v)
+            colv_full = (np.stack(cols) if cols
+                         else np.zeros((0, n), np.float32))
+
+        slow = np.zeros(n, bool)
+        dead = np.zeros(n, bool)
+        g0 = None
+        sl = np.full(n, -1, np.int64)
+        rw = np.zeros(n, np.int64)
+        if self.mesh is not None or self.spill_dir is not None:
+            # mesh-sharded batch builds are per-shard device-resident
+            # already; tiered cohorts need fault-in/LRU bookkeeping —
+            # both take the per-tick path wholesale
+            slow[:] = True
+            for i in range(n):
+                if members[i].cohort is not self:
+                    raise ValueError(
+                        f"stream {members[i].name!r} belongs to a "
+                        f"different cohort")
+        else:
+            for i in range(n):
+                m = members[i]
+                if m.cohort is not self:
+                    raise ValueError(
+                        f"stream {m.name!r} belongs to a different "
+                        f"cohort")
+                sk = skeys[i] if skeys is not None else series_ids
+                k = m._row.get(sk)
+                if k is None:
+                    errors[i] = ValueError(
+                        f"unknown series {sk!r} on stream {m.name!r}: "
+                        f"a cohort stream's series set grows only "
+                        f"through add_series")
+                    dead[i] = True
+                    continue
+                rw[i] = k
+                g = m._group
+                if g is None:        # not resident (shouldn't happen
+                    slow[i] = True   # without spill_dir; be safe)
+                    continue
+                if g0 is None:
+                    g0 = g
+                if g is not g0:      # other shape bucket
+                    slow[i] = True
+                    continue
+                sl[i] = m.slot
+            fastable = ~dead & ~slow & (sl >= 0)
+            if fastable.any():
+                # duplicate (member, series) ticks need lanes and
+                # strict per-member arrival order: per-tick path
+                kid = sl * np.int64(g0.bucket) + rw
+                fi = np.nonzero(fastable)[0]
+                _, inv, cnt = np.unique(kid[fi], return_inverse=True,
+                                        return_counts=True)
+                dup = cnt[inv] > 1
+                if dup.any():
+                    slow[fi[dup]] = True
+                self._dispatch_block_fast(
+                    np.nonzero(~dead & ~slow & (sl >= 0))[0], is_left,
+                    members, sl, rw, ts, sq_arr, colv_full, g0, out,
+                    errors, n)
+
+        s_idx = np.nonzero(slow)[0]
+        if len(s_idx):
+            self._dispatch_block_slow(s_idx, is_left, members, skeys,
+                                      series_ids, ts, seq, sq_arr,
+                                      colv_full, out, errors, n)
+        self._maybe_snapshot()
+        return out, errors
+
+    def _out_col(self, out, name, n):
+        a = out.get(name)
+        if a is None:
+            if name == "right_row_idx":
+                a = out[name] = np.full(n, -1, np.int32)
+            elif name.endswith("_found"):
+                a = out[name] = np.zeros(n, bool)
+            else:
+                a = out[name] = np.full(n, np.nan, np.float32)
+        return a
+
+    def _dispatch_block_fast(self, f_idx, is_left, members, sl, rw, ts,
+                             sq_arr, colv_full, g0, out, errors, n):
+        """The device block path for single-tick members of one bucket
+        group: per side, ONE vectorized watermark admission (the
+        singles rule) and ONE compiled scatter+step+gather program."""
+        if not len(f_idx):
+            return
+        S, C = g0.capacity, len(self.value_cols)
+        for side_i in (_SIDE_RIGHT, _SIDE_LEFT):
+            left = side_i == _SIDE_LEFT
+            idx = f_idx[is_left[f_idx]] if left \
+                else f_idx[~is_left[f_idx]]
+            if not len(idx):
+                continue
+            isl, irw = sl[idx], rw[idx]
+            its, isq = ts[idx], sq_arr[idx]
+            wts = g0.wm_ts[isl, irw]
+            wsq = g0.wm_seq[isl, irw]
+            wsd = g0.wm_side[isl, irw]
+            late = (its < wts) | ((its == wts) & (
+                (isq < wsq) | ((isq == wsq) & (side_i < wsd))))
+            if late.any():
+                for j in np.nonzero(late)[0]:
+                    i = int(idx[j])
+                    m = members[i]
+                    errors[i] = LateTickError(
+                        f"{m.name}/{m.series[int(irw[j])]!r}",
+                        int(its[j]), float(isq[j]), side_i,
+                        (int(wts[j]), float(wsq[j]), int(wsd[j])))
+                keep = ~late
+                idx, isl, irw = idx[keep], isl[keep], irw[keep]
+                its, isq = its[keep], isq[keep]
+            nk = len(idx)
+            if not nk:
+                continue
+            Nb = stream_mod._bucket(nk)
+            slp = np.full(Nb, S, np.int32)   # pad: out of range, DROPPED
+            slp[:nk] = isl
+            rwp = np.zeros(Nb, np.int32)
+            rwp[:nk] = irw
+            if side_i == _SIDE_RIGHT:
+                tsp = np.full(Nb, TS_PAD, np.int64)
+                tsp[:nk] = its
+                colp = np.full((C, Nb), np.nan, np.float32)
+                if C:
+                    colp[:, :nk] = colv_full[:, idx]
+                exe = g0.executable("block_push", Nb)
+                new_state, gath = exe(*g0.state.values(), slp, rwp,
+                                      tsp, colp)
+                g0.state = dict(zip(g0.cfg.state_names(), new_state))
+                for name, key, c in self._emit_fields(gath.keys()):
+                    self._out_col(out, name, n)[idx] = \
+                        np.asarray(gath[key])[:nk, c]
+            else:
+                exe = g0.executable("block_query", Nb)
+                args = [g0.state[nm] for nm in sst._QUERY_STATE]
+                new_nm, (v, f, ii) = exe(*args, slp, rwp)
+                g0.state["n_merged"] = new_nm
+                v = np.asarray(v)[:nk]
+                f = np.asarray(f)[:nk]
+                for c, col in enumerate(self.value_cols):
+                    self._out_col(out, col, n)[idx] = v[:, c]
+                    self._out_col(out, col + "_found", n)[idx] = f[:, c]
+                self._out_col(out, "right_row_idx", n)[idx] = \
+                    np.asarray(ii)[:nk]
+            # commit-after-success: vectorized watermark advance
+            g0.wm_ts[isl, irw] = its
+            g0.wm_seq[isl, irw] = isq
+            g0.wm_side[isl, irw] = side_i
+            for i in idx:
+                members[i].acked += 1
+            self.acked_total += nk
+            self.dispatches += 1
+            self._dirty.add(g0.bucket)
+
+    def _dispatch_block_slow(self, s_idx, is_left, members, skeys,
+                             series_ids, ts, seq, sq_arr, colv_full,
+                             out, errors, n):
+        """Per-tick fallback for the block ticks the device path cannot
+        take.  Ticks are regrouped into side-homogeneous runs with the
+        executor's cross-member greedy rule (a tick lands in the
+        earliest side-matching run at or after its member's last run —
+        only each member's OWN order is a contract), then each run is
+        one :meth:`dispatch`."""
+        runs: List[list] = []            # [side_is_left, [tick idx]]
+        last: Dict[int, int] = {}
+        for i in s_idx:
+            i = int(i)
+            mid = id(members[i])
+            want = bool(is_left[i])
+            placed = -1
+            for bi in range(last.get(mid, 0), len(runs)):
+                if runs[bi][0] == want:
+                    placed = bi
+                    break
+            if placed < 0:
+                runs.append([want, [i]])
+                placed = len(runs) - 1
+            else:
+                runs[placed][1].append(i)
+            last[mid] = placed
+        for want, lst in runs:
+            items = []
+            for i in lst:
+                sk = skeys[i] if skeys is not None else series_ids
+                sqi = None if seq is None else float(sq_arr[i])
+                row = None
+                if not want:
+                    row = {col: colv_full[c, i]
+                           for c, col in enumerate(self.value_cols)}
+                items.append((members[i], sk, int(ts[i]), sqi, row))
+            res = self.dispatch("left" if want else "right", items)
+            for i, r in zip(lst, res):
+                if isinstance(r, Exception):
+                    errors[i] = r
+                    continue
+                for name, val in r.items():
+                    self._out_col(out, name, n)[i] = val
+
     # -- tiered member-state spill -------------------------------------
 
     def _member_artifact(self, name: str) -> str:
@@ -1055,10 +1355,14 @@ class StreamCohort:
 
     # -- warmup --------------------------------------------------------
 
-    def warmup(self, max_rows: int) -> int:
+    def warmup(self, max_rows: int, max_block: int = 0) -> int:
         """Pre-build every bucket group's push/query executables for
         the padded-batch ladder up to ``max_rows`` — a fresh process
-        reaches the zero-recompile steady state before traffic."""
+        reaches the zero-recompile steady state before traffic.  With
+        ``max_block`` set, also build the :meth:`dispatch_block` device
+        programs for the pow2 block-size ladder up to ``max_block``
+        (meshless cohorts only — a meshed cohort block-routes to the
+        per-tick path, whose shapes the first ladder covers)."""
         shapes = []
         b = stream_mod._bucket(1)
         while True:
@@ -1070,7 +1374,21 @@ class StreamCohort:
             for Lb in shapes:
                 g.executable("push", Lb)
                 g.executable("query", Lb)
-        return len(shapes) * len(self._groups)
+        built = len(shapes) * len(self._groups)
+        if max_block and self.mesh is None:
+            blocks = []
+            b = stream_mod._bucket(1)
+            while True:
+                blocks.append(b)
+                if b >= max_block:
+                    break
+                b *= 2
+            for g in self._groups.values():
+                for Nb in blocks:
+                    g.executable("block_push", Nb)
+                    g.executable("block_query", Nb)
+            built += len(blocks) * len(self._groups)
+        return built
 
     # -- durability ----------------------------------------------------
 

@@ -189,6 +189,40 @@ class Ticket:
         return self.t_done - self.t_submit
 
 
+class BlockTicket(Ticket):
+    """One submitted columnar tick block
+    (:meth:`CohortExecutor.submit_block`): a waitable handle whose
+    ``result()`` is the block's full-length columnar emission dict
+    (``StreamCohort.dispatch_block``'s ``out``).  Per-tick rejections
+    (late tick, unknown series, quarantined member) land in
+    :attr:`errors` — index -> exception — with the rejected rows left
+    at their fill values; only a BLOCK-level failure raises from
+    ``result()``.  ``cancel()``/deadlines drop the whole block before
+    dispatch, exactly like a per-tick ticket."""
+
+    __slots__ = ("kinds", "members", "series_ids", "tsv", "seqv",
+                 "_errors")
+
+    def __init__(self, kinds, members, series_ids, ts, seq, values,
+                 deadline: Optional[Deadline] = None):
+        n = len(members)
+        ts_span = f"{int(ts[0])}..{int(ts[-1])}" if n else ""
+        super().__init__("block", f"<{n} ticks>", ts_span, None,
+                         values, deadline=deadline)
+        self.kinds = kinds
+        self.members = members
+        self.series_ids = series_ids
+        self.tsv = ts
+        self.seqv = seq
+        self._errors: Dict[int, Exception] = {}
+
+    @property
+    def errors(self) -> Dict[int, Exception]:
+        """Per-tick rejections (tick index -> exception), populated by
+        the time ``result()`` returns."""
+        return self._errors
+
+
 class MicroBatchExecutor:
     """See module docstring.  While an executor is attached, all
     traffic must go through it (``StreamingTSDF`` itself is
@@ -580,8 +614,19 @@ class CohortExecutor(MicroBatchExecutor):
 
     def __init__(self, cohort, queue_depth: Optional[int] = None,
                  batch_rows: Optional[int] = None,
-                 coalesce_s: float = 0.002,
+                 coalesce_s: Optional[float] = None,
                  breaker: Optional[CircuitBreaker] = None):
+        if coalesce_s is None:
+            # the coalescing window is a measured autotuner axis on the
+            # cohort path (tune/space.py): env knob wins, then the
+            # profile's winner, then the built-in 2ms
+            from tempo_tpu import tune
+
+            coalesce_s = config.get_float("TEMPO_TPU_SERVE_COALESCE_S")
+            if coalesce_s is None:
+                tuned = tune.knob_value("TEMPO_TPU_SERVE_COALESCE_S",
+                                        "serve_cohort")
+                coalesce_s = 0.002 if tuned is None else float(tuned)
         super().__init__(cohort, queue_depth=queue_depth,
                          batch_rows=batch_rows, coalesce_s=coalesce_s)
         self.cohort = cohort
@@ -672,11 +717,58 @@ class CohortExecutor(MicroBatchExecutor):
                 raise
         return out
 
+    def submit_block(self, kinds, members, series_ids, ts, values=None,
+                     seq=None, timeout: Optional[float] = None,
+                     deadline=None) -> BlockTicket:
+        """Enqueue a columnar tick block: parallel arrays instead of a
+        per-tick item list, ONE queue entry, ONE waitable
+        :class:`BlockTicket`, dispatched through
+        :meth:`~tempo_tpu.serve.cohort.StreamCohort.dispatch_block` —
+        at most one device program per side for the single-tick-
+        per-(member, series) majority, no per-tick python on either
+        side of the queue.  Arguments mirror ``dispatch_block``
+        (``kinds`` a side string or per-tick array; ``series_ids``
+        scalar or per-tick; ``values`` columnar).  A block is a
+        BARRIER in the worker's split: per-tick tickets queued before
+        it dispatch before it and vice versa, so mixing
+        ``submit``/``submit_many`` with blocks preserves every
+        member's arrival order.  Quarantined members are checked at
+        dispatch time (their ticks land in :attr:`BlockTicket.errors`
+        as ``QuarantinedError`` while the rest of the block proceeds);
+        ``deadline`` covers the whole block exactly like a per-tick
+        ticket's."""
+        if isinstance(kinds, str) and kinds not in ("right", "left"):
+            raise ValueError(f"kinds must be 'right' or 'left', got "
+                             f"{kinds!r}")
+        dl = self._deadline(deadline)
+        bt = BlockTicket(kinds, list(members), series_ids,
+                         np.asarray(ts, np.int64), seq, values,
+                         deadline=dl)
+        self._put(bt, timeout, dl)
+        return bt
+
     @staticmethod
     def _series_key(t: Ticket):
         return (id(t.member), t.series)
 
     def _split(self, group: List[Ticket]):
+        """Block tickets are barriers: per-tick runs split on either
+        side of each block (``_split_ticks``), the block itself is
+        yielded whole — relative order of a member's per-tick and
+        block traffic is preserved."""
+        run: List[Ticket] = []
+        for t in group:
+            if isinstance(t, BlockTicket):
+                if run:
+                    yield from self._split_ticks(run)
+                    run = []
+                yield t
+            else:
+                run.append(t)
+        if run:
+            yield from self._split_ticks(run)
+
+    def _split_ticks(self, group: List[Ticket]):
         """Cohort-aware micro-batching: member streams are independent
         merged streams, so ticks of DIFFERENT members may legally
         reorder around each other — only each member's own order is a
@@ -720,6 +812,8 @@ class CohortExecutor(MicroBatchExecutor):
             self.breaker.abandon(t.member.name)
 
     def _process(self, batch):
+        if isinstance(batch, BlockTicket):
+            return self._process_block(batch)
         batch, max_rows = batch
         kind = batch[0].kind
         try:
@@ -749,6 +843,107 @@ class CohortExecutor(MicroBatchExecutor):
         b = stream_mod._bucket(max_rows)
         self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1
 
+    def _process_block(self, bt: BlockTicket):
+        """One block ticket -> one ``dispatch_block``.  Breaker
+        integration is sized for block rates: the quarantine pre-pass
+        only runs when the breaker has EVER tripped (``trips`` never
+        decrements, so a healthy fleet pays one integer check per
+        block, not a lock round per tick), and successes are recorded
+        only for members the breaker already tracks — ``record(ok)``
+        setdefaults an entry per key, so blanket per-tick success
+        recording would both grow the state dict by fleet size and
+        take the breaker lock per tick."""
+        members = bt.members
+        kinds, series_ids = bt.kinds, bt.series_ids
+        tsv, seqv, values = bt.tsv, bt.seqv, bt.values
+        n_full = len(members)
+        pre: Dict[int, Exception] = {}
+        keep = None
+        if self.breaker.trips:
+            qexc: Dict[str, Exception] = {}
+            with self.breaker._lock:
+                open_names = {k for k, st in self.breaker._st.items()
+                              if st[1] is not None}
+            for name in ({m.name for m in members} & open_names):
+                try:
+                    self.breaker.allow(name, label="stream member")
+                except QuarantinedError as e:
+                    qexc[name] = e
+            if qexc:
+                keep = [i for i in range(n_full)
+                        if members[i].name not in qexc]
+                for i in range(n_full):
+                    e = qexc.get(members[i].name)
+                    if e is not None:
+                        pre[i] = e
+                ki = np.asarray(keep, np.intp)
+                members = [members[i] for i in keep]
+                if not isinstance(kinds, str):
+                    kinds = np.asarray(kinds)[ki]
+                if isinstance(series_ids, (list, tuple, np.ndarray)):
+                    series_ids = [series_ids[i] for i in keep]
+                tsv = np.asarray(tsv)[ki]
+                if seqv is not None:
+                    seqv = np.asarray(seqv)[ki]
+                if values is not None:
+                    values = {c: np.asarray(v)[ki]
+                              for c, v in values.items()}
+        try:
+            out, errors = self.cohort.dispatch_block(
+                kinds, members, series_ids, tsv, seq=seqv,
+                values=values)
+        except Exception as e:       # block-level failure: one result
+            for m in members:
+                self.breaker.record(m.name, ok=False)
+            bt._errors = pre
+            bt._finish(exc=e)
+            self._ring([bt])
+            return
+        if keep is not None:
+            # remap the kept-subset columns/errors back to full-length
+            # block indices; quarantined rows keep their fill values
+            errors = {keep[j]: e for j, e in errors.items()}
+            full = {}
+            for name, col in out.items():
+                self.cohort._out_col(full, name, n_full)[
+                    np.asarray(keep, np.intp)] = col
+            out = full
+        merged = dict(pre)
+        merged.update(errors)
+        for i, e in errors.items():
+            self.breaker.record(bt.members[i].name, ok=False)
+        if self.breaker._st:
+            with self.breaker._lock:
+                hot = {k for k, st in self.breaker._st.items()
+                       if st[0] or st[1] is not None}
+            if hot:
+                for i, m in enumerate(bt.members):
+                    if m.name in hot and i not in merged:
+                        self.breaker.record(m.name, ok=True)
+        bt._errors = merged
+        bt._finish(result=out)
+        self._ring([bt])
+        self.batches += 1
+        nok = n_full - len(merged)
+        self.ticks += nok
+        lat = bt.t_done - bt.t_submit
+        if isinstance(bt.kinds, str):
+            n_left = nok if bt.kinds == "left" else 0
+        else:
+            ka = np.asarray(bt.kinds)
+            is_left = (ka == "left") if ka.dtype.kind in "UO" \
+                else ka.astype(bool)
+            ok_mask = np.ones(n_full, bool)
+            for i in merged:
+                ok_mask[i] = False
+            n_left = int((is_left & ok_mask).sum())
+        for side, cnt in (("right", nok - n_left), ("left", n_left)):
+            if cnt:
+                self._latencies[side].extend(
+                    [lat] * min(cnt, LATENCY_WINDOW))
+        b = stream_mod._bucket(max(1, nok))
+        self.bucket_hist[b] = self.bucket_hist.get(b, 0) + 1
+
     # -- failover ------------------------------------------------------
 
     @classmethod
@@ -756,7 +951,7 @@ class CohortExecutor(MicroBatchExecutor):
                mesh=None, stream_axis: str = "streams",
                queue_depth: Optional[int] = None,
                batch_rows: Optional[int] = None,
-               coalesce_s: float = 0.002,
+               coalesce_s: Optional[float] = None,
                breaker: Optional[CircuitBreaker] = None,
                **overrides) -> "CohortExecutor":
         """Failover in one call: restore the newest intact cohort

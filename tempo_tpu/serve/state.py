@@ -568,3 +568,155 @@ def cohort_query_executable(cfg: StreamConfig, S: int, Lb: int,
 
     return CACHE.get_or_build(
         _cohort_cache_key("cohort_query", cfg, S, Lb, mesh), build)
+
+
+# ----------------------------------------------------------------------
+# Block dispatch programs: batch build + step + emission gather as ONE
+# device program.  The per-tick cohort path assembles the [S, K, Lb]
+# batch with host numpy fancy-indexing, ships it H2D, and pulls EVERY
+# emission plane ([S, C, K, Lb] each) back D2H just to gather a handful
+# of rows — at fleet rates the host scatter plus the full-plane
+# transfers ARE the dispatch floor.  The block program takes the ticks
+# in COMPACT form (flat index/value arrays of one pow2-padded length
+# Nb), scatters them into the padded batch ON DEVICE (pad lanes carry
+# an out-of-range slot index, dropped by ``mode='drop'``), runs the
+# identical vmapped step, and gathers the emissions back to compact
+# ``[Nb, C]`` planes on device — H2D is O(ticks), D2H is O(ticks), and
+# the host never touches an [S, ...] array.
+#
+# Bitwise contract: the scattered batch holds exactly the values the
+# host path would have built (same TS_PAD/NaN/zero fill, same f32
+# payloads, one tick per (slot, row) by the caller's single-tick
+# precondition, lane 0 like the singles path), and an
+# ``optimization_barrier`` pins the batch arrays so the step consumes
+# concrete operands — the step itself is the SAME traced
+# ``_push_fn``/``_query_fn`` under ``jax.vmap``, so each member's
+# emissions and state are bitwise the per-tick dispatch's
+# (tests/test_block_dispatch.py pins it).
+# ----------------------------------------------------------------------
+
+def block_lanes() -> int:
+    """The block programs' padded per-series row count: one tick per
+    (slot, row) means every batch lane beyond the first is pad, but the
+    step shape must MATCH the per-tick singles path (which pads a
+    1-row batch to ``stream._bucket(1)``) so both paths share one step
+    trace per config."""
+    from tempo_tpu.serve import stream as stream_mod
+
+    return stream_mod._bucket(1)
+
+
+def _block_push_fn(cfg: StreamConfig, S: int, Nb: int):
+    C, K = cfg.n_cols, cfg.n_series
+    Lb = block_lanes()
+    step = jax.vmap(_push_fn(cfg, Lb))
+    n_state = len(cfg.state_names())
+
+    def prog(*args):
+        st = args[:n_state]
+        sl, rw, tsv, colv = args[n_state:]
+        ts_p = jnp.full((S, K, Lb), TS_PAD, jnp.int64)
+        ts_p = ts_p.at[sl, rw, 0].set(tsv, mode="drop")
+        mask = jnp.zeros((S, K, Lb), bool)
+        mask = mask.at[sl, rw, 0].set(True, mode="drop")
+        xs = jnp.full((S, C, K, Lb), jnp.nan, jnp.float32)
+        for c in range(C):
+            xs = xs.at[sl, c, rw, 0].set(colv[c], mode="drop")
+        counts = jnp.zeros((S, K), jnp.int64)
+        counts = counts.at[sl, rw].add(jnp.int64(1), mode="drop")
+        ts_p, xs, mask, counts = jax.lax.optimization_barrier(
+            (ts_p, xs, mask, counts))
+        new_state, emits = step(*st, ts_p, xs, mask, counts)
+        slg = jnp.minimum(sl, S - 1)    # pad slots clamp; host drops
+        gathered = {k: v[slg, :, rw, 0] for k, v in emits.items()}
+        return new_state, gathered
+
+    return prog
+
+
+def _block_query_fn(cfg: StreamConfig, S: int, Nb: int):
+    K = cfg.n_series
+    Lb = block_lanes()
+    qstep = jax.vmap(_query_fn(cfg, Lb))
+
+    def prog(*args):
+        st = args[:len(_QUERY_STATE)]
+        sl, rw = args[len(_QUERY_STATE):]
+        counts = jnp.zeros((S, K), jnp.int64)
+        counts = counts.at[sl, rw].add(jnp.int64(1), mode="drop")
+        counts = jax.lax.optimization_barrier(counts)
+        new_n_merged, (vals, found, idx) = qstep(*st, counts)
+        slg = jnp.minimum(sl, S - 1)
+        return new_n_merged, (vals[slg, :, rw, 0], found[slg, :, rw, 0],
+                              idx[slg, rw, 0])
+
+    return prog
+
+
+def block_push_avals(cfg: StreamConfig, S: int, Nb: int):
+    C = cfg.n_cols
+    return cohort_push_avals(cfg, S, block_lanes())[
+        :len(cfg.state_names())] + (
+        _abstract((Nb,), np.int32),          # slot per tick
+        _abstract((Nb,), np.int32),          # series row per tick
+        _abstract((Nb,), np.int64),          # ts per tick
+        _abstract((C, Nb), np.float32),      # value planes per tick
+    )
+
+
+def block_query_avals(cfg: StreamConfig, S: int, Nb: int):
+    base = dict(zip(cfg.state_names(),
+                    cohort_push_avals(cfg, S, block_lanes())[
+                        :len(cfg.state_names())]))
+    return tuple(base[n] for n in _QUERY_STATE) + (
+        _abstract((Nb,), np.int32),
+        _abstract((Nb,), np.int32),
+    )
+
+
+def _require_meshless(mesh, kind: str) -> None:
+    if mesh is not None:
+        raise NotImplementedError(
+            f"the {kind} block program is host-edge code for the "
+            f"meshless cohort; a mesh-sharded cohort takes the "
+            f"per-tick dispatch path (its batch build is already "
+            f"device-resident per shard)")
+
+
+def cohort_block_push_executable(cfg: StreamConfig, S: int, Nb: int,
+                                 mesh=None,
+                                 stream_axis: str = "streams"):
+    """AOT-compiled block push program for one (shape bucket, S, pow2
+    tick-count bucket ``Nb``): device-side scatter + the vmapped step +
+    compact emission gathers, with the retired state donated — cached
+    under the planner's executable cache like every other serve
+    program."""
+    from tempo_tpu.plan.cache import CACHE
+
+    _require_meshless(mesh, "push")
+
+    def build():
+        n_state = len(cfg.state_names())
+        fn = jax.jit(_block_push_fn(cfg, S, Nb),
+                     donate_argnums=_serve_donate(tuple(range(n_state))))
+        return fn.lower(*block_push_avals(cfg, S, Nb)).compile()
+
+    return CACHE.get_or_build(
+        _cohort_cache_key("cohort_block_push", cfg, S, Nb, mesh), build)
+
+
+def cohort_block_query_executable(cfg: StreamConfig, S: int, Nb: int,
+                                  mesh=None,
+                                  stream_axis: str = "streams"):
+    from tempo_tpu.plan.cache import CACHE
+
+    _require_meshless(mesh, "query")
+
+    def build():
+        fn = jax.jit(_block_query_fn(cfg, S, Nb),
+                     donate_argnums=_serve_donate((7,)))
+        return fn.lower(*block_query_avals(cfg, S, Nb)).compile()
+
+    return CACHE.get_or_build(
+        _cohort_cache_key("cohort_block_query", cfg, S, Nb, mesh),
+        build)

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import re
 import threading
@@ -54,7 +55,14 @@ TUNABLE_KNOBS = (
     "TEMPO_TPU_STREAM_MAX_ROWS",
     "TEMPO_TPU_MEGACORE",
     "TEMPO_TPU_SERVE_BATCH_ROWS",
+    "TEMPO_TPU_INGEST_RING",
+    "TEMPO_TPU_STITCH_MAX_OPS",
+    "TEMPO_TPU_SERVE_COALESCE_S",
 )
+
+#: the few tunable knobs whose values are (finite) floats, not ints —
+#: everything else in a profile's ``knobs`` section must be an integer
+FLOAT_KNOBS = ("TEMPO_TPU_SERVE_COALESCE_S",)
 
 
 class TuneProfileError(ValueError):
@@ -150,10 +158,19 @@ def validate(payload: dict, path: str,
                 raise TuneProfileError(
                     f"tuned profile {path!r} refused: {name!r} is not a "
                     f"tunable knob ({', '.join(TUNABLE_KNOBS)})")
-            # every tunable knob is integer-valued: refuse malformed
-            # values HERE, by name, so a bad profile never half-applies
-            # and then crashes inside a knob reader mid-kernel-build
-            if isinstance(value, bool) or not isinstance(value, int):
+            # tunable knobs are integer-valued (FLOAT_KNOBS: finite
+            # float): refuse malformed values HERE, by name, so a bad
+            # profile never half-applies and then crashes inside a
+            # knob reader mid-kernel-build
+            if name in FLOAT_KNOBS:
+                if isinstance(value, bool) \
+                        or not isinstance(value, (int, float)) \
+                        or not math.isfinite(value):
+                    raise TuneProfileError(
+                        f"tuned profile {path!r} refused: knob "
+                        f"{name!r} has non-finite-float value "
+                        f"{value!r} ({type(value).__name__})")
+            elif isinstance(value, bool) or not isinstance(value, int):
                 raise TuneProfileError(
                     f"tuned profile {path!r} refused: knob {name!r} has "
                     f"non-integer value {value!r} "

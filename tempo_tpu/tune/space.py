@@ -25,7 +25,9 @@ Shape classes mirror the regimes the bench measures: the dense/medium
 streaming stats kernels (configs 2b's densities), the column-packed
 streaming kernel, the fused join+stats+EMA chain (configs 1-3's
 composite), the lane-chunked AS-OF join (TPU-only: the Mosaic kernel),
-and the serving micro-batch executor.  Each knob has exactly ONE
+the serving micro-batch executor, and the PR 17 dispatch-floor planes
+(the slab-pipeline ring depth, the whole-chain stitch length, and the
+cohort dispatch-coalescing window).  Each knob has exactly ONE
 owning class (``owns``) whose winner feeds the profile's merged knob
 set — the other classes sweeping the same knob are cross-checks whose
 results are recorded but never merged.
@@ -117,6 +119,40 @@ SPACE: Tuple[ShapeClass, ...] = (
         owns=("TEMPO_TPU_SERVE_BATCH_ROWS",),
         doc="the serving micro-batch executor under a deterministic "
             "tick load — owns the per-series micro-batch row cap"),
+    ShapeClass(
+        "ingest_sweep", "ingest_sweep",
+        axes=(
+            Axis("TEMPO_TPU_INGEST_RING", (2, 1, 4, 8), (2, 4)),
+        ),
+        owns=("TEMPO_TPU_INGEST_RING",),
+        doc="the three-stage slab pipeline (io/ingest.sweep_slabs: "
+            "decode thread / in-order compute / drain thread) — owns "
+            "the slab-buffer ring depth; any depth is bitwise "
+            "identical by construction (in-order consumption), so a "
+            "digest mismatch is an ordering regression"),
+    ShapeClass(
+        "stitched_chain", "stitched_chain",
+        axes=(
+            Axis("TEMPO_TPU_STITCH_MAX_OPS", (8, 1, 4, 16), (8, 1)),
+        ),
+        owns=("TEMPO_TPU_STITCH_MAX_OPS",),
+        doc="the whole-chain program stitcher (plan/stitch.py) on a "
+            "resample->EMA->range_stats planned chain — owns the max "
+            "stitch run length; every value is bitwise (stitch "
+            "boundaries are optimization_barrier-pinned, so stitched "
+            "== per-op chain bit-for-bit)"),
+    ShapeClass(
+        "serve_cohort", "serve_cohort",
+        axes=(
+            Axis("TEMPO_TPU_SERVE_COALESCE_S",
+                 (0.002, 0.0, 0.001, 0.004, 0.008), (0.002, 0.0)),
+        ),
+        owns=("TEMPO_TPU_SERVE_COALESCE_S",),
+        doc="the cohort executor's dispatch-coalescing window under a "
+            "deterministic Poisson tick load — owns the only "
+            "float-valued knob (profile.FLOAT_KNOBS); the window only "
+            "moves the micro-batch split, never per-(slot,row) state "
+            "math, so every value is bitwise"),
 )
 
 

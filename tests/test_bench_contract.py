@@ -51,7 +51,7 @@ def test_bench_smoke_prints_one_json_line():
         "13_query_service_qps", "14_fleet_serving_ticks_per_sec",
         "15_chaos_serving_ticks_per_sec",
         "16_chaos_pipeline_rows_per_sec",
-        "17_chaos_store_ticks_per_sec",
+        "17_chaos_store_ticks_per_sec", "18_overlap_rows_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -111,6 +111,35 @@ def test_bench_smoke_prints_one_json_line():
     base = fs.get("per_instance_baseline") or {}
     assert base.get("ticks_per_sec", 0) > 0, fs
     assert fs.get("aggregate_vs_per_instance", 0) > 0
+    # PR 17: the batched native dispatch phase must have re-fed the
+    # same tick mix as columnar blocks, zero-recompile, with the
+    # block-vs-per-tick ratio measured and the bitwise audit performed
+    bd = fs.get("block_dispatch") or {}
+    assert bd.get("ticks_per_sec", 0) > 0, fs
+    assert bd.get("vs_per_tick_executor", 0) > 0
+    assert bd.get("zero_builds_steady_state") is True
+    assert "bitwise" in bd.get("value_audit", "")
+    # config 18 (PR 17): all three dispatch-floor planes must have
+    # run with their bitwise audits — the serial-vs-pipelined slab
+    # twin (per-stage times present), the real from_parquet ring flip,
+    # and the stitched-chain roofline (the speedup asserts are
+    # full-mode-only; smoke proves the machinery + the audits)
+    ov = rec.get("overlap") or {}
+    sw = ov.get("sweep_slabs") or {}
+    assert sw.get("speedup_vs_serial", 0) > 0, ov
+    for stage in ("load", "compute", "drain"):
+        assert (sw.get("pipelined") or {}).get(
+            "stage_s", {}).get(stage, -1) >= 0, sw
+    assert "bitwise" in sw.get("value_audit", "")
+    ig = ov.get("ingest") or {}
+    assert ig.get("pipelined_rows_per_sec", 0) > 0, ov
+    assert ig.get("serial_rows_per_sec", 0) > 0
+    assert "bitwise" in ig.get("value_audit", "")
+    sc = ov.get("stitched_chain") or {}
+    assert sc.get("stitched_rows_per_sec", 0) > 0, ov
+    assert sc.get("unstitched_rows_per_sec", 0) > 0
+    assert sc.get("roofline_fraction_of_stream_rate", -1) >= 0
+    assert "bitwise" in sc.get("value_audit", "")
     # config 13 (round 11): the multi-tenant query service must have
     # run >= 2 tenants of mixed shapes with the shared-cache hit-rate
     # reported, the hard zero-recompiles-at-steady-state assert, the

@@ -1,0 +1,343 @@
+"""Batched cohort dispatch (``StreamCohort.dispatch_block`` +
+``CohortExecutor.submit_block``): the per-tick python scatter and
+full-plane D2H gather of the per-tick path are replaced, for the
+single-tick-per-(member, series) majority of a block, by ONE compiled
+scatter-step-gather program per side whose H2D/D2H traffic is
+O(ticks), not O(cohort).
+
+The contract: block results are BITWISE the per-tick path's for any
+mixed-side block; ticks the device path cannot take — duplicate
+(member, series) ticks in one block, spilled/tiered cohorts, meshed
+cohorts — fall back to :meth:`dispatch` internally in per-member
+arrival order; rejections (late ticks, unknown series, quarantined
+members) are per tick index, never whole-block; the block programs
+join the warmup ladder so the steady state stays zero-recompile; and
+a block ticket is a BARRIER in the executor's split, so mixing block
+and per-tick traffic preserves every member's order.
+"""
+
+import numpy as np
+import pytest
+
+from tempo_tpu import dist, profiling
+from tempo_tpu.resilience import (CircuitBreaker, QuarantinedError,
+                                  ShutdownError)
+from tempo_tpu.serve import LateTickError, StreamCohort
+from tempo_tpu.serve.executor import BlockTicket, CohortExecutor
+from tempo_tpu.testing import faults
+
+S = 16
+KW = dict(window_secs=10.0, window_rows_bound=8, ema_alpha=0.2,
+          max_lookback=8)
+
+
+def _mk(slots=S, n=S, **kw):
+    cohort = StreamCohort(("px", "qty"), slots=slots, **KW, **kw)
+    members = [cohort.add_stream(f"u{i}", ["ticks"]) for i in range(n)]
+    return cohort, members
+
+
+def _gen_block(rng, n, n_members, t0=0, left_p=0.35):
+    mi = rng.integers(0, n_members, n)
+    ts = t0 + np.sort(rng.integers(0, 900 * n, n)).astype(np.int64)
+    is_left = rng.random(n) < left_p
+    vals = {"px": rng.standard_normal(n).astype(np.float32),
+            "qty": rng.standard_normal(n).astype(np.float32)}
+    return mi, ts, is_left, vals
+
+
+def _per_tick_ref(cohort, members, mi, ts, is_left, vals):
+    """The per-tick reference: each block tick as its own dispatch, in
+    block order — the strictest serialization the block may refine."""
+    out = []
+    for i in range(len(mi)):
+        side = "left" if is_left[i] else "right"
+        row = (None if is_left[i] else
+               {c: float(v[i]) for c, v in vals.items()})
+        out.append(cohort.dispatch(
+            side, [(members[mi[i]], "ticks", int(ts[i]), None, row)])[0])
+    return out
+
+
+def _assert_block_matches(out, errors, ref, is_left):
+    for i, r in enumerate(ref):
+        if isinstance(r, Exception):
+            assert type(errors[i]) is type(r), (i, errors.get(i), r)
+            continue
+        assert i not in errors, (i, errors[i])
+        for name, v in r.items():
+            got = np.asarray(out[name][i])
+            want = np.asarray(v)
+            assert got.dtype == want.dtype and \
+                got.tobytes() == want.tobytes(), (i, name, got, want)
+
+
+# ----------------------------------------------------------------------
+# dispatch_block: bitwise identity vs the per-tick path
+# ----------------------------------------------------------------------
+
+def test_block_equals_per_tick_unique_members():
+    """All-fast block (every (member, series) once): mixed sides run
+    as at most one push + one query program, bitwise the per-tick
+    path's results and state."""
+    c1, m1 = _mk()
+    c2, m2 = _mk()
+    rng = np.random.default_rng(0)
+    for rnd in range(3):
+        perm = rng.permutation(S)
+        n = len(perm)
+        ts = (10_000 * rnd +
+              np.sort(rng.integers(0, 9_000, n)).astype(np.int64))
+        is_left = rng.random(n) < 0.4
+        vals = {"px": rng.standard_normal(n).astype(np.float32),
+                "qty": rng.standard_normal(n).astype(np.float32)}
+        ref = _per_tick_ref(c1, m1, perm, ts, is_left, vals)
+        d0 = c2.dispatches
+        out, errors = c2.dispatch_block(
+            is_left, [m2[j] for j in perm], "ticks", ts, values=vals)
+        assert not errors
+        # the whole mixed block ran as <= 2 device dispatches
+        assert c2.dispatches - d0 <= 2
+        _assert_block_matches(out, errors, ref, is_left)
+    assert c1.acked_total == c2.acked_total
+
+
+def test_block_duplicates_route_per_tick_order_preserved():
+    """Multi-tick members keep strict arrival order (the fallback
+    path); single-tick members still take the device path — mixed in
+    one block, results bitwise the fully-serialized reference."""
+    c1, m1 = _mk(n=6, slots=8)
+    c2, m2 = _mk(n=6, slots=8)
+    rng = np.random.default_rng(1)
+    mi, ts, is_left, vals = _gen_block(rng, 40, 6)
+    assert len(set(mi.tolist())) < len(mi)      # dups present
+    ref = _per_tick_ref(c1, m1, mi, ts, is_left, vals)
+    out, errors = c2.dispatch_block(
+        is_left, [m2[j] for j in mi], "ticks", ts, values=vals)
+    _assert_block_matches(out, errors, ref, is_left)
+    assert c1.acked_total == c2.acked_total
+    for a, b in zip(m1, m2):
+        assert a.acked == b.acked
+
+
+def test_block_side_strings_and_scalar_series():
+    c1, m1 = _mk(n=4, slots=4)
+    c2, m2 = _mk(n=4, slots=4)
+    ts = np.arange(4, dtype=np.int64) * 100 + 100
+    vals = {"px": np.float32([1, 2, 3, 4]),
+            "qty": np.float32([5, 6, 7, 8])}
+    ref = _per_tick_ref(c1, m1, np.arange(4), ts,
+                        np.zeros(4, bool), vals)
+    out, errors = c2.dispatch_block("right", m2, "ticks", ts,
+                                    values=vals)
+    _assert_block_matches(out, errors, ref, np.zeros(4, bool))
+    # per-tick side strings also accepted
+    out, errors = c2.dispatch_block(
+        np.array(["left"] * 4), m2, "ticks", ts + 1000)
+    assert not errors and bool(out["px_found"].all())
+
+
+def test_block_late_ticks_error_per_index():
+    """A late tick is rejected per index with the per-tick path's
+    LateTickError; the rest of the block lands, and the watermark
+    state afterwards matches the per-tick twin's."""
+    c1, m1 = _mk(n=8, slots=8)
+    c2, m2 = _mk(n=8, slots=8)
+    ts = np.full(8, 1_000, np.int64)
+    vals = {"px": np.ones(8, np.float32), "qty": np.ones(8, np.float32)}
+    for c, m in ((c1, m1), (c2, m2)):
+        c.dispatch("right", [(m[3], "ticks", 5_000, None,
+                              {"px": 0.0, "qty": 0.0})])
+    ref = _per_tick_ref(c1, m1, np.arange(8), ts, np.zeros(8, bool),
+                        vals)
+    assert isinstance(ref[3], LateTickError)
+    out, errors = c2.dispatch_block("right", m2, "ticks", ts,
+                                    values=vals)
+    assert set(errors) == {3} and isinstance(errors[3], LateTickError)
+    assert np.isnan(out["px_ema"][3]) and not np.isnan(out["px_ema"][0])
+    _assert_block_matches(out, errors, ref, np.zeros(8, bool))
+    # late queries too
+    out, errors = c2.dispatch_block("left", m2, "ticks", ts + 1)
+    assert set(errors) == {3}
+    assert not out["px_found"][3] and out["px_found"][0]
+
+
+def test_block_unknown_series_and_foreign_member():
+    c, m = _mk(n=2, slots=2)
+    out, errors = c.dispatch_block(
+        "left", [m[0], m[1]], ["ticks", "nope"],
+        np.array([10, 10], np.int64))
+    assert set(errors) == {1} and "unknown series" in str(errors[1])
+    assert 0 not in errors
+    other, om = _mk(n=1, slots=2)
+    with pytest.raises(ValueError, match="different cohort"):
+        c.dispatch_block("left", [om[0]], "ticks",
+                         np.array([20], np.int64))
+
+
+def test_block_validation_errors():
+    c, m = _mk(n=2, slots=2)
+    with pytest.raises(ValueError, match="parallel arrays"):
+        c.dispatch_block("left", m, "ticks", np.array([1], np.int64))
+    with pytest.raises(ValueError, match="'right' or 'left'"):
+        c.dispatch_block("up", m, "ticks", np.array([1, 2], np.int64))
+    with pytest.raises(ValueError, match="no values"):
+        c.dispatch_block("right", m, "ticks", np.array([1, 2], np.int64))
+    with pytest.raises(ValueError, match="missing value column"):
+        c.dispatch_block("right", m, "ticks", np.array([1, 2], np.int64),
+                         values={"px": np.ones(2, np.float32)})
+    assert c.dispatch_block("left", [], "ticks",
+                            np.array([], np.int64)) == ({}, {})
+
+
+# ----------------------------------------------------------------------
+# Fallback routes: spill tier, mesh — whole-block per-tick, bitwise
+# ----------------------------------------------------------------------
+
+def test_block_spill_dir_falls_back_bitwise(tmp_path):
+    c1, m1 = _mk(n=6, slots=8)
+    c2, m2 = _mk(n=6, slots=8, spill_dir=str(tmp_path / "spill"))
+    rng = np.random.default_rng(2)
+    mi, ts, is_left, vals = _gen_block(rng, 24, 6)
+    ref = _per_tick_ref(c1, m1, mi, ts, is_left, vals)
+    d0 = profiling.plan_cache_stats()["builds"]
+    out, errors = c2.dispatch_block(
+        is_left, [m2[j] for j in mi], "ticks", ts, values=vals)
+    _assert_block_matches(out, errors, ref, is_left)
+    # the per-tick ladder served it: no block programs were built
+    assert not any(k[0].startswith("block_")
+                   for g in c2._groups.values() for k in g._exes), \
+        "tiered cohort must not take the device block path"
+
+
+def test_block_meshed_falls_back_bitwise():
+    mesh = dist.stream_mesh()
+    c1, m1 = _mk(n=4, slots=4)
+    c2, m2 = _mk(n=4, slots=4, mesh=mesh)
+    rng = np.random.default_rng(3)
+    mi, ts, is_left, vals = _gen_block(rng, 16, 4)
+    ref = _per_tick_ref(c1, m1, mi, ts, is_left, vals)
+    out, errors = c2.dispatch_block(
+        is_left, [m2[j] for j in mi], "ticks", ts, values=vals)
+    _assert_block_matches(out, errors, ref, is_left)
+
+
+# ----------------------------------------------------------------------
+# Warmup ladder + zero recompiles
+# ----------------------------------------------------------------------
+
+def test_block_zero_recompiles_after_warmup():
+    c, m = _mk()
+    built = c.warmup(8, max_block=64)
+    # per-series ladder (one shape: 8) + block ladder (8,16,32,64)
+    assert built == 1 + 4
+    rng = np.random.default_rng(4)
+    b0 = profiling.plan_cache_stats()["builds"]
+    for rnd in range(3):
+        perm = rng.permutation(S)
+        ts = (100_000 * (rnd + 1) +
+              np.sort(rng.integers(0, 9_000, S)).astype(np.int64))
+        is_left = rng.random(S) < 0.5
+        vals = {"px": rng.standard_normal(S).astype(np.float32),
+                "qty": rng.standard_normal(S).astype(np.float32)}
+        out, errors = c.dispatch_block(
+            is_left, [m[j] for j in perm], "ticks", ts, values=vals)
+        assert not errors
+    assert profiling.plan_cache_stats()["builds"] == b0, \
+        "block dispatch recompiled after warmup(max_block)"
+
+
+# ----------------------------------------------------------------------
+# Executor: submit_block, barrier ordering, quarantine, supervision
+# ----------------------------------------------------------------------
+
+def test_executor_submit_block_end_to_end():
+    c, m = _mk()
+    c.warmup(8, max_block=32)
+    with CohortExecutor(c, coalesce_s=0.001) as ex:
+        t1 = ex.submit(m[0], "right", "ticks", 100,
+                       values={"px": 1.0, "qty": 2.0})
+        ts = np.arange(200, 200 + S, dtype=np.int64)
+        is_left = (np.arange(S) % 3) == 0
+        vals = {"px": np.ones(S, np.float32),
+                "qty": np.ones(S, np.float32)}
+        bt = ex.submit_block(is_left, m, "ticks", ts, values=vals)
+        t2 = ex.submit(m[0], "left", "ticks", 300)
+        assert isinstance(bt, BlockTicket)
+        out = bt.result(timeout=60)
+        assert not bt.errors
+        assert out["px_ema"].shape == (S,)
+        r1 = t1.result(60)
+        r2 = t2.result(60)
+        # the block is a barrier: m[0]'s ts=100 push landed before its
+        # block tick at ts=200, the ts=300 query after — all admitted
+        assert not np.isnan(r1["px_ema"])
+        assert bool(r2["px_found"]) and float(r2["px"]) == 1.0
+        assert ex.ticks == 2 + S
+        assert ex.latency_stats()["all"]["count"] == 2 + S
+
+
+def test_executor_block_per_index_errors_and_quarantine():
+    """A member quarantined by repeated failures gets its block ticks
+    rejected per index with QuarantinedError while the rest of the
+    block lands; after the cooldown the block's probe traffic closes
+    the breaker again."""
+    c, m = _mk(n=4, slots=4)
+    breaker = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    with CohortExecutor(c, coalesce_s=0.0, breaker=breaker) as ex:
+        for _ in range(2):      # trip u3 via unknown-series failures
+            t = ex.submit(m[3], "right", "nope", 1,
+                          values={"px": 0.0, "qty": 0.0})
+            with pytest.raises(ValueError, match="unknown series"):
+                t.result(60)
+        assert breaker.trips == 1
+        ts = np.array([10, 11, 12, 13], np.int64)
+        vals = {"px": np.ones(4, np.float32),
+                "qty": np.ones(4, np.float32)}
+        bt = ex.submit_block("right", m, "ticks", ts, values=vals)
+        out = bt.result(60)
+        assert set(bt.errors) == {3}
+        assert isinstance(bt.errors[3], QuarantinedError)
+        assert not np.isnan(out["px_ema"][0])
+        assert np.isnan(out["px_ema"][3])       # fill value kept
+        import time as _t
+        _t.sleep(0.06)
+        bt = ex.submit_block("right", m, "ticks", ts + 100, values=vals)
+        assert bt.result(60) is not None and not bt.errors, bt.errors
+        bt = ex.submit_block("right", m, "ticks", ts + 200, values=vals)
+        assert not bt.result(60) is None and not bt.errors
+
+
+def test_executor_block_level_failure_and_plane_death(monkeypatch):
+    c, m = _mk(n=2, slots=2)
+    with CohortExecutor(c, coalesce_s=0.0) as ex:
+        monkeypatch.setattr(
+            c, "dispatch_block",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+        bt = ex.submit_block("left", m, "ticks",
+                             np.array([1, 2], np.int64))
+        with pytest.raises(RuntimeError, match="boom"):
+            bt.result(60)
+    c2, m2 = _mk(n=2, slots=2)
+    ex = CohortExecutor(c2, coalesce_s=0.0)
+    monkeypatch.setattr(
+        c2, "dispatch_block",
+        lambda *a, **k: (_ for _ in ()).throw(
+            faults.SimulatedKill("die")))
+    bt = ex.submit_block("left", m2, "ticks", np.array([1, 2], np.int64))
+    with pytest.raises(ShutdownError):
+        bt.result(60)
+    assert ex.fatal is not None
+    ex.close()
+
+
+def test_executor_coalesce_knob_default(monkeypatch):
+    c, _ = _mk(n=1, slots=2)
+    monkeypatch.setenv("TEMPO_TPU_SERVE_COALESCE_S", "0.0075")
+    with CohortExecutor(c) as ex:
+        assert ex.coalesce_s == pytest.approx(0.0075)
+    monkeypatch.delenv("TEMPO_TPU_SERVE_COALESCE_S")
+    with CohortExecutor(c) as ex:
+        assert ex.coalesce_s == pytest.approx(0.002)
+    with CohortExecutor(c, coalesce_s=0.0) as ex:
+        assert ex.coalesce_s == 0.0

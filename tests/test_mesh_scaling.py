@@ -187,7 +187,9 @@ def test_reshard_sink_blocked_by_ema_stays_bitwise(plan_toggle):
 def test_reshard_sinks_through_series_local_ops(plan_toggle):
     """join -> stats -> resample: resample is itself series-local, so
     the pending reshard-back sinks through it and the whole chain runs
-    in ONE series-local region (a single placed reshard)."""
+    in ONE series-local region (a single placed reshard).  The stats
+    -> resample run then stitches into one program; the resample's
+    reshard-elimination record must survive on the stitched node."""
     lt, rt = make_frames(seed=4)
 
     def fn():
@@ -202,7 +204,11 @@ def test_reshard_sinks_through_series_local_ops(plan_toggle):
     opt = _optimized(fn())
     placed = _reshard_nodes(opt)
     assert len(placed) == 1
-    rs = [n for n in opt.walk() if n.op == "resample"][0]
+    rs = [n for n in opt.walk()
+          if n.op == "resample"
+          or (n.op == "stitched"
+              and any(op == "resample"
+                      for op, _ in n.param("stages")))][0]
     assert "reshard_eliminated" in rs.ann
 
     plan_toggle(False)

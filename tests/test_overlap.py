@@ -1,0 +1,215 @@
+"""Slab pipelining (io/ingest.py sweep_slabs + the pipelined
+from_parquet shard loop, TEMPO_TPU_INGEST_RING).
+
+The contracts: the pipelined sweep is BITWISE-identical to the serial
+loop (the main thread consumes slabs strictly in order); stage overlap
+is real (wall time approaches max(load, compute, drain) per slab, not
+the sum); the first failure from any stage re-raises in the caller
+with the pipeline cleanly drained; donated slab buffers are either
+refused by the backend or still hold clean bits (never silently
+recycled into wrong results); and a kill mid-slab under ``resume_dir``
+commits in shard order so the resume re-streams only uncommitted
+shards, bitwise equal to a fresh serial ingest.
+"""
+
+import glob
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tempo_tpu.io import ingest
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.testing import chaos, faults
+
+N_ROWS = 12_000
+N_KEYS = 24
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("overlap") / "ds")
+    chaos.make_parquet_dataset(d, n_rows=N_ROWS, n_keys=N_KEYS, seed=5,
+                               n_files=4)
+    return d
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"series": 8})
+
+
+KW = dict(ts_col="event_ts", partition_cols=["symbol"],
+          batch_rows=2048)
+
+
+def _srt(frame):
+    return frame.collect().df.sort_values(
+        ["symbol", "event_ts"], kind="stable").reset_index(drop=True)
+
+
+# ----------------------------------------------------------------------
+# sweep_slabs: ordering, bitwise identity, overlap, failure drain
+# ----------------------------------------------------------------------
+
+def test_sweep_matches_serial_and_preserves_order():
+    rng = np.random.default_rng(0)
+    slabs = [rng.standard_normal(64) for _ in range(9)]
+    trace = []
+
+    def load(i):
+        time.sleep(float(rng.uniform(0, 0.004)))
+        return slabs[i] * 2.0
+
+    def compute(i, x):
+        trace.append(i)
+        return x + 1.0
+
+    def drain(i, y):
+        time.sleep(float(rng.uniform(0, 0.004)))
+        return y.sum()
+
+    serial = ingest.sweep_slabs(9, load, compute, drain, ring=1)
+    trace.clear()
+    piped = ingest.sweep_slabs(9, load, compute, drain, ring=4)
+    assert trace == list(range(9)), "compute ran out of slab order"
+    assert piped == serial              # float-exact: same data flow
+
+
+def test_sweep_overlaps_stages():
+    n, dt = 6, 0.03
+
+    def stage(i, *_):
+        time.sleep(dt)
+        return i
+
+    t0 = time.perf_counter()
+    ingest.sweep_slabs(n, stage, stage, stage, ring=1)
+    serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    ingest.sweep_slabs(n, stage, stage, stage, ring=3)
+    piped = time.perf_counter() - t0
+    # ideal: 3*n*dt serial vs (n+2)*dt pipelined; generous CI margin
+    assert piped < 0.75 * serial, (
+        f"no overlap: pipelined {piped:.3f}s vs serial {serial:.3f}s")
+
+
+@pytest.mark.parametrize("stage", ["load", "compute", "drain"])
+def test_sweep_first_failure_reraises(stage):
+    class Boom(RuntimeError):
+        pass
+
+    def maybe(name, i):
+        if name == stage and i == 3:
+            raise Boom(f"{name} died at slab {i}")
+        return i
+
+    with pytest.raises(Boom, match=f"{stage} died at slab 3"):
+        ingest.sweep_slabs(
+            6, lambda i: maybe("load", i),
+            lambda i, x: maybe("compute", i),
+            lambda i, y: maybe("drain", i), ring=3)
+
+
+def test_sweep_serial_fallbacks():
+    calls = []
+    out = ingest.sweep_slabs(
+        3, lambda i: i, lambda i, x: calls.append(i) or x * 10, None,
+        ring=1)
+    assert out == [0, 10, 20] and calls == [0, 1, 2]
+    assert ingest.sweep_slabs(0, None, None) == []
+    assert ingest.sweep_slabs(
+        1, lambda i: 5, lambda i, x: x + 1, ring=8) == [6]
+
+
+def test_sweep_ring_knob_default(monkeypatch):
+    """ring=None reads TEMPO_TPU_INGEST_RING; 1 forces the serial
+    path (no threads — compute interleaves with load 1:1)."""
+    monkeypatch.setenv("TEMPO_TPU_INGEST_RING", "1")
+    order = []
+    ingest.sweep_slabs(3, lambda i: order.append(("L", i)),
+                       lambda i, x: order.append(("C", i)))
+    assert order == [("L", 0), ("C", 0), ("L", 1), ("C", 1),
+                     ("L", 2), ("C", 2)]
+
+
+# ----------------------------------------------------------------------
+# Donation safety (chaos): poisoned returned-then-donated buffers
+# ----------------------------------------------------------------------
+
+def test_donated_slab_buffers_refused_or_bitwise():
+    """compute donates its input slab buffer.  The pipeline must hand
+    back clean results, and the donated inputs must afterwards be
+    either REFUSED by the backend (deleted buffer) or still hold their
+    original bits — a donated buffer silently recycled into another
+    live slab would corrupt results undetectably."""
+    step = jax.jit(lambda x: x * 2.0 + 1.0, donate_argnums=(0,))
+    slabs = [np.arange(100, dtype=np.float64) + 17 * i
+             for i in range(6)]
+    donated = []
+
+    def load(i):
+        a = jax.device_put(jnp.asarray(slabs[i]))
+        donated.append(a)
+        return a
+
+    out = ingest.sweep_slabs(
+        6, load, lambda i, x: step(x), lambda i, y: np.asarray(y),
+        ring=3)
+    for i, got in enumerate(out):
+        np.testing.assert_array_equal(got, slabs[i] * 2.0 + 1.0)
+    for i, a in enumerate(donated):
+        try:
+            back = np.asarray(a)        # poison probe
+        except RuntimeError:
+            continue                    # refused: donated buffer dead
+        np.testing.assert_array_equal(back, slabs[i])
+
+
+# ----------------------------------------------------------------------
+# Pipelined from_parquet: bitwise vs serial, kill-mid-slab resume
+# ----------------------------------------------------------------------
+
+def test_pipelined_ingest_bitwise_equals_serial(dataset, mesh):
+    serial = ingest.from_parquet(dataset, mesh=mesh, ring=1, **KW)
+    piped = ingest.from_parquet(dataset, mesh=mesh, ring=4, **KW)
+    pd.testing.assert_frame_equal(_srt(piped), _srt(serial),
+                                  check_exact=True)
+    np.testing.assert_array_equal(np.asarray(piped.ts),
+                                  np.asarray(serial.ts))
+    np.testing.assert_array_equal(np.asarray(piped.mask),
+                                  np.asarray(serial.mask))
+
+
+def test_kill_mid_slab_resume_pipelined(dataset, mesh, tmp_path):
+    """Kill the producer mid-stream under ring=4: every shard the main
+    thread already placed is committed IN SHARD ORDER (no gaps), and
+    the resumed pipelined ingest re-streams only the uncommitted tail,
+    bitwise equal to a fresh serial ingest."""
+    rd = str(tmp_path / "resume")
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(ingest, "_stream_shard", call_no=4)
+        with pytest.raises(faults.SimulatedKill):
+            ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                ring=4, **KW)
+    committed = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(rd, "shard_*.json")))
+    assert committed == [f"shard_{i:04d}.json" for i in
+                         range(len(committed))], (
+        f"commit order has gaps: {committed}")
+    assert len(committed) == 3          # shards 0-2 streamed before the kill
+    with faults.FaultInjector() as fi:
+        fi.flaky(ingest, "_stream_shard", failures=0)    # call counter
+        frame = ingest.from_parquet(dataset, mesh=mesh, resume_dir=rd,
+                                    ring=4, **KW)
+        assert len(fi.records) == 8 - len(committed), (
+            "resume re-streamed committed shards")
+    fresh = ingest.from_parquet(dataset, mesh=mesh, ring=1, **KW)
+    pd.testing.assert_frame_equal(_srt(frame), _srt(fresh),
+                                  check_exact=True)

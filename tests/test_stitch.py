@@ -1,0 +1,343 @@
+"""Whole-chain program stitching (tempo_tpu/plan/stitch.py + the
+optimizer's ``_stitch_chains`` pass).
+
+The contracts: a maximal single-consumer run of adjacent series-local
+planned ops executes as ONE jitted dispatch, BIT-IDENTICAL to the
+op-by-op chain (``jax.lax.optimization_barrier`` pins every op
+boundary); ``explain()`` renders the stitch group; the
+``TEMPO_TPU_STITCH_MAX_OPS`` knob caps/disables the pass; stitched
+plans re-key the executable cache (signature change, MIGRATION.md); a
+refused chain falls back to the op-by-op replay with the eager results
+AND the eager error messages; and PR-14 checkpoint barriers resume a
+stitched chain re-running only whole post-barrier stitch groups with
+zero new executable builds.
+"""
+
+import logging
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tempo_tpu  # noqa: F401  (jax config side effects)
+import jax
+
+from tempo_tpu import TSDF, checkpoint, profiling
+from tempo_tpu.parallel import make_mesh
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import checkpoints as plan_ckpt
+from tempo_tpu.plan import ir, optimizer, stitch
+from tempo_tpu.service import lazy_frame
+from tempo_tpu.testing import faults
+
+K, L = 3, 48
+
+
+def make_frame(seed=0, rows=L):
+    rng = np.random.default_rng(seed)
+    secs = np.cumsum(rng.integers(1, 3, size=(K, rows)).astype(np.int64),
+                     axis=-1)
+    syms = np.repeat([f"s{i}" for i in range(K)], rows)
+    x = rng.standard_normal(K * rows)
+    y = rng.standard_normal(K * rows)
+    df = pd.DataFrame({"sym": syms, "event_ts": secs.ravel(),
+                       "x": x, "y": y})
+    return TSDF(df, "event_ts", ["sym"])
+
+
+def mesh_frame(seed=0, rows=L, shards=1):
+    return make_frame(seed, rows).on_mesh(make_mesh({"series": shards}))
+
+
+@pytest.fixture
+def plan_on(monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    plan_cache.CACHE.clear()
+    yield
+    plan_cache.CACHE.clear()
+
+
+def _stitched_nodes(root):
+    return [n for n in optimizer.optimize(root).walk()
+            if n.op == "stitched"]
+
+
+# ----------------------------------------------------------------------
+# Bitwise planned == eager across the stitched-chain matrix
+# ----------------------------------------------------------------------
+
+CHAINS = {
+    "resample_interp": lambda d: d.resample("5 seconds", "mean")
+    .interpolate(method="linear"),
+    "resample_interp_flags": lambda d: d.resample("5 seconds", "mean")
+    .interpolate(method="ffill", show_interpolated=True),
+    "interp_ema": lambda d: d.interpolate(
+        freq="5 seconds", func="mean", method="linear").EMA("x", window=6),
+    "ema_stats": lambda d: d.EMA("x", window=6)
+    .withRangeStats(colsToSummarize=["x", "y"], rangeBackWindowSecs=10),
+    "ema_ema_stats": lambda d: d.EMA("x", window=4).EMA("y", window=6)
+    .withRangeStats(colsToSummarize=["EMA_x", "EMA_y"],
+                    rangeBackWindowSecs=12),
+    "resample_ema_stats": lambda d: d.resample("5 seconds", "mean")
+    .EMA("x", window=6)
+    .withRangeStats(colsToSummarize=["x"], rangeBackWindowSecs=20),
+    "bars_interp": lambda d: d.calc_bars("5 seconds", metricCols=["x"])
+    .interpolate(method="ffill"),
+    "bars_fill_singleton": lambda d: d.calc_bars(
+        "5 seconds", metricCols=["x", "y"], fill=True),
+}
+
+
+# the bars variants are the two slowest compiles of the matrix; they
+# ride the per-commit overlap gate (tools/run_checks.sh runs this file
+# without the slow filter) instead of tier-1
+@pytest.mark.parametrize("name", [
+    pytest.param(n, marks=pytest.mark.slow)
+    if n in ("bars_interp", "bars_fill_singleton") else n
+    for n in sorted(CHAINS)])
+def test_stitched_matches_eager_bitwise(plan_on, name, monkeypatch):
+    fn = CHAINS[name]
+    monkeypatch.delenv("TEMPO_TPU_PLAN", raising=False)
+    eager = fn(mesh_frame()).collect().df
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    lz = fn(mesh_frame())
+    opt = optimizer.optimize(lz.plan)
+    stitched = [n for n in opt.walk() if n.op == "stitched"]
+    device_ops = [n for n in lz.plan.walk()
+                  if n.op in stitch.STITCHABLE_OPS]
+    if len(device_ops) >= 2:
+        assert stitched, f"{name}: no stitched group"
+        assert sum(n.param("n_ops") for n in stitched) == len(device_ops)
+    else:
+        assert not stitched       # singletons never stitch
+    planned = fn(mesh_frame()).collect().df
+    pd.testing.assert_frame_equal(planned, eager, check_exact=True)
+
+
+def test_nbbo_session_pipeline_stitches(plan_on, monkeypatch):
+    """The acceptance pipeline: calc_bars -> interpolate -> lookback
+    tensor.  The two device ops stitch into one dispatch; the lookback
+    collect barrier stays outside the group; bitwise vs eager."""
+    def fn(d):
+        return (d.calc_bars("5 seconds", metricCols=["x", "y"])
+                .interpolate(method="ffill")
+                .withLookbackFeatures(["close_x", "close_y"], 4))
+
+    monkeypatch.delenv("TEMPO_TPU_PLAN", raising=False)
+    eager = fn(mesh_frame())           # lookback collects to a host df
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    lz = fn(mesh_frame())
+    stitched = _stitched_nodes(lz.plan)
+    assert len(stitched) == 1
+    assert [op for op, _ in stitched[0].param("stages")] == [
+        "calc_bars", "interpolate"]
+    # .copy() is not a recorded op: the wrapper materialises the chain
+    # and delegates to the eager result (the lookback DataFrame)
+    planned = lz.copy()
+    pd.testing.assert_frame_equal(planned, eager, check_exact=True)
+
+
+# ----------------------------------------------------------------------
+# explain() rendering + knob
+# ----------------------------------------------------------------------
+
+def test_explain_renders_stitch_group(plan_on):
+    lz = CHAINS["resample_ema_stats"](mesh_frame())
+    txt = lz.explain()
+    assert "stitched[resample -> ema -> range_stats]" in txt
+    assert "3 ops -> 1 dispatch" in txt
+    assert "optimization_barrier" in txt
+
+
+def test_knob_disables_stitching_bitwise(plan_on, monkeypatch):
+    fn = CHAINS["resample_ema_stats"]
+    want = fn(mesh_frame()).collect().df
+    monkeypatch.setenv("TEMPO_TPU_STITCH_MAX_OPS", "1")
+    plan_cache.CACHE.clear()
+    lz = fn(mesh_frame())
+    assert not _stitched_nodes(lz.plan)
+    got = lz.collect().df
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_knob_caps_chain_length(plan_on, monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_STITCH_MAX_OPS", "2")
+    plan_cache.CACHE.clear()
+    lz = CHAINS["resample_ema_stats"](mesh_frame())
+    opt = optimizer.optimize(lz.plan)
+    stitched = [n for n in opt.walk() if n.op == "stitched"]
+    assert [n.param("n_ops") for n in stitched] == [2]
+    # the op the cap left out still executes unstitched
+    left_out = [n.op for n in opt.walk()
+                if n.op in stitch.STITCHABLE_OPS]
+    assert len(left_out) == 1
+
+
+def test_stitched_signature_rekeys_cache(plan_on, monkeypatch):
+    """MIGRATION.md contract: enabling stitching changes the optimized
+    plan signature, so a cached unstitched executable re-plans instead
+    of replaying."""
+    lz = CHAINS["ema_stats"](mesh_frame())
+    sig_stitched = ir.signature(optimizer.optimize(lz.plan))
+    monkeypatch.setenv("TEMPO_TPU_STITCH_MAX_OPS", "0")
+    sig_plain = ir.signature(optimizer.optimize(lz.plan))
+    assert sig_stitched != sig_plain
+
+
+# ----------------------------------------------------------------------
+# Dispatch/compile accounting
+# ----------------------------------------------------------------------
+
+def _count_compiles(run):
+    compiles = []
+
+    class Trap(logging.Handler):
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                compiles.append(record.getMessage())
+
+    trap = Trap()
+    names = ("jax._src.dispatch", "jax._src.interpreters.pxla",
+             "jax._src.pjit", "jax._src.compiler")
+    loggers = [logging.getLogger(n) for n in names]
+    jax.config.update("jax_log_compiles", True)
+    for lg in loggers:
+        lg.addHandler(trap)
+    try:
+        run()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        for lg in loggers:
+            lg.removeHandler(trap)
+    return len(compiles)
+
+
+@pytest.mark.slow       # compile-heavy; runs in the overlap gate
+def test_fewer_dispatch_programs_than_ops(plan_on, monkeypatch):
+    """The K-op chain lowers to ONE compiled program where the op-by-op
+    chain compiles one per op (unique shapes so nothing is pre-cached)."""
+    fn = CHAINS["resample_ema_stats"]
+    rows_a, rows_b = L + 24, L + 32          # unique, uncached shapes
+    stitched = _count_compiles(
+        lambda: fn(mesh_frame(rows=rows_a)).collect())
+    monkeypatch.setenv("TEMPO_TPU_STITCH_MAX_OPS", "0")
+    plan_cache.CACHE.clear()
+    unstitched = _count_compiles(
+        lambda: fn(mesh_frame(rows=rows_b)).collect())
+    if stitched == 0 and unstitched == 0:
+        pytest.skip("jax_log_compiles emitted nothing in this "
+                    "environment — compile counting unavailable")
+    assert stitched < unstitched, (
+        f"stitched chain compiled {stitched} programs vs "
+        f"{unstitched} op-by-op")
+
+
+def test_second_run_is_compile_free(plan_on):
+    fn = CHAINS["ema_stats"]
+    rows = L + 40                             # unique shape
+    first = _count_compiles(lambda: fn(mesh_frame(rows=rows)).collect())
+    second = _count_compiles(lambda: fn(mesh_frame(rows=rows)).collect())
+    if first == 0:
+        pytest.skip("jax_log_compiles emitted nothing in this "
+                    "environment — compile counting unavailable")
+    assert second == 0, "second stitched run recompiled"
+
+
+# ----------------------------------------------------------------------
+# Refusal -> op-by-op fallback
+# ----------------------------------------------------------------------
+
+def test_refused_chain_falls_back_bitwise(plan_on, monkeypatch):
+    fn = CHAINS["resample_ema_stats"]
+    want = fn(mesh_frame()).collect().df
+    monkeypatch.setattr(stitch, "_plan", lambda *a, **k: (
+        (_ for _ in ()).throw(stitch._Refuse("forced"))))
+    plan_cache.CACHE.clear()
+    got = fn(mesh_frame()).collect().df
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def test_fallback_surfaces_eager_error(plan_on):
+    """A bad argument inside a stitched chain is refused at plan time
+    and the op-by-op replay raises the eager method's exact error."""
+    lz = (mesh_frame().resample("5 seconds", "mean")
+          .interpolate(method="cubic"))
+    assert _stitched_nodes(lz.plan)
+    with pytest.raises(ValueError, match="fill options"):
+        lz.collect()
+
+
+def test_untouched_column_rides_by_reference():
+    """A column the chain never rewrites keeps the ORIGINAL DistCol
+    object through the stitched program (eager's dict(self.cols))."""
+    frame = mesh_frame()
+    node = ir.Node("stitched", params=dict(
+        stages=(("ema", (("colName", "x"), ("exact", False),
+                         ("exp_factor", 0.2),
+                         ("inclusive_window", False), ("window", 6))),),
+        n_ops=1))
+    out = stitch.run(frame, node)
+    assert out is not None
+    assert out.cols["y"] is frame.cols["y"]
+    assert out.cols["x"] is frame.cols["x"]
+    assert "EMA_x" in out.cols
+
+
+# ----------------------------------------------------------------------
+# Checkpoint barriers inside a stitched chain (PR-14 interaction)
+# ----------------------------------------------------------------------
+
+def _ckpt_chain(frame):
+    return (lazy_frame(frame).resample("5 seconds", "mean")
+            .EMA("x", window=6)
+            .withRangeStats(colsToSummarize=["x"], rangeBackWindowSecs=20)
+            .EMA("y", window=4))
+
+
+def test_checkpoint_barriers_split_stitch_groups(tmp_path):
+    """Barriers placed before the stitch pass are chain boundaries: a
+    4-op chain under every=2 checkpointing becomes two 2-op stitch
+    groups with a barrier between them."""
+    frame = mesh_frame(seed=7)
+    with plan_ckpt.checkpointed(str(tmp_path), every=2):
+        root = ir.Node("collect", inputs=(_ckpt_chain(frame)._node,))
+        opt = optimizer.optimize(root)
+    stitched = [n for n in opt.walk() if n.op == "stitched"]
+    assert [n.param("n_ops") for n in stitched] == [2, 2]
+    assert len([n for n in opt.walk() if n.op == "checkpoint"]) == 2
+
+
+def test_resume_reruns_only_post_barrier_stitch_group(tmp_path):
+    """Kill while saving the terminal barrier; the resumed run restores
+    barrier 1 and re-runs ONLY the post-barrier stitch group — one
+    stitched dispatch, zero new executable builds, bitwise output."""
+    frame = mesh_frame(seed=8)
+    d = str(tmp_path / "ck")
+    want = _ckpt_chain(frame).collect().df
+
+    with faults.FaultInjector() as fi:
+        fi.kill_on_call(np, "savez", call_no=2)
+        with pytest.raises(faults.SimulatedKill):
+            with plan_ckpt.checkpointed(d, every=2):
+                _ckpt_chain(frame).collect()
+    assert checkpoint.latest(d).endswith("step_00001")
+
+    builds0 = profiling.plan_cache_stats()["builds"]
+    calls = []
+    orig = stitch.run
+
+    def counting_run(fr, node):
+        calls.append([op for op, _ in node.param("stages")])
+        return orig(fr, node)
+
+    stitch.run = counting_run
+    try:
+        with plan_ckpt.checkpointed(d, every=2):
+            got = _ckpt_chain(frame).collect().df
+    finally:
+        stitch.run = orig
+    assert calls == [["range_stats", "ema"]], (
+        f"resume re-ran {calls}, wanted only the post-barrier group")
+    assert profiling.plan_cache_stats()["builds"] == builds0, (
+        "resume rebuilt an executable")
+    pd.testing.assert_frame_equal(got, want, check_exact=True)

@@ -84,6 +84,41 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_store.py \
     -m 'not slow' -p no:cacheprovider -p no:xdist -p no:randomly \
     || exit $?
 
+echo "== overlap smoke (slab pipeline bitwise + stitch/block suites) =="
+# the PR 17 dispatch-floor planes, surfaced before tier-1: a tiny
+# two-slab pipelined sweep_slabs run must be bit-identical to its
+# serial twin (exit nonzero on mismatch), then the overlap, stitching
+# and block-dispatch contract suites
+JAX_PLATFORMS=cpu python - <<'EOF' || exit $?
+import sys
+import numpy as np
+from tempo_tpu.io import ingest
+
+def load(i):
+    rng = np.random.default_rng(40 + i)
+    return rng.standard_normal(4096).astype(np.float32)
+
+def compute(i, x):
+    return np.cumsum(x, dtype=np.float64)
+
+def drain(i, y):
+    return y.tobytes()
+
+serial = ingest.sweep_slabs(2, load, compute, drain, ring=1)
+piped = ingest.sweep_slabs(2, load, compute, drain, ring=4)
+if piped != serial:
+    sys.exit("overlap smoke: pipelined slab sweep diverged bitwise "
+             "from the serial twin")
+print("overlap smoke: 2-slab pipelined == serial bitwise")
+EOF
+# no slow filter here: the bars-chain bitwise variants and the
+# dispatch-count contract are marked slow for tier-1 wall budget but
+# must still run per-commit — this gate is where they live
+JAX_PLATFORMS=cpu python -m pytest tests/test_overlap.py \
+    tests/test_stitch.py tests/test_block_dispatch.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
