@@ -3341,6 +3341,156 @@ def bench_query_service(seed=13):
     }
 
 
+def bench_sql(seed=19):
+    """Config 19 (--only-sql): SQL text through the query service's
+    front door (PR 18 — plan/sql_compile.py).
+
+    Three statements (filter, projection arithmetic + WHERE, AS-OF
+    JOIN + WHERE) compile through the planner and round-trip through
+    :meth:`QueryService.submit_sql`.  Hard in-bench invariants:
+
+    * **bitwise** — every SQL answer equals its planned method-chain
+      twin AND the eager pandas oracle (assert_frame_equal
+      check_exact);
+    * **zero recompiles at steady state** — after one warmup per
+      statement the plan cache's builds counter stays flat across the
+      measured phase (text in -> cached sharded executable out);
+    * **the explain() seam** — the compiled statement's plan renders
+      ``sql_filter`` / ``sql_project`` nodes with their
+      ``eval[sql]=...`` backend annotation (the jit-plane vs
+      host-vector pick is visible before anything runs).
+
+    The record carries the SQL-through-service rate next to the
+    planned-chain and eager-host rates for the same queries — the
+    materialization barrier this PR kills is that gap.
+    """
+    import pandas as pd
+
+    from tempo_tpu import TSDF, profiling
+    from tempo_tpu.plan import cache as plan_cache
+    from tempo_tpu.plan import render, sql_compile
+    from tempo_tpu.service import QueryService, lazy_frame
+
+    rng = np.random.default_rng(seed)
+    Ks, Ls = 8, 2048
+    n_rounds = 40
+    if os.environ.get("TEMPO_BENCH_SMOKE"):
+        Ks, Ls, n_rounds = 4, 256, 6
+
+    def mk(cols, k=Ks, l=Ls):
+        secs = np.cumsum(rng.integers(1, 3, size=(k, l)), axis=-1)
+        data = {"sym": np.repeat(np.arange(k), l),
+                "event_ts": secs.ravel().astype(np.int64)}
+        for c in cols:
+            data[c] = rng.standard_normal(k * l)
+        return TSDF(pd.DataFrame(data), "event_ts", ["sym"])
+
+    trades = mk(["price", "size"])
+    quotes = mk(["bid"], l=Ls // 2)
+    tables = {"trades": trades, "quotes": quotes}
+    statements = {
+        "filter": "SELECT * FROM trades WHERE price > 0.5 "
+                  "AND size < 1.5",
+        "project": "SELECT price * 2 AS p2, price + size AS ps "
+                   "FROM trades WHERE size > -0.5",
+        "join": "SELECT * FROM trades ASOF JOIN quotes PREFIX 'q' "
+                "WHERE q_bid > 0",
+    }
+    # the planned method-chain twins (same queries, method-chain API)
+    twins = {
+        "filter": lambda: lazy_frame(trades).filter(
+            "price > 0.5 AND size < 1.5"),
+        "project": lambda: lazy_frame(trades)
+        .filter("size > -0.5")
+        .selectExpr("event_ts", "sym", "price * 2 as p2",
+                    "price + size as ps"),
+        "join": lambda: lazy_frame(trades)
+        .asofJoin(quotes, right_prefix="q").filter("q_bid > 0"),
+    }
+
+    plan_cache.CACHE.clear()
+    svc = QueryService(workers=2)
+    warm = {name: svc.submit_sql("warmup", text, tables)
+            .result(timeout=600)
+            for name, text in statements.items()}
+
+    # bitwise: SQL == planned twin == eager oracle, per statement
+    os.environ.pop("TEMPO_TPU_PLAN", None)
+    eager = {
+        "filter": trades.filter("price > 0.5 AND size < 1.5").df,
+        "project": trades.filter("size > -0.5").selectExpr(
+            "event_ts", "sym", "price * 2 as p2",
+            "price + size as ps").df,
+        "join": trades.asofJoin(quotes, right_prefix="q")
+        .filter("q_bid > 0").df,
+    }
+    for name in statements:
+        twin = svc.submit("audit", twins[name]()).result(timeout=600)
+        sql_df = warm[name].df
+        # the project statement injects the structural spine first;
+        # align column order before the bitwise compare
+        pd.testing.assert_frame_equal(
+            sql_df[twin.df.columns].reset_index(drop=True),
+            twin.df.reset_index(drop=True), check_exact=True)
+        pd.testing.assert_frame_equal(
+            sql_df[eager[name].columns].reset_index(drop=True),
+            eager[name].reset_index(drop=True), check_exact=True)
+
+    # measured phase: every statement, n_rounds times, through the
+    # service — all cache hits (warmup + twin audits above built every
+    # signature this phase will touch)
+    builds0 = profiling.plan_cache_stats()["builds"]
+    names = list(statements)
+    t0 = time.perf_counter()
+    tickets = [svc.submit_sql(f"tenant{i % 4}", statements[n], tables)
+               for i in range(n_rounds) for n in names]
+    for tk in tickets:
+        tk.result(timeout=600)
+    wall = time.perf_counter() - t0
+    st = svc.stats()
+    svc.close()
+    pc = st["plan_cache"]
+    assert pc["builds"] == builds0, (
+        f"SQL steady state recompiled: builds went {builds0} -> "
+        f"{pc['builds']} (by_signature={pc['by_signature']})")
+
+    # eager-host baseline for the same three queries
+    e0 = time.perf_counter()
+    for _ in range(max(1, n_rounds // 4)):
+        trades.filter("price > 0.5 AND size < 1.5")
+        trades.filter("size > -0.5").selectExpr(
+            "event_ts", "sym", "price * 2 as p2", "price + size as ps")
+        trades.asofJoin(quotes, right_prefix="q").filter("q_bid > 0")
+    eager_qps = 3 * max(1, n_rounds // 4) / (time.perf_counter() - e0)
+
+    # the explain() seam: compiled statements render their sql nodes
+    # and the chosen evaluation backend
+    seam = render.explain_text(
+        sql_compile.compile_statement(statements["project"], tables))
+    assert "sql_project" in seam and "sql_filter" in seam, seam
+    assert "eval[sql]=" in seam, seam
+    backend = ("jit-plane" if "eval[sql]=jit-plane" in seam
+               else "host-vector")
+
+    hit_rate = pc["hits"] / max(1, pc["hits"] + pc["misses"])
+    return {
+        "qps": round(3 * n_rounds / wall, 1),
+        "eager_qps": round(eager_qps, 1),
+        "statements": names,
+        "rows": {"trades": len(trades.df), "quotes": len(quotes.df)},
+        "cache_hit_rate": round(hit_rate, 4),
+        "plan_cache": {k: pc[k] for k in
+                       ("hits", "misses", "builds", "evictions")},
+        "zero_builds_steady_state": True,
+        "explain_seam": f"sql_project+sql_filter rendered, "
+                        f"eval[sql]={backend}",
+        "value_audit": "every SQL answer == planned method-chain twin "
+                       "== eager pandas oracle bitwise "
+                       "(assert_frame_equal check_exact) across "
+                       "filter/project/asof-join statements",
+    }
+
+
 def bench_chaos_serving(seed=15):
     """Config 15 (--only-chaos-serving): the fault-domain chaos
     campaign against live serving + query planes
@@ -3638,6 +3788,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-sql" in sys.argv:
+        res = _attempt("sql", bench_sql)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-chaos-serving" in sys.argv:
         res = _attempt("chaos_serving", bench_chaos_serving)
         if res is None:
@@ -3789,6 +3945,7 @@ def main():
                                        "fleet_serving", timeout=2400)
     query_service = _config_subprocess("--only-query-service",
                                        "query_service", timeout=2400)
+    sql_rec = _config_subprocess("--only-sql", "sql", timeout=2400)
     chaos_serving = _config_subprocess("--only-chaos-serving",
                                        "chaos_serving", timeout=2400)
     # config 16 needs a multi-device mesh for real shard-resume
@@ -3966,6 +4123,14 @@ def main():
             "18_overlap_rows_per_sec": (
                 round(overlap["ingest"]["pipelined_rows_per_sec"])
                 if overlap else None),
+            # statements/sec through QueryService.submit_sql — SQL
+            # text compiled through the planner (PR 18), plan-cache
+            # hits at steady state (zero recompiles asserted), every
+            # answer bitwise vs the planned method-chain twin and the
+            # eager pandas oracle; the record below carries the eager
+            # baseline rate and the explain() seam proof
+            "19_sql_service_qps": (
+                round(sql_rec["qps"]) if sql_rec else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -3982,6 +4147,12 @@ def main():
         # per-tenant p50/p99, the starvation audit and the
         # cost-decided (bitwise-safe) engine-flip record
         "query_service": query_service,
+        # config 19: the SQL front door — text statements through
+        # QueryService.submit_sql at planned-chain rates, zero
+        # recompiles at steady state, bitwise vs method-chain twins
+        # and the eager oracle, the explain() seam (sql nodes + the
+        # eval[sql] backend pick) rendered before execution
+        "sql": sql_rec,
         # config 15: the fault-domain chaos campaign — no hung
         # tickets, bounded recovery, zero recompiles after recovery,
         # bitwise tails vs the uninjected twin, diff-vs-full snapshot

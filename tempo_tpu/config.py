@@ -91,6 +91,11 @@ KNOBS: Dict[str, Knob] = _knobs(
     Knob("TEMPO_TPU_STRICT_SQL", "bool", "0", "tempo_tpu/frame",
          "make selectExpr/filter re-raise instead of falling back to "
          "pandas eval/query"),
+    Knob("TEMPO_TPU_SQL_STRICT", "bool", "0", "tempo_tpu/frame",
+         "strict compiled-SQL mode: any fallback from the compiled "
+         "surface to a host-pandas engine raises StrictSqlFallback by "
+         "name (supersedes the legacy TEMPO_TPU_STRICT_SQL alias; "
+         "per-call strict= wins over both)"),
     Knob("TEMPO_TPU_JOIN_ENGINE", "enum(single|chunked|bracket|bitonic)",
          None, "tempo_tpu/profiling",
          "force one AS-OF merge engine; unset = auto"),

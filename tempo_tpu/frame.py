@@ -39,12 +39,14 @@ DEFAULT_SEQ_COLNAME = "sequence_num"  # parity: scala TSDF.scala:529
 
 def _strict_sql(strict: Optional[bool]) -> bool:
     """Resolve the strict-SQL escape hatch: an explicit argument wins,
-    else the TEMPO_TPU_STRICT_SQL env default (off)."""
+    else ``TEMPO_TPU_SQL_STRICT`` (the compiled-surface knob), else the
+    legacy ``TEMPO_TPU_STRICT_SQL`` alias (both default off)."""
     if strict is not None:
         return bool(strict)
     from tempo_tpu import config
 
-    return config.get_bool("TEMPO_TPU_STRICT_SQL")
+    return (config.get_bool("TEMPO_TPU_SQL_STRICT")
+            or config.get_bool("TEMPO_TPU_STRICT_SQL"))
 
 
 def _split_alias(raw: str):
@@ -321,20 +323,43 @@ class TSDF:
             "seq_col_stub(optional) must be present"
         )
 
-    def selectExpr(self, *exprs, strict: Optional[bool] = None) -> "TSDF":  # plan-ok: eager-only
+    def selectExpr(self, *exprs, strict: Optional[bool] = None) -> "TSDF":
         """Spark-style SQL projections (parity: TSDF.scala:226-229) via
         the vectorized expression engine (``tempo_tpu.sql``): arithmetic,
         CASE WHEN, CAST, IN/BETWEEN/LIKE, and the common function
-        library, with ``expr AS alias`` naming.  Expressions the SQL
-        grammar rejects fall back to pandas ``eval`` syntax (backward
-        compat with the pre-SQL implementation, e.g. ``price ** 2``) —
-        the switch is LOGGED (the two engines differ on NULL semantics
-        and function surface), and ``strict=True`` (or
-        ``TEMPO_TPU_STRICT_SQL=1``) re-raises the ``SqlError`` instead
-        of silently changing evaluation semantics."""
-        from tempo_tpu import sql
+        library, with ``expr AS alias`` naming.  Under plan recording
+        the parsed expressions lower into a ``sql_project`` IR node
+        (plan/sql_compile.py), so text projections flow through the
+        optimizer and the executable cache like method chains do.
+        Expressions the SQL grammar rejects fall back to pandas ``eval``
+        syntax (backward compat with the pre-SQL implementation, e.g.
+        ``price ** 2``) — the switch is LOGGED (the two engines differ
+        on NULL semantics and function surface), and ``strict=True``
+        (or ``TEMPO_TPU_SQL_STRICT=1`` / the legacy
+        ``TEMPO_TPU_STRICT_SQL=1``) raises ``StrictSqlFallback``
+        instead of silently changing evaluation semantics."""
+        from tempo_tpu import plan, sql
 
         strict = _strict_sql(strict)
+        if plan.recording():
+            from tempo_tpu.plan import sql_compile
+
+            try:
+                lowered, objs = sql_compile.lower_select_exprs(
+                    exprs, columns=list(self.df.columns))
+            except sql.SqlError as e:
+                if strict:
+                    raise sql.StrictSqlFallback(
+                        f"selectExpr{tuple(exprs)!r} left the compiled "
+                        f"SQL surface ({e}); strict mode forbids the "
+                        f"host-pandas fallback") from e
+                logger.debug("selectExpr%r: outside the SQL grammar "
+                             "(%s); evaluating eagerly", tuple(exprs), e)
+            else:
+                return self._plan_record("sql_project", params=dict(
+                    exprs=lowered["exprs"], aliases=lowered["aliases"],
+                    asts=lowered["asts"], cols=lowered["cols"],
+                    strict=strict), objs=objs)
         out = {}
         for raw in exprs:
             try:
@@ -343,11 +368,14 @@ class TSDF:
                              "engine", raw)
             except sql.SqlError as e:
                 if strict:
-                    raise
+                    raise sql.StrictSqlFallback(
+                        f"selectExpr({raw!r}) left the compiled SQL "
+                        f"surface ({e}); strict mode forbids the "
+                        f"pandas-eval fallback") from e
                 logger.warning(
                     "selectExpr(%r): SQL engine rejected the expression "
                     "(%s); falling back to pandas eval semantics — pass "
-                    "strict=True (or set TEMPO_TPU_STRICT_SQL=1) to "
+                    "strict=True (or set TEMPO_TPU_SQL_STRICT=1) to "
                     "re-raise instead", raw, e)
                 split = _split_alias(raw)
                 if split is not None:
@@ -358,13 +386,38 @@ class TSDF:
                     out[raw.strip()] = self.df[raw.strip()]
         return self._with_df(pd.DataFrame(out))
 
-    def filter(self, condition, strict: Optional[bool] = None) -> "TSDF":  # plan-ok: eager-only
+    def filter(self, condition, strict: Optional[bool] = None) -> "TSDF":
         """Row filter (parity: TSDF.scala:232-238).  String predicates
-        parse as SQL (three-valued logic: NULL rows drop, like Spark),
-        falling back to pandas ``query`` syntax for backward compat —
-        logged, because the engines disagree on NULL handling, and
-        suppressed entirely by ``strict=True`` /
-        ``TEMPO_TPU_STRICT_SQL=1`` (the ``SqlError`` re-raises)."""
+        parse as SQL (three-valued logic: NULL rows drop, like Spark);
+        under plan recording they lower into a ``sql_filter`` IR node
+        (plan/sql_compile.py) that executes on the jitted plane backend
+        when the predicate's schema allows.  Non-SQL strings fall back
+        to pandas ``query`` syntax for backward compat — logged, because
+        the engines disagree on NULL handling, and turned into a
+        ``StrictSqlFallback`` error by ``strict=True`` /
+        ``TEMPO_TPU_SQL_STRICT=1`` (legacy ``TEMPO_TPU_STRICT_SQL``)."""
+        from tempo_tpu import plan
+
+        if plan.recording() and isinstance(condition, str):
+            from tempo_tpu import sql
+            from tempo_tpu.plan import sql_compile
+
+            try:
+                lowered, objs = sql_compile.lower_filter(
+                    condition, columns=list(self.df.columns))
+            except sql.SqlError as e:
+                if _strict_sql(strict):
+                    raise sql.StrictSqlFallback(
+                        f"filter({condition!r}) left the compiled SQL "
+                        f"surface ({e}); strict mode forbids the "
+                        f"host-pandas fallback") from e
+                logger.debug("filter(%r): outside the SQL grammar (%s); "
+                             "evaluating eagerly", condition, e)
+            else:
+                return self._plan_record("sql_filter", params=dict(
+                    condition=condition, ast=lowered["ast"],
+                    cols=lowered["cols"],
+                    strict=_strict_sql(strict)), objs=objs)
         if callable(condition):
             mask = condition(self.df)
         elif isinstance(condition, str):
@@ -376,11 +429,14 @@ class TSDF:
                              condition)
             except sql.SqlError as e:
                 if _strict_sql(strict):
-                    raise
+                    raise sql.StrictSqlFallback(
+                        f"filter({condition!r}) left the compiled SQL "
+                        f"surface ({e}); strict mode forbids the "
+                        f"pandas-query fallback") from e
                 logger.warning(
                     "filter(%r): SQL engine rejected the predicate "
                     "(%s); falling back to pandas query semantics — "
-                    "pass strict=True (or set TEMPO_TPU_STRICT_SQL=1) "
+                    "pass strict=True (or set TEMPO_TPU_SQL_STRICT=1) "
                     "to re-raise instead", condition, e)
                 return self._with_df(self.df.query(condition))
         else:

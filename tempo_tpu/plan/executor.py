@@ -267,6 +267,12 @@ def _eval_op(node: ir.Node, ins: List):
             halo_fraction=p("halo_fraction", 0.5))
     if op == "select":
         return ins[0].select(list(p("cols", ())))
+    if op in ("sql_project", "sql_filter"):
+        from tempo_tpu.plan import sql_compile
+
+        if op == "sql_project":
+            return sql_compile.run_project(ins[0], node)
+        return sql_compile.run_filter(ins[0], node)
     if op == "with_column":
         return ins[0].withColumn(p("colName"), node.objs["values"])
     if op == "asof_join":

@@ -28,8 +28,9 @@ import numpy as np
 #: ``# plan-ok: eager-only`` marker on its ``def`` line.
 PLANNED_METHODS = {
     "TSDF": (
-        "select", "withColumn", "asofJoin", "withRangeStats", "EMA",
-        "resample", "resampleEMA", "interpolate", "on_mesh",
+        "select", "selectExpr", "filter", "withColumn", "asofJoin",
+        "withRangeStats", "EMA", "resample", "resampleEMA",
+        "interpolate", "on_mesh",
     ),
     "DistributedTSDF": (
         "asofJoin", "withRangeStats", "EMA", "resample", "interpolate",
@@ -216,8 +217,10 @@ def output_columns(node: Node) -> Optional[List[str]]:
     cols = output_columns(node.inputs[0])
     if cols is None:
         return None
-    if node.op in ("on_mesh", "reshard", "checkpoint"):
+    if node.op in ("on_mesh", "reshard", "checkpoint", "sql_filter"):
         return cols
+    if node.op == "sql_project":
+        return list(node.param("aliases", ()))
     if node.op == "select":
         sel = node.param("cols", ())
         if "*" in sel:
@@ -252,6 +255,11 @@ def consumed_columns(node: Node) -> Optional[List[str]]:
     """Columns an op reads by name (beyond structural), or None for
     "potentially all"."""
     if node.op in ("select",):
+        return list(node.param("cols", ()))
+    if node.op in ("sql_project", "sql_filter"):
+        # sql_compile stores the (compile-time resolved) column refs of
+        # the parsed expressions in params, so pruning reads them here
+        # without re-walking the ASTs
         return list(node.param("cols", ()))
     if node.op == "with_column":
         return None

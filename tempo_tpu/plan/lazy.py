@@ -20,6 +20,14 @@ from tempo_tpu.plan import ir
 logger = logging.getLogger(__name__)
 
 
+def _frame_strict(strict) -> bool:
+    """The frame layer's strict-SQL resolution (explicit arg >
+    TEMPO_TPU_SQL_STRICT > legacy TEMPO_TPU_STRICT_SQL)."""
+    from tempo_tpu.frame import _strict_sql
+
+    return _strict_sql(strict)
+
+
 def _as_node(frame) -> ir.Node:
     """Plan node for an op input: lazy wrappers contribute their
     recorded node; eager frames become fresh source nodes."""
@@ -126,6 +134,60 @@ class LazyTSDF(_LazyBase):
         return self._rec("with_column",
                          params=dict(colName=colName, values=values),
                          objs=dict(values=values))
+
+    def selectExpr(self, *exprs, strict: Optional[bool] = None):
+        from tempo_tpu import sql
+        from tempo_tpu.plan import sql_compile
+
+        try:
+            lowered, objs = sql_compile.lower_select_exprs(
+                exprs, columns=ir.output_columns(self._node))
+        except sql.SqlError as e:
+            return self._sql_boundary("selectExpr", e, strict,
+                                      lambda f: f.selectExpr(*exprs))
+        lowered["strict"] = _frame_strict(strict)
+        return self._rec("sql_project", params=lowered, objs=objs)
+
+    def filter(self, condition, strict: Optional[bool] = None):
+        if not isinstance(condition, str):
+            # callable / mask filters are eager-only: plan boundary
+            from tempo_tpu import plan as plan_mod
+
+            result = self._execute()
+            with plan_mod.suspended():
+                return result.filter(condition, strict=strict)
+        from tempo_tpu import sql
+        from tempo_tpu.plan import sql_compile
+
+        try:
+            lowered, objs = sql_compile.lower_filter(
+                condition, columns=ir.output_columns(self._node))
+        except sql.SqlError as e:
+            return self._sql_boundary(
+                "filter", e, strict,
+                lambda f: f.filter(condition, strict=strict))
+        lowered["strict"] = _frame_strict(strict)
+        return self._rec("sql_filter", params=lowered, objs=objs)
+
+    where = filter
+
+    def _sql_boundary(self, what, err, strict, cont):
+        """An expression outside the SQL grammar under planning: strict
+        raises by name; otherwise the chain materialises here and the
+        eager fallback engine continues (the logged plan boundary)."""
+        from tempo_tpu import plan as plan_mod
+        from tempo_tpu import sql
+
+        if _frame_strict(strict):
+            raise sql.StrictSqlFallback(
+                f"{what} left the compiled SQL surface ({err}); strict "
+                f"mode forbids the host-pandas fallback")
+        logger.debug(
+            "plan: %s is outside the SQL grammar (%s) — materialising "
+            "the lazy chain and continuing eagerly", what, err)
+        result = self._execute()
+        with plan_mod.suspended():
+            return cont(result)
 
     def asofJoin(self, right_tsdf, left_prefix=None, right_prefix="right",
                  tsPartitionVal=None, fraction=0.5, skipNulls=True,

@@ -54,9 +54,11 @@ def optimize(root: ir.Node) -> ir.Node:
     """A new, annotated (possibly rewritten) plan DAG; the logical plan
     is left untouched."""
     root = _copy(root)
+    root = _fuse_sql_filters(root)
     root = _fuse_resample_ema(root)
     root = _fuse_mesh_chain(root)
     _hoist_engines(root)
+    _annotate_sql_backends(root)
     root = _place_reshards(root)
     _prune_columns(root)
     _mark_barriers(root)
@@ -127,6 +129,86 @@ def _mesh_side(node: ir.Node) -> bool:
         if not cur.inputs:
             return False
         cur = cur.inputs[0]
+
+
+# ----------------------------------------------------------------------
+# Pass 0: adjacent sql_filter fusion + backend annotation
+# ----------------------------------------------------------------------
+
+def _fuse_sql_filters(root: ir.Node) -> ir.Node:
+    """``filter(p).filter(q)`` recorded as two ``sql_filter`` nodes
+    collapses into ONE with the Kleene-AND predicate — bitwise-equal
+    (both keep exactly the rows where p AND q is TRUE; row-wise pandas
+    evaluation is pure, so evaluating q before p's row drop changes no
+    surviving value) and one plane program instead of two."""
+    from tempo_tpu import sql
+
+    def fn(n: ir.Node) -> ir.Node:
+        if n.op != "sql_filter" or not n.inputs:
+            return n
+        inner = n.inputs[0]
+        if inner.op != "sql_filter":
+            return n
+        a, b = inner.objs.get("ast"), n.objs.get("ast")
+        if a is None or b is None:
+            return n
+        combined = sql.And(a, b)
+        fused = ir.Node("sql_filter", params=dict(
+            condition=sql.unparse(combined), ast=combined.canon(),
+            cols=tuple(sorted(set(inner.param("cols", ()))
+                              | set(n.param("cols", ())))),
+            strict=bool(inner.param("strict")) or bool(n.param("strict"))),
+            inputs=inner.inputs, objs=dict(ast=combined))
+        fused.ann["rewrite"] = (
+            "adjacent sql_filter predicates AND-fused into one node "
+            "(one mask program instead of two)")
+        return fused
+
+    return _rewrite(root, fn)
+
+
+def _derived_dtypes(node: ir.Node):
+    """Static column->dtype map of a node's result, walked through the
+    schema-preserving ops; None when not derivable at plan time."""
+    if node.op == "source":
+        df = node.payload.df
+        return {c: df[c].dtype for c in df.columns}
+    if not node.inputs:
+        return None
+    if node.op in ("sql_filter", "checkpoint"):
+        return _derived_dtypes(node.inputs[0])
+    if node.op == "select":
+        base = _derived_dtypes(node.inputs[0])
+        if base is None:
+            return None
+        sel = node.param("cols", ())
+        if "*" in sel:
+            return base
+        return {c: base[c] for c in sel if c in base}
+    return None
+
+
+def _annotate_sql_backends(root: ir.Node) -> None:
+    """Annotate each ``sql_filter`` with the execution backend its
+    predicate lands on (``jit-plane`` / ``host-vector``) when the input
+    schema is statically derivable — rendered by ``explain()`` as
+    ``eval[sql]=...`` so a predicate silently outside the plane subset
+    is visible before anything runs."""
+    from tempo_tpu.plan import sql_compile
+
+    for n in root.walk():
+        if n.op != "sql_filter" or "sql_eval" in n.ann:
+            continue
+        ast = n.objs.get("ast")
+        if ast is None or not n.inputs:
+            continue
+        dtypes = _derived_dtypes(n.inputs[0])
+        if dtypes is None:
+            continue
+        try:
+            n.ann["sql_eval"] = sql_compile.filter_backend(ast, dtypes)
+        except Exception as e:  # pragma: no cover - annotation only
+            logger.debug("plan: sql backend annotation skipped (%s)", e)
 
 
 # ----------------------------------------------------------------------
@@ -715,6 +797,15 @@ def _required_inputs(node: ir.Node, wanted: Wanted):
         if "*" in sel:
             return [ALL]
         return [frozenset(sel)]
+    if node.op == "sql_project":
+        # the node evaluates EVERY projection (its aliases are its
+        # output schema), so its input always needs the full resolved
+        # ref set — already a strict subset of upstream for any
+        # projection that drops columns
+        return [frozenset(node.param("cols", ()))]
+    if node.op == "sql_filter":
+        refs = frozenset(node.param("cols", ()))
+        return [ALL if wanted is ALL else frozenset(wanted) | refs]
     if node.op == "ema":
         if wanted is ALL:
             return [ALL]

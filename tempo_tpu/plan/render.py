@@ -60,8 +60,16 @@ def _node_line(node: ir.Node) -> str:
                      f"(stitched~{sc['stitched_s'] * 1e6:.1f}us vs "
                      f"chain~{sc['chain_s'] * 1e6:.1f}us)")
         return line
-    line = f"{node.op}({_param_str(node)})"
+    if node.op == "sql_project":
+        aliases = node.param("aliases", ())
+        line = f"sql_project[{', '.join(aliases)}]"
+    elif node.op == "sql_filter":
+        line = f"sql_filter[{node.param('condition')}]"
+    else:
+        line = f"{node.op}({_param_str(node)})"
     notes = []
+    if "sql_eval" in node.ann:
+        notes.append(f"eval[sql]={node.ann['sql_eval']}")
     if "reshard_eliminated" in node.ann:
         notes.append(f"reshard ELIMINATED: {node.ann['reshard_eliminated']}")
     if "reshard_note" in node.ann:

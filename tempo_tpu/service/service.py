@@ -277,6 +277,28 @@ class QueryService:
                 raise
         return ticket
 
+    def submit_sql(self, tenant: str, text: str, tables,
+                   timeout: Optional[float] = None,
+                   deadline_s=None) -> QueryTicket:
+        """Submit one SQL statement: ``text`` compiles through the plan
+        IR (plan/sql_compile.py — projections, ``ASOF JOIN``,
+        ``WHERE``, ``GROUP BY time_bucket``) over the registered
+        ``tables`` ({name: TSDF | DistributedTSDF | lazy}), then flows
+        through the SAME admission / fairness / dispatch path as a
+        lazy-chain submission — so text queries hit the executable
+        cache and the sharded dispatch tiers exactly like method
+        chains.  The compiled root carries ``_origin='sql'``: its plan
+        signature (the quota, breaker and cache identity) is distinct
+        from the equivalent method chain's (MIGRATION v0.18).
+        ``sql.SqlError`` raises here, before anything is enqueued."""
+        from tempo_tpu.plan import optimizer, sql_compile
+
+        root = sql_compile.compile_statement(text, tables)
+        if optimizer._mesh_side(root):
+            root = ir.Node("collect", inputs=(root,))
+        return self.submit(tenant, root, timeout=timeout,
+                           deadline_s=deadline_s)
+
     def _enqueue_locked(self, tenant, root, sig, footprint, dl,
                         deadline) -> QueryTicket:
         """The quota-wait + append half of submit (under the

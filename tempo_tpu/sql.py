@@ -40,11 +40,19 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
-__all__ = ["SqlError", "parse", "evaluate", "eval_expr", "select_exprs"]
+__all__ = ["SqlError", "StrictSqlFallback", "parse", "evaluate",
+           "eval_expr", "select_exprs", "filter_mask", "split_projection",
+           "resolve_column", "column_refs", "map_columns", "unparse"]
 
 
 class SqlError(ValueError):
     """Raised for unparseable or unsupported SQL expressions."""
+
+
+class StrictSqlFallback(SqlError):
+    """Raised under strict mode (``strict=True`` / TEMPO_TPU_SQL_STRICT)
+    when an expression would silently leave the compiled SQL surface and
+    fall back to a host-pandas engine."""
 
 
 # ----------------------------------------------------------------------
@@ -500,6 +508,613 @@ def _like_to_regex(pat: str) -> str:
 
 
 # ----------------------------------------------------------------------
+# AST node classes
+# ----------------------------------------------------------------------
+#
+# Every node is callable ``env -> value`` (a pandas Series or scalar), so
+# a parsed tree evaluates exactly like the closure engine it replaced —
+# and it is introspectable: ``canon()`` renders the tree as nested
+# hashable tuples (the plan IR embeds these in node params so SQL-born
+# plans get stable cache signatures), ``column_refs`` collects referenced
+# columns for dead-column pruning, and ``map_columns`` rewrites
+# references for compile-time resolution and filter pushdown.
+
+
+def resolve_column(name: str, env) -> Optional[str]:
+    """THE column-resolution ladder, shared by host evaluation and plan
+    compilation so the two paths cannot diverge: exact match, then the
+    dotted-suffix base (``tbl.col`` -> ``col``), then Spark's
+    case-insensitive scan in column order.  ``env`` is any mapping or
+    iterable of column names; returns the matching key or ``None``."""
+    if name in env:
+        return name
+    base = name.split(".")[-1]
+    if base in env:
+        return base
+    low = name.lower()
+    for k in env:
+        if k.lower() == low:
+            return k
+    return None
+
+
+def null_masked_bool(computed: pd.Series, source: pd.Series) -> pd.Series:
+    """Nullable-boolean coercion with the source's NULLs restored.
+
+    Shared by LIKE / RLIKE / IN: passing ``na=pd.NA`` into a bool-dtype
+    string op raises on this image's pandas ("boolean value of NA is
+    ambiguous"), so predicates are computed over stringified values and
+    the source NAs masked back in afterwards — one helper so the host
+    path and the compiled path use byte-identical NULL handling."""
+    return computed.astype("boolean").mask(source.isna())
+
+
+class Expr:
+    """Base class for parsed SQL expression nodes."""
+
+    __slots__ = ()
+
+    def __call__(self, env: "Env"):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def canon(self) -> tuple:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def children(self) -> Tuple["Expr", ...]:
+        return ()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"{type(self).__name__}{self.canon()!r}"
+
+
+class Lit(Expr):
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, env):
+        return self.value
+
+    def canon(self):
+        # the type tag keeps 2 / 2.0 / True apart: they compare equal as
+        # tuple elements but evaluate differently (int preservation), so
+        # they must not share a plan signature
+        return ("lit", type(self.value).__name__, self.value)
+
+
+class Col(Expr):
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __call__(self, env):
+        k = resolve_column(self.name, env)
+        if k is None:
+            raise SqlError(f"column {self.name!r} not found")
+        return env[k]
+
+    def canon(self):
+        return ("col", self.name)
+
+
+class Func(Expr):
+    __slots__ = ("name", "args")
+
+    def __init__(self, name: str, args: Tuple[Expr, ...]):
+        self.name = name  # lowercase registry key
+        self.args = tuple(args)
+
+    def __call__(self, env):
+        return _FUNCTIONS[self.name](*[a(env) for a in self.args])
+
+    def canon(self):
+        return ("func", self.name, tuple(a.canon() for a in self.args))
+
+    def children(self):
+        return self.args
+
+
+class Cast(Expr):
+    __slots__ = ("inner", "typ")
+
+    def __init__(self, inner: Expr, typ: str):
+        self.inner = inner
+        self.typ = typ
+
+    def __call__(self, env):
+        return _cast(self.inner(env), self.typ)
+
+    def canon(self):
+        return ("cast", self.typ.lower(), self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class Neg(Expr):
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        return -self.inner(env)
+
+    def canon(self):
+        return ("neg", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class Arith(Expr):
+    __slots__ = ("op", "left", "right")
+
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def __call__(self, env):
+        return _numeric_binop(self.op, self.left(env), self.right(env))
+
+    def canon(self):
+        return ("arith", self.op, self.left.canon(), self.right.canon())
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class Concat(Expr):
+    __slots__ = ("left", "right")
+
+    def __init__(self, left: Expr, right: Expr):
+        self.left = left
+        self.right = right
+
+    def __call__(self, env):
+        return _f_concat(self.left(env), self.right(env))
+
+    def canon(self):
+        return ("concat", self.left.canon(), self.right.canon())
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class Cmp(Expr):
+    __slots__ = ("op", "left", "right")
+
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def __call__(self, env):
+        return _compare(self.op, self.left(env), self.right(env))
+
+    def canon(self):
+        return ("cmp", self.op, self.left.canon(), self.right.canon())
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class And(Expr):
+    __slots__ = ("left", "right")
+
+    def __init__(self, left: Expr, right: Expr):
+        self.left = left
+        self.right = right
+
+    def __call__(self, env):
+        return _sql_and(self.left(env), self.right(env))
+
+    def canon(self):
+        return ("and", self.left.canon(), self.right.canon())
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class Or(Expr):
+    __slots__ = ("left", "right")
+
+    def __init__(self, left: Expr, right: Expr):
+        self.left = left
+        self.right = right
+
+    def __call__(self, env):
+        return _sql_or(self.left(env), self.right(env))
+
+    def canon(self):
+        return ("or", self.left.canon(), self.right.canon())
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class Not(Expr):
+    """Three-valued NOT (both the prefix ``NOT`` and predicate negation:
+    Series negate through the nullable-boolean dtype, scalar NULL stays
+    NULL)."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        v = self.inner(env)
+        if isinstance(v, pd.Series):
+            return ~_as_bool(v)
+        return _scalar_not(v)
+
+    def canon(self):
+        return ("not", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class Flip(Expr):
+    """Plain two-valued complement for IS NOT NULL / IS NOT TRUE|FALSE —
+    the inner result is never NULL, so no NA handling."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        v = self.inner(env)
+        if isinstance(v, pd.Series):
+            return ~v
+        return not v
+
+    def canon(self):
+        return ("flip", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class IsNull(Expr):
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        return _is_null(self.inner(env))
+
+    def canon(self):
+        return ("isnull", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class IsTrue(Expr):
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        v = self.inner(env)
+        if isinstance(v, pd.Series):
+            return _as_bool(v).fillna(False)
+        # bool() also accepts np.bool_, which `is True` does not
+        return (not pd.isna(v)) and bool(v)
+
+    def canon(self):
+        return ("istrue", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class IsFalse(Expr):
+    __slots__ = ("inner",)
+
+    def __init__(self, inner: Expr):
+        self.inner = inner
+
+    def __call__(self, env):
+        v = self.inner(env)
+        if isinstance(v, pd.Series):
+            return ~_as_bool(v).fillna(True)
+        return (not pd.isna(v)) and not bool(v)
+
+    def canon(self):
+        return ("isfalse", self.inner.canon())
+
+    def children(self):
+        return (self.inner,)
+
+
+class Between(Expr):
+    __slots__ = ("inner", "lo", "hi")
+
+    def __init__(self, inner: Expr, lo: Expr, hi: Expr):
+        self.inner = inner
+        self.lo = lo
+        self.hi = hi
+
+    def __call__(self, env):
+        v = self.inner(env)
+        return _sql_and(_compare(">=", v, self.lo(env)),
+                        _compare("<=", v, self.hi(env)))
+
+    def canon(self):
+        return ("between", self.inner.canon(), self.lo.canon(),
+                self.hi.canon())
+
+    def children(self):
+        return (self.inner, self.lo, self.hi)
+
+
+class InList(Expr):
+    __slots__ = ("inner", "items")
+
+    def __init__(self, inner: Expr, items: Tuple[Expr, ...]):
+        self.inner = inner
+        self.items = tuple(items)
+
+    def __call__(self, env):
+        v = self.inner(env)
+        vals = [it(env) for it in self.items]
+        if isinstance(v, pd.Series):
+            return null_masked_bool(v.isin(vals), v)
+        if pd.isna(v):
+            return pd.NA
+        return v in vals
+
+    def canon(self):
+        return ("in", self.inner.canon(),
+                tuple(it.canon() for it in self.items))
+
+    def children(self):
+        return (self.inner,) + self.items
+
+
+class Like(Expr):
+    __slots__ = ("inner", "pat")
+
+    def __init__(self, inner: Expr, pat: Expr):
+        self.inner = inner
+        self.pat = pat
+
+    def __call__(self, env):
+        v, p = self.inner(env), self.pat(env)
+        rx = _like_to_regex(str(p))
+        if isinstance(v, pd.Series):
+            return null_masked_bool(v.astype(str).str.match(rx), v)
+        return bool(re.match(rx, str(v)))
+
+    def canon(self):
+        return ("like", self.inner.canon(), self.pat.canon())
+
+    def children(self):
+        return (self.inner, self.pat)
+
+
+class RLike(Expr):
+    __slots__ = ("inner", "pat")
+
+    def __init__(self, inner: Expr, pat: Expr):
+        self.inner = inner
+        self.pat = pat
+
+    def __call__(self, env):
+        v, p = self.inner(env), self.pat(env)
+        if isinstance(v, pd.Series):
+            return null_masked_bool(
+                v.astype(str).str.contains(str(p), regex=True), v)
+        return bool(re.search(str(p), str(v)))
+
+    def canon(self):
+        return ("rlike", self.inner.canon(), self.pat.canon())
+
+    def children(self):
+        return (self.inner, self.pat)
+
+
+class Case(Expr):
+    __slots__ = ("subject", "branches", "default")
+
+    def __init__(self, subject: Optional[Expr],
+                 branches: Tuple[Tuple[Expr, Expr], ...],
+                 default: Optional[Expr]):
+        self.subject = subject
+        self.branches = tuple(branches)
+        self.default = default
+
+    def __call__(self, env):
+        subject, branches, default = self.subject, self.branches, self.default
+        conds = []
+        vals = []
+        for c, v in branches:
+            cv = c(env)
+            if subject is not None:
+                cv = _compare("=", subject(env), cv)
+            cv = _as_bool(cv)
+            if isinstance(cv, pd.Series):
+                cv = cv.fillna(False).to_numpy(bool)
+            conds.append(cv)
+            vals.append(v(env))
+        dv = default(env) if default is not None else None
+
+        def numeric_branch(v):
+            if v is None:
+                return True
+            if isinstance(v, pd.Series):
+                return pd.api.types.is_numeric_dtype(v)
+            return isinstance(v, (int, float, np.number)) \
+                and not isinstance(v, bool)
+
+        all_numeric = all(numeric_branch(v) for v in vals + [dv])
+        # vectorized if any piece is a Series
+        series = [x for x in conds + vals + [dv]
+                  if isinstance(x, (pd.Series, np.ndarray))]
+        if series:
+            n = len(series[0])
+            conds = [np.broadcast_to(np.asarray(c), (n,))
+                     if not np.isscalar(c)
+                     else np.full(n, bool(c)) for c in conds]
+            vals = [np.asarray(v.astype(object) if isinstance(v, pd.Series)
+                               else v)
+                    if isinstance(v, (pd.Series, np.ndarray))
+                    else np.full(n, v, dtype=object) for v in vals]
+            dvv = (np.asarray(dv.astype(object)) if isinstance(dv, pd.Series)
+                   else np.full(n, dv, dtype=object))
+            out = pd.Series(np.select(conds, vals, default=dvv))
+            if not all_numeric:
+                # string/object branches keep their dtype — Spark
+                # does not re-parse '01' into 1
+                return out
+            try:
+                return pd.to_numeric(out)
+            except (ValueError, TypeError):
+                return out
+        for c, v in zip(conds, vals):
+            if c is not pd.NA and c:
+                return v
+        return dv
+
+    def canon(self):
+        return ("case",
+                self.subject.canon() if self.subject is not None else None,
+                tuple((c.canon(), v.canon()) for c, v in self.branches),
+                self.default.canon() if self.default is not None else None)
+
+    def children(self):
+        kids = [] if self.subject is None else [self.subject]
+        for c, v in self.branches:
+            kids += [c, v]
+        if self.default is not None:
+            kids.append(self.default)
+        return tuple(kids)
+
+
+def unparse(expr: Expr) -> str:
+    """Render a parsed tree back to SQL text (fully parenthesized — for
+    ``explain()`` display and plan params, not for round-tripping the
+    user's exact formatting)."""
+    e, u = expr, unparse
+    if isinstance(e, Lit):
+        v = e.value
+        if v is None:
+            return "NULL"
+        if v is True:
+            return "TRUE"
+        if v is False:
+            return "FALSE"
+        if isinstance(v, str):
+            return "'" + v.replace("'", "''") + "'"
+        return repr(v)
+    if isinstance(e, Col):
+        return e.name
+    if isinstance(e, Func):
+        return f"{e.name}({', '.join(u(a) for a in e.args)})"
+    if isinstance(e, Cast):
+        return f"CAST({u(e.inner)} AS {e.typ})"
+    if isinstance(e, Neg):
+        return f"(-{u(e.inner)})"
+    if isinstance(e, (Arith, Cmp)):
+        return f"({u(e.left)} {e.op} {u(e.right)})"
+    if isinstance(e, Concat):
+        return f"({u(e.left)} || {u(e.right)})"
+    if isinstance(e, And):
+        return f"({u(e.left)} AND {u(e.right)})"
+    if isinstance(e, Or):
+        return f"({u(e.left)} OR {u(e.right)})"
+    if isinstance(e, Not):
+        return f"(NOT {u(e.inner)})"
+    if isinstance(e, Flip):
+        inner = e.inner
+        for cls, word in ((IsNull, "NULL"), (IsTrue, "TRUE"),
+                          (IsFalse, "FALSE")):
+            if isinstance(inner, cls):
+                return f"({u(inner.inner)} IS NOT {word})"
+        return f"(NOT {u(inner)})"
+    if isinstance(e, IsNull):
+        return f"({u(e.inner)} IS NULL)"
+    if isinstance(e, IsTrue):
+        return f"({u(e.inner)} IS TRUE)"
+    if isinstance(e, IsFalse):
+        return f"({u(e.inner)} IS FALSE)"
+    if isinstance(e, Between):
+        return f"({u(e.inner)} BETWEEN {u(e.lo)} AND {u(e.hi)})"
+    if isinstance(e, InList):
+        return f"({u(e.inner)} IN ({', '.join(u(i) for i in e.items)}))"
+    if isinstance(e, Like):
+        return f"({u(e.inner)} LIKE {u(e.pat)})"
+    if isinstance(e, RLike):
+        return f"({u(e.inner)} RLIKE {u(e.pat)})"
+    if isinstance(e, Case):
+        parts = ["CASE"]
+        if e.subject is not None:
+            parts.append(u(e.subject))
+        for c, v in e.branches:
+            parts.append(f"WHEN {u(c)} THEN {u(v)}")
+        if e.default is not None:
+            parts.append(f"ELSE {u(e.default)}")
+        parts.append("END")
+        return " ".join(parts)
+    return repr(e)  # pragma: no cover - new node classes
+
+
+def walk(expr: Expr):
+    """Yield every node of a parsed tree (pre-order)."""
+    yield expr
+    for child in expr.children():
+        yield from walk(child)
+
+
+def column_refs(expr: Expr):
+    """The set of column names an expression reads."""
+    return {n.name for n in walk(expr) if isinstance(n, Col)}
+
+
+def map_columns(expr: Expr, fn) -> Expr:
+    """Rebuild a tree with every column reference renamed through
+    ``fn(name) -> name`` (compile-time resolution, filter pushdown
+    through projection aliases).  Shared subtrees are rebuilt, never
+    mutated, so parsed Exprs stay immutable/cacheable."""
+    if isinstance(expr, Col):
+        nn = fn(expr.name)
+        return expr if nn == expr.name else Col(nn)
+    if isinstance(expr, Lit):
+        return expr
+    m = lambda e: map_columns(e, fn)  # noqa: E731
+    if isinstance(expr, Func):
+        return Func(expr.name, tuple(m(a) for a in expr.args))
+    if isinstance(expr, Cast):
+        return Cast(m(expr.inner), expr.typ)
+    if isinstance(expr, (Neg, Not, Flip, IsNull, IsTrue, IsFalse)):
+        return type(expr)(m(expr.inner))
+    if isinstance(expr, (Arith, Cmp)):
+        return type(expr)(expr.op, m(expr.left), m(expr.right))
+    if isinstance(expr, (Concat, And, Or)):
+        return type(expr)(m(expr.left), m(expr.right))
+    if isinstance(expr, Between):
+        return Between(m(expr.inner), m(expr.lo), m(expr.hi))
+    if isinstance(expr, InList):
+        return InList(m(expr.inner), tuple(m(i) for i in expr.items))
+    if isinstance(expr, (Like, RLike)):
+        return type(expr)(m(expr.inner), m(expr.pat))
+    if isinstance(expr, Case):
+        return Case(None if expr.subject is None else m(expr.subject),
+                    tuple((m(c), m(v)) for c, v in expr.branches),
+                    None if expr.default is None else m(expr.default))
+    raise SqlError(f"unknown expression node {type(expr).__name__}")
+
+
+# ----------------------------------------------------------------------
 # Parser (precedence climbing)
 # ----------------------------------------------------------------------
 
@@ -539,150 +1154,89 @@ class _Parser:
     def parse_expr(self) -> Node:
         return self.parse_or()
 
-    def parse_or(self) -> Node:
+    def parse_or(self) -> Expr:
         left = self.parse_and()
         while self.kw("or"):
-            right = self.parse_and()
-            l, r = left, right
-            left = lambda env, l=l, r=r: _sql_or(l(env), r(env))
+            left = Or(left, self.parse_and())
         return left
 
-    def parse_and(self) -> Node:
+    def parse_and(self) -> Expr:
         left = self.parse_not()
         while self.kw("and"):
-            right = self.parse_not()
-            l, r = left, right
-            left = lambda env, l=l, r=r: _sql_and(l(env), r(env))
+            left = And(left, self.parse_not())
         return left
 
-    def parse_not(self) -> Node:
+    def parse_not(self) -> Expr:
         if self.kw("not"):
-            inner = self.parse_not()
-
-            def neg(env, inner=inner):
-                v = inner(env)
-                if isinstance(v, pd.Series):
-                    return ~_as_bool(v)
-                return _scalar_not(v)
-            return neg
+            return Not(self.parse_not())
         return self.parse_predicate()
 
-    def parse_predicate(self) -> Node:
+    def parse_predicate(self) -> Expr:
         left = self.parse_additive()
         # IS [NOT] NULL / IS [NOT] TRUE|FALSE
         if self.kw("is"):
             negate = self.kw("not")
             if self.kw("null"):
-                node = lambda env, l=left: _is_null(l(env))
+                node = IsNull(left)
             elif self.kw("true"):
-                def node(env, l=left):
-                    v = l(env)
-                    if isinstance(v, pd.Series):
-                        return _as_bool(v).fillna(False)
-                    # bool() also accepts np.bool_, which `is True` does not
-                    return (not pd.isna(v)) and bool(v)
+                node = IsTrue(left)
             elif self.kw("false"):
-                def node(env, l=left):
-                    v = l(env)
-                    if isinstance(v, pd.Series):
-                        return ~_as_bool(v).fillna(True)
-                    return (not pd.isna(v)) and not bool(v)
+                node = IsFalse(left)
             else:
                 raise SqlError("expected NULL/TRUE/FALSE after IS")
-            if negate:
-                inner = node
-                node = lambda env: ~inner(env) if isinstance(inner(env), pd.Series) \
-                    else not inner(env)
-            return node
+            return Flip(node) if negate else node
         negate = self.kw("not")
         if self.kw("between"):
             lo = self.parse_additive()
             if not self.kw("and"):
                 raise SqlError("BETWEEN requires AND")
             hi = self.parse_additive()
-            node = lambda env, l=left, lo=lo, hi=hi: _sql_and(
-                _compare(">=", l(env), lo(env)),
-                _compare("<=", l(env), hi(env)))
-            return _maybe_negate(node, negate)
+            return _maybe_negate(Between(left, lo, hi), negate)
         if self.kw("in"):
             self.expect_op("(")
             items = [self.parse_expr()]
             while self.op(","):
                 items.append(self.parse_expr())
             self.expect_op(")")
-
-            def node(env, l=left, items=items):
-                v = l(env)
-                vals = [it(env) for it in items]
-                if isinstance(v, pd.Series):
-                    r = v.isin(vals).astype("boolean")
-                    return r.mask(v.isna())
-                if pd.isna(v):
-                    return pd.NA
-                return v in vals
-            return _maybe_negate(node, negate)
+            return _maybe_negate(InList(left, tuple(items)), negate)
         if self.kw("like"):
-            pat = self.parse_additive()
-            def node(env, l=left, pat=pat):
-                v, p = l(env), pat(env)
-                rx = _like_to_regex(str(p))
-                if isinstance(v, pd.Series):
-                    return v.astype(str).str.match(rx).astype("boolean").mask(v.isna())
-                return bool(re.match(rx, str(v)))
-            return _maybe_negate(node, negate)
+            return _maybe_negate(Like(left, self.parse_additive()), negate)
         if self.kw("rlike"):
-            pat = self.parse_additive()
-            def node(env, l=left, pat=pat):
-                v, p = l(env), pat(env)
-                if isinstance(v, pd.Series):
-                    # na=pd.NA into a bool-dtype contains raises on this
-                    # image's pandas ("boolean value of NA is ambiguous");
-                    # compute on stringified values, restore NA by mask
-                    # (the LIKE branch's idiom)
-                    return (v.astype(str).str.contains(str(p), regex=True)
-                            .astype("boolean").mask(v.isna()))
-                return bool(re.search(str(p), str(v)))
-            return _maybe_negate(node, negate)
+            return _maybe_negate(RLike(left, self.parse_additive()), negate)
         if negate:
             raise SqlError("dangling NOT")
         cmp = self.op("<=>", "<=", ">=", "!=", "<>", "==", "=", "<", ">")
         if cmp:
-            right = self.parse_additive()
-            return lambda env, l=left, r=right, c=cmp: _compare(c, l(env), r(env))
+            return Cmp(cmp, left, self.parse_additive())
         return left
 
-    def parse_additive(self) -> Node:
+    def parse_additive(self) -> Expr:
         left = self.parse_multiplicative()
         while True:
             o = self.op("+", "-", "||")
             if not o:
                 break
             right = self.parse_multiplicative()
-            if o == "||":
-                left = lambda env, l=left, r=right: _f_concat(l(env), r(env))
-            else:
-                left = lambda env, l=left, r=right, o=o: _numeric_binop(o, l(env), r(env))
+            left = Concat(left, right) if o == "||" else Arith(o, left, right)
         return left
 
-    def parse_multiplicative(self) -> Node:
+    def parse_multiplicative(self) -> Expr:
         left = self.parse_unary()
         while True:
             o = self.op("*", "/", "%")
             if not o:
                 break
-            right = self.parse_unary()
-            left = lambda env, l=left, r=right, o=o: _numeric_binop(o, l(env), r(env))
+            left = Arith(o, left, self.parse_unary())
         return left
 
-    def parse_unary(self) -> Node:
+    def parse_unary(self) -> Expr:
         if self.op("-"):
-            inner = self.parse_unary()
-            return lambda env: -inner(env)
+            return Neg(self.parse_unary())
         if self.op("+"):
             return self.parse_unary()
         return self.parse_primary()
 
-    def parse_primary(self) -> Node:
+    def parse_primary(self) -> Expr:
         t = self.peek()
         if self.op("("):
             inner = self.parse_expr()
@@ -696,14 +1250,14 @@ class _Parser:
                 val = float(text)
             else:
                 val = int(text)
-            return lambda env, v=val: v
+            return Lit(val)
         if t.kind == "str":
             self.pos += 1
             body = t.text[1:-1]
             if t.text[0] == "'":
                 body = body.replace("''", "'")
             body = re.sub(r"\\(.)", r"\1", body)
-            return lambda env, v=body: v
+            return Lit(body)
         if t.kind == "ident":
             low = t.text.lower()
             if low == "case":
@@ -718,33 +1272,32 @@ class _Parser:
                 if typ_tok.kind != "ident":
                     raise SqlError("CAST requires a type name")
                 self.expect_op(")")
-                return lambda env, e=inner, ty=typ_tok.text: _cast(e(env), ty)
+                return Cast(inner, typ_tok.text)
             if low == "true":
                 self.pos += 1
-                return lambda env: True
+                return Lit(True)
             if low == "false":
                 self.pos += 1
-                return lambda env: False
+                return Lit(False)
             if low == "null":
                 self.pos += 1
-                return lambda env: None
+                return Lit(None)
             self.pos += 1
             # function call?
             if self.peek().kind == "op" and self.peek().text == "(" \
                     and low not in _KEYWORDS:
                 self.pos += 1  # consume (
-                args: List[Node] = []
+                args: List[Expr] = []
                 if not self.op(")"):
                     args.append(self.parse_expr())
                     while self.op(","):
                         args.append(self.parse_expr())
                     self.expect_op(")")
-                fn = _FUNCTIONS.get(low)
-                if fn is None:
+                if low not in _FUNCTIONS:
                     raise SqlError(
                         f"unsupported SQL function {t.text!r}; supported: "
                         + ", ".join(sorted(_FUNCTIONS)))
-                return lambda env, fn=fn, args=args: fn(*[a(env) for a in args])
+                return Func(low, tuple(args))
             name = t.text[1:-1] if t.text.startswith("`") else t.text
             # dotted access (`tbl.col`) resolves to the bare column
             while self.peek().kind == "op" and self.peek().text == ".":
@@ -753,90 +1306,30 @@ class _Parser:
                 if nxt.kind != "ident":
                     raise SqlError("expected identifier after '.'")
                 name = name + "." + nxt.text
-
-            def col(env, name=name):
-                if name in env:
-                    return env[name]
-                base = name.split(".")[-1]
-                if base in env:
-                    return env[base]
-                # case-insensitive fallback (Spark resolution)
-                for k in env:
-                    if k.lower() == name.lower():
-                        return env[k]
-                raise SqlError(f"column {name!r} not found")
-            return col
+            return Col(name)
         raise SqlError(f"unexpected token {t.text!r}")
 
-    def parse_case(self) -> Node:
+    def parse_case(self) -> Expr:
         self.pos += 1  # consume CASE
-        subject: Optional[Node] = None
+        subject: Optional[Expr] = None
         if not (self.peek().kind == "ident"
                 and self.peek().text.lower() == "when"):
             subject = self.parse_expr()
-        branches: List[Tuple[Node, Node]] = []
+        branches: List[Tuple[Expr, Expr]] = []
         while self.kw("when"):
             cond = self.parse_expr()
             if not self.kw("then"):
                 raise SqlError("WHEN requires THEN")
             val = self.parse_expr()
             branches.append((cond, val))
-        default: Optional[Node] = None
+        default: Optional[Expr] = None
         if self.kw("else"):
             default = self.parse_expr()
         if not self.kw("end"):
             raise SqlError("CASE requires END")
         if not branches:
             raise SqlError("CASE requires at least one WHEN")
-
-        def node(env, subject=subject, branches=branches, default=default):
-            conds = []
-            vals = []
-            for c, v in branches:
-                cv = c(env)
-                if subject is not None:
-                    cv = _compare("=", subject(env), cv)
-                cv = _as_bool(cv)
-                if isinstance(cv, pd.Series):
-                    cv = cv.fillna(False).to_numpy(bool)
-                conds.append(cv)
-                vals.append(v(env))
-            dv = default(env) if default is not None else None
-
-            def numeric_branch(v):
-                if v is None:
-                    return True
-                if isinstance(v, pd.Series):
-                    return pd.api.types.is_numeric_dtype(v)
-                return isinstance(v, (int, float, np.number)) \
-                    and not isinstance(v, bool)
-
-            all_numeric = all(numeric_branch(v) for v in vals + [dv])
-            # vectorized if any piece is a Series
-            series = [x for x in conds + vals + [dv] if isinstance(x, (pd.Series, np.ndarray))]
-            if series:
-                n = len(series[0])
-                conds = [np.broadcast_to(np.asarray(c), (n,)) if not np.isscalar(c)
-                         else np.full(n, bool(c)) for c in conds]
-                vals = [np.asarray(v.astype(object) if isinstance(v, pd.Series) else v)
-                        if isinstance(v, (pd.Series, np.ndarray))
-                        else np.full(n, v, dtype=object) for v in vals]
-                dvv = (np.asarray(dv.astype(object)) if isinstance(dv, pd.Series)
-                       else np.full(n, dv, dtype=object))
-                out = pd.Series(np.select(conds, vals, default=dvv))
-                if not all_numeric:
-                    # string/object branches keep their dtype — Spark
-                    # does not re-parse '01' into 1
-                    return out
-                try:
-                    return pd.to_numeric(out)
-                except (ValueError, TypeError):
-                    return out
-            for c, v in zip(conds, vals):
-                if c is not pd.NA and c:
-                    return v
-            return dv
-        return node
+        return Case(subject, tuple(branches), default)
 
 
 def _scalar_not(v):
@@ -845,24 +1338,19 @@ def _scalar_not(v):
     return not v
 
 
-def _maybe_negate(node: Node, negate: bool) -> Node:
-    if not negate:
-        return node
-
-    def neg(env):
-        v = node(env)
-        if isinstance(v, pd.Series):
-            return ~v.astype("boolean")
-        return _scalar_not(v)
-    return neg
+def _maybe_negate(node: Expr, negate: bool) -> Expr:
+    # predicate negation is the same three-valued NOT as the prefix
+    # keyword (~astype("boolean") == ~_as_bool for any Series dtype)
+    return Not(node) if negate else node
 
 
 # ----------------------------------------------------------------------
 # Public API
 # ----------------------------------------------------------------------
 
-def parse(expr: str) -> Node:
-    """Parse one SQL expression into an evaluatable node."""
+def parse(expr: str) -> Expr:
+    """Parse one SQL expression into an evaluatable, introspectable
+    ``Expr`` node."""
     p = _Parser(_tokenize(expr))
     node = p.parse_expr()
     if p.peek().kind != "end":
@@ -870,7 +1358,7 @@ def parse(expr: str) -> Node:
     return node
 
 
-def evaluate(node: Node, df: pd.DataFrame):
+def evaluate(node: Expr, df: pd.DataFrame):
     """Evaluate a parsed node against a DataFrame's columns."""
     env = {c: df[c] for c in df.columns}
     out = node(env)
@@ -889,19 +1377,25 @@ _AS_SPLIT_RE = re.compile(r"\s+as\s+(`[^`]+`|[A-Za-z_][A-Za-z_0-9]*)\s*$",
                           re.IGNORECASE)
 
 
+def split_projection(raw: str) -> Tuple[str, str]:
+    """Split one ``selectExpr`` string into ``(alias, body)``: a trailing
+    ``AS alias`` names the output column, otherwise the expression text
+    itself does (bare columns keep their name)."""
+    m = _AS_SPLIT_RE.search(raw)
+    if m:
+        alias = m.group(1)
+        alias = alias[1:-1] if alias.startswith("`") else alias
+        return alias, raw[: m.start()]
+    return raw.strip(), raw
+
+
 def select_exprs(df: pd.DataFrame, exprs: Sequence[str]) -> pd.DataFrame:
     """Spark ``selectExpr`` semantics: each string is an expression with
     an optional trailing ``AS alias``; unaliased expressions use their
     text as the output column name (bare columns keep their name)."""
     out = {}
     for raw in exprs:
-        m = _AS_SPLIT_RE.search(raw)
-        if m:
-            alias = m.group(1)
-            alias = alias[1:-1] if alias.startswith("`") else alias
-            body = raw[: m.start()]
-        else:
-            alias, body = raw.strip(), raw
+        alias, body = split_projection(raw)
         val = eval_expr(df, body)
         if not isinstance(val, pd.Series):
             val = pd.Series([val] * len(df), index=df.index)

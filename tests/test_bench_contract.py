@@ -52,6 +52,7 @@ def test_bench_smoke_prints_one_json_line():
         "15_chaos_serving_ticks_per_sec",
         "16_chaos_pipeline_rows_per_sec",
         "17_chaos_store_ticks_per_sec", "18_overlap_rows_per_sec",
+        "19_sql_service_qps",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -161,6 +162,26 @@ def test_bench_smoke_prints_one_json_line():
     assert cd.get("default_inputs") != cd.get("flipped_inputs"), cd
     assert "bitwise" in cd.get("value_audit", "")
     assert "bitwise" in qs.get("value_audit", "")
+    # config 19 (PR 18): the SQL front door — text statements through
+    # QueryService.submit_sql must have run at a measured rate with
+    # the eager-host baseline next to it, the zero-recompile steady
+    # state asserted (warm signatures only in the measured phase), the
+    # explain() seam rendering the sql nodes AND the eval[sql] backend
+    # pick, and every answer bitwise vs the planned method-chain twin
+    # and the eager pandas oracle
+    sq = rec.get("sql") or {}
+    assert sq.get("qps", 0) > 0, sq
+    assert sq.get("eager_qps", 0) > 0, sq
+    assert set(sq.get("statements") or ()) == {
+        "filter", "project", "join"}, sq
+    assert sq.get("zero_builds_steady_state") is True
+    assert 0 < sq.get("cache_hit_rate", 0) <= 1
+    assert "sql_project" in sq.get("explain_seam", "") \
+        and "sql_filter" in sq.get("explain_seam", ""), sq
+    assert "eval[sql]=" in sq.get("explain_seam", ""), sq
+    assert "bitwise" in sq.get("value_audit", "")
+    assert "method-chain twin" in sq.get("value_audit", "") \
+        and "oracle" in sq.get("value_audit", "")
     # config 15 (round 13): the fault-domain chaos campaign — every
     # availability invariant asserted hard inside the campaign, its
     # record keys pinned here so the driver-recorded line always

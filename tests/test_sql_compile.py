@@ -1,0 +1,435 @@
+"""SQL-through-the-planner (tempo_tpu/plan/sql_compile.py): the
+compiled surface's bitwise parity matrix.
+
+The load-bearing guarantee of PR 18: a text query compiled into plan
+IR (``sql_project`` / ``sql_filter`` / statement lowering onto
+``asof_join`` + ``resample``) produces BIT-IDENTICAL results to (a)
+the equivalent eager method chain and (b) the host pandas oracle —
+across projection arithmetic, three-valued NULL logic in AND/OR/
+comparison chains, ts/series predicates, bucket GROUP BY, and AS-OF
+JOIN — while flowing through the same optimizer passes and executable
+cache as method chains.  Strict mode must never fire on this surface.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tempo_tpu  # noqa: F401  (jax config side effects)
+from tempo_tpu import TSDF, sql
+from tempo_tpu.plan import cache as plan_cache
+from tempo_tpu.plan import ir, lazy, optimizer, sql_compile
+
+N = 60
+
+
+def make_frame(seed=0, nulls=True):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "ts": pd.date_range("2024-01-01", periods=N, freq="1s"),
+        "sym": ["A", "B", "C"] * (N // 3),
+        "price": rng.normal(100.0, 5.0, N),
+        "vol": rng.integers(1, 100, N).astype("int64"),
+        "extra": rng.standard_normal(N),
+    })
+    if nulls:
+        df.loc[::7, "price"] = np.nan
+    return TSDF(df, ts_col="ts", partition_cols=["sym"])
+
+
+def make_quotes(seed=1, rows=18):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "ts": pd.date_range("2024-01-01", periods=rows, freq="3s"),
+        "sym": ["A", "B", "C"] * (rows // 3),
+        "bid": rng.normal(99.0, 5.0, rows),
+    })
+    return TSDF(df, ts_col="ts", partition_cols=["sym"])
+
+
+@pytest.fixture
+def plan_on(monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_PLAN", "1")
+    plan_cache.CACHE.clear()
+    yield
+    plan_cache.CACHE.clear()
+
+
+@pytest.fixture
+def plan_off(monkeypatch):
+    monkeypatch.delenv("TEMPO_TPU_PLAN", raising=False)
+
+
+def exact(a: pd.DataFrame, b: pd.DataFrame):
+    pd.testing.assert_frame_equal(a.reset_index(drop=True),
+                                  b.reset_index(drop=True),
+                                  check_exact=True)
+
+
+# ----------------------------------------------------------------------
+# The predicate matrix: compiled == eager == oracle, both backends
+# ----------------------------------------------------------------------
+
+#: (predicate, expected backend on make_frame's schema)
+PREDICATES = [
+    ("price > 100", "jit-plane"),
+    ("price > 100 AND vol < 50", "jit-plane"),
+    ("price IS NULL OR vol >= 90", "jit-plane"),
+    ("NOT (price > 100 OR vol < 20)", "jit-plane"),
+    ("price BETWEEN 95 AND 105", "jit-plane"),
+    ("vol IN (1, 2, 3, 40, 41)", "jit-plane"),
+    ("price + vol > 150", "jit-plane"),
+    ("price * 2 - vol / 4 >= 180", "jit-plane"),
+    ("price IS NOT NULL AND price <= 98", "jit-plane"),
+    ("price <=> NULL", "jit-plane"),
+    ("ts > '2024-01-01 00:00:10'", "jit-plane"),
+    ("ts BETWEEN '2024-01-01 00:00:05' AND '2024-01-01 00:00:30'",
+     "jit-plane"),
+    # outside the plane subset: string equality, CASE, modulo
+    ("sym = 'A'", "host-vector"),
+    ("sym LIKE 'A%' AND price > 90", "host-vector"),
+    ("CASE WHEN price > 100 THEN TRUE ELSE FALSE END", "host-vector"),
+    ("vol % 2 = 0", "host-vector"),
+]
+
+
+@pytest.mark.parametrize("pred,backend",
+                         PREDICATES, ids=[p for p, _ in PREDICATES])
+def test_filter_parity_and_backend(plan_on, pred, backend):
+    t = make_frame()
+    lz = t.filter(pred)
+    assert isinstance(lz, lazy.LazyTSDF)
+    planned = lz.df
+
+    # eager twin (recording suspended via env) and the pandas oracle
+    from tempo_tpu import plan as plan_mod
+
+    with plan_mod.suspended():
+        eager = t.filter(pred).df
+        mask = sql.filter_mask(t.df, pred)
+    exact(planned, eager)
+    exact(planned, t.df[mask])
+
+    ast = sql_compile._resolve(sql.parse(pred), list(t.df.columns))
+    got = sql_compile.filter_backend(
+        ast, {c: t.df[c].dtype for c in t.df.columns})
+    assert got == backend
+
+
+def test_filter_backend_annotated_in_explain(plan_on):
+    t = make_frame()
+    txt = t.filter("price > 100").explain()
+    assert "eval[sql]=jit-plane" in txt
+    txt = t.filter("sym = 'A'").explain()
+    assert "eval[sql]=host-vector" in txt
+
+
+PROJECTIONS = [
+    ("ts", "sym", "price * 2 as p2"),
+    ("ts", "sym", "price + vol as pv", "price - vol as mv"),
+    ("ts", "sym", "vol / 4 as q", "price as p"),
+    ("ts", "sym", "CASE WHEN price > 100 THEN 1 ELSE 0 END as hi"),
+    ("ts", "sym", "coalesce(price, 0) as p0"),
+    ("ts", "sym", "abs(price - 100) as dev", "round(price, 1) as r1"),
+]
+
+
+@pytest.mark.parametrize("exprs", PROJECTIONS,
+                         ids=[" | ".join(e[2:]) for e in PROJECTIONS])
+def test_selectexpr_parity(plan_on, exprs):
+    t = make_frame()
+    lz = t.selectExpr(*exprs)
+    assert isinstance(lz, lazy.LazyTSDF)
+    planned = lz.df
+    from tempo_tpu import plan as plan_mod
+
+    with plan_mod.suspended():
+        eager = t.selectExpr(*exprs).df
+    exact(planned, eager)
+
+
+def test_three_valued_null_chain_matches_oracle(plan_on):
+    # Kleene: NULL AND FALSE = FALSE (row drops, no error), NULL AND
+    # TRUE = NULL (row drops), NULL OR TRUE = TRUE (row kept)
+    t = make_frame()
+    null_rows = t.df["price"].isna()
+    from tempo_tpu import plan as plan_mod
+
+    kept = t.filter("price > 1e9 OR vol >= 0").df   # NULL OR TRUE
+    with plan_mod.suspended():
+        assert len(kept) == len(t.df)               # all rows kept
+    dropped = t.filter("price < 1e9 AND vol >= 0").df  # NULL AND TRUE
+    with plan_mod.suspended():
+        assert len(dropped) == int((~null_rows).sum())
+
+
+# ----------------------------------------------------------------------
+# Optimizer integration: fusion, pruning, cacheability
+# ----------------------------------------------------------------------
+
+def test_adjacent_filters_and_fuse(plan_on):
+    t = make_frame()
+    lz = t.filter("price > 95").filter("vol < 80")
+    opt = optimizer.optimize(lz.plan)
+    filters = [n for n in opt.walk() if n.op == "sql_filter"]
+    assert len(filters) == 1
+    assert "AND" in filters[0].param("condition")
+    from tempo_tpu import plan as plan_mod
+
+    planned = lz.df
+    with plan_mod.suspended():
+        eager = t.filter("price > 95").filter("vol < 80").df
+    exact(planned, eager)
+
+
+def test_dead_column_pruning_through_sql_ops(plan_on):
+    t = make_frame()
+    lz = t.filter("price > 95").select("ts", "sym", "price")
+    opt = optimizer.optimize(lz.plan)
+    src = [n for n in opt.walk() if n.op == "source"][0]
+    assert "extra" in (src.ann.get("pruned") or ())
+    assert "vol" in (src.ann.get("pruned") or ())
+
+
+def test_sql_plans_are_cacheable(plan_on):
+    t = make_frame()
+    lz = t.filter("price > 100")
+    assert not lz.plan.uncacheable()
+    assert ir.state_key(lz.plan) is not None
+    _ = lz.df
+    st0 = plan_cache.CACHE.stats()
+    _ = t.filter("price > 100").df      # same signature: cache hit
+    st1 = plan_cache.CACHE.stats()
+    assert st1["hits"] == st0["hits"] + 1
+    assert st1["misses"] == st0["misses"]
+
+
+def test_literal_type_distinguishes_signatures(plan_on):
+    # 2 and 2.0 hash-equal in Python; the canonical AST carries the
+    # literal's type tag so the plans never share an executable
+    t = make_frame()
+    a = t.filter("vol > 2")
+    b = t.filter("vol > 2.0")
+    assert ir.signature(a.plan) != ir.signature(b.plan)
+
+
+# ----------------------------------------------------------------------
+# Statement compiler: WHERE / projections / GROUP BY / ASOF JOIN
+# ----------------------------------------------------------------------
+
+def test_statement_where_matches_method_chain(plan_off):
+    t = make_frame()
+    got = sql_compile.run_statement(
+        "SELECT * FROM trades WHERE price > 100 AND vol < 80",
+        {"trades": t})
+    want = t.filter("price > 100 AND vol < 80")
+    exact(got.df, want.df)
+
+
+def test_statement_projection_injects_structural(plan_off):
+    t = make_frame()
+    got = sql_compile.run_statement(
+        "SELECT price * 2 AS p2 FROM trades", {"trades": t})
+    want = t.selectExpr("ts", "sym", "price * 2 as p2")
+    exact(got.df, want.df)
+
+
+def test_statement_group_by_time_bucket(plan_off):
+    t = make_frame()
+    got = sql_compile.run_statement(
+        "SELECT mean(price) FROM trades "
+        "GROUP BY time_bucket('10 seconds')", {"trades": t})
+    want = t.resample(freq="10 seconds", func="mean",
+                      metricCols=["price"])
+    exact(got.df, want.df)
+
+
+def test_statement_group_by_alias_renames(plan_off):
+    t = make_frame()
+    got = sql_compile.run_statement(
+        "SELECT max(price) AS px FROM trades "
+        "GROUP BY time_bucket('10 seconds')", {"trades": t})
+    want = t.resample(freq="10 seconds", func="max",
+                      metricCols=["price"]).df
+    assert "px" in got.df.columns
+    np.testing.assert_array_equal(got.df["px"].to_numpy(),
+                                  want["price"].to_numpy())
+
+
+def test_statement_asof_join(plan_off):
+    t, q = make_frame(), make_quotes()
+    got = sql_compile.run_statement(
+        "SELECT * FROM trades ASOF JOIN quotes PREFIX 'q'",
+        {"trades": t, "quotes": q})
+    want = t.asofJoin(q, right_prefix="q")
+    exact(got.df, want.df)
+
+
+def test_statement_asof_join_where_chain(plan_off):
+    t, q = make_frame(), make_quotes()
+    got = sql_compile.run_statement(
+        "SELECT * FROM trades ASOF JOIN quotes PREFIX 'q' "
+        "WHERE q_bid > 95", {"trades": t, "quotes": q})
+    want = t.asofJoin(q, right_prefix="q").filter("q_bid > 95")
+    exact(got.df, want.df)
+
+
+def test_statement_errors_are_named(plan_off):
+    t = make_frame()
+    with pytest.raises(sql.SqlError, match="unknown table"):
+        sql_compile.run_statement("SELECT * FROM nope", {"trades": t})
+    with pytest.raises(sql.SqlError, match="GROUP BY"):
+        sql_compile.run_statement("SELECT mean(price) FROM trades",
+                                  {"trades": t})
+    with pytest.raises(sql.SqlError, match="trailing"):
+        sql_compile.run_statement("SELECT * FROM trades LIMIT 5",
+                                  {"trades": t})
+
+
+def test_sql_origin_distinct_signature(plan_on):
+    t = make_frame()
+    root_sql = sql_compile.compile_statement(
+        "SELECT * FROM trades WHERE price > 100", {"trades": t})
+    twin = t.filter("price > 100")
+    assert root_sql.param("_origin") == "sql"
+    assert ir.signature(root_sql) != ir.signature(twin.plan)
+
+
+# ----------------------------------------------------------------------
+# Strict mode: never fires on the supported surface, raises by name off it
+# ----------------------------------------------------------------------
+
+def test_strict_never_fires_on_supported_surface(plan_on, monkeypatch):
+    monkeypatch.setenv("TEMPO_TPU_SQL_STRICT", "1")
+    t = make_frame()
+    for pred, _ in PREDICATES:
+        _ = t.filter(pred).df
+    for exprs in PROJECTIONS:
+        _ = t.selectExpr(*exprs).df
+    got = sql_compile.run_statement(
+        "SELECT * FROM trades WHERE price > 100", {"trades": t})
+    assert len(got.df)
+
+
+def test_strict_kwarg_raises_by_name(plan_on):
+    t = make_frame()
+    with pytest.raises(sql.StrictSqlFallback):
+        t.filter("1 < price < 3", strict=True)
+    with pytest.raises(sql.StrictSqlFallback):
+        t.selectExpr("price ** 2 as p2", strict=True)
+
+
+def test_strict_env_knob_and_priority(plan_on, monkeypatch):
+    t = make_frame()
+    monkeypatch.setenv("TEMPO_TPU_SQL_STRICT", "1")
+    with pytest.raises(sql.StrictSqlFallback):
+        t.filter("1 < vol < 30")
+    # the explicit kwarg wins over the env knob
+    out = t.filter("1 < vol < 30", strict=False).df
+    assert len(out)
+    monkeypatch.delenv("TEMPO_TPU_SQL_STRICT")
+    monkeypatch.setenv("TEMPO_TPU_STRICT_SQL", "1")  # legacy alias
+    with pytest.raises(sql.SqlError):
+        t.filter("1 < vol < 30")
+
+
+def test_strict_eager_raises_by_name(plan_off):
+    t = make_frame()
+    with pytest.raises(sql.StrictSqlFallback):
+        t.filter("1 < price < 3", strict=True)
+    with pytest.raises(sql.StrictSqlFallback):
+        t.selectExpr("price ** 2 as p2", strict=True)
+
+
+def test_non_strict_fallback_still_works_under_planning(plan_on):
+    # the unsupported tail materialises at the plan boundary and runs
+    # on the host engine — same rows as the fully-eager path
+    t = make_frame()
+    from tempo_tpu import plan as plan_mod
+
+    got = t.filter("vol > 10").filter("1 < vol < 30").df
+    with plan_mod.suspended():
+        want = t.filter("vol > 10").filter("1 < vol < 30").df
+    exact(got, want)
+
+
+# ----------------------------------------------------------------------
+# The shared resolution/coercion helpers (satellite: one ladder)
+# ----------------------------------------------------------------------
+
+def test_resolve_column_one_ladder():
+    env = ["Price", "bid", "vol"]
+    assert sql.resolve_column("Price", env) == "Price"
+    assert sql.resolve_column("price", env) == "Price"      # case fold
+    assert sql.resolve_column("quotes.bid", env) == "bid"   # dotted base
+    assert sql.resolve_column("nope", env) is None
+
+
+def test_null_masked_bool_shared_coercion():
+    src = pd.Series([1.0, np.nan, 3.0])
+    computed = pd.Series([True, True, False])
+    out = sql.null_masked_bool(computed, src)
+    assert str(out.dtype) == "boolean"
+    assert out[0] is not pd.NA and bool(out[0])
+    assert out[1] is pd.NA                      # NULL propagates
+    # and filter_mask drops the NULL row, Spark-style
+    df = pd.DataFrame({"x": src})
+    mask = sql.filter_mask(df, "x LIKE '%'")
+    assert not mask[1]
+
+
+def test_unparse_round_trips():
+    for pred, _ in PREDICATES:
+        ast = sql.parse(pred)
+        again = sql.parse(sql.unparse(ast))
+        assert again.canon() == ast.canon()
+
+
+# ----------------------------------------------------------------------
+# Service front door
+# ----------------------------------------------------------------------
+
+def test_service_submit_sql_round_trip(plan_off):
+    from tempo_tpu.service import QueryService
+
+    t = make_frame()
+    svc = QueryService(workers=1)
+    try:
+        tk = svc.submit_sql(
+            "acme", "SELECT * FROM trades WHERE price > 100",
+            {"trades": t})
+        res = tk.result(timeout=60)
+        want = t.filter("price > 100")
+        exact(res.df, want.df)
+    finally:
+        svc.close()
+
+
+def test_service_submit_sql_steady_state_cache(plan_off):
+    from tempo_tpu.service import QueryService
+
+    t = make_frame()
+    plan_cache.CACHE.clear()
+    svc = QueryService(workers=1)
+    try:
+        text = "SELECT price * 2 AS p2 FROM trades WHERE vol > 10"
+        svc.submit_sql("acme", text, {"trades": t}).result(timeout=60)
+        st0 = plan_cache.CACHE.stats()
+        svc.submit_sql("acme", text, {"trades": t}).result(timeout=60)
+        st1 = plan_cache.CACHE.stats()
+        assert st1["misses"] == st0["misses"]   # zero recompiles
+        assert st1["hits"] > st0["hits"]
+    finally:
+        svc.close()
+
+
+def test_service_rejects_bad_sql_before_enqueue(plan_off):
+    from tempo_tpu.service import QueryService
+
+    t = make_frame()
+    svc = QueryService(workers=1)
+    try:
+        with pytest.raises(sql.SqlError):
+            svc.submit_sql("acme", "DELETE FROM trades", {"trades": t})
+    finally:
+        svc.close()

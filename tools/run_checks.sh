@@ -119,9 +119,73 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_overlap.py \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     || exit $?
 
+echo "== SQL parity gate (compiled SQL == method chain == pandas oracle) =="
+# the PR 18 front door, surfaced before tier-1: a fast in-process
+# matrix proves the compiled-SQL path (sql_compile lowering through
+# the planner) bitwise-equal to the eager pandas evaluator on
+# jit-plane AND host-vector predicates plus a full statement, exits
+# nonzero on the first divergence, then the full parity suite
+JAX_PLATFORMS=cpu TEMPO_TPU_PLAN=1 python - <<'EOF' || exit $?
+import sys
+import numpy as np
+import pandas as pd
+from tempo_tpu import TSDF, plan, sql
+from tempo_tpu.plan import cache as plan_cache, sql_compile
+
+rng = np.random.default_rng(18)
+n = 256
+df = pd.DataFrame({
+    "ts": np.cumsum(rng.integers(1, 3, size=n)).astype(np.int64),
+    "sym": np.repeat(np.arange(4), n // 4),
+    "price": np.where(rng.random(n) < 0.1, np.nan,
+                      rng.standard_normal(n)),
+    "vol": rng.integers(1, 100, size=n),
+})
+t = TSDF(df, "ts", ["sym"])
+preds = [
+    "price > 0 AND vol < 50",            # jit-plane
+    "price + vol / 10 >= 1 OR price IS NULL",
+    "vol BETWEEN 10 AND 60",
+    "NOT (price <=> NULL)",
+    "vol % 7 = 0",                       # host-vector (% excluded)
+]
+plan_cache.CACHE.clear()
+for pred in preds:
+    planned = t.filter(pred).df
+    with plan.suspended():
+        eager = t.filter(pred).df
+    try:
+        pd.testing.assert_frame_equal(
+            planned.reset_index(drop=True), eager.reset_index(drop=True),
+            check_exact=True)
+    except AssertionError as e:
+        sys.exit(f"SQL parity: planned filter diverged from the "
+                 f"eager oracle on {pred!r}: {e}")
+planned = t.selectExpr("ts", "sym", "price * 2 as p2",
+                       "coalesce(price, 0) as p0").df
+with plan.suspended():
+    eager = t.selectExpr("ts", "sym", "price * 2 as p2",
+                         "coalesce(price, 0) as p0").df
+pd.testing.assert_frame_equal(planned.reset_index(drop=True),
+                              eager.reset_index(drop=True),
+                              check_exact=True)
+stmt = "SELECT * FROM trades WHERE price > 0 AND vol < 50"
+got = sql_compile.run_statement(stmt, {"trades": t}).df
+with plan.suspended():
+    want = t.filter("price > 0 AND vol < 50").df
+pd.testing.assert_frame_equal(
+    got[want.columns].reset_index(drop=True),
+    want.reset_index(drop=True), check_exact=True)
+print(f"SQL parity smoke: {len(preds)} predicates + projection + "
+      f"statement, compiled == eager bitwise")
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_sql_compile.py \
+    tests/test_sql.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
     -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
