@@ -834,8 +834,12 @@ def sweep_slabs(n_slabs: int, load, compute, drain=None,
                 x = load(i)
                 if not _offer(loaded, (i, x)):
                     return
+        # fail is appended from the producer, the collector, AND the
+        # host body: list.append is atomic under the GIL, the list is
+        # only append-only while threads run, and the host reads it
+        # after join() (first failure wins) — a lock would add nothing
         except BaseException as e:            # noqa: BLE001
-            fail.append(e)
+            fail.append(e)  # lint-ok: guarded-attr: GIL-atomic append-only list, read after join
             stop.set()
 
     def collector():

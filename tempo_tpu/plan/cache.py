@@ -83,27 +83,28 @@ def _signature_of(key: Optional[tuple]) -> str:
     return "uncacheable"
 
 
-class PlanCache:
+class PlanCache:  # thread-shared
     """Thread-safe LRU of built executables + hit/miss/evict/build
     counters (totals, per-signature, per-tenant) and single-flight
     ``get_or_build``."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._entries = collections.OrderedDict()
-        self._building: Dict[tuple, threading.Event] = {}
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
-        self.builds = 0          # executables constructed (cache misses
-        #                          + uncacheable plans)
-        self.uncacheable = 0     # runs that bypassed the cache entirely
-        self.by_signature: Dict[str, Dict[str, int]] = {}
-        self.by_tenant: Dict[str, Dict[str, int]] = {}
+        self._entries = collections.OrderedDict()  # guarded-by: self._lock
+        self._building: Dict[tuple, threading.Event] = {}  # guarded-by: self._lock
+        self.hits = 0  # guarded-by: self._lock
+        self.misses = 0  # guarded-by: self._lock
+        self.evictions = 0  # guarded-by: self._lock
+        # builds: executables constructed (cache misses + uncacheable)
+        self.builds = 0  # guarded-by: self._lock
+        # uncacheable: runs that bypassed the cache entirely
+        self.uncacheable = 0  # guarded-by: self._lock
+        self.by_signature: Dict[str, Dict[str, int]] = {}  # guarded-by: self._lock
+        self.by_tenant: Dict[str, Dict[str, int]] = {}  # guarded-by: self._lock
 
     # -- counter plumbing (callers hold self._lock) ---------------------
 
-    def _bump(self, key: Optional[tuple], field: str) -> None:
+    def _bump(self, key: Optional[tuple], field: str) -> None:  # guarded-by: self._lock
         sig = _signature_of(key)
         self.by_signature.setdefault(
             sig, {"hits": 0, "misses": 0, "builds": 0, "evictions": 0})
@@ -114,7 +115,7 @@ class PlanCache:
                 tenant, {"hits": 0, "misses": 0, "builds": 0})
             self.by_tenant[tenant][field] += 1
 
-    def _hit_locked(self, key: tuple):
+    def _hit_locked(self, key: tuple):  # guarded-by: self._lock
         """LRU-touch + hit bookkeeping for a present entry (caller
         holds the lock) — the ONE hit path shared by :meth:`lookup`
         and :meth:`get_or_build`, so the counters the zero-recompile

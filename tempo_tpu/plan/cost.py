@@ -94,7 +94,7 @@ PRIORS: Dict[str, float] = {
 }
 
 _lock = threading.Lock()
-_measured: Dict[str, float] = {}
+_measured: Dict[str, float] = {}  # guarded-by: _lock
 
 #: build-time pin: the executor snapshots the active inputs ONCE when
 #: it computes the cache key and installs them here for the whole

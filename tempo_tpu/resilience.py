@@ -193,7 +193,7 @@ class Deadline:
 # Circuit breaker (per-key quarantine with half-open probes)
 # ----------------------------------------------------------------------
 
-class CircuitBreaker:
+class CircuitBreaker:  # thread-shared
     """Per-key failure quarantine for the serving/query planes.
 
     Keys are whatever identifies a repeat offender — a plan signature
@@ -221,9 +221,9 @@ class CircuitBreaker:
         self._clock = clock
         self._lock = threading.Lock()
         # key -> [consecutive_failures, opened_at | None, probing]
-        self._st = {}
-        self.quarantined_total = 0
-        self.trips = 0
+        self._st = {}  # guarded-by: self._lock
+        self.quarantined_total = 0  # guarded-by: self._lock
+        self.trips = 0  # guarded-by: self._lock
 
     def state(self, key) -> str:
         """``"closed"`` / ``"open"`` / ``"half-open"`` for ``key``."""

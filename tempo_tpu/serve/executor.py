@@ -276,7 +276,7 @@ class MicroBatchExecutor:
         #: the BaseException that killed the plane, when it is dead
         self.fatal: Optional[BaseException] = None
         self._inflight: List[Ticket] = []
-        self._closed = False
+        self._closed = False  # guarded-by: self._submit_lock
         # serializes the closed-check+enqueue against close(): without
         # it a tick can land BEHIND the close sentinel and hang its
         # result() forever
@@ -323,7 +323,12 @@ class MicroBatchExecutor:
             if self._closed:
                 raise ShutdownError("executor is closed")
             try:
-                self._q.put(item, block=True, timeout=timeout)
+                # Deliberate (PR 8): the closed-check+enqueue must be
+                # atomic vs close() or a tick lands BEHIND the close
+                # sentinel and its result() hangs forever; the lock's
+                # only other users flip the _closed flag, so the stall
+                # here is pure backpressure.
+                self._q.put(item, block=True, timeout=timeout)  # lint-ok: blocking-under-lock: atomic closed-check+enqueue vs close() is the PR-8 close-sentinel fix; see comment above
             except queue.Full:
                 if dl is not None and dl.expired():
                     raise DeadlineExceeded(
@@ -341,9 +346,16 @@ class MicroBatchExecutor:
         dead — are failed with :class:`ShutdownError`, never left to
         hang their callers."""
         with self._submit_lock:
-            if not self._closed:
-                self._closed = True
-                self._q.put(_CLOSE)
+            sentinel_needed = not self._closed
+            self._closed = True
+        if sentinel_needed:
+            # the sentinel enqueue deliberately sits OUTSIDE the
+            # critical section: with _closed already up, submitters
+            # fail fast with ShutdownError instead of stacking behind
+            # a close() blocked on a full queue, and ordering is
+            # preserved — _put's closed-check+enqueue is atomic under
+            # the same lock, so nothing can land behind the sentinel
+            self._q.put(_CLOSE)
         # idempotent: a second close (e.g. __exit__ after an explicit
         # close) joins the SAME drain within its own timeout — it must
         # never steal queued tickets from a worker that is still
@@ -413,7 +425,7 @@ class MicroBatchExecutor:
         for gate in gates:
             gate.ring()
 
-    def _supervise(self):
+    def _supervise(self):  # owns-tickets: _finish, _fail_pending
         """The drain thread's supervisor: an unexpected ``Exception``
         escaping the worker loop (poisoned work already fails inside
         its own batch — this catches plane-level faults) fails the

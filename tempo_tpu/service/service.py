@@ -177,7 +177,7 @@ class QueryService:
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         #: supervised worker restarts (an exception escaping the
         #: scheduler loop, NOT a query's own failure)
-        self.restarts = 0
+        self.restarts = 0  # guarded-by: self._cond
         self.tenant_quota = max(1, int(tenant_quota))
         #: budget reservation threshold: once a head-of-queue query has
         #: sat unfitting this long, the scheduler stops handing the
@@ -191,11 +191,11 @@ class QueryService:
         #: fails + releases it if the loop dies mid-query)
         self._running: Dict[int, QueryTicket] = {}
         self._cond = threading.Condition()
-        self._queues: Dict[str, collections.deque] = {}
-        self._tokens: Dict[str, int] = {}       # dispatches charged
-        self._counts: Dict[str, Dict[str, int]] = {}
-        self._latencies: Dict[str, "collections.deque"] = {}
-        self._closed = False
+        self._queues: Dict[str, collections.deque] = {}  # guarded-by: self._cond
+        self._tokens: Dict[str, int] = {}  # guarded-by: self._cond
+        self._counts: Dict[str, Dict[str, int]] = {}  # guarded-by: self._cond
+        self._latencies: Dict[str, "collections.deque"] = {}  # guarded-by: self._cond
+        self._closed = False  # guarded-by: self._cond
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"tempo-query-service-{i}")
@@ -206,7 +206,7 @@ class QueryService:
 
     # -- client side ---------------------------------------------------
 
-    def _count(self, tenant: str, field: str, by: int = 1) -> None:
+    def _count(self, tenant: str, field: str, by: int = 1) -> None:  # guarded-by: self._cond
         c = self._counts.setdefault(tenant, {
             "submitted": 0, "completed": 0, "failed": 0, "rejected": 0,
             "cancelled": 0, "quarantined": 0})
@@ -300,7 +300,7 @@ class QueryService:
                            deadline_s=deadline_s)
 
     def _enqueue_locked(self, tenant, root, sig, footprint, dl,
-                        deadline) -> QueryTicket:
+                        deadline) -> QueryTicket:  # guarded-by: self._cond
         """The quota-wait + append half of submit (under the
         scheduler condition)."""
         q = self._queues.setdefault(tenant, collections.deque())
@@ -371,7 +371,7 @@ class QueryService:
 
     # -- scheduler/worker side ------------------------------------------
 
-    def _dispatch_locked(self, tenant: str) -> QueryTicket:
+    def _dispatch_locked(self, tenant: str) -> QueryTicket:  # guarded-by: self._cond
         ticket = self._queues[tenant].popleft()
         if not self._queues[tenant]:
             # prune drained queues so _pick's sort scans tenants with
@@ -385,7 +385,7 @@ class QueryService:
         self.admission.acquire(ticket.footprint)
         return ticket
 
-    def _pick(self) -> Optional[QueryTicket]:
+    def _pick(self) -> Optional[QueryTicket]:  # guarded-by: self._cond
         """Next dispatchable ticket under the scheduler lock: tenants
         offered in token order (fewest dispatches first — the fairness
         accounting), first whose head query fits the free HBM share.
@@ -427,7 +427,7 @@ class QueryService:
                 return self._dispatch_locked(t)
         return None
 
-    def _expire_locked(self) -> None:
+    def _expire_locked(self) -> None:  # guarded-by: self._cond
         """Fail every queued ticket whose deadline died waiting for
         admission (stage-named) — under the scheduler lock.  Expired
         work must resolve NOW, not when it happens to reach its
@@ -451,7 +451,7 @@ class QueryService:
                 del self._queues[tenant]
             self._cond.notify_all()     # quota slots freed
 
-    def _worker(self) -> None:
+    def _worker(self) -> None:  # owns-tickets: _finish
         """Supervised scheduler/executor loop: a query's own failure is
         delivered on its ticket (the inner try); an exception escaping
         the LOOP itself (scheduler bug, injected plane fault) restarts
@@ -465,7 +465,11 @@ class QueryService:
                 self._worker_loop(tid)
                 return                       # clean close
             except Exception as e:  # noqa: BLE001 - supervised restart
-                ticket = self._running.pop(tid, None)
+                # _running is keyed by thread ident: each worker only
+                # ever touches its OWN slot, and dict item ops are
+                # atomic under the GIL — taking the scheduler condition
+                # here would drag it into the dispatch hot path
+                ticket = self._running.pop(tid, None)  # lint-ok: guarded-attr: per-thread-ident slot, GIL-atomic dict item ops
                 if ticket is not None and not ticket.done():
                     ticket._finish(exc=e)
                     self.breaker.abandon(ticket.signature)

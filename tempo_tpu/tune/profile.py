@@ -204,7 +204,7 @@ def validate(payload: dict, path: str,
 
 _lock = threading.Lock()
 #: {"env": <knob string at load>, "profile": dict|None, "path": str|None}
-_cache: Optional[dict] = None
+_cache: Optional[dict] = None  # guarded-by: _lock
 
 
 def _resolve():
@@ -274,7 +274,9 @@ def reload() -> None:
 
 def active_path() -> Optional[str]:
     """The path of the currently-loaded profile (None when none)."""
-    return _cache["path"] if (_cache and _cache["profile"]) else None
+    with _lock:
+        snap = _cache
+    return snap["path"] if (snap and snap["profile"]) else None
 
 
 def knob_value(name: str, shape_class: Optional[str] = None):

@@ -1,0 +1,753 @@
+"""Fixture tests for the concurrency-discipline analyzer tier
+(tools/analysis/concurrency/, ``python tools/analyze.py --threads``):
+every rule fires on a known-bad snippet, passes a known-good twin,
+and is silenced by a same-line ``# lint-ok: <rule>: <reason>`` —
+plus the exit-bit algebra, the CLI contract, and the whole-battery
+gate that keeps HEAD clean.
+
+The historical reconstructions the round-19 issue requires are here:
+the PR-8 close-sentinel TOCTOU (guarded-attr + blocking-under-lock),
+the PR-11 lost-query deque race (wait-loop stale-alias), the PR-11
+spurious ``queue.Full`` (wait-loop timed-gate), and the close-hang
+ticket leak (ticket-resolution) — plus deterministic regressions for
+the two true positives the tier found at HEAD (the executor close()
+sentinel enqueued under the submit lock, and the tuned-profile
+``active_path()`` torn read)."""
+
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct invocation outside pytest rootdir
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis import core  # noqa: E402
+from tools.analysis.concurrency.rules import (  # noqa: E402
+    CONCURRENCY_RULES,
+    BlockingUnderLockRule,
+    GuardedAttrRule,
+    LockOrderRule,
+    TicketResolutionRule,
+    WaitLoopRule,
+)
+
+PRELUDE = "import threading\nimport queue\nimport time\n"
+
+
+def run_rule(rule, tmp_path, source, name="runtime_mod.py"):
+    path = tmp_path / name
+    path.write_text(PRELUDE + source)
+    files = core.load_sources([path])
+    assert files[0].parse_error is None, files[0].parse_error
+    return rule.check_project(tmp_path, files)
+
+
+def run_battery(tmp_path, source, audit=False, name="runtime_mod.py"):
+    path = tmp_path / name
+    path.write_text(PRELUDE + source)
+    files = core.load_sources([path])
+    assert files[0].parse_error is None, files[0].parse_error
+    return core.run(list(CONCURRENCY_RULES), files, root=tmp_path,
+                    audit=audit)
+
+
+# ----------------------------------------------------------------------
+# guarded-attr
+# ----------------------------------------------------------------------
+
+TWO_THREAD_RACE = (
+    "class Box:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.count = 0\n"
+    "        self._t1 = threading.Thread(target=self._bump)\n"
+    "        self._t2 = threading.Thread(target=self._drain)\n"
+    "    def _bump(self):\n"
+    "        self.count += 1\n"
+    "    def _drain(self):\n"
+    "        self.count = 0\n"
+)
+
+
+def test_guarded_attr_flags_undeclared_two_thread_write(tmp_path):
+    found = run_rule(GuardedAttrRule(), tmp_path, TWO_THREAD_RACE)
+    assert len(found) == 1
+    assert "count" in found[0].message
+    assert "guarded-by" in found[0].message
+
+
+def test_guarded_attr_passes_declared_and_held(tmp_path):
+    found = run_rule(GuardedAttrRule(), tmp_path, (
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # guarded-by: self._lock\n"
+        "        self._t1 = threading.Thread(target=self._bump)\n"
+        "        self._t2 = threading.Thread(target=self._drain)\n"
+        "    def _bump(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n"
+        "    def _drain(self):\n"
+        "        with self._lock:\n"
+        "            self.count = 0\n"
+    ))
+    assert found == []
+
+
+def test_guarded_attr_flags_declared_access_without_lock(tmp_path):
+    """The OTHER direction of the check: a declared attribute touched
+    lock-free."""
+    found = run_rule(GuardedAttrRule(), tmp_path, (
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # guarded-by: self._lock\n"
+        "        self._t1 = threading.Thread(target=self._bump)\n"
+        "    def _bump(self):\n"
+        "        self.count += 1\n"
+    ))
+    assert len(found) == 1
+    assert "without holding" in found[0].message
+
+
+def test_guarded_attr_suppressed_with_reason(tmp_path):
+    src = TWO_THREAD_RACE.replace(
+        "        self.count += 1\n",
+        "        self.count += 1  "
+        "# lint-ok: guarded-attr: GIL-atomic int bump, test fixture\n")
+    found = run_rule(GuardedAttrRule(), tmp_path, src)
+    assert found == []
+
+
+def test_guarded_attr_flags_stale_declaration(tmp_path):
+    found = run_rule(GuardedAttrRule(), tmp_path, (
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0  # guarded-by: self._lock\n"
+    ))
+    assert len(found) == 1
+    assert "stale" in found[0].message
+
+
+def test_guarded_attr_thread_shared_counts_callers(tmp_path):
+    """'# thread-shared' opts a threadless class in: bare caller
+    writes alone now count as concurrent."""
+    shared = (
+        "class Stats:  # thread-shared\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def bump(self):\n"
+        "        self.n += 1\n"
+    )
+    assert len(run_rule(GuardedAttrRule(), tmp_path, shared)) == 1
+    quiet = shared.replace("  # thread-shared", "")
+    assert run_rule(GuardedAttrRule(), tmp_path, quiet) == []
+
+
+def test_guarded_attr_flags_shared_closure_writes(tmp_path):
+    """The sweep_slabs shape: two nested-function threads appending to
+    a host-function list with no lock."""
+    found = run_rule(GuardedAttrRule(), tmp_path, (
+        "def sweep(n):\n"
+        "    out = []\n"
+        "    def producer():\n"
+        "        out.append(1)\n"
+        "    def collector():\n"
+        "        out.append(2)\n"
+        "    tp = threading.Thread(target=producer)\n"
+        "    tc = threading.Thread(target=collector)\n"
+        "    tp.start(); tc.start()\n"
+        "    return out\n"
+    ))
+    assert len(found) == 1
+    assert "'out'" in found[0].message
+
+
+# -- PR-8 reconstruction: the close-sentinel TOCTOU --------------------
+
+CLOSE_SENTINEL = (
+    "class Executor:\n"
+    "    def __init__(self):\n"
+    "        self._submit_lock = threading.Lock()\n"
+    "        self._q = queue.Queue(maxsize=4)\n"
+    "        self._closed = False\n"
+    "        self._worker = threading.Thread(target=self._drain)\n"
+    "    def submit(self, item):\n"
+    "        with self._submit_lock:\n"
+    "            if self._closed:\n"
+    "                raise RuntimeError('closed')\n"
+    "            self._q.put(item)\n"
+    "    def close(self):\n"
+    "        with self._submit_lock:\n"
+    "            self._closed = True\n"
+    "            self._q.put(None)\n"
+    "    def _drain(self):\n"
+    "        while True:\n"
+    "            item = self._q.get()\n"
+    "            if item is None:\n"
+    "                self._closed = False\n"
+    "                return\n"
+)
+
+
+def test_guarded_attr_fires_on_pr8_close_sentinel_shape(tmp_path):
+    """The executor-close flag written from both the caller plane and
+    the worker thread with no declaration — the PR-8 bug class."""
+    found = run_rule(GuardedAttrRule(), tmp_path, CLOSE_SENTINEL)
+    assert len(found) == 1
+    assert "_closed" in found[0].message
+
+
+def test_exit_bits_or_across_rules(tmp_path):
+    """The PR-8 shape trips guarded-attr (1) AND blocking-under-lock
+    (8): the battery ORs the tier's own power-of-two bits."""
+    violations, code = run_battery(tmp_path, CLOSE_SENTINEL)
+    rules_fired = {v.rule for v in violations}
+    assert rules_fired == {"guarded-attr", "blocking-under-lock"}
+    assert code == (GuardedAttrRule.code | BlockingUnderLockRule.code)
+
+
+def test_exit_bits_distinct_powers_of_two():
+    codes = [r.code for r in CONCURRENCY_RULES]
+    assert sorted(codes) == [1, 2, 4, 8, 16]
+    for c in codes:
+        assert c & (c - 1) == 0
+
+
+# ----------------------------------------------------------------------
+# wait-loop
+# ----------------------------------------------------------------------
+
+def test_wait_loop_flags_bare_wait_outside_while(tmp_path):
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def take(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()\n"
+    ))
+    assert len(found) == 1
+    assert "while-predicate" in found[0].message
+
+
+def test_wait_loop_passes_predicate_loop(tmp_path):
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._ready = False\n"
+        "    def take(self):\n"
+        "        with self._cond:\n"
+        "            while not self._ready:\n"
+        "                self._cond.wait()\n"
+    ))
+    assert found == []
+
+
+def test_wait_loop_suppressed_with_reason(tmp_path):
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "    def take(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()  "
+        "# lint-ok: wait-loop: single-shot latch, test fixture\n"
+    ))
+    assert found == []
+
+
+# -- PR-11 reconstruction: the spurious queue.Full ---------------------
+
+def test_wait_loop_flags_timed_wait_gating_raise(tmp_path):
+    """``if not cv.wait(t): raise`` — a False return only means the
+    timeout elapsed; raising without re-checking the predicate is the
+    spurious-queue.Full bug."""
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._full = True\n"
+        "    def put(self, timeout):\n"
+        "        with self._cond:\n"
+        "            while self._full:\n"
+        "                ok = self._cond.wait(timeout)\n"
+        "                if not ok:\n"
+        "                    raise RuntimeError('full')\n"
+    ))
+    assert len(found) == 1
+    assert "re-check the predicate" in found[0].message
+
+
+def test_wait_loop_passes_timed_wait_rechecking_predicate(tmp_path):
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._full = True\n"
+        "    def put(self, timeout):\n"
+        "        with self._cond:\n"
+        "            while self._full:\n"
+        "                ok = self._cond.wait(timeout)\n"
+        "                if not ok and self._full:\n"
+        "                    raise RuntimeError('still full')\n"
+    ))
+    assert found == []
+
+
+# -- PR-11 reconstruction: the lost-query deque race -------------------
+
+def test_wait_loop_flags_stale_alias_across_wait(tmp_path):
+    """A local bound from shared state BEFORE the wait and mutated
+    after it: the wait released the lock, so the binding may be the
+    deque another thread already popped the query from."""
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._queues = {}\n"
+        "    def submit(self, tenant, item):\n"
+        "        with self._cond:\n"
+        "            q = self._queues.setdefault(tenant, [])\n"
+        "            while len(q) > 4:\n"
+        "                self._cond.wait()\n"
+        "            q.append(item)\n"
+    ))
+    assert len(found) == 1
+    assert "stale" in found[0].message
+    assert "'q'" in found[0].message
+
+
+def test_wait_loop_passes_alias_rebound_after_wait(tmp_path):
+    found = run_rule(WaitLoopRule(), tmp_path, (
+        "class Sched:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._queues = {}\n"
+        "    def submit(self, tenant, item):\n"
+        "        with self._cond:\n"
+        "            while len(self._queues.get(tenant, ())) > 4:\n"
+        "                self._cond.wait()\n"
+        "            q = self._queues.setdefault(tenant, [])\n"
+        "            q.append(item)\n"
+    ))
+    assert found == []
+
+
+# ----------------------------------------------------------------------
+# lock-order
+# ----------------------------------------------------------------------
+
+LOCK_CYCLE = (
+    "class AB:\n"
+    "    def __init__(self):\n"
+    "        self._a = threading.Lock()\n"
+    "        self._b = threading.Lock()\n"
+    "    def one(self):\n"
+    "        with self._a:\n"
+    "            with self._b:\n"
+    "                pass\n"
+    "    def two(self):\n"
+    "        with self._b:\n"
+    "            with self._a:\n"
+    "                pass\n"
+)
+
+
+def test_lock_order_flags_cycle(tmp_path):
+    found = run_rule(LockOrderRule(), tmp_path, LOCK_CYCLE)
+    assert len(found) == 1
+    assert "deadlock" in found[0].message
+
+
+def test_lock_order_passes_consistent_order(tmp_path):
+    found = run_rule(LockOrderRule(), tmp_path, LOCK_CYCLE.replace(
+        "        with self._b:\n"
+        "            with self._a:\n",
+        "        with self._a:\n"
+        "            with self._b:\n"))
+    assert found == []
+
+
+def test_lock_order_flags_reacquisition_self_deadlock(tmp_path):
+    found = run_rule(LockOrderRule(), tmp_path, (
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "    def re(self):\n"
+        "        with self._a:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    ))
+    assert len(found) == 1
+    assert "re-acquisition" in found[0].message
+
+
+def test_lock_order_flags_cycle_through_callee(tmp_path):
+    """One leg of the cycle hides inside an intra-class call."""
+    found = run_rule(LockOrderRule(), tmp_path, (
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._a = threading.Lock()\n"
+        "        self._b = threading.Lock()\n"
+        "    def one(self):\n"
+        "        with self._a:\n"
+        "            self._grab_b()\n"
+        "    def _grab_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def two(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    ))
+    assert len(found) == 1
+    assert "deadlock" in found[0].message
+
+
+def test_lock_order_suppressed_with_reason(tmp_path):
+    src = LOCK_CYCLE.replace(
+        "        with self._a:\n"
+        "            with self._b:\n",
+        "        with self._a:\n"
+        "            with self._b:  "
+        "# lint-ok: lock-order: ordering proven by construction\n", 1)
+    found = run_rule(LockOrderRule(), tmp_path, src)
+    assert found == []
+
+
+# ----------------------------------------------------------------------
+# blocking-under-lock
+# ----------------------------------------------------------------------
+
+def test_blocking_flags_unbounded_queue_put_under_lock(tmp_path):
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue(maxsize=2)\n"
+        "    def send(self, item):\n"
+        "        with self._lock:\n"
+        "            self._q.put(item)\n"
+    ))
+    assert len(found) == 1
+    assert "potentially-unbounded" in found[0].message
+
+
+def test_blocking_flags_timed_put_as_bounded_stall(tmp_path):
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue(maxsize=2)\n"
+        "    def send(self, item):\n"
+        "        with self._lock:\n"
+        "            self._q.put(item, timeout=0.5)\n"
+    ))
+    assert len(found) == 1
+    assert "bounded-stall" in found[0].message
+
+
+def test_blocking_passes_nowait_variants(tmp_path):
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue(maxsize=2)\n"
+        "    def send(self, item):\n"
+        "        with self._lock:\n"
+        "            self._q.put_nowait(item)\n"
+        "            self._q.put(item, block=False)\n"
+    ))
+    assert found == []
+
+
+def test_blocking_flags_sleep_and_wait_on_other_condition(tmp_path):
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Mixed:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition()\n"
+        "    def nap(self):\n"
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"
+        "    def cross_wait(self):\n"
+        "        with self._lock:\n"
+        "            with self._cv:\n"
+        "                pass\n"
+        "            self._cv.wait()\n"
+    ))
+    msgs = " | ".join(v.message for v in found)
+    assert "time.sleep" in msgs
+    assert "NOT the held lock" in msgs
+
+
+def test_blocking_passes_wait_on_condition_wrapping_held_lock(tmp_path):
+    """threading.Condition(self._lock).wait() releases the held lock —
+    that coupling is the point of a condition, not a stall."""
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Gate:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._cv = threading.Condition(self._lock)\n"
+        "        self._ready = False\n"
+        "    def take(self):\n"
+        "        with self._lock:\n"
+        "            while not self._ready:\n"
+        "                self._cv.wait()\n"
+    ))
+    assert found == []
+
+
+def test_blocking_suppressed_with_reason(tmp_path):
+    found = run_rule(BlockingUnderLockRule(), tmp_path, (
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._q = queue.Queue(maxsize=2)\n"
+        "    def send(self, item):\n"
+        "        with self._lock:\n"
+        "            self._q.put(item)  "
+        "# lint-ok: blocking-under-lock: atomic check+enqueue fixture\n"
+    ))
+    assert found == []
+
+
+# ----------------------------------------------------------------------
+# ticket-resolution
+# ----------------------------------------------------------------------
+
+TICKET_WORKER = (
+    "class Worker:\n"
+    "    def __init__(self):\n"
+    "        self._pending = []\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _fail_all(self, exc):\n"
+    "        for t in self._pending:\n"
+    "            t.set_exception(exc)\n"
+    "    def _run(self):  # owns-tickets: _fail_all\n"
+    "        try:\n"
+    "            self._loop()\n"
+    "        except Exception:\n"
+    "            return\n"
+    "    def _loop(self):\n"
+    "        self._fail_all(RuntimeError('closed'))\n"
+)
+
+
+def test_ticket_resolution_flags_swallowing_except_edge(tmp_path):
+    """The close-hang class: the worker dies, its except edge returns
+    without failing the tickets, every submitted result() blocks
+    forever."""
+    found = run_rule(TicketResolutionRule(), tmp_path, TICKET_WORKER)
+    assert len(found) == 1
+    assert "block forever" in found[0].message
+
+
+def test_ticket_resolution_passes_resolving_handler(tmp_path):
+    found = run_rule(TicketResolutionRule(), tmp_path, TICKET_WORKER.replace(
+        "        except Exception:\n"
+        "            return\n",
+        "        except Exception as e:\n"
+        "            self._fail_all(e)\n"))
+    assert found == []
+
+
+def test_ticket_resolution_passes_reraising_handler(tmp_path):
+    found = run_rule(TicketResolutionRule(), tmp_path, TICKET_WORKER.replace(
+        "        except Exception:\n"
+        "            return\n",
+        "        except Exception:\n"
+        "            raise\n"))
+    assert found == []
+
+
+def test_ticket_resolution_flags_unregistered_resolver_entry(tmp_path):
+    """Both ways: a thread entry that resolves tickets without an
+    '# owns-tickets:' registration escapes the except-edge checks."""
+    found = run_rule(TicketResolutionRule(), tmp_path, (
+        "class W2:\n"
+        "    def __init__(self):\n"
+        "        self._pending = []\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):\n"
+        "        for t in self._pending:\n"
+        "            t.set_result(None)\n"
+    ))
+    assert len(found) == 1
+    assert "no '# owns-tickets:'" in found[0].message
+
+
+def test_ticket_resolution_flags_unknown_resolver_name(tmp_path):
+    found = run_rule(TicketResolutionRule(), tmp_path, (
+        "class W3:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _run(self):  # owns-tickets: _nope\n"
+        "        pass\n"
+    ))
+    assert any("names no known" in v.message for v in found)
+
+
+def test_ticket_resolution_flags_stale_registration(tmp_path):
+    found = run_rule(TicketResolutionRule(), tmp_path, (
+        "class W4:\n"
+        "    def __init__(self):\n"
+        "        self._t = threading.Thread(target=self._run)\n"
+        "    def _fail_all(self, exc):\n"
+        "        pass\n"
+        "    def _run(self):  # owns-tickets: _fail_all\n"
+        "        pass\n"
+    ))
+    assert len(found) == 1
+    assert "stale '# owns-tickets'" in found[0].message
+
+
+def test_ticket_resolution_suppressed_with_reason(tmp_path):
+    src = TICKET_WORKER.replace(
+        "        except Exception:\n",
+        "        except Exception:  "
+        "# lint-ok: ticket-resolution: tickets resolved by supervisor\n")
+    found = run_rule(TicketResolutionRule(), tmp_path, src)
+    assert found == []
+
+
+# ----------------------------------------------------------------------
+# dead-suppression audit (this tier's own bit space)
+# ----------------------------------------------------------------------
+
+def test_dead_suppression_flags_stale_concurrency_marker(tmp_path):
+    violations, code = run_battery(tmp_path, (
+        "class Quiet:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0  # lint-ok: guarded-attr: never fires here\n"
+    ), audit=True)
+    assert any(v.rule == "dead-suppression" for v in violations)
+    assert code & core.DEAD_SUPPRESSION_CODE
+
+
+def test_dead_suppression_skips_other_tier_markers(tmp_path):
+    """A marker naming an AST-tier rule is that tier's business — the
+    concurrency audit must not flag it as unknown/stale."""
+    violations, code = run_battery(tmp_path, (
+        "class Quiet:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0  # lint-ok: vmem-budget: judged by AST tier\n"
+    ), audit=True)
+    assert violations == []
+    assert code == 0
+
+
+def test_live_suppression_not_flagged_dead(tmp_path):
+    src = TWO_THREAD_RACE.replace(
+        "        self.count += 1\n",
+        "        self.count += 1  "
+        "# lint-ok: guarded-attr: GIL-atomic int bump, test fixture\n")
+    violations, code = run_battery(tmp_path, src, audit=True)
+    assert all(v.rule != "dead-suppression" for v in violations)
+
+
+# ----------------------------------------------------------------------
+# CLI contract
+# ----------------------------------------------------------------------
+
+def _analyze(*args):
+    return subprocess.run(
+        [sys.executable, str(REPO / "tools" / "analyze.py"), *args],
+        capture_output=True, text=True, cwd=REPO, timeout=600)
+
+
+def test_cli_unknown_rule_exits_2_under_threads():
+    p = _analyze("--threads", "--rule", "no-such-rule")
+    assert p.returncode == 2
+    assert "no-such-rule" in (p.stderr + p.stdout)
+
+
+def test_cli_threads_and_compiled_are_exclusive():
+    p = _analyze("--threads", "--compiled")
+    assert p.returncode == 2
+
+
+def test_cli_list_rules_names_all_three_tiers():
+    p = _analyze("--list-rules")
+    assert p.returncode == 0
+    for name in ("guarded-attr", "wait-loop", "lock-order",
+                 "blocking-under-lock", "ticket-resolution",
+                 "vmem-budget", "no-f64-leak"):
+        assert name in p.stdout
+
+
+def test_head_is_concurrency_clean():
+    """The live gate: the tier must exit 0 over the real runtime with
+    zero unreasoned suppressions (the dead-suppression audit runs —
+    a stale marker fails this too)."""
+    p = _analyze("--threads")
+    assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ----------------------------------------------------------------------
+# regressions for the true positives the tier found at HEAD
+# ----------------------------------------------------------------------
+
+def test_executor_close_enqueues_sentinel_outside_submit_lock():
+    """PR-19 fix: close() used to hold _submit_lock across a blocking
+    _q.put(_CLOSE) — with the queue full, submitters stacked behind a
+    stalled close instead of failing fast with ShutdownError.  The
+    sentinel put must now run with the lock RELEASED (and only once;
+    a second close must not enqueue a second sentinel)."""
+    from tempo_tpu.serve.executor import MicroBatchExecutor
+
+    ex = MicroBatchExecutor.__new__(MicroBatchExecutor)
+    ex._submit_lock = threading.Lock()
+    ex._closed = False
+    ex.fatal = None
+    lock_states = []
+
+    class SpyQueue:
+        def put(self, item, **kw):
+            lock_states.append(ex._submit_lock.locked())
+
+        def empty(self):
+            return True
+
+    class DoneThread:
+        def join(self, *a):
+            pass
+
+        def is_alive(self):
+            return False
+
+    ex._q = SpyQueue()
+    ex._thread = DoneThread()
+
+    ex.close(timeout=0.1)
+    assert ex._closed is True
+    assert lock_states == [False]   # sentinel put ran lock-free
+
+    ex.close(timeout=0.1)           # idempotent: no second sentinel
+    assert lock_states == [False]
+
+
+def test_tune_active_path_survives_concurrent_reload(monkeypatch):
+    """PR-19 fix: active_path() read the module-level _cache three
+    times without the lock — a reload() between the truthiness check
+    and the subscript crashed it with a TypeError.  It must snapshot
+    under the lock instead."""
+    from tempo_tpu.tune import profile
+
+    class FlippingCache(dict):
+        def __getitem__(self, key):
+            if key == "profile":
+                profile._cache = None   # simulated concurrent reload
+            return dict.__getitem__(self, key)
+
+    monkeypatch.setattr(profile, "_cache", FlippingCache(
+        env="", profile={"knobs": {}}, path="/tuned/p.json", error=None))
+    assert profile.active_path() == "/tuned/p.json"

@@ -1,0 +1,73 @@
+"""Concurrency-discipline analyzer tier (``python tools/analyze.py
+--threads``).
+
+Third static-analysis tier of the project: where ``tools/analysis``
+checks kernel-safety *source* patterns and ``tools/analysis/compiled``
+checks **compiled artifacts**, this tier checks the **threaded host
+runtime** — the plane where every concurrency bug PRs 8-14 caught by
+manual review actually lived.  It builds a thread-entry graph and a
+lock-site map over the swept files (``threadmodel.py``), then runs
+five rules (``rules.py``): guarded-attr, wait-loop, lock-order,
+blocking-under-lock, ticket-resolution.  Reuses the core engine
+(shared parses, ``# lint-ok: <rule>: <reason>`` suppressions,
+power-of-two exit bits in this tier's OWN bit space, the
+dead-suppression audit).  See BUILDING.md "Concurrency discipline".
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+from tools.analysis import core
+from tools.analysis.concurrency.rules import CONCURRENCY_RULES  # noqa: F401
+
+_REPO = Path(__file__).resolve().parent.parent.parent.parent
+
+
+def default_paths() -> List[Path]:
+    """The enforced sweep: the runtime package and the dryrun entry
+    point (its stderr-filter pump thread).  Unlike the AST tier,
+    ``tools/`` and test helpers are NOT swept — they spawn no
+    threads; the threaded plane is the package itself."""
+    return [_REPO / "tempo_tpu", _REPO / "__graft_entry__.py"]
+
+
+def main(paths: Optional[Sequence[Path]] = None,
+         rules: Optional[Sequence[str]] = None) -> int:
+    """Run the battery, print findings, return the tier's exit-bit OR
+    (``analyze.py`` folds it into the 8-bit process status)."""
+    battery = list(CONCURRENCY_RULES)
+    if rules:
+        known = {r.name: r for r in CONCURRENCY_RULES}
+        unknown = [n for n in rules if n not in known]
+        if unknown:
+            # a CLI usage error, NOT a finding: exit 2, matching the
+            # compiled tier's convention (the bit table stays honest)
+            print(f"unknown concurrency rule(s): {', '.join(unknown)} "
+                  f"(see analyze.py --list-rules)", file=sys.stderr)
+            return 2
+        battery = [known[n] for n in rules]
+
+    swept = [Path(p) for p in paths] if paths else \
+        [p for p in default_paths() if p.exists()]
+    files = core.load_sources(swept)
+    # the dead-suppression audit needs the WHOLE battery's hits to
+    # judge a marker dead — a --rule-filtered run skips it
+    violations, exit_code = core.run(battery, files, root=_REPO,
+                                     audit=rules is None)
+    for v in violations:
+        print(v.render())
+    if violations:
+        by_rule = {}
+        for v in violations:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        detail = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items()))
+        print(f"{len(violations)} concurrency finding(s) ({detail}) "
+              f"over {len(files)} file(s); exit code {exit_code}",
+              file=sys.stderr)
+    else:
+        print(f"concurrency discipline clean over {len(files)} file(s)",
+              file=sys.stderr)
+    return exit_code

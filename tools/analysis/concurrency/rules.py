@@ -1,0 +1,1046 @@
+"""The concurrency-discipline rule battery (``analyze.py --threads``).
+
+Five decidable bug classes over the threaded host runtime, each one a
+direct descendant of a bug CHANGES.md records being caught by manual
+review (PRs 8-14): guarded-attr (the close-sentinel TOCTOU), wait-loop
+(the lost-query deque race and the spurious ``queue.Full``),
+lock-order (nested-acquisition cycles), blocking-under-lock (the
+dispatch-stall family), ticket-resolution (forever-blocked tickets on
+``close()``).  The tier owns its exit-bit space — see
+``tools/analyze.py --list-rules``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis import core
+from tools.analysis import dataflow
+from tools.analysis.concurrency import threadmodel as tm
+
+
+def _own_nodes(func_node: tm.FuncNode) -> List[ast.AST]:
+    """Nodes of a function body excluding nested function/lambda
+    subtrees (a nested def runs in whatever context CALLS it)."""
+    out: List[ast.AST] = []
+
+    def visit(n: ast.AST) -> None:
+        for c in ast.iter_child_nodes(n):
+            if isinstance(c, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            out.append(c)
+            visit(c)
+
+    visit(func_node)
+    return out
+
+
+def _wait_recv(project: tm.ProjectModel, mm: tm.ModuleModel,
+               fi: Optional[tm.FuncInfo],
+               call: ast.Call) -> Optional[Tuple[str, tm.LockKey, str]]:
+    """Classify ``X.wait(...)`` / ``X.wait_for(...)`` receivers:
+    ('condition'|'event', key, attr-or-name) or None for unknown."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = call.func.value
+    got = tm.resolve_lock_expr(project, mm, fi, recv)
+    if got is not None and got[1] == "condition":
+        return ("condition", got[0], tm.render_key(got[0]))
+    chain = tm.attr_chain(recv)
+    if not chain:
+        return None
+    cls = fi.cls if fi is not None else None
+    if chain[0] == "self" and cls is not None and len(chain) == 2:
+        flat = project.flattened(cls)
+        if chain[1] in flat.event_attrs:
+            key = ("cls", cls.name, chain[1])
+            return ("event", key, tm.render_key(key))
+    if len(chain) == 1 and fi is not None:
+        cur: Optional[tm.FuncInfo] = fi
+        while cur is not None:
+            if chain[0] in cur.local_events:
+                key = ("fn", f"{mm.key}:{cur.qualname}", chain[0])
+                return ("event", key, chain[0])
+            cur = tm._enclosing_funcinfo(mm, cur.node)
+    return None
+
+
+class ConcurrencyRule(core.Rule):
+    """Base: concurrency rules are whole-project passes sharing one
+    :class:`threadmodel.ProjectModel` per invocation."""
+
+    def applies(self, path) -> bool:  # project-pass only
+        return False
+
+    def check_project(self, root, files) -> List[core.Violation]:
+        model = tm.get_model(files)
+        out: List[core.Violation] = []
+        for mm in model.modules:
+            out.extend(self.check_module(model, mm))
+        out.extend(self.finish(model))
+        return out
+
+    def check_module(self, project: tm.ProjectModel,
+                     mm: tm.ModuleModel) -> List[core.Violation]:
+        return []
+
+    def finish(self, project: tm.ProjectModel) -> List[core.Violation]:
+        return []
+
+
+# ---------------------------------------------------------------------
+
+
+class GuardedAttrRule(ConcurrencyRule):
+    name = "guarded-attr"
+    code = 1
+    doc = ("shared attributes written from >=2 thread contexts must "
+           "declare '# guarded-by: <lock>' and every access must hold "
+           "it (checked both ways; '# thread-shared' classes count "
+           "callers as concurrent)")
+
+    def check_project(self, root, files):
+        #: (id(owner cls), attr) -> [mm, decl_ln, cls name, hits]
+        self._decls: Dict[Tuple[int, str], list] = {}
+        return super().check_project(root, files)
+
+    def check_module(self, project, mm):
+        out: List[core.Violation] = []
+        seen: Set[Tuple[int, str]] = set()
+        for cls in mm.classes.values():
+            out.extend(self._check_class(project, mm, cls, seen))
+        out.extend(self._check_globals(project, mm))
+        out.extend(self._check_closures(project, mm))
+        out.extend(self._check_cross_object(project, mm))
+        return out
+
+    def finish(self, project):
+        # a declaration is stale only if NO class in the hierarchy
+        # (base or subclass, any module) accesses the attribute
+        out: List[core.Violation] = []
+        for (_oid, attr), (mm, decl_ln, cname, hits) in sorted(
+                self._decls.items(),
+                key=lambda kv: (str(kv[1][0].mod.path), kv[1][1])):
+            if hits:
+                continue
+            v = self.violation(
+                mm.mod, decl_ln,
+                f"stale '# guarded-by' on {cname}.{attr}: the "
+                f"attribute is never accessed outside __init__ — "
+                f"delete the annotation or the attribute")
+            if v is not None:
+                out.append(v)
+        return out
+
+    # -- instance attributes ------------------------------------------
+
+    def _check_class(self, project, mm, cls, seen):
+        out: List[core.Violation] = []
+        flat = project.flattened(cls)
+        accesses = [a for a in tm.collect_self_accesses(flat)
+                    if a.method not in ("__init__", "__del__")]
+        ctxs = flat.contexts()
+        by_attr: Dict[str, List[tm.AttrAccess]] = {}
+        for a in accesses:
+            by_attr.setdefault(a.attr, []).append(a)
+
+        for attr, (spec, decl_ln, owner) in sorted(
+                flat.guarded_attrs.items()):
+            got = self._resolve_spec(project, mm, flat, spec)
+            if got is None:
+                if owner is cls:  # decl-site checks: defining class only
+                    v = self.violation(
+                        mm.mod, decl_ln,
+                        f"'# guarded-by: {spec}' on {cls.name}.{attr} "
+                        f"names no known lock site — declare the lock "
+                        f"(threading.Lock/RLock/Condition) or fix the "
+                        f"spec")
+                    if v is not None:
+                        out.append(v)
+                continue
+            key, _kind = got
+            acc = by_attr.get(attr, [])
+            rec = self._decls.setdefault(
+                (id(owner), attr),
+                [self._mod_of(project, owner) or mm, decl_ln,
+                 owner.name, 0])
+            rec[3] += len(acc)
+            if not acc:
+                continue
+            for a in acc:
+                if (a.lineno, attr) in seen:
+                    continue
+                held = tm.locks_held(project, mm, a.node)
+                if key not in held:
+                    seen.add((a.lineno, attr))
+                    kind = "write to" if a.is_write else "read of"
+                    v = self.violation(
+                        mm.mod, a.lineno,
+                        f"{kind} {cls.name}.{attr} (declared "
+                        f"# guarded-by: {spec}) without holding "
+                        f"{tm.render_key(key)} — take the lock or "
+                        f"annotate the enclosing def "
+                        f"'# guarded-by: {spec}' if callers hold it")
+                    if v is not None:
+                        out.append(v)
+
+        # undeclared attrs written from >= 2 contexts
+        for attr, acc in sorted(by_attr.items()):
+            if attr in flat.guarded_attrs:
+                continue
+            writes = [a for a in acc if a.is_write]
+            if not writes:
+                continue
+            labels: Set[str] = set()
+            for a in writes:
+                labels |= ctxs.get(a.method, set())
+            if flat.context_weight(labels) < 2:
+                continue
+            first = min(writes, key=lambda a: a.lineno)
+            if (first.lineno, attr) in seen:
+                continue
+            seen.add((first.lineno, attr))
+            pretty = ", ".join(sorted(labels))
+            v = self.violation(
+                mm.mod, first.lineno,
+                f"{cls.name}.{attr} is written from multiple thread "
+                f"contexts ({pretty}) with no '# guarded-by: <lock>' "
+                f"declaration — declare the guarding lock on its "
+                f"__init__ binding line (and hold it at every access), "
+                f"or suppress with a reason if it is provably safe")
+            if v is not None:
+                out.append(v)
+        return out
+
+    def _resolve_spec(self, project, mm, flat, spec):
+        try:
+            expr = ast.parse(spec, mode="eval").body
+        except SyntaxError:
+            return None
+        chain = tm.attr_chain(expr)
+        if not chain:
+            return None
+        if chain[0] == "self":
+            rest = chain[1:]
+            if len(rest) == 1:
+                return flat.lock_key(rest[0])
+            if len(rest) == 2 and rest[0] in flat.attr_class:
+                other = project.class_index.get(flat.attr_class[rest[0]])
+                if other is not None:
+                    return project.flattened(other).lock_key(rest[1])
+            return None
+        if len(chain) == 1 and chain[0] in mm.module_locks:
+            return ("mod", mm.key, chain[0]), mm.module_locks[chain[0]]
+        return None
+
+    def _mod_of(self, project, cls):
+        for m in project.modules:
+            if cls.name in m.classes and m.classes[cls.name] is cls:
+                return m
+        return None
+
+    # -- module globals (opt-in via annotation) -----------------------
+
+    def _check_globals(self, project, mm):
+        out: List[core.Violation] = []
+        for gname, (spec, decl_ln) in sorted(mm.module_guarded.items()):
+            got = tm.resolve_lock_spec(project, mm, None, spec)
+            if got is None:
+                v = self.violation(
+                    mm.mod, decl_ln,
+                    f"'# guarded-by: {spec}' on module global {gname!r} "
+                    f"names no known lock site in this module")
+                if v is not None:
+                    out.append(v)
+                continue
+            key, _kind = got
+            hit = False
+            for fnode, fi in mm.funcs.items():
+                bound = {a.arg for a in fnode.args.args}
+                bound |= {a.arg for a in fnode.args.kwonlyargs}
+                owns = list(_own_nodes(fnode))
+                for n in owns:
+                    if isinstance(n, ast.Assign):
+                        for t in n.targets:
+                            if isinstance(t, ast.Name):
+                                bound.add(t.id)
+                has_global = any(
+                    isinstance(n, ast.Global) and gname in n.names
+                    for n in owns)
+                if gname in bound and not has_global:
+                    continue  # shadowed: a different, local name
+                for n in owns:
+                    if not (isinstance(n, ast.Name) and n.id == gname):
+                        continue
+                    hit = True
+                    held = tm.locks_held(project, mm, n)
+                    if key not in held:
+                        v = self.violation(
+                            mm.mod, n.lineno,
+                            f"access to module global {gname!r} "
+                            f"(declared # guarded-by: {spec}) without "
+                            f"holding {tm.render_key(key)}")
+                        if v is not None:
+                            out.append(v)
+            if not hit:
+                v = self.violation(
+                    mm.mod, decl_ln,
+                    f"stale '# guarded-by' on module global {gname!r}: "
+                    f"no function accesses it — delete the annotation")
+                if v is not None:
+                    out.append(v)
+        return out
+
+    # -- closure-shared locals (the sweep_slabs pattern) --------------
+
+    def _check_closures(self, project, mm):
+        out: List[core.Violation] = []
+        containers: Dict[tm.FuncNode, List[tm.ThreadEntry]] = {}
+        for e in mm.entries:
+            if e.target is None or e.target.cls is not None:
+                continue
+            host = tm._enclosing_funcinfo(mm, e.target.node)
+            if host is not None:
+                containers.setdefault(host.node, []).append(e)
+        for host_node, entries in containers.items():
+            host_fi = mm.funcs[host_node]
+            publish_ln = min(e.lineno for e in entries)
+            targets = {e.target.node: e for e in entries}
+            own = _own_nodes(host_node)
+            host_bound = {a.arg for a in host_node.args.args}
+            for n in own:
+                if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    tgts = (n.targets if isinstance(n, ast.Assign)
+                            else [n.target])
+                    for t in tgts:
+                        if isinstance(t, ast.Name):
+                            host_bound.add(t.id)
+            # free-variable uses/writes per nested thread target
+            shared: Dict[str, Dict[str, object]] = {}
+
+            def note(name, lineno, label, write):
+                if name not in host_bound:
+                    return
+                if name in host_fi.local_locks \
+                        or name in host_fi.local_queues \
+                        or name in host_fi.local_events \
+                        or name in host_fi.local_threads:
+                    return
+                rec = shared.setdefault(
+                    name, {"labels": set(), "writes": [], "reads": []})
+                rec["labels"].add(label) if write else None
+                (rec["writes"] if write else rec["reads"]).append(
+                    (lineno, label))
+
+            for tnode, entry in targets.items():
+                label = f"thread:{mm.funcs[tnode].name}"
+                if entry.multi:
+                    label += "[xN]"
+                tbound = {a.arg for a in tnode.args.args}
+                nonlocals: Set[str] = set()
+                for n in ast.walk(tnode):
+                    if isinstance(n, ast.Nonlocal):
+                        nonlocals |= set(n.names)
+                for n in ast.walk(tnode):
+                    if isinstance(n, (ast.Assign, ast.AugAssign)):
+                        tgts = (n.targets if isinstance(n, ast.Assign)
+                                else [n.target])
+                        for t in tgts:
+                            if isinstance(t, ast.Name) \
+                                    and t.id in nonlocals:
+                                note(t.id, n.lineno, label, True)
+                            elif isinstance(t, ast.Subscript) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id not in tbound:
+                                note(t.value.id, n.lineno, label, True)
+                    elif isinstance(n, ast.Call) \
+                            and isinstance(n.func, ast.Attribute) \
+                            and isinstance(n.func.value, ast.Name) \
+                            and n.func.attr in tm.MUTATORS \
+                            and n.func.value.id not in tbound:
+                        note(n.func.value.id, n.lineno, label, True)
+            # host-body writes after thread publication
+            for n in own:
+                if getattr(n, "lineno", 0) <= publish_ln:
+                    continue
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.attr in tm.MUTATORS:
+                    note(n.func.value.id, n.lineno, tm.CALLER, True)
+                elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                    tgts = (n.targets if isinstance(n, ast.Assign)
+                            else [n.target])
+                    for t in tgts:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.value, ast.Name):
+                            note(t.value.id, n.lineno, tm.CALLER, True)
+            for name, rec in sorted(shared.items()):
+                writes = rec["writes"]
+                labels = {lab for _, lab in writes}
+                weight = sum(2 if lab.endswith("[xN]") else 1
+                             for lab in labels)
+                if weight < 2 or not writes:
+                    continue
+                first = min(ln for ln, _ in writes)
+                pretty = ", ".join(sorted(labels))
+                v = self.violation(
+                    mm.mod, first,
+                    f"closure variable {name!r} of "
+                    f"{host_fi.qualname}() is written from multiple "
+                    f"thread contexts ({pretty}) with no lock — guard "
+                    f"it with a function-local threading.Lock or "
+                    f"suppress with a reason if the interleaving is "
+                    f"provably safe")
+                if v is not None:
+                    out.append(v)
+        return out
+
+    # -- cross-object accesses (self.breaker._st) ---------------------
+
+    def _check_cross_object(self, project, mm):
+        out: List[core.Violation] = []
+        for node in ast.walk(mm.mod.tree):
+            chain = None
+            if isinstance(node, ast.Attribute):
+                chain = tm.attr_chain(node)
+            if not chain or len(chain) < 3 or chain[0] != "self":
+                continue
+            fi = tm._enclosing_funcinfo(mm, node)
+            if fi is None or fi.cls is None:
+                continue
+            flat = project.flattened(fi.cls)
+            other_name = flat.attr_class.get(chain[1])
+            if other_name is None:
+                continue
+            other = project.class_index.get(other_name)
+            if other is None:
+                continue
+            oflat = project.flattened(other)
+            guarded = oflat.guarded_attrs.get(chain[2])
+            if guarded is None:
+                continue
+            spec, _ln, _owner = guarded
+            got = self._resolve_spec(project, mm, oflat, spec)
+            if got is None:
+                continue
+            key, _kind = got
+            held = tm.locks_held(project, mm, node)
+            if key not in held:
+                v = self.violation(
+                    mm.mod, node.lineno,
+                    f"access to {other_name}.{chain[2]} through "
+                    f"self.{chain[1]} (declared # guarded-by: {spec}) "
+                    f"without holding {tm.render_key(key)}")
+                if v is not None:
+                    out.append(v)
+        return out
+
+
+# ---------------------------------------------------------------------
+
+
+class WaitLoopRule(ConcurrencyRule):
+    name = "wait-loop"
+    code = 2
+    doc = ("Condition.wait must sit in a while-predicate loop, a timed "
+           "wait's False result must not directly gate a raise "
+           "(re-check the predicate — the spurious queue.Full class), "
+           "and locals aliasing shared state before a wait must be "
+           "re-resolved after the wake (the lost-query deque race)")
+
+    def check_module(self, project, mm):
+        out: List[core.Violation] = []
+        for fnode, fi in mm.funcs.items():
+            out.extend(self._check_func(project, mm, fi))
+        return out
+
+    def _check_func(self, project, mm, fi):
+        out: List[core.Violation] = []
+        own = _own_nodes(fi.node)
+        parents = mm.parents
+        waits = []  # (call, kind 'wait'|'wait_for', recv info)
+        for n in own:
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("wait", "wait_for"):
+                recv = _wait_recv(project, mm, fi, n)
+                if recv is not None and recv[0] == "condition":
+                    waits.append((n, n.func.attr, recv))
+        if not waits:
+            return out
+
+        assigned_names = {}
+        for n in own:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                assigned_names.setdefault(
+                    n.targets[0].id, []).append(n)
+
+        for call, meth, (_, _key, pretty) in waits:
+            enclosing_whiles = []
+            cur = parents.get(call)
+            while cur is not None and cur is not fi.node:
+                if isinstance(cur, ast.While):
+                    enclosing_whiles.append(cur)
+                cur = parents.get(cur)
+            # w1: bare wait outside any while loop
+            if meth == "wait" and not enclosing_whiles:
+                v = self.violation(
+                    mm.mod, call.lineno,
+                    f"{pretty}.wait() outside a while-predicate loop — "
+                    f"a wake is a hint, not a guarantee (spurious "
+                    f"wakeups, stolen predicates); loop on the "
+                    f"predicate or use wait_for")
+                if v is not None:
+                    out.append(v)
+            # w2: timed wait result gating a raise
+            if meth == "wait" and (call.args or call.keywords):
+                out.extend(self._check_timed_gate(
+                    mm, fi, call, pretty, assigned_names, parents))
+            # w3: stale aliases across the wait
+            if enclosing_whiles:
+                out.extend(self._check_stale_alias(
+                    project, mm, fi, call, enclosing_whiles[0],
+                    pretty, own))
+        return out
+
+    def _check_timed_gate(self, mm, fi, call, pretty, assigned, parents):
+        out = []
+
+        def fires_if(test_node, anchor):
+            if isinstance(test_node, ast.UnaryOp) \
+                    and isinstance(test_node.op, ast.Not):
+                inner = test_node.operand
+                if inner is call:
+                    return True
+                if isinstance(inner, ast.Name):
+                    for a in assigned.get(inner.id, []):
+                        if a.value is call:
+                            return True
+            return False
+
+        for n in _own_nodes(fi.node):
+            if isinstance(n, ast.If) and fires_if(n.test, n) \
+                    and any(isinstance(s, ast.Raise)
+                            for s in ast.walk(n)):
+                v = self.violation(
+                    mm.mod, n.lineno,
+                    f"a False return from timed {pretty}.wait() only "
+                    f"means the timeout elapsed, not that the "
+                    f"predicate is false — re-check the predicate "
+                    f"before raising (the spurious queue.Full class)")
+                if v is not None:
+                    out.append(v)
+        return out
+
+    def _check_stale_alias(self, project, mm, fi, call, loop,
+                           pretty, own):
+        out = []
+        loop_nodes = set(id(x) for x in ast.walk(loop))
+        rebound_after = set()
+        for n in ast.walk(loop):
+            if isinstance(n, (ast.Assign, ast.AugAssign)) \
+                    and getattr(n, "lineno", 0) > call.lineno:
+                tgts = (n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                for t in tgts:
+                    if isinstance(t, ast.Name):
+                        rebound_after.add(t.id)
+        candidates = {}
+        for n in own:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and n.lineno < call.lineno:
+                name = n.targets[0].id
+                rooted = False
+                for sub in ast.walk(n.value):
+                    if isinstance(sub, ast.Attribute) \
+                            and isinstance(sub.value, ast.Name) \
+                            and sub.value.id == "self":
+                        flat = (project.flattened(fi.cls)
+                                if fi.cls else None)
+                        if flat is not None \
+                                and sub.attr in flat.sync_attrs:
+                            continue  # lock/cv aliases are fine
+                        rooted = True
+                if rooted and name not in rebound_after:
+                    candidates[name] = n
+        if not candidates:
+            return out
+        mutators = tm.MUTATORS | {"put", "put_nowait"}
+        for n in own:
+            if getattr(n, "lineno", 0) <= call.lineno:
+                continue
+            use = None
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.attr in mutators \
+                    and n.func.value.id in candidates:
+                use = n.func.value.id
+            elif isinstance(n, ast.Subscript) \
+                    and isinstance(n.ctx, (ast.Store, ast.Del)) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id in candidates:
+                use = n.value.id
+            if use is None:
+                continue
+            src = candidates.pop(use)
+            v = self.violation(
+                mm.mod, n.lineno,
+                f"local {use!r} (bound from shared state at line "
+                f"{src.lineno}) is mutated after {pretty}.{call.func.attr}"
+                f"() without being re-resolved after the wake — the "
+                f"wait releases the lock, so the binding may be stale "
+                f"(the lost-query deque race); re-read it from the "
+                f"shared structure after the wait returns")
+            if v is not None:
+                out.append(v)
+        return out
+
+
+# ---------------------------------------------------------------------
+
+
+class LockOrderRule(ConcurrencyRule):
+    name = "lock-order"
+    code = 4
+    doc = ("cycles in the nested lock-acquisition graph (potential "
+           "deadlock), incl. re-acquiring a non-reentrant Lock and "
+           "nesting through one level of intra-class calls")
+
+    def check_module(self, project, mm):
+        return []  # all work happens in finish() on the global graph
+
+    def finish(self, project):
+        out: List[core.Violation] = []
+        # function -> set of lock keys it (transitively) acquires
+        acquires: Dict[int, Set[tm.LockKey]] = {}
+        calls: Dict[int, List[Tuple[tm.FuncInfo, object]]] = {}
+        funcs: List[Tuple[tm.ModuleModel, tm.FuncInfo]] = []
+        for mm in project.modules:
+            for fnode, fi in mm.funcs.items():
+                funcs.append((mm, fi))
+                acq: Set[tm.LockKey] = set()
+                for n in _own_nodes(fnode):
+                    if isinstance(n, (ast.With, ast.AsyncWith)):
+                        for item in n.items:
+                            got = tm.resolve_lock_expr(
+                                project, mm, fi, item.context_expr)
+                            if got is not None:
+                                acq.add(got[0])
+                acquires[id(fi)] = acq
+                callees = []
+                for n in _own_nodes(fnode):
+                    if isinstance(n, ast.Call):
+                        callee = self._resolve_callee(project, mm, fi, n)
+                        if callee is not None:
+                            callees.append((callee, n))
+                calls[id(fi)] = callees
+        closure: Dict[int, Set[tm.LockKey]] = {}
+
+        def close(fi, depth=0):
+            if id(fi) in closure:
+                return closure[id(fi)]
+            acq = set(acquires.get(id(fi), set()))
+            closure[id(fi)] = acq  # cycle guard
+            if depth < 3:
+                for callee, _site in calls.get(id(fi), []):
+                    acq |= close(callee, depth + 1)
+            closure[id(fi)] = acq
+            return acq
+
+        edges: Dict[Tuple[tm.LockKey, tm.LockKey],
+                    Tuple[tm.ModuleModel, int, str]] = {}
+        kinds: Dict[tm.LockKey, str] = {}
+        for mm, fi in funcs:
+            for n in _own_nodes(fi.node):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    held = dict(tm.locks_held(project, mm, n))
+                    prior: List[tm.LockKey] = []
+                    for item in n.items:
+                        got = tm.resolve_lock_expr(project, mm, fi,
+                                                   item.context_expr)
+                        if got is None:
+                            continue
+                        key, kind = got
+                        kinds.setdefault(key, kind)
+                        for h in list(held) + prior:
+                            if h != key:
+                                edges.setdefault(
+                                    (h, key), (mm, n.lineno,
+                                               f"{tm.render_key(key)} "
+                                               f"acquired while holding "
+                                               f"{tm.render_key(h)}"))
+                            elif kinds.get(h) in ("lock", "semaphore"):
+                                v = self.violation(
+                                    mm.mod, n.lineno,
+                                    f"re-acquisition of non-reentrant "
+                                    f"{tm.render_key(key)} while "
+                                    f"already holding it — instant "
+                                    f"self-deadlock (use RLock or "
+                                    f"restructure)")
+                                if v is not None:
+                                    out.append(v)
+                        prior.append(key)
+                elif isinstance(n, ast.Call):
+                    held = tm.locks_held(project, mm, n)
+                    if not held:
+                        continue
+                    callee = self._resolve_callee(project, mm, fi, n)
+                    if callee is None:
+                        continue
+                    for key in close(callee):
+                        kinds.setdefault(key, "lock")
+                        for h in held:
+                            if h != key:
+                                edges.setdefault(
+                                    (h, key),
+                                    (mm, n.lineno,
+                                     f"{callee.qualname}() acquires "
+                                     f"{tm.render_key(key)} while the "
+                                     f"caller holds "
+                                     f"{tm.render_key(h)}"))
+        out.extend(self._report_cycles(edges))
+        return out
+
+    def _resolve_callee(self, project, mm, fi, call):
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self" and fi.cls is not None:
+            flat = project.flattened(fi.cls)
+            return flat.methods.get(f.attr)
+        if isinstance(f, ast.Name):
+            for fnode, other in mm.funcs.items():
+                if other.name == f.id and other.cls is None \
+                        and tm._enclosing_funcinfo(mm, fnode) is None:
+                    return other
+        return None
+
+    def _report_cycles(self, edges):
+        out = []
+        adj: Dict[tm.LockKey, Set[tm.LockKey]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        # find one representative cycle per strongly-connected pair
+        reported = set()
+        for (a, b), (mm, lineno, detail) in sorted(
+                edges.items(),
+                key=lambda kv: (str(kv[1][0].mod.path), kv[1][1])):
+            if a == b:
+                continue
+            # is there a path b -> a?
+            stack, seen = [b], set()
+            found = False
+            while stack:
+                cur = stack.pop()
+                if cur == a:
+                    found = True
+                    break
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(adj.get(cur, ()))
+            if not found:
+                continue
+            pair = frozenset((a, b))
+            if pair in reported:
+                continue
+            reported.add(pair)
+            back = edges.get((b, a))
+            back_txt = (f"; reverse order at "
+                        f"{back[0].mod.path}:{back[1]}" if back else
+                        f" (reverse path exists through intermediate "
+                        f"locks)")
+            v = self.violation(
+                mm.mod, lineno,
+                f"potential deadlock: lock-order cycle between "
+                f"{tm.render_key(a)} and {tm.render_key(b)} — {detail}"
+                f"{back_txt}; pick one global order and stick to it")
+            if v is not None:
+                out.append(v)
+        return out
+
+
+# ---------------------------------------------------------------------
+
+#: dotted call targets that block on IO / child processes / time.
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "os.replace", "os.system",
+    "numpy.save", "numpy.savez", "numpy.load",
+    "shutil.move", "shutil.rmtree", "shutil.copy", "shutil.copyfile",
+    "json.dump", "json.load", "pandas.read_parquet",
+}
+_BLOCKING_TERMINALS = {
+    "read_text", "write_text", "read_bytes", "write_bytes",
+    "to_parquet",
+}
+
+
+class BlockingUnderLockRule(ConcurrencyRule):
+    name = "blocking-under-lock"
+    code = 8
+    doc = ("blocking queue.put/get, thread joins, .result(), file IO, "
+           "sleeps, or waits on a DIFFERENT condition while holding a "
+           "lock — every other lock user stalls behind the block")
+
+    def check_module(self, project, mm):
+        out: List[core.Violation] = []
+        for fnode, fi in mm.funcs.items():
+            flat = (project.flattened(fi.cls) if fi.cls is not None
+                    else None)
+            for n in _own_nodes(fnode):
+                if not isinstance(n, ast.Call):
+                    continue
+                held = tm.locks_held(project, mm, n)
+                if not held:
+                    continue
+                msg = self._classify(project, mm, fi, flat, n, held)
+                if msg is None:
+                    continue
+                held_txt = ", ".join(sorted(
+                    tm.render_key(k) for k in held))
+                v = self.violation(
+                    mm.mod, n.lineno,
+                    f"{msg} while holding {held_txt} — every other "
+                    f"user of the lock stalls behind it; move the "
+                    f"blocking call outside the critical section or "
+                    f"suppress with the reason the coupling is "
+                    f"deliberate")
+                if v is not None:
+                    out.append(v)
+        return out
+
+    def _classify(self, project, mm, fi, flat, call, held):
+        f = call.func
+        term = dataflow.terminal_name(f)
+        dotted = dataflow.dotted_name(f, mm.aliases) or ""
+        if isinstance(f, ast.Name) and f.id == "open":
+            return "file open()"
+        if dotted in _BLOCKING_DOTTED:
+            return f"blocking call {dotted}()"
+        if dotted.startswith("subprocess."):
+            return f"child-process call {dotted}()"
+        if term in _BLOCKING_TERMINALS:
+            return f"file IO .{term}()"
+        if term in ("put", "get") and isinstance(f, ast.Attribute):
+            if self._is_queue_recv(project, mm, fi, flat, f.value):
+                for kw in call.keywords:
+                    if kw.arg == "block" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and kw.value.value is False:
+                        return None
+                timed = any(kw.arg == "timeout" for kw in call.keywords) \
+                    or len(call.args) >= 2
+                how = ("bounded-stall (timed)" if timed
+                       else "potentially-unbounded")
+                return f"{how} blocking queue .{term}()"
+        if term == "join" and isinstance(f, ast.Attribute):
+            if self._is_thread_recv(project, mm, fi, flat, f.value):
+                return "thread .join()"
+        if term == "result":
+            return "ticket/future .result()"
+        if term in ("wait", "wait_for"):
+            recv = _wait_recv(project, mm, fi, call)
+            if recv is not None:
+                kind, key, pretty = recv
+                if kind == "condition":
+                    if key in held:
+                        return None  # waiting on the held cv releases it
+                    if flat is not None and key[0] == "cls":
+                        wraps = flat.cond_wraps.get(key[2])
+                        if wraps is not None and any(
+                                h[0] == "cls" and h[2] == wraps
+                                for h in held):
+                            return None
+                    return (f"wait on condition {pretty} which is NOT "
+                            f"the held lock")
+                return f"wait on event {pretty}"
+        return None
+
+    def _is_queue_recv(self, project, mm, fi, flat, recv):
+        chain = tm.attr_chain(recv)
+        if not chain:
+            return False
+        if chain[0] == "self" and flat is not None and len(chain) == 2:
+            return chain[1] in flat.queue_attrs
+        if len(chain) == 1:
+            cur = fi
+            while cur is not None:
+                if chain[0] in cur.local_queues:
+                    return True
+                cur = tm._enclosing_funcinfo(mm, cur.node)
+        return False
+
+    def _is_thread_recv(self, project, mm, fi, flat, recv):
+        chain = tm.attr_chain(recv)
+        if not chain:
+            return False
+        if chain[0] == "self" and flat is not None and len(chain) == 2:
+            return chain[1] in flat.thread_attrs
+        if len(chain) == 1:
+            cur = fi
+            while cur is not None:
+                if chain[0] in cur.local_threads:
+                    return True
+                cur = tm._enclosing_funcinfo(mm, cur.node)
+        return False
+
+
+# ---------------------------------------------------------------------
+
+
+class TicketResolutionRule(ConcurrencyRule):
+    name = "ticket-resolution"
+    code = 16
+    doc = ("every exception edge of a '# owns-tickets:'-registered "
+           "worker must resolve/fail its tickets or re-raise (the "
+           "forever-blocked-ticket class); registration is checked "
+           "both ways against the thread-entry graph")
+
+    #: resolver-shaped terminals that flag an UNregistered thread entry.
+    COMMON_RESOLVERS = {"set_result", "set_exception"}
+
+    def check_module(self, project, mm):
+        out: List[core.Violation] = []
+        project_resolvers = set(self.COMMON_RESOLVERS)
+        for m2 in project.modules:
+            for fi in m2.funcs.values():
+                if fi.owns_tickets:
+                    project_resolvers |= set(fi.owns_tickets)
+
+        for fnode, fi in mm.funcs.items():
+            if fi.owns_tickets:
+                out.extend(self._check_registered(project, mm, fi))
+        # both ways: thread entries that resolve tickets unregistered
+        for entry in mm.entries:
+            fi = entry.target
+            if fi is None or fi.owns_tickets:
+                continue
+            if fi.name in project_resolvers:
+                continue
+            hits = sorted({
+                dataflow.terminal_name(n.func)
+                for n in _own_nodes(fi.node)
+                if isinstance(n, ast.Call)
+                and dataflow.terminal_name(n.func) in project_resolvers})
+            if hits:
+                v = self.violation(
+                    mm.mod, fi.node.lineno,
+                    f"thread entry {fi.qualname}() calls ticket "
+                    f"resolver(s) {', '.join(hits)} but has no "
+                    f"'# owns-tickets:' registration — register it so "
+                    f"its exception edges are checked")
+                if v is not None:
+                    out.append(v)
+        return out
+
+    def _check_registered(self, project, mm, fi):
+        out: List[core.Violation] = []
+        resolvers = set(fi.owns_tickets or ())
+        flat = (project.flattened(fi.cls) if fi.cls is not None
+                else None)
+        # (c) declared resolvers must exist
+        for r in sorted(resolvers - self.COMMON_RESOLVERS):
+            exists = (flat is not None and r in flat.methods) or any(
+                other.name == r for other in mm.funcs.values())
+            if not exists and not self._method_anywhere(project, r):
+                v = self.violation(
+                    mm.mod, fi.node.lineno,
+                    f"'# owns-tickets: {r}' on {fi.qualname}() names "
+                    f"no known function/method — fix the resolver "
+                    f"name or delete it from the registration")
+                if v is not None:
+                    out.append(v)
+        # (b) stale registration: no resolver reachable at all
+        terminals = self._call_terminals(project, flat, fi, depth=2)
+        if not (terminals & resolvers):
+            v = self.violation(
+                mm.mod, fi.node.lineno,
+                f"stale '# owns-tickets' on {fi.qualname}(): none of "
+                f"its declared resolvers ({', '.join(sorted(resolvers))})"
+                f" are called by it (directly or through its own "
+                f"methods) — delete the registration or fix the worker")
+            if v is not None:
+                out.append(v)
+            return out
+        # (a) every except edge resolves or re-raises
+        for n in _own_nodes(fi.node):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            if self._handler_resolves(project, flat, resolvers, n):
+                continue
+            v = self.violation(
+                mm.mod, n.lineno,
+                f"exception edge of ticket-owning worker "
+                f"{fi.qualname}() neither calls a declared resolver "
+                f"({', '.join(sorted(resolvers))}) nor re-raises — "
+                f"submitted tickets block forever (the close-hang "
+                f"class); resolve/fail them or re-raise")
+            if v is not None:
+                out.append(v)
+        return out
+
+    def _handler_resolves(self, project, flat, resolvers, handler):
+        for n in ast.walk(handler):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                term = dataflow.terminal_name(n.func)
+                if term in resolvers:
+                    return True
+                if flat is not None \
+                        and isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == "self" \
+                        and term in flat.methods:
+                    callee = flat.methods[term]
+                    for sub in ast.walk(callee.node):
+                        if isinstance(sub, ast.Call) \
+                                and dataflow.terminal_name(sub.func) \
+                                in resolvers:
+                            return True
+        return False
+
+    def _call_terminals(self, project, flat, fi, depth):
+        seen: Set[str] = set()
+        frontier = [fi]
+        visited = set()
+        for _ in range(depth + 1):
+            nxt = []
+            for cur in frontier:
+                if id(cur) in visited:
+                    continue
+                visited.add(id(cur))
+                for n in ast.walk(cur.node):
+                    if isinstance(n, ast.Call):
+                        term = dataflow.terminal_name(n.func)
+                        if term:
+                            seen.add(term)
+                        if flat is not None \
+                                and isinstance(n.func, ast.Attribute) \
+                                and isinstance(n.func.value, ast.Name) \
+                                and n.func.value.id == "self" \
+                                and term in flat.methods:
+                            nxt.append(flat.methods[term])
+            frontier = nxt
+        return seen
+
+    def _method_anywhere(self, project, name):
+        for cls in project.class_index.values():
+            if name in cls.methods:
+                return True
+        return False
+
+
+CONCURRENCY_RULES = [
+    GuardedAttrRule(),
+    WaitLoopRule(),
+    LockOrderRule(),
+    BlockingUnderLockRule(),
+    TicketResolutionRule(),
+]

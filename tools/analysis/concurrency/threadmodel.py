@@ -1,0 +1,740 @@
+"""Thread-context and lock-site model shared by the concurrency rules.
+
+The tier's rules all need the same three structures, built once per
+invocation over the swept files (mirroring how ``tools/analysis/core``
+shares one parse per file):
+
+* a **lock-site map** — every name bound to a
+  ``threading.Lock/RLock/Condition/Semaphore`` (through
+  ``dataflow.build_aliases``), whether an instance attribute
+  (``self._lock = threading.Lock()``), a module global, or a
+  function local (the ``sweep_slabs`` closure pattern); queue, event
+  and thread sites ride along because several rules must tell a
+  synchronization object apart from plain shared state;
+* a **thread-entry graph** — every ``threading.Thread(target=...)``
+  site, resolved to the method / nested function it runs, with a
+  *multi-instance* flag when the Thread is constructed inside a
+  loop or comprehension (N workers sharing one target are N
+  contexts, not one);
+* per-class (and per-closure) **context sets** — which thread
+  context(s) can execute each method, propagated through the
+  intra-class ``self.m()`` call graph (including same-file base
+  classes, so ``CohortExecutor`` inherits ``MicroBatchExecutor``'s
+  supervisor threads).
+
+Annotations the model understands (checked both ways by the rules —
+a stale annotation is itself a finding, like the env-knob registry):
+
+* ``# guarded-by: <lock>`` on an attribute/global binding line —
+  declares the lock that must be held for **every** access;
+* ``# guarded-by: <lock>`` on a ``def`` line — "callers hold this
+  lock": the body is analyzed as holding it (the ``_hit_locked`` /
+  ``_dispatch_locked`` helper convention);
+* ``# thread-shared`` on a ``class`` line — instances are used from
+  multiple threads even though the class spawns none of its own
+  (``PlanCache``, ``CircuitBreaker``): the caller context counts
+  as concurrent;
+* ``# owns-tickets: <resolver[, resolver...]>`` on a ``def`` line —
+  registers a ticket-owning worker and names the methods that
+  resolve/fail its tickets (the ``ticket-resolution`` rule).
+
+Everything is flow-insensitive and intentionally conservative in the
+same direction as ``dataflow``: an unresolvable receiver widens to
+"unknown" and the rules stay silent rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis import core
+from tools.analysis import dataflow
+
+#: factory dotted-name -> lock kind (Condition doubles as a lock;
+#: Event is NOT a lock — level-triggered, no ownership).
+LOCK_FACTORIES = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+}
+QUEUE_FACTORIES = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue",
+}
+EVENT_FACTORY = "threading.Event"
+THREAD_FACTORY = "threading.Thread"
+
+#: method calls that mutate their receiver — a ``self.x.append(v)``
+#: is a write to the shared structure ``x`` for context counting.
+MUTATORS = {
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+}
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+_THREAD_SHARED_RE = re.compile(r"#\s*thread-shared\b")
+_OWNS_TICKETS_RE = re.compile(
+    r"#\s*owns-tickets:\s*([A-Za-z_][A-Za-z0-9_]*"
+    r"(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)")
+
+CALLER = "caller"
+
+FuncNode = dataflow.FuncNode
+
+#: canonical lock key: ("cls", defining class name, attr) |
+#: ("mod", module key, name) | ("fn", function key, name) |
+#: ("foreign", scope, dotted).  The DEFINING class names inherited
+#: locks so base and subclass references unify.
+LockKey = Tuple[str, str, str]
+
+
+def render_key(key: LockKey) -> str:
+    scope, owner, name = key
+    if scope == "cls":
+        return f"{owner}.{name}"
+    if scope == "foreign":
+        return name
+    owner = owner.rsplit(":", 1)[-1]
+    return f"{owner}.{name}" if owner else name
+
+
+@dataclass
+class FuncInfo:
+    node: FuncNode
+    name: str
+    qualname: str
+    cls: Optional["ClassInfo"]
+    #: raw lockspec strings from a def-line ``# guarded-by:``.
+    guarded_by: List[str] = field(default_factory=list)
+    #: resolver names from ``# owns-tickets:``, or None.
+    owns_tickets: Optional[List[str]] = None
+    #: function-local lock/queue/event sites: name -> kind.
+    local_locks: Dict[str, str] = field(default_factory=dict)
+    local_queues: Set[str] = field(default_factory=set)
+    local_events: Set[str] = field(default_factory=set)
+    #: names bound to a Thread (or iterated from a thread list).
+    local_threads: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassInfo:
+    node: ast.ClassDef
+    name: str
+    bases: List[str]
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+    thread_shared: bool = False
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    queue_attrs: Set[str] = field(default_factory=set)
+    event_attrs: Set[str] = field(default_factory=set)
+    thread_attrs: Set[str] = field(default_factory=set)
+    #: attr -> (lockspec, decl lineno) from annotated binding lines.
+    guarded_attrs: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    #: attr -> project class name its value was constructed from
+    #: (``self.breaker = CircuitBreaker(...)``) — lets the rules
+    #: resolve cross-object locks like ``self.breaker._lock``.
+    attr_class: Dict[str, str] = field(default_factory=dict)
+    #: (method name, multi-instance) thread entries targeting self.m.
+    thread_targets: List[Tuple[str, bool]] = field(default_factory=list)
+    #: condition attr -> lock attr it wraps (Condition(self._lock)).
+    cond_wraps: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ThreadEntry:
+    """One ``threading.Thread(target=...)`` site, resolved."""
+    lineno: int
+    multi: bool
+    target: Optional[FuncInfo]
+
+
+@dataclass
+class ModuleModel:
+    mod: core.ModuleSource
+    key: str  # stable short module key for lock-site names
+    aliases: Dict[str, str] = field(default_factory=dict)
+    parents: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    module_locks: Dict[str, str] = field(default_factory=dict)
+    #: global name -> (lockspec, decl lineno).
+    module_guarded: Dict[str, Tuple[str, int]] = field(default_factory=dict)
+    funcs: Dict[FuncNode, FuncInfo] = field(default_factory=dict)
+    entries: List[ThreadEntry] = field(default_factory=list)
+
+
+class ProjectModel:
+    """The whole-sweep model: per-module models plus the cross-module
+    indices (class registry, known lock attribute names)."""
+
+    def __init__(self, files: Sequence[core.ModuleSource]):
+        self.modules: List[ModuleModel] = []
+        self.class_index: Dict[str, ClassInfo] = {}
+        self.lock_attr_names: Set[str] = set()
+        self._flat_cache: Dict[int, "FlatClass"] = {}
+        for mod in files:
+            if mod.parse_error is not None or mod.tree is None:
+                continue
+            mm = _build_module(mod)
+            self.modules.append(mm)
+            for cname, cls in mm.classes.items():
+                # last definition wins on a (rare) name collision —
+                # good enough for message rendering and lock keys
+                self.class_index[cname] = cls
+                self.lock_attr_names |= set(cls.lock_attrs)
+            self.lock_attr_names |= set(mm.module_locks)
+
+    # -- flattened class views (same-project single-inheritance) ------
+
+    def flattened(self, cls: ClassInfo) -> "FlatClass":
+        got = self._flat_cache.get(id(cls))
+        if got is None:
+            got = FlatClass(cls, self)
+            self._flat_cache[id(cls)] = got
+        return got
+
+
+class FlatClass:
+    """A class with its project-resolvable base chain folded in:
+    method table (overrides win), lock/queue/event/thread sites,
+    guarded-attr declarations, and thread entries, each attributed to
+    the DEFINING class so lock keys unify across the hierarchy."""
+
+    def __init__(self, cls: ClassInfo, project: ProjectModel):
+        self.cls = cls
+        self.name = cls.name
+        chain: List[ClassInfo] = []
+        seen = set()
+        todo = [cls]
+        while todo:
+            c = todo.pop(0)
+            if c.name in seen:
+                continue
+            seen.add(c.name)
+            chain.append(c)
+            for b in c.bases:
+                base = project.class_index.get(b)
+                if base is not None:
+                    todo.append(base)
+        self.chain = chain  # derived first
+        self.thread_shared = any(c.thread_shared for c in chain)
+        self.methods: Dict[str, FuncInfo] = {}
+        self.lock_attrs: Dict[str, Tuple[str, str]] = {}  # attr->(owner,kind)
+        self.queue_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        self.guarded_attrs: Dict[str, Tuple[str, int, ClassInfo]] = {}
+        self.attr_class: Dict[str, str] = {}
+        self.cond_wraps: Dict[str, str] = {}
+        self.thread_targets: List[Tuple[str, bool]] = []
+        for c in chain:  # derived first: first writer wins = override
+            for mname, fi in c.methods.items():
+                self.methods.setdefault(mname, fi)
+            for attr, kind in c.lock_attrs.items():
+                self.lock_attrs.setdefault(attr, (c.name, kind))
+            self.queue_attrs |= c.queue_attrs
+            self.event_attrs |= c.event_attrs
+            self.thread_attrs |= c.thread_attrs
+            for attr, (spec, ln) in c.guarded_attrs.items():
+                self.guarded_attrs.setdefault(attr, (spec, ln, c))
+            for attr, k in c.attr_class.items():
+                self.attr_class.setdefault(attr, k)
+            for cond, lk in c.cond_wraps.items():
+                self.cond_wraps.setdefault(cond, lk)
+            self.thread_targets.extend(c.thread_targets)
+        self.sync_attrs = (set(self.lock_attrs) | self.queue_attrs
+                          | self.event_attrs | self.thread_attrs)
+        self._contexts: Optional[Dict[str, Set[str]]] = None
+        self._multi: Dict[str, bool] = {}
+
+    def lock_key(self, attr: str) -> Optional[Tuple[LockKey, str]]:
+        got = self.lock_attrs.get(attr)
+        if got is None:
+            return None
+        owner, kind = got
+        return ("cls", owner, attr), kind
+
+    # -- thread-context propagation -----------------------------------
+
+    def contexts(self) -> Dict[str, Set[str]]:
+        """method name -> set of context labels ('caller' or
+        'thread:<entry>'), via fixpoint over the intra-class call
+        graph.  ``multi_label(label)`` says whether a label stands
+        for more than one concurrent thread."""
+        if self._contexts is not None:
+            return self._contexts
+        ctxs: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        entry_names = set()
+        for mname, multi in self.thread_targets:
+            if mname in ctxs:
+                label = f"thread:{mname}"
+                ctxs[mname].add(label)
+                entry_names.add(mname)
+                self._multi[label] = self._multi.get(label, False) or multi
+        edges: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        callers: Dict[str, Set[str]] = {m: set() for m in self.methods}
+        for mname, fi in self.methods.items():
+            for node in ast.walk(fi.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                        and node.func.attr in self.methods):
+                    edges[mname].add(node.func.attr)
+                    callers[node.func.attr].add(mname)
+        for mname in self.methods:
+            is_public = not mname.startswith("_") or (
+                mname.startswith("__") and mname.endswith("__"))
+            if mname in entry_names:
+                continue
+            if is_public or not callers[mname]:
+                # externally callable (or dead-from-inside): runs on
+                # whatever thread the caller is — the caller context
+                ctxs[mname].add(CALLER)
+        changed = True
+        while changed:
+            changed = False
+            for mname, callees in edges.items():
+                for callee in callees:
+                    if not ctxs[mname] <= ctxs[callee]:
+                        ctxs[callee] |= ctxs[mname]
+                        changed = True
+        self._contexts = ctxs
+        return ctxs
+
+    def multi_label(self, label: str) -> bool:
+        return self._multi.get(label, False)
+
+    def context_weight(self, labels: Set[str]) -> int:
+        """How many concurrent executors the label set stands for —
+        >= 2 means unsynchronized writes can race."""
+        w = 0
+        for label in labels:
+            if label == CALLER:
+                w += 2 if self.thread_shared else 1
+            else:
+                w += 2 if self.multi_label(label) else 1
+        return w
+
+
+# ---------------------------------------------------------------------
+# module construction
+
+
+def _def_comment_lines(mod: core.ModuleSource, node: FuncNode) -> str:
+    """The comment-bearing text of a (possibly multi-line) def
+    signature: from the ``def`` line to the line before the body."""
+    start = node.lineno
+    stop = node.body[0].lineno if node.body else node.lineno + 1
+    return "\n".join(mod.line(i) for i in range(start, stop))
+
+
+def _contains_thread_call(node: ast.expr, aliases: Dict[str, str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            if dataflow.dotted_name(sub.func, aliases) == THREAD_FACTORY:
+                return True
+    return False
+
+
+def _build_module(mod: core.ModuleSource) -> ModuleModel:
+    tree = mod.tree
+    assert tree is not None
+    parts = mod.path.parts
+    key = "/".join(parts[-2:]) if len(parts) >= 2 else mod.path.name
+    mm = ModuleModel(mod=mod, key=key,
+                     aliases=dataflow.build_aliases(tree))
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            mm.parents[child] = parent
+
+    # classes + funcs skeleton
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            cls = ClassInfo(
+                node=node, name=node.name,
+                bases=[b.id for b in node.bases
+                       if isinstance(b, ast.Name)],
+                thread_shared=bool(
+                    _THREAD_SHARED_RE.search(mod.line(node.lineno))))
+            mm.classes[node.name] = cls
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cls = _enclosing_class(mm, node)
+        fi = FuncInfo(node=node, name=node.name,
+                      qualname=_qualname(mm, node), cls=cls)
+        sig = _def_comment_lines(mod, node)
+        for m in _GUARDED_BY_RE.finditer(sig):
+            fi.guarded_by.append(m.group(1))
+        m = _OWNS_TICKETS_RE.search(sig)
+        if m:
+            fi.owns_tickets = [s.strip() for s in m.group(1).split(",")]
+        mm.funcs[node] = fi
+        if cls is not None and mm.parents.get(node) is cls.node:
+            cls.methods[node.name] = fi
+
+    # sites: locks / queues / events / threads, per scope
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        dotted = (dataflow.dotted_name(value.func, mm.aliases)
+                  if isinstance(value, ast.Call) else None)
+        kind = LOCK_FACTORIES.get(dotted or "")
+        is_queue = dotted in QUEUE_FACTORIES
+        is_event = dotted == EVENT_FACTORY
+        is_thread = _contains_thread_call(value, mm.aliases)
+        ctor_cls = None
+        if isinstance(value, ast.Call):
+            tail = (dotted or "").rsplit(".", 1)[-1]
+            if tail in mm.classes or tail and tail[:1].isupper():
+                ctor_cls = tail
+        owner_fi = _enclosing_funcinfo(mm, node)
+        line = mod.line(node.lineno)
+        gm = _GUARDED_BY_RE.search(line)
+        for tgt in targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                cls = owner_fi.cls if owner_fi else None
+                if cls is None:
+                    continue
+                if kind:
+                    cls.lock_attrs[tgt.attr] = kind
+                    if (kind == "condition"
+                            and isinstance(value, ast.Call)
+                            and value.args
+                            and isinstance(value.args[0], ast.Attribute)
+                            and isinstance(value.args[0].value, ast.Name)
+                            and value.args[0].value.id == "self"):
+                        cls.cond_wraps[tgt.attr] = value.args[0].attr
+                elif is_queue:
+                    cls.queue_attrs.add(tgt.attr)
+                elif is_event:
+                    cls.event_attrs.add(tgt.attr)
+                elif is_thread:
+                    cls.thread_attrs.add(tgt.attr)
+                elif ctor_cls:
+                    cls.attr_class[tgt.attr] = ctor_cls
+                if gm and not kind:
+                    cls.guarded_attrs[tgt.attr] = (gm.group(1), node.lineno)
+            elif isinstance(tgt, ast.Name):
+                if owner_fi is None:  # module level
+                    if kind:
+                        mm.module_locks[tgt.id] = kind
+                    elif gm:
+                        mm.module_guarded[tgt.id] = (gm.group(1),
+                                                     node.lineno)
+                else:
+                    if kind:
+                        owner_fi.local_locks[tgt.id] = kind
+                    elif is_queue:
+                        owner_fi.local_queues.add(tgt.id)
+                    elif is_event:
+                        owner_fi.local_events.add(tgt.id)
+                    elif is_thread:
+                        owner_fi.local_threads.add(tgt.id)
+
+    # names iterated from a thread-list attribute count as threads
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and isinstance(node.iter, ast.Attribute)
+                and isinstance(node.iter.value, ast.Name)
+                and node.iter.value.id == "self"):
+            fi = _enclosing_funcinfo(mm, node)
+            if fi is not None and fi.cls is not None \
+                    and node.iter.attr in fi.cls.thread_attrs:
+                fi.local_threads.add(node.target.id)
+
+    # thread entries
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and dataflow.dotted_name(node.func, mm.aliases)
+                == THREAD_FACTORY):
+            continue
+        target_expr = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target_expr = kw.value
+        multi = _in_multi_context(mm, node)
+        target_fi = _resolve_target(mm, node, target_expr)
+        mm.entries.append(ThreadEntry(lineno=node.lineno, multi=multi,
+                                      target=target_fi))
+        if (target_fi is not None and target_fi.cls is not None
+                and isinstance(target_expr, ast.Attribute)):
+            target_fi.cls.thread_targets.append((target_fi.name, multi))
+    return mm
+
+
+def _qualname(mm: ModuleModel, node: FuncNode) -> str:
+    parts = [node.name]
+    cur = mm.parents.get(node)
+    while cur is not None and not isinstance(cur, ast.Module):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = mm.parents.get(cur)
+    return ".".join(reversed(parts))
+
+
+def _enclosing_class(mm: ModuleModel, node: ast.AST) -> Optional[ClassInfo]:
+    cur = mm.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return mm.classes.get(cur.name)
+        cur = mm.parents.get(cur)
+    return None
+
+
+def _enclosing_funcinfo(mm: ModuleModel,
+                        node: ast.AST) -> Optional[FuncInfo]:
+    cur = mm.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return mm.funcs.get(cur)
+        cur = mm.parents.get(cur)
+    return None
+
+
+def _in_multi_context(mm: ModuleModel, node: ast.AST) -> bool:
+    """True when the Thread(...) is constructed inside a loop or
+    comprehension — N instances of one target are N contexts."""
+    cur = mm.parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        if isinstance(cur, (ast.For, ast.While, ast.ListComp,
+                            ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            return True
+        cur = mm.parents.get(cur)
+    return False
+
+
+def _resolve_target(mm: ModuleModel, site: ast.AST,
+                    expr: Optional[ast.expr]) -> Optional[FuncInfo]:
+    if expr is None:
+        return None
+    if (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        cls = None
+        fi = _enclosing_funcinfo(mm, site)
+        if fi is not None:
+            cls = fi.cls
+        if cls is not None:
+            return cls.methods.get(expr.attr)
+        return None
+    if isinstance(expr, ast.Name):
+        # nearest enclosing function with a nested def of that name,
+        # else a module-level function
+        cur = mm.parents.get(site)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module)):
+                for child in ast.iter_child_nodes(cur):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) \
+                            and child.name == expr.id:
+                        return mm.funcs.get(child)
+                if isinstance(cur, ast.Module):
+                    break
+            cur = mm.parents.get(cur)
+    return None
+
+
+# ---------------------------------------------------------------------
+# lock resolution / locks-held
+
+
+def attr_chain(node: ast.expr) -> Optional[List[str]]:
+    """``self.breaker._lock`` -> ['self', 'breaker', '_lock'];
+    None for non-name roots."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return list(reversed(parts))
+
+
+def resolve_lock_expr(project: ProjectModel, mm: ModuleModel,
+                      fi: Optional[FuncInfo],
+                      expr: ast.expr) -> Optional[Tuple[LockKey, str]]:
+    """Resolve a ``with``-style expression to a canonical lock key, or
+    None when it is not a known lock.  Unknown receivers widen to a
+    'foreign' key only when the terminal attribute is a known lock
+    attribute name somewhere in the sweep."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    cls = fi.cls if fi is not None else None
+    if chain[0] == "self" and cls is not None:
+        flat = project.flattened(cls)
+        rest = chain[1:]
+        if len(rest) == 1:
+            got = flat.lock_key(rest[0])
+            if got is not None:
+                return got
+        if len(rest) >= 2 and rest[0] in flat.attr_class:
+            other = project.class_index.get(flat.attr_class[rest[0]])
+            if other is not None:
+                oflat = project.flattened(other)
+                got = oflat.lock_key(rest[1])
+                if got is not None and len(rest) == 2:
+                    return got
+        if rest[-1] in project.lock_attr_names:
+            return (("foreign", cls.name, ".".join(chain)), "foreign")
+        return None
+    if len(chain) == 1:
+        name = chain[0]
+        cur = fi
+        while cur is not None:
+            if name in cur.local_locks:
+                return (("fn", f"{mm.key}:{cur.qualname}", name),
+                        cur.local_locks[name])
+            cur = _enclosing_funcinfo(mm, cur.node)
+        if name in mm.module_locks:
+            return (("mod", mm.key, name), mm.module_locks[name])
+    if chain[-1] in project.lock_attr_names:
+        scope = cls.name if cls is not None else mm.key
+        return (("foreign", scope, ".".join(chain)), "foreign")
+    return None
+
+
+def resolve_lock_spec(project: ProjectModel, mm: ModuleModel,
+                      fi: Optional[FuncInfo],
+                      spec: str) -> Optional[Tuple[LockKey, str]]:
+    """Resolve an annotation string ('self._lock', '_lock', 'lk')."""
+    try:
+        expr = ast.parse(spec, mode="eval").body
+    except SyntaxError:
+        return None
+    return resolve_lock_expr(project, mm, fi, expr)
+
+
+def locks_held(project: ProjectModel, mm: ModuleModel,
+               node: ast.AST) -> Dict[LockKey, str]:
+    """Lock keys lexically held at ``node``: enclosing ``with``
+    statements up to the function boundary, plus the enclosing
+    function's def-line ``# guarded-by`` annotations (callers hold
+    those by contract)."""
+    held: Dict[LockKey, str] = {}
+    fi = _enclosing_funcinfo(mm, node)
+    cur = mm.parents.get(node)
+    prev = node
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            in_body = any(prev is stmt or _is_descendant(mm, prev, stmt)
+                          for stmt in cur.body)
+            # only the body holds the lock (not the context expr)
+            if in_body or (hasattr(prev, "lineno") and cur.body
+                           and prev.lineno >= cur.body[0].lineno):
+                for item in cur.items:
+                    got = resolve_lock_expr(project, mm, fi,
+                                            item.context_expr)
+                    if got is not None:
+                        held.setdefault(got[0], got[1])
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        prev = cur
+        cur = mm.parents.get(cur)
+    if fi is not None:
+        for spec in fi.guarded_by:
+            got = resolve_lock_spec(project, mm, fi, spec)
+            if got is not None:
+                held.setdefault(got[0], got[1])
+    return held
+
+
+def _is_descendant(mm: ModuleModel, node: ast.AST,
+                   ancestor: ast.AST) -> bool:
+    cur = node
+    while cur is not None:
+        if cur is ancestor:
+            return True
+        cur = mm.parents.get(cur)
+    return False
+
+
+# ---------------------------------------------------------------------
+# attribute-access collection (guarded-attr's raw material)
+
+
+@dataclass
+class AttrAccess:
+    attr: str
+    node: ast.Attribute
+    lineno: int
+    method: str
+    is_write: bool
+
+
+def collect_self_accesses(flat: FlatClass) -> List[AttrAccess]:
+    """Every ``self.X`` access in the flattened class's methods,
+    classified read/write (Store/Del, subscript stores, and mutator
+    method calls all count as writes).  Synchronization attributes
+    (locks, queues, events, thread handles) are excluded — calling
+    methods on those IS their contract."""
+    out: List[AttrAccess] = []
+    for mname, fi in flat.methods.items():
+        mm_parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(fi.node):
+            for child in ast.iter_child_nodes(parent):
+                mm_parents[child] = parent
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                continue
+            if node.attr in flat.sync_attrs:
+                continue
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            cur, parent = node, mm_parents.get(node)
+            while not is_write and parent is not None:
+                if isinstance(parent, ast.Subscript) \
+                        and parent.value is cur:
+                    if isinstance(parent.ctx, (ast.Store, ast.Del)):
+                        is_write = True
+                        break
+                    cur, parent = parent, mm_parents.get(parent)
+                    continue
+                if isinstance(parent, ast.Attribute) \
+                        and parent.value is cur \
+                        and parent.attr in MUTATORS:
+                    grand = mm_parents.get(parent)
+                    if isinstance(grand, ast.Call) \
+                            and grand.func is parent:
+                        is_write = True
+                    break
+                break
+            out.append(AttrAccess(attr=node.attr, node=node,
+                                  lineno=node.lineno, method=mname,
+                                  is_write=is_write))
+    return out
+
+
+# ---------------------------------------------------------------------
+# shared model cache (one build per `core.run` invocation)
+
+_MODEL_CACHE: Dict[Tuple[int, ...], ProjectModel] = {}
+
+
+def get_model(files: Sequence[core.ModuleSource]) -> ProjectModel:
+    key = tuple(id(f) for f in files)
+    model = _MODEL_CACHE.get(key)
+    if model is None:
+        _MODEL_CACHE.clear()  # one sweep at a time; don't leak parses
+        model = ProjectModel(files)
+        _MODEL_CACHE[key] = model
+    return model

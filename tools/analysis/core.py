@@ -216,6 +216,31 @@ class _DeadSuppressionProbe(Rule):
            "(stale suppressions rot in place)")
 
 
+def all_tier_rule_names() -> set:
+    """Every rule name across ALL analyzer tiers (AST, compiled,
+    concurrency) plus the engine-level pseudo-rules — the universe a
+    ``# lint-ok:`` marker may legitimately name.  Imported lazily so
+    a broken tier degrades to 'its names look unknown' instead of
+    taking the other tiers down."""
+    names = {"parse-error", "dead-suppression", "build-error"}
+    try:
+        from tools.analysis.rules import ALL_RULES
+        names |= {r.name for r in ALL_RULES}
+    except ImportError:
+        pass
+    try:
+        from tools.analysis.compiled.rules import COMPILED_RULES
+        names |= {r.name for r in COMPILED_RULES}
+    except ImportError:
+        pass
+    try:
+        from tools.analysis.concurrency.rules import CONCURRENCY_RULES
+        names |= {r.name for r in CONCURRENCY_RULES}
+    except ImportError:
+        pass
+    return names
+
+
 def audit_suppressions(rules: Sequence[Rule],
                        files: Sequence[ModuleSource]) -> List[Violation]:
     """Dead-suppression audit: every ``# lint-ok: <rule>: ...`` comment
@@ -227,15 +252,12 @@ def audit_suppressions(rules: Sequence[Rule],
     marker may belong to an unselected rule."""
     probe = _DeadSuppressionProbe()
     known = {r.name for r in rules}
-    # markers naming a COMPILED-tier rule (BUILDING.md's documented
-    # suppression at a contracts.py @register site) belong to the
-    # other tier: not unknown, and their liveness is judged against
-    # built artifacts, which a source sweep cannot do — skip them
-    try:
-        from tools.analysis.compiled.rules import COMPILED_RULES
-        other_tier = {r.name for r in COMPILED_RULES} | {"build-error"}
-    except ImportError:
-        other_tier = set()
+    # markers naming ANOTHER tier's rule belong to that tier: not
+    # unknown, and their liveness is judged by that tier's own audit
+    # over its own sweep/artifacts — skip them here.  (The AST tier
+    # sweeps files carrying concurrency-tier markers and vice versa;
+    # compiled-tier markers sit at contracts.py @register sites.)
+    other_tier = all_tier_rule_names() - known
     out: List[Violation] = []
     for mod in files:
         if mod.parse_error is not None:

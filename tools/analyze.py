@@ -38,24 +38,45 @@ is always the authoritative breakdown (statuses >= 128 can also be
 signal deaths, which print no summary).  0 means clean.  Suppress one
 finding with ``# lint-ok: <rule>: <reason>`` on the flagged line.
 
-A second, *compiled-artifact* tier checks contracts against what XLA
-actually compiled (sharding, donation, collectives, dtype,
-host-transfer) for the registry of production programs declared in
-``tempo_tpu/plan/contracts.py``::
+Two further tiers share the engine and CLI; each owns its OWN exit-bit
+space (the tiers are separate invocations, so statuses never mix).
+All three bit spaces in one table:
 
-    python tools/analyze.py --compiled             # whole registry
-    python tools/analyze.py --compiled --program fused.asof_stats_ema
+================ ==== ==================== ==== =================== ====
+AST tier         exit compiled tier        exit concurrency tier    exit
+(default)             (--compiled)              (--threads)
+================ ==== ==================== ==== =================== ====
+vmem-budget         1 no-f64-leak             1 guarded-attr           1
+weak-dtype          2 no-host-transfer        2 wait-loop              2
+dynamic-gather      4 collective-inventory    4 lock-order             4
+grid-carry          8 donation-applied        8 blocking-under-lock    8
+env-knobs          16 stage-sharding-match   16 ticket-resolution     16
+bare-except        32 recompile-coverage     32
+parse-error        64 build-error            64 parse-error           64
+plan-registry     128
+dead-suppression  256 dead-suppression      256 dead-suppression    256
+================ ==== ==================== ==== =================== ====
 
-The compiled tier owns its own exit-bit space (see
-``tools/analysis/compiled``) — the two tiers are separate invocations,
-so their statuses never mix.
+* ``--compiled`` checks contracts against what XLA actually compiled
+  (sharding, donation, collectives, dtype, host-transfer) for the
+  production-program registry in ``tempo_tpu/plan/contracts.py``.
+* ``--threads`` checks the threaded host runtime: a thread-entry
+  graph + lock-site map over ``tempo_tpu/`` drive race/deadlock/
+  liveness rules (``# guarded-by:`` / ``# thread-shared`` /
+  ``# owns-tickets:`` annotations, checked both ways).  See
+  BUILDING.md "Concurrency discipline".
+
+An unknown ``--rule`` name exits 2 (argparse's usage status) under
+every tier.
 
 Usage::
 
     python tools/analyze.py                  # default sweep, all rules
     python tools/analyze.py --rule vmem-budget [paths...]
-    python tools/analyze.py --list-rules     # both tiers
+    python tools/analyze.py --list-rules     # all three tiers
     python tools/analyze.py --compiled
+    python tools/analyze.py --threads
+    python tools/analyze.py --threads --rule guarded-attr tempo_tpu/serve
 """
 
 from __future__ import annotations
@@ -84,8 +105,14 @@ def default_paths() -> list:
 
 
 def main(argv=None) -> int:
+    # --help carries the three-tier exit-bit table from the module
+    # docstring (one source of truth for all three bit spaces)
+    table = __doc__[__doc__.index("All three bit spaces"):
+                    __doc__.index("Usage::")].rstrip()
     ap = argparse.ArgumentParser(
-        description="tempo-tpu kernel-safety static analyzer")
+        description="tempo-tpu kernel-safety static analyzer",
+        epilog=table,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to sweep (default: tempo_tpu/, "
                          "tools/, tests/helpers.py, __graft_entry__.py)")
@@ -101,6 +128,11 @@ def main(argv=None) -> int:
                     default=None, metavar="NAME",
                     help="with --compiled: check only the named "
                          "registry program(s)")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the concurrency-discipline tier (thread-"
+                         "entry graph + lock-site map over tempo_tpu/; "
+                         "race/deadlock/liveness rules) instead of the "
+                         "AST tier")
     ap.add_argument("--root", type=Path, default=_REPO,
                     help="project root for whole-tree consistency passes "
                          "(BUILDING.md / knob registry)")
@@ -108,6 +140,7 @@ def main(argv=None) -> int:
 
     if args.list_rules:
         from tools.analysis import compiled as compiled_tier
+        from tools.analysis.concurrency import CONCURRENCY_RULES
 
         print("AST tier (python tools/analyze.py):")
         for rule in ALL_RULES:
@@ -122,10 +155,29 @@ def main(argv=None) -> int:
         print(f"  {'build-error':18s} exit "
               f"{compiled_tier.BUILD_ERROR_CODE:3d}  registry programs "
               f"that fail to build/compile at all")
+        print("concurrency tier (python tools/analyze.py --threads; "
+              "separate exit-bit space):")
+        for rule in CONCURRENCY_RULES:
+            print(f"  {rule.name:19s} exit {rule.code:3d}  {rule.doc}")
+        print(f"  {'dead-suppression':19s} exit "
+              f"{core.DEAD_SUPPRESSION_CODE:3d}  stale '# lint-ok:' "
+              f"markers whose rule never fires on that line")
         return 0
 
     if args.programs and not args.compiled:
         ap.error("--program requires --compiled")
+    if args.compiled and args.threads:
+        ap.error("--compiled and --threads are separate tiers; pick one")
+    if args.threads:
+        from tools.analysis import concurrency as conc_tier
+
+        if args.paths:
+            missing = [p for p in args.paths if not Path(p).exists()]
+            if missing:
+                ap.error("no such path(s): "
+                         + ", ".join(str(p) for p in missing))
+        return _fold_status(conc_tier.main(
+            paths=args.paths or None, rules=args.rules))
     if args.compiled:
         from tools.analysis import compiled as compiled_tier
 

@@ -1,14 +1,19 @@
 #!/usr/bin/env bash
 # The enforced gate, runnable as one command: the kernel-safety static
 # analyzer (tools/analyze.py — exit code ORs the fired rule bits, see
-# BUILDING.md "Static analysis"), the compiled-contract tier over the
-# production-program registry (BUILDING.md "Compiled contracts"),
-# then the tier-1 test suite exactly as ROADMAP.md specifies it.
+# BUILDING.md "Static analysis"), the concurrency-discipline tier over
+# the threaded host runtime (BUILDING.md "Concurrency discipline"),
+# the compiled-contract tier over the production-program registry
+# (BUILDING.md "Compiled contracts"), then the tier-1 test suite
+# exactly as ROADMAP.md specifies it.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== static analysis (tools/analyze.py) =="
 python tools/analyze.py || exit $?
+
+echo "== concurrency discipline (tools/analyze.py --threads) =="
+python tools/analyze.py --threads || exit $?
 
 echo "== compiled contracts (tools/analyze.py --compiled) =="
 JAX_PLATFORMS=cpu python tools/analyze.py --compiled || exit $?
