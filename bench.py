@@ -3491,6 +3491,161 @@ def bench_sql(seed=19):
     }
 
 
+def bench_standing(seed=20):
+    """Config 20 (--only-standing): continuous queries — thousands of
+    concurrent standing subscriptions over one live
+    :class:`StreamTable` under Poisson event arrivals
+    (``tempo_tpu/query``, round 20).
+
+    A fleet of subscriptions across every split mode — EMA deltas on
+    two serving coefficients (incremental carries on the shared
+    planes), stateless projections, and a remainder-mode range-stats
+    aggregate — registers against one table, then the measured phase
+    drives Poisson-timed push batches (exponential inter-event gaps on
+    one shared strictly-increasing timeline) through the merged-stream
+    watermark, flushing the delivery worker each push so the timed
+    unit is admit -> every subscriber notified.  Hard in-bench
+    invariants:
+
+    * **zero recompiles at steady state** — after the warmup pushes
+      the plan cache's builds counter stays flat across the whole
+      measured phase (the incremental step programs and the fixed
+      push-shape host paths are all warm; a single recompile across
+      thousands of subscribers fails the bench);
+    * **bitwise** — sampled subscriptions' ``result()`` equals a full
+      batch re-run of the registered canonical plan over the table's
+      unified snapshot, one sample per split mode (delta on BOTH
+      alphas, stateless, remainder);
+    * **no silent drops** — per-subscriber backpressure is reported
+      (``dropped``), and a drop can only shed queued notifications,
+      never rows from ``result()``.
+
+    The record carries pushes/s, subscriber-notification fanout/s, and
+    the per-push end-to-end latency p50/p99.
+    """
+    import pandas as pd
+
+    from tempo_tpu import profiling
+    from tempo_tpu.plan import cache as plan_cache
+    from tempo_tpu.query import StandingQueryEngine, StreamTable
+    from tempo_tpu.query.standing import _run_batch
+
+    rng = np.random.default_rng(seed)
+    n_delta, n_stateless, n_remainder = 1536, 384, 128
+    warm_pushes, meas_pushes, rows_per_push = 6, 24, 128
+    if os.environ.get("TEMPO_BENCH_SMOKE"):
+        n_delta, n_stateless, n_remainder = 64, 24, 8
+        warm_pushes, meas_pushes, rows_per_push = 3, 6, 32
+    syms = np.asarray(["AAA", "BBB"], object)
+
+    # Poisson arrivals: exponential inter-event gaps, cumsum'd into one
+    # strictly increasing ns timeline, sliced into push batches (each
+    # slice is trivially admissible under the merged-stream watermark)
+    n_rows = (1 + warm_pushes + meas_pushes) * rows_per_push
+    gaps = rng.exponential(scale=2e6, size=n_rows).astype(np.int64) + 1
+    ts = np.cumsum(gaps) + np.int64(10 ** 9)
+    timeline = pd.DataFrame({
+        "event_ts": ts,
+        "sym": syms[rng.integers(0, len(syms), n_rows)],
+        "px": np.where(rng.random(n_rows) < 0.05, np.nan,
+                       rng.normal(100.0, 5.0, n_rows)),
+    })
+
+    def batch(i):
+        lo = i * rows_per_push
+        return timeline.iloc[lo:lo + rows_per_push]
+
+    plan_cache.CACHE.clear()
+    t = StreamTable("ticks", "event_ts", ["sym"], ["px"])
+    t.append(batch(0))                 # seed history -> catchup replay
+    # remainder refreshes run the batch executor over a GROWING
+    # snapshot (new shapes compile); push them past the horizon so the
+    # measured phase stays recompile-free — result() still re-runs
+    eng = StandingQueryEngine(remainder_every=10 ** 6)
+    alphas = (0.2, 0.35)
+    audit, modes = {}, {"delta": 0, "stateless": 0, "remainder": 0}
+    queries = []
+    for i in range(n_delta):
+        queries.append(("delta", t.frame().EMA(
+            "px", exp_factor=alphas[i % 2], exact=True)))
+    for i in range(n_stateless):
+        queries.append(("stateless",
+                        t.frame().select("event_ts", "sym", "px")))
+    for i in range(n_remainder):
+        queries.append(("remainder", t.frame().withRangeStats(
+            colsToSummarize=["px"], rangeBackWindowSecs=600)))
+    r0 = time.perf_counter()
+    for want, q in queries:
+        sub = eng.register(q)
+        assert sub.mode == want, (want, sub.mode, sub.reason)
+        modes[want] += 1
+        # one audited sample per mode, plus the second EMA alpha
+        audit.setdefault(
+            want if want != "delta" else f"delta_a{sub.plan.emas[0].alpha}",
+            sub)
+    register_wall = time.perf_counter() - r0
+    n_subs = len(queries)
+
+    for i in range(warm_pushes):
+        eng.push(t, batch(1 + i))
+        eng.flush()
+
+    builds0 = profiling.plan_cache_stats()["builds"]
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(meas_pushes):
+        p0 = time.perf_counter()
+        eng.push(t, batch(1 + warm_pushes + i))
+        eng.flush()
+        lat.append(time.perf_counter() - p0)
+    wall = time.perf_counter() - t0
+    pc = profiling.plan_cache_stats()
+    assert pc["builds"] == builds0, (
+        f"standing steady state recompiled: builds went {builds0} -> "
+        f"{pc['builds']} across {n_subs} subscriptions "
+        f"(by_signature={pc['by_signature']})")
+
+    # bitwise: sampled standing results == batch re-run of the
+    # canonical plan over the unified snapshot (AFTER the steady-state
+    # assert — the batch twin may compile whatever it wants)
+    snap = {t.name: t.snapshot_df()}
+    for label, sub in audit.items():
+        res = sub.result()
+        twin = _run_batch(sub.plan.root, dict(snap))
+        assert list(res.df.columns) == list(twin.df.columns), label
+        assert len(res.df) == len(twin.df), label
+        for c in res.df.columns:
+            a = res.df[c].to_numpy()
+            b = twin.df[c].to_numpy()
+            if a.dtype.kind == "f":
+                assert a.tobytes() == b.tobytes(), (label, c)
+            else:
+                assert (a == b).all(), (label, c)
+    dropped = sum(s.dropped for s in audit.values())
+    eng.close()
+
+    lat_ms = np.sort(np.asarray(lat) * 1e3)
+    return {
+        "pushes_per_sec": round(meas_pushes / wall, 2),
+        "rows_per_sec": round(meas_pushes * rows_per_push / wall, 1),
+        "notifications_per_sec": round(n_subs * meas_pushes / wall, 1),
+        "n_subscriptions": n_subs,
+        "modes": modes,
+        "rows_total": int(t.rows_total()),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+        "register_per_sec": round(n_subs / register_wall, 1),
+        "dropped": int(dropped),
+        "plan_cache": {k: pc[k] for k in
+                       ("hits", "misses", "builds", "evictions")},
+        "zero_builds_steady_state": True,
+        "value_audit": "sampled standing result() == batch re-run of "
+                       "the canonical plan over the unified snapshot "
+                       "bitwise, one sample per split mode (delta on "
+                       "both alphas, stateless, remainder)",
+    }
+
+
 def bench_chaos_serving(seed=15):
     """Config 15 (--only-chaos-serving): the fault-domain chaos
     campaign against live serving + query planes
@@ -3794,6 +3949,12 @@ def main():
             raise SystemExit(1)
         print(json.dumps(res))
         return
+    if "--only-standing" in sys.argv:
+        res = _attempt("standing", bench_standing)
+        if res is None:
+            raise SystemExit(1)
+        print(json.dumps(res))
+        return
     if "--only-chaos-serving" in sys.argv:
         res = _attempt("chaos_serving", bench_chaos_serving)
         if res is None:
@@ -3946,6 +4107,8 @@ def main():
     query_service = _config_subprocess("--only-query-service",
                                        "query_service", timeout=2400)
     sql_rec = _config_subprocess("--only-sql", "sql", timeout=2400)
+    standing_rec = _config_subprocess("--only-standing", "standing",
+                                      timeout=2400)
     chaos_serving = _config_subprocess("--only-chaos-serving",
                                        "chaos_serving", timeout=2400)
     # config 16 needs a multi-device mesh for real shard-resume
@@ -4131,6 +4294,14 @@ def main():
             # baseline rate and the explain() seam proof
             "19_sql_service_qps": (
                 round(sql_rec["qps"]) if sql_rec else None),
+            # per-push fanout rate across thousands of concurrent
+            # standing subscriptions (round 20) — Poisson arrivals,
+            # zero recompiles asserted across the measured phase,
+            # sampled result() bitwise vs the batch re-run over the
+            # unified snapshot in every split mode
+            "20_standing_notifications_per_sec": (
+                round(standing_rec["notifications_per_sec"])
+                if standing_rec else None),
         },
         # 1->2->4->8 device sweep of config 7's frame chain: rows/s per
         # device count, scaling efficiency vs 1 device, per-stage comm
@@ -4153,6 +4324,12 @@ def main():
         # and the eager oracle, the explain() seam (sql nodes + the
         # eval[sql] backend pick) rendered before execution
         "sql": sql_rec,
+        # config 20: continuous queries — thousands of standing
+        # subscriptions (EMA delta / stateless / remainder) over one
+        # live StreamTable under Poisson pushes; pushes/s, fanout/s,
+        # per-push p50/p99, hard zero-recompile steady state, sampled
+        # standing==batch bitwise audit per split mode
+        "standing": standing_rec,
         # config 15: the fault-domain chaos campaign — no hung
         # tickets, bounded recovery, zero recompiles after recovery,
         # bitwise tails vs the uninjected twin, diff-vs-full snapshot

@@ -433,9 +433,9 @@ def load_state(path: str, verify: bool = True,
                kind: str = "stream_state"):
     """Restore a :func:`save_state` snapshot: ``(arrays dict, meta)``.
     ``kind`` names the expected snapshot family (``"stream_state"`` /
-    ``"cohort_state"``) — a mismatch raises by name so a cohort resume
-    can never silently swallow a single-stream snapshot (or vice
-    versa).  ``verify=True`` checks every array against the manifest
+    ``"cohort_state"`` / ``"standing_state"``) — a mismatch raises by
+    name so a cohort resume can never silently swallow a single-stream
+    snapshot (or vice versa).  ``verify=True`` checks every array against the manifest
     CRCs and raises :class:`CheckpointError` naming the corrupt array;
     stale ``.tmp`` residue is cleaned and a crash mid-swap falls back
     to ``.bak`` exactly like :func:`load`."""
@@ -450,7 +450,9 @@ def load_state(path: str, verify: bool = True,
             f"{path!r} is a {man['kind']!r} checkpoint, not a "
             f"{kind!r} snapshot: restore frames with checkpoint.load, "
             f"single streams with load_state(kind='stream_state'), "
-            f"cohorts with load_state(kind='cohort_state')")
+            f"cohorts with load_state(kind='cohort_state'), standing "
+            f"subscriptions with query.resume_subscription "
+            f"(kind='standing_state')")
     arrs = _load_npz(os.path.join(path, "state.npz"),
                      _npz_checksums(man, "state.npz"), verify=verify)
     return dict(arrs), man.get("meta") or {}

@@ -170,6 +170,24 @@ KNOBS: Dict[str, Knob] = _knobs(
          "artifact, per-stream acked cursors in the manifest) every N "
          "total acked events; 0 disables automatic snapshots — "
          "StreamCohort.snapshot() stays available"),
+    Knob("TEMPO_TPU_STANDING_QUEUE_DEPTH", "int", "1024",
+         "tempo_tpu/query/standing",
+         "bound of each standing subscription's notification queue; a "
+         "full queue drops the OLDEST notification (counted on "
+         "Subscription.dropped) so one slow consumer never stalls the "
+         "push path — result() stays exact regardless of drops"),
+    Knob("TEMPO_TPU_STANDING_REMAINDER_EVERY", "int", "64",
+         "tempo_tpu/query/standing",
+         "push-boundary cadence at which remainder-mode standing "
+         "queries (plans with no incremental carry) re-run the full "
+         "canonical plan over the unified scan and emit a refresh "
+         "notification; result() always re-runs regardless"),
+    Knob("TEMPO_TPU_STANDING_PUSH_PERIOD", "float", "0",
+         "tempo_tpu/query/standing",
+         "delivery-worker coalescing window in seconds: pushes "
+         "admitted within one period merge into fewer delivery "
+         "boundaries (fewer, larger cohort dispatches); 0 (default) "
+         "delivers every push as its own boundary"),
     Knob("TEMPO_TPU_COST_MODEL", "bool", "1", "tempo_tpu/plan/cost",
          "0 reverts engine picks, fusion and reshard placement to the "
          "pure rule-based decisions; on (default) they are argmins "

@@ -81,6 +81,20 @@ Registry coverage map (program -> production user):
                                 query shapes (stats-only and
                                 stats+EMA), donation + alignment
                                 collectives pinned
+``standing.step``               the standing-query engine's
+                                incremental EMA step: the serving push
+                                program at the canonical standing
+                                config (EMA carry, no window/lookback
+                                planes — query/standing.py's shared
+                                subscription plane), donated retired
+                                state, zero per-push collectives
+``standing.unified_scan``       the ``ema_stream`` batch kernel
+                                (query/split.py:eval_ema_stream):
+                                the sequential split-invariant EMA
+                                scan the unified history+live path and
+                                every catch-up replay verify against,
+                                f32 pinned (the serving carry's
+                                precision), packed input donated
 ==============================  =======================================
 
 The Mosaic-lowered engines (lane-chunked join, streaming window
@@ -871,6 +885,72 @@ def _build_engine_range_windowed():
 
     compiled = jax.jit(fn).lower(secs, a["x"], a["valid"]).compile()
     return CompiledProgram("engine.range_windowed", compiled, Contract())
+
+
+@register("standing.step")
+def _build_standing_step():
+    """The standing-query engine's incremental EMA step
+    (query/standing.py): subscriptions in delta-EMA mode share a
+    serving-plane cohort whose push program IS serve/state.py's step at
+    the canonical standing config — EMA carry only, no window or
+    lookback planes (max_lookback=0, window off), one value column.
+    Contracts: retired state donated (input_output_aliases — the
+    standing fleet's steady state must update in place), no f64 creep
+    (the standing==batch bitwise contract is an f32 contract), no host
+    transfers, and zero per-push collectives (nothing in the step
+    mixes subscriptions)."""
+    from tempo_tpu.serve import state as serve_state
+
+    cfg = serve_state.StreamConfig(
+        n_series=CONTRACT_SERIES, n_cols=1, skip_nulls=True,
+        max_lookback=0, window_ns=None, rows_bound=8, ema_alpha=0.3)
+    Lb = 8
+    fn, n_state = serve_state.push_jitted(cfg, Lb)
+    compiled = fn.lower(*serve_state.push_avals(cfg, Lb)).compile()
+    donate = (tuple(range(n_state))
+              if serve_state.donate_serve_steps() else ())
+    return CompiledProgram("standing.step", compiled,
+                           Contract(donate_argnums=donate))
+
+
+@register("standing.unified_scan")
+def _build_standing_unified_scan():
+    """The ``ema_stream`` batch kernel (query/split.py:
+    eval_ema_stream): the sequential split-invariant EMA scan over the
+    packed unified history+live layout — the program every standing
+    catch-up replay, resume rebuild and batch twin run through.
+    Contracts: f32 end to end (the serving carry's precision — an f64
+    creep here would break the standing==batch bitwise identity, not
+    just the no-f64 policy), packed value plane donated (the scan's
+    output has the input's shape; the replay never needs the raw plane
+    back), no collectives, no host transfers."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tempo_tpu.ops import rolling as ops_rolling
+
+    L = contract_lanes()
+    x = jax.ShapeDtypeStruct((CONTRACT_SERIES, L), jnp.float32)
+    valid = jax.ShapeDtypeStruct((CONTRACT_SERIES, L), jnp.bool_)
+    fn = jax.jit(
+        lambda v, m: ops_rolling.ema_scan(v, m, np.float32(0.3)),
+        donate_argnums=(0,))
+    compiled = fn.lower(x, valid).compile()
+    donate = (0,) if _donate_landed(compiled) else ()
+    return CompiledProgram("standing.unified_scan", compiled,
+                           Contract(donate_argnums=donate))
+
+
+def _donate_landed(compiled) -> bool:
+    """XLA:CPU sometimes declines a requested donation (no
+    input_output_alias in the artifact); the contract pins what the
+    backend actually honoured, mirroring serve_state.donate_serve_steps
+    gating."""
+    try:
+        return "input_output_alias" in compiled.as_text()
+    except Exception:  # pragma: no cover - backend-specific
+        return False
 
 
 @register("engine.join_chunked", requires_tpu=True)

@@ -240,6 +240,11 @@ def _load_barrier(node: ir.Node, path: str, payloads: List,
 
 
 def _bind_source(node: ir.Node, payload):
+    if node.op == "unified_scan":
+        # the unified history+live source: one TSDF over everything
+        # ever written — Parquet store history plus the live tail —
+        # snapshotted at this version under the table's watermark
+        return payload.materialize()
     keep = node.ann.get("prune_to")
     if keep is None or node.op != "source":
         return payload
@@ -301,6 +306,14 @@ def _eval_op(node: ir.Node, ins: List):
             p("colName"), window=int(p("window", 30)),
             exp_factor=p("exp_factor", 0.2), exact=bool(p("exact", False)),
             inclusive_window=bool(p("inclusive_window", False)))
+    if op == "ema_stream":
+        # the standing-query canonical form of EMA(exact=True): the
+        # sequential split-invariant kernel (ops/rolling.ema_scan) the
+        # serving carries resume bitwise (query/split.py)
+        from tempo_tpu.query import split as standing_split
+
+        return standing_split.eval_ema_stream(
+            ins[0], p("colName"), float(p("exp_factor", 0.2)))
     if op == "resample":
         cols = p("metricCols")
         cols = list(cols) if cols else None

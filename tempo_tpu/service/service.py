@@ -196,6 +196,7 @@ class QueryService:
         self._counts: Dict[str, Dict[str, int]] = {}  # guarded-by: self._cond
         self._latencies: Dict[str, "collections.deque"] = {}  # guarded-by: self._cond
         self._closed = False  # guarded-by: self._cond
+        self._standing_engine = None  # guarded-by: self._cond
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"tempo-query-service-{i}")
@@ -298,6 +299,59 @@ class QueryService:
             root = ir.Node("collect", inputs=(root,))
         return self.submit(tenant, root, timeout=timeout,
                            deadline_s=deadline_s)
+
+    # -- standing queries ----------------------------------------------
+
+    def _standing(self):
+        """The service's standing-query engine, created on first
+        ``register`` (one engine shared by every tenant — subscriptions
+        on the same serving config share one AOT-warmed cohort
+        plane)."""
+        from tempo_tpu.query.standing import StandingQueryEngine
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("query service is closed")
+            if self._standing_engine is None:
+                self._standing_engine = StandingQueryEngine()
+            return self._standing_engine
+
+    def register(self, tenant: str, query):
+        """Register a planned method chain over
+        :class:`~tempo_tpu.query.unified.StreamTable` frames as a
+        **standing query**: where :meth:`submit` answers once,
+        ``register`` answers forever — every
+        :meth:`~tempo_tpu.query.standing.StandingQueryEngine.push`
+        fans out to the returned
+        :class:`~tempo_tpu.query.standing.Subscription` as an
+        incremental delta, bitwise what re-running the batch query over
+        the concatenated history produces.  Counted under the tenant
+        like a submission."""
+        eng = self._standing()
+        sub = eng.register(query)
+        with self._cond:
+            self._count(tenant, "submitted")
+            self._count(tenant, "completed")
+        return sub
+
+    def register_sql(self, tenant: str, text: str, tables):
+        """Standing twin of :meth:`submit_sql`: compile one SQL
+        statement over ``tables`` ({name: StreamTable | TSDF | lazy})
+        and register it as a standing query — StreamTable entries enter
+        the plan as ``unified_scan`` sources, so the statement answers
+        over history + live under one watermark."""
+        eng = self._standing()
+        sub = eng.register_sql(text, tables)
+        with self._cond:
+            self._count(tenant, "submitted")
+            self._count(tenant, "completed")
+        return sub
+
+    def push(self, table, df, *, deadline_s=None):
+        """Admit one batch of events for ``table`` and fan it out to
+        every standing subscription registered through this service
+        (see :meth:`~tempo_tpu.query.standing.StandingQueryEngine.push`)."""
+        return self._standing().push(table, df, deadline=deadline_s)
 
     def _enqueue_locked(self, tenant, root, sig, footprint, dl,
                         deadline) -> QueryTicket:  # guarded-by: self._cond
@@ -567,7 +621,11 @@ class QueryService:
             if self._closed:
                 return
             self._closed = True
+            standing = self._standing_engine
+            self._standing_engine = None
             self._cond.notify_all()
+        if standing is not None:
+            standing.close()
         deadline = None if timeout is None else \
             time.perf_counter() + timeout
         for t in self._threads:

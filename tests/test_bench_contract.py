@@ -52,7 +52,7 @@ def test_bench_smoke_prints_one_json_line():
         "15_chaos_serving_ticks_per_sec",
         "16_chaos_pipeline_rows_per_sec",
         "17_chaos_store_ticks_per_sec", "18_overlap_rows_per_sec",
-        "19_sql_service_qps",
+        "19_sql_service_qps", "20_standing_notifications_per_sec",
     }
     # every config must have actually run: _attempt emits null on
     # failure, which is exactly the silent loss this test guards
@@ -182,6 +182,25 @@ def test_bench_smoke_prints_one_json_line():
     assert "bitwise" in sq.get("value_audit", "")
     assert "method-chain twin" in sq.get("value_audit", "") \
         and "oracle" in sq.get("value_audit", "")
+    # config 20 (round 20): continuous queries — a fleet of standing
+    # subscriptions over one live StreamTable under Poisson pushes;
+    # every split mode must be represented, the zero-recompile steady
+    # state asserted hard in-bench across the whole measured phase,
+    # per-push end-to-end latency percentiles measured, and sampled
+    # standing results audited bitwise vs the batch re-run over the
+    # unified snapshot
+    sg = rec.get("standing") or {}
+    assert sg.get("pushes_per_sec", 0) > 0, sg
+    assert sg.get("notifications_per_sec", 0) > 0, sg
+    assert sg.get("n_subscriptions", 0) >= 64, sg
+    md = sg.get("modes") or {}
+    assert set(md) == {"delta", "stateless", "remainder"} \
+        and all(v > 0 for v in md.values()), sg
+    assert sg.get("zero_builds_steady_state") is True
+    assert sg.get("p50_ms") is not None and sg.get("p99_ms") is not None
+    assert sg.get("dropped") is not None
+    assert "bitwise" in sg.get("value_audit", "")
+    assert "split mode" in sg.get("value_audit", ""), sg
     # config 15 (round 13): the fault-domain chaos campaign — every
     # availability invariant asserted hard inside the campaign, its
     # record keys pinned here so the driver-recorded line always

@@ -1,0 +1,475 @@
+"""Continuous queries (tempo_tpu/query/, round 20): standing plans
+over live streams.
+
+The contract under test: a standing subscription's ``result()`` is
+BITWISE what re-running the registered (canonical) plan over the
+concatenated history produces at the current push boundary — for every
+split mode (stateless / delta / remainder), across arbitrary push
+splits, NaN runs, sequence columns and the join matrix — with zero
+recompiles at steady state and byte-identical tails across
+kill -> snapshot -> resume.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu import checkpoint as ckpt
+from tempo_tpu import profiling
+from tempo_tpu.query import (StandingQueryEngine, StreamTable,
+                             resume_subscription, snapshot_subscription)
+from tempo_tpu.query import split as qsplit
+from tempo_tpu.query.standing import _run_batch
+from tempo_tpu.serve.stream import LateTickError
+
+
+def _mk(rng, n, t0, *, syms=("A", "B"), nan_p=0.0, seq=False):
+    df = pd.DataFrame({
+        "event_ts": pd.to_datetime(
+            t0 + np.sort(rng.integers(0, 1000, n)), unit="s"),
+        "sym": rng.choice(list(syms), n),
+        "px": rng.normal(100, 5, n).astype(np.float64),
+    })
+    if nan_p:
+        df.loc[rng.random(n) < nan_p, "px"] = np.nan
+    if seq:
+        df["seqno"] = np.arange(n, dtype=np.float64) + t0
+    return df.sort_values("event_ts", kind="stable").reset_index(drop=True)
+
+
+def _twin(eng, query, tables):
+    """The batch twin: the canonical plan over the tables' unified
+    snapshots, via the same executor the remainder path uses."""
+    root = qsplit.canonicalize(eng._as_root(query))
+    return _run_batch(root, {t.name: t.snapshot_df() for t in tables})
+
+
+def _assert_bitwise(res_df, twin_df, ctx=""):
+    assert list(res_df.columns) == list(twin_df.columns), ctx
+    assert len(res_df) == len(twin_df), ctx
+    for c in res_df.columns:
+        a, b = res_df[c], twin_df[c]
+        assert a.dtype == b.dtype, f"{ctx}{c}: {a.dtype} vs {b.dtype}"
+        if a.dtype.kind == "f":
+            assert a.to_numpy().tobytes() == b.to_numpy().tobytes(), \
+                f"{ctx}{c} not bitwise"
+        else:
+            pd.testing.assert_series_equal(a, b, check_names=False)
+
+
+# ---------------------------------------------------------------------
+# EMA delta mode
+# ---------------------------------------------------------------------
+
+
+def test_ema_delta_bitwise_with_nans_and_catchup():
+    rng = np.random.default_rng(0)
+    t = StreamTable("trades", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 50, 0, syms=("A", "B", "C"), nan_p=0.15))
+    with StandingQueryEngine() as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        assert sub.mode == "delta", sub.reason
+        for k in range(6):
+            eng.push(t, _mk(rng, 17, 2000 + 3000 * k,
+                            syms=("A", "B", "C"), nan_p=0.15))
+        eng.flush()
+        res = sub.result()
+        _assert_bitwise(res.df, _twin(eng, frame, [t]).df)
+        kinds = [n.kind for n in sub.drain()]
+        assert kinds[0] == "catchup" and kinds.count("delta") == 6
+
+
+@pytest.mark.parametrize("splits", [
+    [95],                        # one push
+    [1] * 5 + [30] * 3,          # singleton then chunks
+    [10, 40, 10, 20, 15],        # mixed
+])
+def test_ema_split_invariance(splits):
+    """Arbitrary push splits of the SAME row stream produce the same
+    bytes — the sequential-scan carry is split-invariant."""
+    rng = np.random.default_rng(7)
+    rows = _mk(rng, sum(splits), 0, nan_p=0.1)
+    ref = None
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    with StandingQueryEngine() as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        at = 0
+        for n in splits:
+            eng.push(t, rows.iloc[at:at + n].reset_index(drop=True))
+            at += n
+        eng.flush()
+        res = sub.result()
+        _assert_bitwise(res.df, _twin(eng, frame, [t]).df,
+                        ctx=f"splits={splits}: ")
+        ref = res.df["EMA_px"].to_numpy().tobytes()
+    # and identical to the one-shot batch over the raw rows
+    t2 = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t2.append(rows)
+    with StandingQueryEngine() as eng2:
+        twin = _twin(eng2, t2.frame().EMA("px", exp_factor=0.3,
+                                          exact=True), [t2])
+        assert twin.df["EMA_px"].to_numpy().tobytes() == ref
+
+
+def test_ema_with_sequence_col_and_select_suffix():
+    rng = np.random.default_rng(2)
+    t = StreamTable("t3", "event_ts", ["sym"], ["px"],
+                    sequence_col="seqno")
+    t.append(_mk(rng, 30, 0, seq=True))
+    with StandingQueryEngine() as eng:
+        frame = (t.frame().EMA("px", exp_factor=0.25, exact=True)
+                 .select("event_ts", "sym", "seqno", "EMA_px"))
+        sub = eng.register(frame)
+        assert sub.mode == "delta", sub.reason
+        for k in range(3):
+            eng.push(t, _mk(rng, 10, 2000 + 2000 * k, seq=True))
+        eng.flush()
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [t]).df)
+
+
+# ---------------------------------------------------------------------
+# stateless and remainder modes
+# ---------------------------------------------------------------------
+
+
+def test_stateless_select_bitwise():
+    rng = np.random.default_rng(2)
+    t = StreamTable("t1", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 30, 0))
+    with StandingQueryEngine() as eng:
+        frame = t.frame().select("event_ts", "sym", "px")
+        sub = eng.register(frame)
+        assert sub.mode == "stateless", sub.reason
+        for k in range(3):
+            eng.push(t, _mk(rng, 10, 2000 + 2000 * k))
+        eng.flush()
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [t]).df)
+
+
+def test_remainder_bitwise_and_refresh_cadence():
+    rng = np.random.default_rng(2)
+    t = StreamTable("t2", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 30, 0))
+    with StandingQueryEngine(remainder_every=2) as eng:
+        frame = t.frame().withRangeStats(colsToSummarize=["px"],
+                                         rangeBackWindowSecs=600)
+        sub = eng.register(frame)
+        assert sub.mode == "remainder" and sub.reason
+        for k in range(4):
+            eng.push(t, _mk(rng, 10, 2000 + 2000 * k))
+        eng.flush()
+        res = sub.result()
+        twin = _twin(eng, frame, [t])
+        for c in res.df.columns:
+            a, b = res.df[c].to_numpy(), twin.df[c].to_numpy()
+            if a.dtype.kind == "f":
+                assert a.tobytes() == b.tobytes(), c
+        kinds = [n.kind for n in sub.drain()]
+        # remainder refreshes every 2nd of the 4 boundaries
+        assert kinds.count("refresh") == 2
+
+
+# ---------------------------------------------------------------------
+# join delta mode
+# ---------------------------------------------------------------------
+
+
+def _merged_runs(df):
+    """Maximal same-side consecutive runs of a merged timeline (ts
+    ascending, rights before lefts on ties) — the only admissible push
+    order for a standing join's two feeds."""
+    side = df["side"].to_numpy()
+    bounds = [0] + [i for i in range(1, len(df))
+                    if side[i] != side[i - 1]] + [len(df)]
+    return [(bool(side[a]), df.iloc[a:b])
+            for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+@pytest.mark.parametrize("skip", [True, False])
+@pytest.mark.parametrize("mlb", [0, 3])
+def test_join_matrix_bitwise(skip, mlb):
+    rng = np.random.default_rng(1)
+    n = 160
+    ts = np.sort(rng.integers(0, 100000, n))
+    all_df = pd.DataFrame({
+        "event_ts": pd.to_datetime(ts, unit="s"),
+        "sym": rng.choice(["A", "B"], n),
+        "bid": rng.normal(99, 2, n), "ask": rng.normal(101, 2, n),
+        "side": rng.random(n) < 0.45})      # True = left
+    all_df.loc[rng.random(n) < 0.2, "bid"] = np.nan
+    all_df = all_df.sort_values(["event_ts", "side"],
+                                kind="stable").reset_index(drop=True)
+    hist, live = all_df.iloc[:60], all_df.iloc[60:]
+
+    L = StreamTable("orders", "event_ts", ["sym"], [])
+    R = StreamTable("quotes", "event_ts", ["sym"], ["bid", "ask"])
+    L.append(hist[hist["side"]][["event_ts", "sym"]])
+    R.append(hist[~hist["side"]][["event_ts", "sym", "bid", "ask"]])
+    with StandingQueryEngine() as eng:
+        frame = L.frame().asofJoin(R.frame(), right_prefix="right",
+                                   skipNulls=skip, maxLookback=mlb)
+        sub = eng.register(frame)
+        assert sub.mode == "delta", sub.reason
+        for is_left, run in _merged_runs(live):
+            if is_left:
+                eng.push(L, run[["event_ts", "sym"]])
+            else:
+                eng.push(R, run[["event_ts", "sym", "bid", "ask"]])
+        eng.flush()
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [L, R]).df,
+                        ctx=f"skip={skip} mlb={mlb}: ")
+
+
+def test_split_classification_and_rejections():
+    t = StreamTable("t1", "event_ts", ["sym"], ["px"])
+    ts = StreamTable("t4", "event_ts", ["sym"], ["px"],
+                     sequence_col="seqno")
+    eng = StandingQueryEngine()
+    try:
+        root = qsplit.canonicalize(
+            eng._as_root(ts.frame().asofJoin(t.frame())))
+        p = qsplit.split(root)
+        assert p.mode == "remainder" and "sequence column" in p.reason
+        p2 = qsplit.split(qsplit.canonicalize(
+            eng._as_root(t.frame().asofJoin(t.frame()))))
+        assert p2.mode == "remainder" and "self-join" in p2.reason
+        # mixed EMA alphas: one serving coefficient per plane
+        p3 = qsplit.split(qsplit.canonicalize(eng._as_root(
+            t.frame().EMA("px", exp_factor=0.2, exact=True)
+            .EMA("EMA_px", exp_factor=0.5, exact=True))))
+        assert p3.mode == "remainder"
+        # no unified_scan source at all
+        p4 = qsplit.split(qsplit.canonicalize(eng._as_root(
+            t.frame().withRangeStats(colsToSummarize=["px"],
+                                     rangeBackWindowSecs=60))))
+        assert p4.mode == "remainder" and p4.reason
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------
+# admission, backpressure, cancellation, failure
+# ---------------------------------------------------------------------
+
+
+def test_late_tick_rejected_and_nothing_committed():
+    rng = np.random.default_rng(3)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    with StandingQueryEngine() as eng:
+        eng.register(t.frame().EMA("px", exp_factor=0.3, exact=True))
+        eng.push(t, _mk(rng, 10, 5000))
+        before = t.rows_total()
+        late = _mk(rng, 5, 0)         # strictly behind the watermark
+        late["sym"] = "A"
+        with pytest.raises(LateTickError):
+            eng.push(t, late)
+        assert t.rows_total() == before  # admission is all-or-nothing
+
+
+def test_backpressure_drops_oldest_not_result():
+    rng = np.random.default_rng(4)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    with StandingQueryEngine(queue_depth=2) as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        for k in range(8):
+            eng.push(t, _mk(rng, 6, 2000 * k))
+        eng.flush()
+        with eng._lock:
+            dropped = sub.dropped
+        assert dropped > 0              # the queue bounded itself
+        assert len(sub.drain()) <= 2
+        # ...but the standing accumulator is complete and bitwise
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [t]).df)
+
+
+def test_cancel_releases_slot_and_stops_delivery():
+    rng = np.random.default_rng(5)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    with StandingQueryEngine() as eng:
+        sub = eng.register(t.frame().EMA("px", exp_factor=0.3,
+                                         exact=True))
+        eng.push(t, _mk(rng, 10, 0))
+        eng.flush()
+        sub.cancel()
+        assert not sub.live
+        sub.drain()     # pre-cancel catchup/delta notifications
+        eng.push(t, _mk(rng, 10, 5000))   # still admitted to the table
+        eng.flush()
+        assert sub.drain() == []          # but no longer delivered
+        sub.cancel()                      # idempotent
+
+
+def test_invalid_query_surfaces_at_register():
+    t = StreamTable("s", "event_ts", ["sym"], ["px"],
+                    sequence_col="seqno")
+    t.append(pd.DataFrame({
+        "event_ts": pd.to_datetime([1, 2], unit="s"),
+        "sym": ["A", "A"], "px": [1.0, 2.0],
+        "seqno": [0.0, 1.0]}))
+    with StandingQueryEngine() as eng:
+        # select() dropping the declared sequence column is invalid for
+        # the batch twin too — register must surface it, not swallow it
+        with pytest.raises(Exception):
+            eng.register(t.frame().EMA("px", exact=True)
+                         .select("event_ts", "sym", "EMA_px"))
+
+
+def test_push_missing_columns_rejected():
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    with StandingQueryEngine() as eng:
+        eng.register(t.frame().select("event_ts", "sym", "px"))
+        with pytest.raises(ValueError, match="missing columns"):
+            eng.push(t, pd.DataFrame({
+                "event_ts": pd.to_datetime([1], unit="s")}))
+
+
+# ---------------------------------------------------------------------
+# steady state: zero recompiles
+# ---------------------------------------------------------------------
+
+
+def test_zero_recompiles_at_steady_state():
+    rng = np.random.default_rng(6)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 40, 0))
+    with StandingQueryEngine() as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        # warm-up boundaries build the bucket programs once
+        for k in range(2):
+            eng.push(t, _mk(rng, 10, 2000 + 2000 * k))
+        eng.flush()
+        builds0 = profiling.plan_cache_stats()["builds"]
+        for k in range(6):
+            eng.push(t, _mk(rng, 10, 8000 + 2000 * k))
+        eng.flush()
+        assert profiling.plan_cache_stats()["builds"] == builds0, \
+            "standing steady state must be zero-recompile"
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [t]).df)
+
+
+# ---------------------------------------------------------------------
+# kill -> snapshot -> resume
+# ---------------------------------------------------------------------
+
+
+def test_kill_resume_byte_identical_tail(tmp_path):
+    rng = np.random.default_rng(3)
+    batches = [_mk(np.random.default_rng(30 + k), 20, 3000 * k,
+                   nan_p=0.1) for k in range(8)]
+    query = lambda tab: tab.frame().EMA("px", exp_factor=0.3,  # noqa: E731
+                                        exact=True)
+
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(batches[0])
+    with StandingQueryEngine() as eng:
+        sub = eng.register(query(t))
+        for b in batches[1:]:
+            eng.push(t, b)
+        eng.flush()
+        full = sub.result().df
+
+    # killed at boundary 3, snapshotted, resumed on a fresh engine
+    t2 = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t2.append(batches[0])
+    path = str(tmp_path / "standing_ckpt")
+    with StandingQueryEngine() as eng2:
+        sub2 = eng2.register(query(t2))
+        for b in batches[1:4]:
+            eng2.push(t2, b)
+        eng2.flush()
+        snapshot_subscription(sub2, path)
+
+    t3 = StreamTable("s", "event_ts", ["sym"], ["px"])
+    for b in batches[:4]:
+        t3.append(b)
+    with StandingQueryEngine() as eng3:
+        sub3 = resume_subscription(eng3, query(t3), path)
+        for b in batches[4:]:
+            eng3.push(t3, b)
+        eng3.flush()
+        resumed = sub3.result().df
+
+    assert list(full.columns) == list(resumed.columns)
+    for c in full.columns:
+        a, b = full[c].to_numpy(), resumed[c].to_numpy()
+        if a.dtype.kind == "f":
+            assert a.tobytes() == b.tobytes(), \
+                f"{c}: resumed tail not byte-identical"
+        else:
+            assert (pd.Series(a) == pd.Series(b)).all(), c
+
+
+def test_standing_checkpoint_kind_refusals(tmp_path):
+    rng = np.random.default_rng(8)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 20, 0))
+    path = str(tmp_path / "ck")
+    with StandingQueryEngine() as eng:
+        sub = eng.register(t.frame().EMA("px", exp_factor=0.3,
+                                         exact=True))
+        eng.push(t, _mk(rng, 10, 3000))
+        eng.flush()
+        snapshot_subscription(sub, path)
+
+    # kind mismatch is refused BY NAME
+    with pytest.raises(ckpt.CheckpointError, match="standing"):
+        ckpt.load_state(path, kind="cohort_state")
+
+    # a different registered plan (other alpha) is refused by signature
+    t2 = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t2.append(_mk(np.random.default_rng(8), 20, 0))
+    with StandingQueryEngine() as eng2:
+        with pytest.raises(ckpt.CheckpointError, match="signature"):
+            resume_subscription(
+                eng2, t2.frame().EMA("px", exp_factor=0.9, exact=True),
+                path)
+
+
+# ---------------------------------------------------------------------
+# SQL registration through the service
+# ---------------------------------------------------------------------
+
+
+def test_sql_standing_through_service():
+    from tempo_tpu.service.service import QueryService
+
+    rng = np.random.default_rng(5)
+    t = StreamTable("trades", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 30, 0))
+    svc = QueryService()
+    try:
+        sub = svc.register_sql(
+            "acme",
+            "SELECT event_ts, sym, px FROM trades WHERE px > 95",
+            {"trades": t})
+        assert sub.mode == "stateless", sub.reason
+        for k in range(3):
+            svc.push(t, _mk(rng, 10, 2000 + 2000 * k))
+        svc._standing().flush()
+        res = sub.result()
+        twin = _run_batch(sub.plan.root, {t.name: t.snapshot_df()})
+        _assert_bitwise(res.df, twin.df)
+        counts = svc.stats()["tenants"]["acme"]
+        assert counts["submitted"] >= 1 and counts["completed"] >= 1
+    finally:
+        svc.close()
+
+
+def test_sql_standing_binds_stream_tables_directly():
+    rng = np.random.default_rng(9)
+    t = StreamTable("trades", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 20, 0))
+    with StandingQueryEngine() as eng:
+        sub = eng.register_sql(
+            "SELECT event_ts, sym, px FROM trades", {"trades": t})
+        eng.push(t, _mk(rng, 10, 3000))
+        eng.flush()
+        twin = _run_batch(sub.plan.root, {t.name: t.snapshot_df()})
+        _assert_bitwise(sub.result().df, twin.df)

@@ -1,0 +1,150 @@
+"""The unified scan (tempo_tpu/query/unified.py, round 20): one plan
+node unioning Parquet history from the PR-16 store with the live tail
+under a single watermark — bitwise equal to the all-batch twin that
+never went through a store, including across ``store.compact`` racing
+a live subscription.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tempo_tpu.query import StandingQueryEngine, StreamTable
+from tempo_tpu.query import split as qsplit
+from tempo_tpu.query.standing import _run_batch
+from tempo_tpu.store.compact import compact as store_compact
+from tempo_tpu.store.engine import Store
+
+
+def _mk(rng, n, t0):
+    return pd.DataFrame({
+        "event_ts": pd.to_datetime(
+            t0 + np.sort(rng.integers(0, 1000, n)), unit="s"),
+        "sym": rng.choice(["A", "B"], n),
+        "px": rng.normal(100, 5, n).astype(np.float64),
+    }).sort_values("event_ts", kind="stable").reset_index(drop=True)
+
+
+def test_snapshot_is_history_union_tail_bitwise(tmp_path):
+    rng = np.random.default_rng(0)
+    store = Store(str(tmp_path))
+    t = StreamTable("ticks", "event_ts", ["sym"], ["px"], store=store)
+    batches = [_mk(rng, 25, 3000 * k) for k in range(4)]
+    for b in batches[:2]:
+        t.append(b)
+    t.sync_to_store()
+    assert t.tail_rows == 0 and store.current("ticks") is not None
+    for b in batches[2:]:
+        t.append(b)
+    snap = t.snapshot_df()
+    twin = pd.concat(batches, ignore_index=True)
+    assert list(snap.columns) == list(twin.columns)
+    assert snap["px"].to_numpy().tobytes() == \
+        twin["px"].to_numpy().tobytes()
+    assert (snap["sym"].to_numpy() == twin["sym"].to_numpy()).all()
+    assert snap["event_ts"].to_numpy().tobytes() == \
+        twin["event_ts"].to_numpy().tobytes()
+    assert t.rows_total() == len(twin)
+
+
+def test_sync_roundtrip_preserves_arrival_order(tmp_path):
+    """Arrival order is the table's bitwise identity (it drives the
+    packed layouts' key factorization) — the store roundtrip must
+    reproduce it verbatim, not re-cluster it."""
+    rng = np.random.default_rng(1)
+    store = Store(str(tmp_path))
+    t = StreamTable("ticks", "event_ts", ["sym"], ["px"], store=store)
+    # deliberately interleaved keys, non-sorted arrival
+    df = _mk(rng, 60, 0)
+    t.append(df)
+    before = t.snapshot_df()
+    t.sync_to_store()
+    after = t.snapshot_df()            # now read back from parquet
+    assert t.tail_rows == 0
+    pd.testing.assert_frame_equal(before, after)
+
+
+def test_unified_scan_vs_all_batch_across_compact(tmp_path, monkeypatch):
+    """A standing EMA over store-backed history stays bitwise with the
+    all-batch twin while ``store.compact`` rewrites the generation
+    mid-subscription — and the compaction must actually run (multiple
+    segments via a tiny segment-rows knob), not no-op."""
+    monkeypatch.setenv("TEMPO_TPU_STORE_SEGMENT_ROWS", "16")
+    rng = np.random.default_rng(4)
+    store = Store(str(tmp_path))
+    t = StreamTable("ticks", "event_ts", ["sym"], ["px"], store=store)
+    batches = [_mk(rng, 25, 3000 * k) for k in range(6)]
+    for b in batches[:2]:
+        t.append(b)
+    t.sync_to_store()                  # 50 rows / 16 -> 4 segments
+    t.append(batches[2])
+
+    with StandingQueryEngine() as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        eng.push(t, batches[3])
+        eng.flush()
+        out = store_compact("ticks", base_dir=str(tmp_path))
+        assert out is not None, "compact no-opped; test lost its race"
+        eng.push(t, batches[4])
+        eng.push(t, batches[5])
+        eng.flush()
+        res = sub.result()
+        twin_src = pd.concat(batches, ignore_index=True)
+        twin = _run_batch(qsplit.canonicalize(eng._as_root(frame)),
+                          {t.name: twin_src})
+        assert res.df["EMA_px"].to_numpy().tobytes() == \
+            twin.df["EMA_px"].to_numpy().tobytes()
+        assert res.df["px"].to_numpy().tobytes() == \
+            twin.df["px"].to_numpy().tobytes()
+    # the post-compact unified snapshot is also bitwise the raw concat
+    snap = t.snapshot_df()
+    assert snap["px"].to_numpy().tobytes() == \
+        twin_src["px"].to_numpy().tobytes()
+
+
+def test_frame_builds_unified_scan_plan_node():
+    t = StreamTable("x", "event_ts", ["sym"], ["px"])
+    t.append(_mk(np.random.default_rng(2), 20, 0))
+    frame = t.frame()
+    ops = [n.op for n in frame.plan.walk()]
+    assert ops == ["unified_scan"]
+    # executing the bare scan through the batch path == the snapshot
+    out = _run_batch(frame.plan, {t.name: t.snapshot_df()})
+    assert out.df["px"].to_numpy().tobytes() == \
+        t.snapshot_df()["px"].to_numpy().tobytes()
+
+
+def test_storeless_table_has_no_history():
+    t = StreamTable("x", "event_ts", ["sym"], ["px"])
+    assert t.rows_total() == 0
+    assert len(t.snapshot_df()) == 0
+    with pytest.raises(ValueError, match="no store"):
+        t.sync_to_store()
+    df = _mk(np.random.default_rng(3), 10, 0)
+    assert t.append(df) == 10
+    assert t.rows_total() == 10
+    assert "StreamTable" in repr(t) and "rows=10" in repr(t)
+
+
+def test_schema_validation():
+    with pytest.raises(ValueError, match="missing from the schema"):
+        StreamTable("x", "event_ts", ["sym"], ["px"],
+                    columns=["event_ts", "sym"])
+    t = StreamTable("x", "event_ts", ["sym"], ["px"])
+    with pytest.raises(ValueError, match="missing columns"):
+        t.append(pd.DataFrame({"event_ts": []}))
+
+
+def test_state_token_tracks_versions(tmp_path):
+    rng = np.random.default_rng(5)
+    store = Store(str(tmp_path))
+    t = StreamTable("ticks", "event_ts", ["sym"], ["px"], store=store)
+    tok0 = t.state_token()
+    t.append(_mk(rng, 10, 0))
+    tok1 = t.state_token()
+    assert tok1 != tok0
+    t.sync_to_store()
+    tok2 = t.state_token()
+    assert tok2 != tok1                # new generation + empty tail
+    assert t.state_token() == tok2     # stable while nothing changes

@@ -188,6 +188,59 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_sql_compile.py \
     tests/test_sql.py -q -m 'not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
 
+echo "== standing gate (continuous queries: standing == batch bitwise) =="
+# the round 20 subsystem, surfaced before tier-1: a fast in-process
+# smoke registers a standing EMA over a live StreamTable, pushes a
+# split timeline, and proves the incremental standing result bitwise
+# equal to the batch re-run of the same canonical plan over the
+# unified snapshot — with the plan cache's builds counter flat across
+# the steady-state pushes — then the full standing + unified-scan
+# suites
+JAX_PLATFORMS=cpu python - <<'EOF' || exit $?
+import sys
+import numpy as np
+import pandas as pd
+from tempo_tpu import profiling
+from tempo_tpu.query import StandingQueryEngine, StreamTable
+from tempo_tpu.query.standing import _run_batch
+
+rng = np.random.default_rng(20)
+def mk(n, t0):
+    return pd.DataFrame({
+        "event_ts": pd.to_datetime(
+            t0 + np.sort(rng.integers(0, 1000, n)), unit="s"),
+        "sym": rng.choice(["A", "B"], n),
+        "px": np.where(rng.random(n) < 0.1, np.nan,
+                       rng.normal(100, 5, n)),
+    }).sort_values("event_ts", kind="stable").reset_index(drop=True)
+
+t = StreamTable("ticks", "event_ts", ["sym"], ["px"])
+t.append(mk(40, 0))
+with StandingQueryEngine() as eng:
+    frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+    sub = eng.register(frame)
+    eng.push(t, mk(20, 2000))
+    eng.flush()
+    builds0 = profiling.plan_cache_stats()["builds"]
+    for k in range(3):
+        eng.push(t, mk(20, 4000 + 2000 * k))
+    eng.flush()
+    builds1 = profiling.plan_cache_stats()["builds"]
+    if builds1 != builds0:
+        sys.exit(f"standing steady state recompiled: builds went "
+                 f"{builds0} -> {builds1}")
+    res = sub.result()
+    twin = _run_batch(sub.plan.root, {t.name: t.snapshot_df()})
+    if res.df["EMA_px"].to_numpy().tobytes() != \
+            twin.df["EMA_px"].to_numpy().tobytes():
+        sys.exit("standing EMA diverged from the batch twin")
+print(f"standing smoke: {len(res.df)} rows, incremental == batch "
+      f"bitwise, builds flat at steady state")
+EOF
+JAX_PLATFORMS=cpu python -m pytest tests/test_standing.py \
+    tests/test_unified_scan.py -q -m 'not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit $?
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 1500 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
