@@ -472,6 +472,15 @@ class StandingQueryEngine:
                     sub.reason = (f"catch-up replay failed "
                                   f"({type(e).__name__}: {e}); demoted "
                                   f"to the batch remainder")
+                    # the failed incremental catch-up may have claimed
+                    # a plane member — release the cohort slot (the
+                    # remainder path never uses it) and drop the
+                    # half-seeded incremental state
+                    self._release_member(sub)
+                    sub._plane = None
+                    sub._jstate = {}
+                    sub._series_seen = set()
+                    sub._rrows = 0
                     sub._acc = []
                     self._catchup(sub)
                 else:
@@ -497,6 +506,17 @@ class StandingQueryEngine:
     def _adopt(self, table: StreamTable) -> None:  # guarded-by: self._lock
         have = self._tables.get(table.name)
         if have is None:
+            # claim ownership: while adopted, direct table.append()
+            # (and adoption by a second engine) is refused — both
+            # would commit rows the engine's watermarks and per-push
+            # base row counts never saw
+            with table._lock:
+                if table._engine is not None and table._engine is not self:
+                    raise ValueError(
+                        f"StreamTable {table.name!r} is already "
+                        f"adopted by a different standing-query "
+                        f"engine; close it first")
+                table._engine = self
             self._tables[table.name] = table
         elif have is not table:
             raise ValueError(
@@ -621,12 +641,17 @@ class StandingQueryEngine:
 
     def close(self) -> None:
         """Stop the delivery worker and the serving planes.  Standing
-        results already accumulated stay readable."""
+        results already accumulated stay readable; adopted tables are
+        released back to direct :meth:`StreamTable.append` use."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             planes = list(self._planes.values())
+            for t in self._tables.values():
+                with t._lock:
+                    if t._engine is self:
+                        t._engine = None
             self._drained.notify_all()
         self._work.put(None)
         self._worker.join(timeout=30)
@@ -809,10 +834,9 @@ class StandingQueryEngine:
         if nl:
             sub._acc.append({"left": ldf, "row_idx": row_idx,
                              "col_idx": col_idx})
+        res = sub._join_result(sub._acc)
         self._notify(sub, Notification(
-            "catchup", 0, sub._join_result(sub._acc).df
-            if hasattr(sub._join_result(sub._acc), "df")
-            else sub._join_result(sub._acc)))
+            "catchup", 0, res.df if hasattr(res, "df") else res))
 
     def _jseries(self, sub: Subscription, key, nrv, max_lookback) -> _JoinSeries:
         st = sub._jstate.get(key)
@@ -863,8 +887,13 @@ class StandingQueryEngine:
     def _deliver(self, item) -> None:
         _, table, ndf, keys, ts_ns, seq, base, dl = item
         with self._lock:
+            # a subscription registered (or resumed) AFTER this push
+            # committed already holds these rows from its catch-up
+            # snapshot — its cursor sits past `base`; delivering the
+            # delta again would duplicate the rows in the accumulator
+            # and overshoot the cursor past rows_total
             subs = [s for s in self._by_table.get(table.name, ())
-                    if s.live]
+                    if s.live and s._cursors.get(table.name, 0) <= base]
             submitted = []
             for sub in subs:
                 try:
@@ -1181,17 +1210,26 @@ def _resume_state(self, sub: Subscription, arrays, meta,
     sub._plane = self._plane_for(plan)
     if len(pre):
         _, keys, ts_ns, seq = t.prepare(pre)
-        first = list(dict.fromkeys(
+        prefix_series = list(dict.fromkeys(
             keys[i] for i in np.lexsort((seq, ts_ns))))
-        if meta.get("series_repr") and \
-                [repr(s) for s in first] != meta["series_repr"]:
-            raise ckpt.CheckpointError(
-                f"standing-state artifact holds carries for series "
-                f"{meta['series_repr']} but the table prefix yields "
-                f"{[repr(s) for s in first]}: refusing to install "
-                f"FOREIGN carries")
-        sub._series_seen = set(first)
-        sub._member = sub._plane.cohort.add_stream(f"sub{sub.id}", first)
+        # the live member admitted series in push ARRIVAL order, and
+        # the slot carries are laid out in that order — rebuild from
+        # the artifact's saved series list (any permutation of the
+        # prefix's series set is legitimate; a different SET is not)
+        saved = meta.get("series_repr") or []
+        if saved:
+            by_repr = {repr(k): k for k in prefix_series}
+            if sorted(saved) != sorted(by_repr):
+                raise ckpt.CheckpointError(
+                    f"standing-state artifact holds carries for series "
+                    f"{sorted(saved)} but the table prefix yields "
+                    f"{sorted(by_repr)}: refusing to install "
+                    f"FOREIGN carries")
+            order = [by_repr[r] for r in saved]
+        else:
+            order = prefix_series
+        sub._series_seen = set(order)
+        sub._member = sub._plane.cohort.add_stream(f"sub{sub.id}", order)
         sub._plane.members += 1
         if "wm_ts" in arrays:
             _install_slot(sub._plane, sub._member, arrays)

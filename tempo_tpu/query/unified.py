@@ -54,8 +54,9 @@ class StreamTable:
     Pushes normally arrive through
     :meth:`~tempo_tpu.query.standing.StandingQueryEngine.push` (which
     fans them out to subscribers); :meth:`append` is the direct,
-    engine-less form for batch-only use.  Thread-safe: all mutable
-    state is guarded by the table lock."""
+    engine-less form for batch-only use, and is refused while an
+    engine owns the table.  Thread-safe: all mutable state is guarded
+    by the table lock."""
 
     def __init__(self, name: str, ts_col: str,
                  partition_cols: Sequence[str],
@@ -86,6 +87,11 @@ class StreamTable:
         self.tail_rows = 0        # guarded-by: self._lock
         self._history = None      # guarded-by: self._lock
         self._history_gen = None  # guarded-by: self._lock
+        #: the adopting StandingQueryEngine, if any — while set,
+        #: direct append() is refused (it would bypass the engine's
+        #: watermarks and corrupt the per-boundary base row counts the
+        #: join carries index against); released on engine close
+        self._engine = None       # guarded-by: self._lock
 
     # -- admission ------------------------------------------------------
 
@@ -127,7 +133,18 @@ class StreamTable:
 
     def append(self, df: pd.DataFrame) -> int:
         """Direct, engine-less append (no subscriber fanout, no
-        watermark check beyond schema) — batch-only ingestion."""
+        watermark check beyond schema) — batch-only ingestion.  Refused
+        once a standing-query engine has adopted the table: a direct
+        append would slip rows past the engine's watermarks and shift
+        the snapshot row indices its join carries point at — route live
+        data through ``engine.push(table, df)`` instead."""
+        with self._lock:
+            if self._engine is not None:
+                raise RuntimeError(
+                    f"StreamTable {self.name!r} is adopted by a "
+                    f"standing-query engine: direct append() would "
+                    f"bypass its watermarks and subscriber carries — "
+                    f"push through StandingQueryEngine.push(table, df)")
         df, _, _, _ = self.prepare(df)
         self.commit(df)
         return len(df)

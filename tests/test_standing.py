@@ -305,6 +305,71 @@ def test_cancel_releases_slot_and_stops_delivery():
         sub.cancel()                      # idempotent
 
 
+def test_register_during_inflight_push_not_duplicated():
+    """A subscription registered AFTER a push committed but BEFORE the
+    delivery worker ran must not receive that boundary as a delta —
+    its catch-up snapshot already holds the rows."""
+    rng = np.random.default_rng(11)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 20, 0))
+    with StandingQueryEngine() as eng:
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub1 = eng.register(frame)
+        with eng._lock:
+            # holding the engine lock stalls the delivery worker: the
+            # push below is committed to the table tail but still
+            # undelivered when sub2's catch-up snapshots it
+            eng.push(t, _mk(rng, 10, 2000))
+            sub2 = eng.register(frame)
+        eng.flush()
+        eng.push(t, _mk(rng, 10, 5000))
+        eng.flush()
+        twin = _twin(eng, frame, [t])
+        _assert_bitwise(sub1.result().df, twin.df, ctx="sub1: ")
+        _assert_bitwise(sub2.result().df, twin.df, ctx="sub2: ")
+        with eng._lock:
+            assert sub2._cursors["s"] == t.rows_total()
+
+
+def test_demotion_on_failed_catchup_releases_plane_member(monkeypatch):
+    """When the incremental catch-up fails and register() demotes the
+    subscription to the batch remainder, the half-claimed cohort slot
+    is released, not leaked for the subscription's lifetime."""
+    rng = np.random.default_rng(13)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 10, 0))
+    with StandingQueryEngine() as eng:
+        monkeypatch.setattr(
+            StandingQueryEngine, "_dispatch_ema",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected catch-up failure")))
+        frame = t.frame().EMA("px", exp_factor=0.3, exact=True)
+        sub = eng.register(frame)
+        assert sub.mode == "remainder" and "demoted" in sub.reason
+        assert sub._member is None and sub._plane is None
+        with eng._lock:
+            assert all(p.members == 0 for p in eng._planes.values())
+            assert all(p.cohort._resident == 0
+                       for p in eng._planes.values())
+        # the demoted subscription still answers correctly
+        _assert_bitwise(sub.result().df, _twin(eng, frame, [t]).df)
+
+
+def test_append_refused_on_adopted_table_released_on_close():
+    rng = np.random.default_rng(12)
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t.append(_mk(rng, 10, 0))            # pre-adoption: fine
+    eng = StandingQueryEngine()
+    try:
+        eng.register(t.frame().select("event_ts", "sym", "px"))
+        with pytest.raises(RuntimeError, match="adopted"):
+            t.append(_mk(rng, 10, 3000))
+    finally:
+        eng.close()
+    # close() releases ownership: direct append works again
+    t.append(_mk(rng, 10, 6000))
+
+
 def test_invalid_query_surfaces_at_register():
     t = StreamTable("s", "event_ts", ["sym"], ["px"],
                     sequence_col="seqno")
@@ -404,6 +469,48 @@ def test_kill_resume_byte_identical_tail(tmp_path):
                 f"{c}: resumed tail not byte-identical"
         else:
             assert (pd.Series(a) == pd.Series(b)).all(), c
+
+
+def test_resume_with_series_in_push_arrival_order(tmp_path):
+    """Live members admit series in push ARRIVAL order, which need not
+    match the prefix's (ts, seq) first-appearance order — resume must
+    rebuild the member in the artifact's saved order, not refuse."""
+    query = lambda tab: tab.frame().EMA("px", exp_factor=0.3,  # noqa: E731
+                                        exact=True)
+
+    def b(sym, ts0):
+        return pd.DataFrame({
+            "event_ts": pd.to_datetime([ts0, ts0 + 1], unit="s"),
+            "sym": [sym, sym], "px": [100.0 + ts0, 101.0 + ts0]})
+
+    t = StreamTable("s", "event_ts", ["sym"], ["px"])
+    path = str(tmp_path / "ck")
+    with StandingQueryEngine() as eng:
+        sub = eng.register(query(t))
+        eng.push(t, b("B", 100))       # B first in arrival order...
+        eng.push(t, b("A", 50))        # ...but A first by timestamp
+        eng.flush()
+        snapshot_subscription(sub, path)
+        eng.push(t, b("B", 200))
+        eng.push(t, b("A", 150))
+        eng.flush()
+        full = sub.result().df
+
+    t2 = StreamTable("s", "event_ts", ["sym"], ["px"])
+    t2.append(pd.concat([b("B", 100), b("A", 50)], ignore_index=True))
+    with StandingQueryEngine() as eng2:
+        sub2 = resume_subscription(eng2, query(t2), path)
+        eng2.push(t2, b("B", 200))
+        eng2.push(t2, b("A", 150))
+        eng2.flush()
+        resumed = sub2.result().df
+    assert list(full.columns) == list(resumed.columns)
+    for c in full.columns:
+        a, bb = full[c].to_numpy(), resumed[c].to_numpy()
+        if a.dtype.kind == "f":
+            assert a.tobytes() == bb.tobytes(), c
+        else:
+            assert (pd.Series(a) == pd.Series(bb)).all(), c
 
 
 def test_standing_checkpoint_kind_refusals(tmp_path):
